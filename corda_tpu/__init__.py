@@ -1,0 +1,27 @@
+"""corda_tpu — a TPU-native distributed-ledger framework.
+
+A ground-up re-design of the capability surface of Corda (reference:
+mathieuflamant/corda, studied in SURVEY.md) for TPU hardware: the
+verification hot path (batched EdDSA/ECDSA signature verification,
+SHA-256/512 Merkle hashing, back-chain DAG wavefront verification, notary
+uniqueness checking) runs as JAX kernels sharded over a device mesh, while
+the surrounding framework (state-based transactions, flows, vault, notary
+tiers, RPC, out-of-process verifier workers) is idiomatic Python + native
+code.
+
+Layer map (mirrors SURVEY.md §1):
+  crypto/         L0  scheme registry, host sign/verify, hashing, Merkle
+  ops/            L0  device kernels (bigint limbs, SHA-2, ed25519, secp256)
+  serialization/  L2  deterministic canonical binary encoding (CBE)
+  core/           L1  contracts, transactions, identity
+  flows/          L3  flow framework (deterministic-replay checkpoints)
+  messaging/      L4  durable queues, transport, RPC
+  node/           L5  node services, vault, persistence, config
+  notary/         L7  uniqueness providers + notary services (simple/raft/bft)
+  verifier/       L8  out-of-process batched TPU verifier workers
+  parallel/       —   mesh/sharding utilities, wavefront DAG scheduler
+  apps/           L11 finance contracts + sample apps
+  testing/        L13 mock network, driver, ledger DSL, generators
+"""
+
+__version__ = "0.1.0"

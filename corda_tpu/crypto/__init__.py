@@ -1,0 +1,60 @@
+from .hashing import (
+    ALL_ONES_HASH,
+    ZERO_HASH,
+    SecureHash,
+    sha256,
+    sha256_twice,
+    sha512,
+)
+from .keys import KeyPair, PrivateKey, PublicKey
+from .merkle import MerkleTree, MerkleTreeError, PartialMerkleTree, merkle_root_host
+from .schemes import (
+    COMPOSITE_KEY,
+    DEFAULT_SIGNATURE_SCHEME,
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+    EDDSA_ED25519_SHA512,
+    RSA_SHA256,
+    SCHEMES,
+    SPHINCS256_SHA256,
+    CryptoError,
+    SignatureScheme,
+    derive_keypair,
+    derive_keypair_from_entropy,
+    find_scheme,
+    generate_keypair,
+    is_valid,
+    public_key_on_curve,
+    sign,
+    verify,
+)
+from .composite import (
+    CompositeKey,
+    CompositeKeyBuilder,
+    CompositeKeyNode,
+    expand_signers,
+    is_fulfilled_by,
+    verify_composite,
+)
+from .signatures import (
+    CURRENT_PLATFORM_VERSION,
+    SignableData,
+    SignatureMetadata,
+    TransactionSignature,
+    sign_tx_id,
+)
+
+__all__ = [
+    "ALL_ONES_HASH", "ZERO_HASH", "SecureHash", "sha256", "sha256_twice", "sha512",
+    "KeyPair", "PrivateKey", "PublicKey",
+    "MerkleTree", "MerkleTreeError", "PartialMerkleTree", "merkle_root_host",
+    "COMPOSITE_KEY", "DEFAULT_SIGNATURE_SCHEME", "ECDSA_SECP256K1_SHA256",
+    "ECDSA_SECP256R1_SHA256", "EDDSA_ED25519_SHA512", "RSA_SHA256", "SCHEMES",
+    "SPHINCS256_SHA256", "CryptoError", "SignatureScheme", "derive_keypair",
+    "derive_keypair_from_entropy", "find_scheme", "generate_keypair", "is_valid",
+    "public_key_on_curve", "sign", "verify",
+    "CompositeKey", "CompositeKeyBuilder", "CompositeKeyNode", "expand_signers",
+    "is_fulfilled_by", "verify_composite",
+    "CURRENT_PLATFORM_VERSION", "SignableData", "SignatureMetadata",
+    "TransactionSignature", "sign_tx_id",
+]

@@ -1,0 +1,65 @@
+"""Key model.
+
+Uniform scheme-tagged key representation replacing the reference's JCA
+``PublicKey``/``PrivateKey`` object zoo (core/.../crypto/Crypto.kt). Every key
+is (scheme_id, canonical encoded bytes):
+
+  scheme 1  RSA_SHA256            pub = DER SPKI,  priv = DER PKCS8
+  scheme 2  ECDSA_SECP256K1_SHA256  pub = SEC1 compressed (33B), priv = scalar (32B BE)
+  scheme 3  ECDSA_SECP256R1_SHA256  pub = SEC1 compressed (33B), priv = scalar (32B BE)
+  scheme 4  EDDSA_ED25519_SHA512    pub = raw (32B), priv = seed (32B)
+  scheme 5  SPHINCS256_SHA256       pub = root||params, priv = seed||params (hash-based)
+  scheme 6  COMPOSITE_KEY           pub = CBE-encoded weighted threshold tree
+
+The fixed-width encodings are what the device kernels consume directly — an
+ed25519 batch is just a (B, 32)-byte array of compressed points.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.serialization import register_custom
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class PublicKey:
+    scheme_id: int
+    encoded: bytes
+
+    def __repr__(self):
+        return f"PublicKey(scheme={self.scheme_id}, {self.encoded.hex()[:16]}…)"
+
+    def to_string_short(self) -> str:
+        import hashlib
+
+        return hashlib.sha256(bytes([self.scheme_id]) + self.encoded).hexdigest()[:16].upper()
+
+
+@dataclasses.dataclass(frozen=True)
+class PrivateKey:
+    scheme_id: int
+    encoded: bytes
+
+    def __repr__(self):
+        return f"PrivateKey(scheme={self.scheme_id}, ****)"
+
+
+@dataclasses.dataclass(frozen=True)
+class KeyPair:
+    public: PublicKey
+    private: PrivateKey
+
+
+register_custom(
+    PublicKey,
+    "crypto.PublicKey",
+    to_fields=lambda k: {"scheme_id": k.scheme_id, "encoded": k.encoded},
+    from_fields=lambda d: PublicKey(d["scheme_id"], d["encoded"]),
+)
+register_custom(
+    PrivateKey,
+    "crypto.PrivateKey",
+    to_fields=lambda k: {"scheme_id": k.scheme_id, "encoded": k.encoded},
+    from_fields=lambda d: PrivateKey(d["scheme_id"], d["encoded"]),
+)

@@ -1,0 +1,257 @@
+"""Signature scheme registry — host-side sign/verify.
+
+The equivalent of the reference's ``Crypto`` object (core/.../crypto/
+Crypto.kt:64-875): a registry of supported signature schemes with uniform
+generate / derive / sign / verify entry points and scheme discovery from keys.
+Scheme ids and code names mirror the reference (Crypto.kt:70-154) so the
+capability surface maps one-to-one.
+
+Host signing uses OpenSSL (via the ``cryptography`` package) — signing is a
+per-party, low-volume operation that stays on CPU, exactly as in the
+reference. *Verification* also has a host path here (used as the
+differential-test oracle and the CPU fallback), but the production verify
+path is the batched device kernel set in ``corda_tpu.ops`` dispatched by
+``corda_tpu.verifier``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import secrets
+
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from . import sphincs
+from .keys import KeyPair, PrivateKey, PublicKey
+
+RSA_SHA256 = 1
+ECDSA_SECP256K1_SHA256 = 2
+ECDSA_SECP256R1_SHA256 = 3
+EDDSA_ED25519_SHA512 = 4
+SPHINCS256_SHA256 = 5
+COMPOSITE_KEY = 6
+
+# secp256k1 / secp256r1 group orders (for scalar derivation + low-S checks)
+SECP256K1_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+SECP256R1_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+
+
+@dataclasses.dataclass(frozen=True)
+class SignatureScheme:
+    scheme_id: int
+    code_name: str
+    algorithm: str
+    key_size: int | None = None
+
+
+SCHEMES: dict[int, SignatureScheme] = {
+    RSA_SHA256: SignatureScheme(RSA_SHA256, "RSA_SHA256", "SHA256withRSA", 2048),
+    ECDSA_SECP256K1_SHA256: SignatureScheme(
+        ECDSA_SECP256K1_SHA256, "ECDSA_SECP256K1_SHA256", "SHA256withECDSA"
+    ),
+    ECDSA_SECP256R1_SHA256: SignatureScheme(
+        ECDSA_SECP256R1_SHA256, "ECDSA_SECP256R1_SHA256", "SHA256withECDSA"
+    ),
+    EDDSA_ED25519_SHA512: SignatureScheme(
+        EDDSA_ED25519_SHA512, "EDDSA_ED25519_SHA512", "EdDSA.SHA512"
+    ),
+    SPHINCS256_SHA256: SignatureScheme(
+        SPHINCS256_SHA256, "SPHINCS-256_SHA256", "SHA256withSPHINCS256"
+    ),
+    COMPOSITE_KEY: SignatureScheme(COMPOSITE_KEY, "COMPOSITE", "COMPOSITE"),
+}
+
+DEFAULT_SIGNATURE_SCHEME = EDDSA_ED25519_SHA512
+
+
+class CryptoError(Exception):
+    pass
+
+
+def find_scheme(scheme_id: int) -> SignatureScheme:
+    """Reference parity: Crypto.findSignatureScheme (Crypto.kt:236-267)."""
+    try:
+        return SCHEMES[scheme_id]
+    except KeyError:
+        raise CryptoError(f"unsupported signature scheme id {scheme_id}") from None
+
+
+def _curve(scheme_id: int):
+    return ec.SECP256K1() if scheme_id == ECDSA_SECP256K1_SHA256 else ec.SECP256R1()
+
+
+def _order(scheme_id: int) -> int:
+    return SECP256K1_N if scheme_id == ECDSA_SECP256K1_SHA256 else SECP256R1_N
+
+
+def _ec_pub_from_encoded(scheme_id: int, encoded: bytes) -> ec.EllipticCurvePublicKey:
+    return ec.EllipticCurvePublicKey.from_encoded_point(_curve(scheme_id), encoded)
+
+
+def _ec_priv_from_encoded(scheme_id: int, encoded: bytes) -> ec.EllipticCurvePrivateKey:
+    return ec.derive_private_key(int.from_bytes(encoded, "big"), _curve(scheme_id))
+
+
+# ------------------------------------------------------------ generation
+
+def generate_keypair(scheme_id: int = DEFAULT_SIGNATURE_SCHEME) -> KeyPair:
+    find_scheme(scheme_id)
+    if scheme_id == EDDSA_ED25519_SHA512:
+        return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
+    if scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+        return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
+    if scheme_id == SPHINCS256_SHA256:
+        return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
+    if scheme_id == RSA_SHA256:
+        priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+        pub_der = priv.public_key().public_bytes(
+            serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+        )
+        priv_der = priv.private_bytes(
+            serialization.Encoding.DER,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
+        return KeyPair(PublicKey(scheme_id, pub_der), PrivateKey(scheme_id, priv_der))
+    raise CryptoError(f"cannot generate key pairs for scheme {scheme_id}")
+
+
+def derive_keypair_from_entropy(scheme_id: int, entropy: bytes) -> KeyPair:
+    """Deterministic keypair from entropy (reference: Crypto.deriveKeyPair /
+    entropyToKeyPair, Crypto.kt:715,811-834). Supported for EdDSA, ECDSA and
+    the hash-based scheme; RSA is not derivable (same restriction as the
+    reference)."""
+    if scheme_id == EDDSA_ED25519_SHA512:
+        seed = hashlib.sha512(b"ctpu.ed25519" + entropy).digest()[:32]
+        priv = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
+        pub = priv.public_key().public_bytes_raw()
+        return KeyPair(PublicKey(scheme_id, pub), PrivateKey(scheme_id, seed))
+    if scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+        n = _order(scheme_id)
+        d = (int.from_bytes(hashlib.sha512(b"ctpu.ecdsa" + entropy).digest(), "big") % (n - 1)) + 1
+        priv = ec.derive_private_key(d, _curve(scheme_id))
+        pub = priv.public_key().public_bytes(
+            serialization.Encoding.X962, serialization.PublicFormat.CompressedPoint
+        )
+        return KeyPair(
+            PublicKey(scheme_id, pub), PrivateKey(scheme_id, d.to_bytes(32, "big"))
+        )
+    if scheme_id == SPHINCS256_SHA256:
+        seed = hashlib.sha256(b"ctpu.sphincs" + entropy).digest()
+        pub, priv = sphincs.generate(seed)
+        return KeyPair(PublicKey(scheme_id, pub), PrivateKey(scheme_id, priv))
+    raise CryptoError(f"cannot derive key pairs for scheme {scheme_id}")
+
+
+def derive_keypair(private: PrivateKey, seed: bytes) -> KeyPair:
+    """HKDF-style child-key derivation from an existing private key + seed
+    (reference: Crypto.deriveKeyPair, Crypto.kt:715)."""
+    return derive_keypair_from_entropy(
+        private.scheme_id, hashlib.sha512(private.encoded + seed).digest()
+    )
+
+
+# ------------------------------------------------------------ sign / verify
+
+def sign(private: PrivateKey, data: bytes) -> bytes:
+    """Sign raw bytes. Signature encodings are canonical & fixed-width where
+    possible: ed25519 = 64B raw; ECDSA = 64B raw (r||s, low-S normalised);
+    RSA = PKCS#1 v1.5 over SHA-256; SPHINCS = packed WOTS/Merkle opening."""
+    sid = private.scheme_id
+    if sid == EDDSA_ED25519_SHA512:
+        return ed25519.Ed25519PrivateKey.from_private_bytes(private.encoded).sign(data)
+    if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+        der = _ec_priv_from_encoded(sid, private.encoded).sign(
+            data, ec.ECDSA(hashes.SHA256())
+        )
+        r, s = decode_dss_signature(der)
+        n = _order(sid)
+        if s > n // 2:  # low-S normalisation keeps signatures canonical
+            s = n - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+    if sid == RSA_SHA256:
+        priv = serialization.load_der_private_key(private.encoded, password=None)
+        return priv.sign(data, padding.PKCS1v15(), hashes.SHA256())
+    if sid == SPHINCS256_SHA256:
+        return sphincs.sign(private.encoded, data)
+    raise CryptoError(f"cannot sign with scheme {sid}")
+
+
+def verify(public: PublicKey, signature: bytes, data: bytes) -> None:
+    """Verify or raise (reference: Crypto.doVerify, Crypto.kt:524-555)."""
+    if not is_valid(public, signature, data):
+        raise CryptoError(
+            f"signature verification failed (scheme {public.scheme_id})"
+        )
+
+
+def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
+    """Verify without throwing (reference: Crypto.isValid, Crypto.kt:617).
+
+    This is the host/CPU oracle; the production bulk path is
+    ``corda_tpu.verifier``'s device dispatch.
+    """
+    sid = public.scheme_id
+    try:
+        if sid == EDDSA_ED25519_SHA512:
+            ed25519.Ed25519PublicKey.from_public_bytes(public.encoded).verify(
+                signature, data
+            )
+            return True
+        if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+            if len(signature) != 64:
+                return False
+            r = int.from_bytes(signature[:32], "big")
+            s = int.from_bytes(signature[32:], "big")
+            # Reject high-S: sign() emits low-S only, and accepting the
+            # malleated twin would let third parties mutate signature bytes
+            # without invalidating them (and diverge from the device kernels,
+            # which enforce the same canonical form).
+            if not (1 <= r and 1 <= s <= _order(sid) // 2):
+                return False
+            der = encode_dss_signature(r, s)
+            _ec_pub_from_encoded(sid, public.encoded).verify(
+                der, data, ec.ECDSA(hashes.SHA256())
+            )
+            return True
+        if sid == RSA_SHA256:
+            pub = serialization.load_der_public_key(public.encoded)
+            pub.verify(signature, data, padding.PKCS1v15(), hashes.SHA256())
+            return True
+        if sid == SPHINCS256_SHA256:
+            return sphincs.verify(public.encoded, signature, data)
+        if sid == COMPOSITE_KEY:
+            raise CryptoError(
+                "composite keys verify signature *sets*; use "
+                "corda_tpu.crypto.composite.verify_composite"
+            )
+    except CryptoError:
+        raise
+    except Exception:
+        return False
+    raise CryptoError(f"unsupported signature scheme id {sid}")
+
+
+def public_key_on_curve(public: PublicKey) -> bool:
+    """Point/key validation (reference: Crypto.publicKeyOnCurve, Crypto.kt:875)."""
+    try:
+        if public.scheme_id == EDDSA_ED25519_SHA512:
+            ed25519.Ed25519PublicKey.from_public_bytes(public.encoded)
+            return len(public.encoded) == 32
+        if public.scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+            _ec_pub_from_encoded(public.scheme_id, public.encoded)
+            return True
+        if public.scheme_id == RSA_SHA256:
+            serialization.load_der_public_key(public.encoded)
+            return True
+        if public.scheme_id == SPHINCS256_SHA256:
+            return len(public.encoded) == 33
+        return False
+    except Exception:
+        return False

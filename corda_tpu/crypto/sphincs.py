@@ -1,0 +1,141 @@
+"""Hash-based (post-quantum) signature scheme — scheme id 5.
+
+Fills the reference's SPHINCS-256 slot (core/.../crypto/Crypto.kt:138,
+provided there by the BouncyCastle PQC provider). This is a compact
+WOTS+-over-Merkle-tree construction ("SPHINCS-lite"):
+
+  * WOTS chains with w=16 over SHA-256 (len1=64 message digits + len2=3
+    checksum digits = 67 chains of 32 bytes);
+  * a height-``h`` Merkle tree of WOTS leaf keys (default h=8 → 256 leaves);
+  * leaf index chosen by hashing (seed-bound randomizer), signature carries
+    index + 67 chain openings + the Merkle auth path.
+
+NOTE: this is a *capability stand-in* for SPHINCS-256, not a production
+post-quantum implementation — leaf selection by message hash makes it
+few-time per leaf rather than stateless many-time. It is a cold path in the
+framework (same as in the reference, where SPHINCS is never on the hot
+verify path) and is flagged for replacement by full SPHINCS+ parameters.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+W = 16
+LEN1 = 64          # 256-bit digest, 4 bits per digit
+LEN2 = 3           # checksum digits: max checksum 64*15=960 < 16^3
+LEN = LEN1 + LEN2  # 67 chains
+N = 32             # hash output size
+DEFAULT_HEIGHT = 8
+
+
+def _h(*parts: bytes) -> bytes:
+    ctx = hashlib.sha256()
+    for p in parts:
+        ctx.update(p)
+    return ctx.digest()
+
+
+def _chain(x: bytes, start: int, steps: int) -> bytes:
+    """Iterate the chain hash from absolute position ``start`` for ``steps``
+    steps. The position is bound into each step (WOTS+-style addressing), so
+    a verifier continuing a chain from the signature's midpoint computes the
+    same endpoint as the signer only when the claimed digit is honest."""
+    for k in range(start, start + steps):
+        x = _h(b"sphincs.chain", struct.pack(">I", k), x)
+    return x
+
+
+def _wots_sk(seed: bytes, leaf: int, j: int) -> bytes:
+    return _h(b"sphincs.sk", seed, struct.pack(">II", leaf, j))
+
+
+def _digits(digest: bytes) -> list[int]:
+    """Base-w digits of the digest plus checksum digits."""
+    out = []
+    for byte in digest:
+        out.append(byte >> 4)
+        out.append(byte & 0xF)
+    checksum = sum((W - 1) - d for d in out)
+    for _ in range(LEN2):
+        out.append(checksum & 0xF)
+        checksum >>= 4
+    return out
+
+
+def _wots_leaf_pk(seed: bytes, leaf: int) -> bytes:
+    parts = []
+    for j in range(LEN):
+        parts.append(_chain(_wots_sk(seed, leaf, j), 0, W - 1))
+    return _h(b"sphincs.leaf", *parts)
+
+
+def _tree(seed: bytes, height: int) -> list[list[bytes]]:
+    row = [_wots_leaf_pk(seed, i) for i in range(1 << height)]
+    levels = [row]
+    while len(row) > 1:
+        row = [_h(b"sphincs.node", row[i], row[i + 1]) for i in range(0, len(row), 2)]
+        levels.append(row)
+    return levels
+
+
+def generate(seed: bytes, height: int = DEFAULT_HEIGHT) -> tuple[bytes, bytes]:
+    """Returns (public_encoded, private_encoded)."""
+    levels = _tree(seed, height)
+    root = levels[-1][0]
+    pub = struct.pack(">B", height) + root
+    priv = struct.pack(">B", height) + seed
+    return pub, priv
+
+
+def sign(private_encoded: bytes, message: bytes) -> bytes:
+    height = private_encoded[0]
+    seed = private_encoded[1:]
+    randomizer = _h(b"sphincs.rand", seed, message)
+    leaf = int.from_bytes(randomizer[:4], "big") % (1 << height)
+    digest = _h(b"sphincs.msg", randomizer, message)
+    digits = _digits(digest)
+    chains = [_chain(_wots_sk(seed, leaf, j), 0, digits[j]) for j in range(LEN)]
+    levels = _tree(seed, height)
+    auth = []
+    idx = leaf
+    for level in range(height):
+        auth.append(levels[level][idx ^ 1])
+        idx //= 2
+    return (
+        struct.pack(">I", leaf)
+        + randomizer
+        + b"".join(chains)
+        + b"".join(auth)
+    )
+
+
+def verify(public_encoded: bytes, signature: bytes, message: bytes) -> bool:
+    try:
+        height = public_encoded[0]
+        root = public_encoded[1:]
+        if len(signature) != 4 + N + LEN * N + height * N:
+            return False
+        leaf = struct.unpack(">I", signature[:4])[0]
+        if leaf >= (1 << height):
+            return False
+        randomizer = signature[4:4 + N]
+        off = 4 + N
+        chains = [signature[off + j * N: off + (j + 1) * N] for j in range(LEN)]
+        off += LEN * N
+        auth = [signature[off + k * N: off + (k + 1) * N] for k in range(height)]
+        digest = _h(b"sphincs.msg", randomizer, message)
+        digits = _digits(digest)
+        parts = [_chain(chains[j], digits[j], (W - 1) - digits[j]) for j in range(LEN)]
+        node = _h(b"sphincs.leaf", *parts)
+        idx = leaf
+        for k in range(height):
+            if idx % 2 == 0:
+                node = _h(b"sphincs.node", node, auth[k])
+            else:
+                node = _h(b"sphincs.node", auth[k], node)
+            idx //= 2
+        return node == root
+    except Exception:
+        return False

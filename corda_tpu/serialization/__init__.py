@@ -1,0 +1,21 @@
+from .cbe import (
+    GenericRecord,
+    SerializationError,
+    cbe_serializable,
+    decode,
+    deserialize,
+    encode,
+    register_custom,
+    serialize,
+)
+
+__all__ = [
+    "GenericRecord",
+    "SerializationError",
+    "cbe_serializable",
+    "decode",
+    "deserialize",
+    "encode",
+    "register_custom",
+    "serialize",
+]

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so every sharding/mesh code path
+(the multi-chip verify fan-out, wavefront DAG batches, notary batch dispatch)
+is exercised without TPU hardware — the equivalent of the reference's
+in-process MockNetwork tier (testing/node-driver/.../MockNode.kt) where
+multi-node behavior runs in one JVM. Real-chip execution is covered by
+bench.py and __graft_entry__.py, which the driver runs on TPU.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,318 @@
+"""Host crypto layer: schemes, hashing, Merkle trees, composite keys,
+transaction signatures. Mirrors the reference's crypto unit-test tier
+(core/src/test/kotlin/net/corda/core/crypto/)."""
+
+import hashlib
+
+import pytest
+
+from corda_tpu import crypto
+from corda_tpu.crypto import (
+    CompositeKeyBuilder,
+    CryptoError,
+    MerkleTree,
+    PartialMerkleTree,
+    SecureHash,
+    TransactionSignature,
+    sha256,
+    sha256_twice,
+)
+
+ALL_SIGNING_SCHEMES = [
+    crypto.RSA_SHA256,
+    crypto.ECDSA_SECP256K1_SHA256,
+    crypto.ECDSA_SECP256R1_SHA256,
+    crypto.EDDSA_ED25519_SHA512,
+    crypto.SPHINCS256_SHA256,
+]
+
+
+# ------------------------------------------------------------ hashing
+
+def test_sha256_vector():
+    assert sha256(b"abc").bytes == bytes.fromhex(
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad"
+    )
+
+
+def test_sha256_twice():
+    assert sha256_twice(b"abc").bytes == hashlib.sha256(
+        hashlib.sha256(b"abc").digest()
+    ).digest()
+
+
+def test_secure_hash_parse_and_str():
+    h = sha256(b"x")
+    assert SecureHash.parse(str(h)) == h
+    with pytest.raises(ValueError):
+        SecureHash(b"short")
+
+
+# ------------------------------------------------------------ merkle
+
+def test_merkle_root_two_leaves():
+    a, b = sha256(b"a"), sha256(b"b")
+    assert MerkleTree.build([a, b]).root == sha256(a.bytes + b.bytes)
+
+
+def test_merkle_pads_with_zero_hash():
+    a, b, c = sha256(b"a"), sha256(b"b"), sha256(b"c")
+    t = MerkleTree.build([a, b, c])
+    assert len(t.leaves) == 4
+    assert t.leaves[3] == crypto.ZERO_HASH
+    manual = sha256(
+        sha256(a.bytes + b.bytes).bytes + sha256(c.bytes + crypto.ZERO_HASH.bytes).bytes
+    )
+    assert t.root == manual
+
+
+@pytest.mark.parametrize("n_leaves", [1, 2, 3, 5, 8, 13])
+def test_partial_merkle_all_subsets(n_leaves):
+    leaves = [sha256(bytes([i])) for i in range(n_leaves)]
+    tree = MerkleTree.build(leaves)
+    import itertools
+
+    idx = list(range(n_leaves))
+    subsets = [list(c) for r in range(1, min(n_leaves, 3) + 1)
+               for c in itertools.combinations(idx, r)]
+    for subset in subsets:
+        pmt = PartialMerkleTree.build(tree, subset)
+        assert pmt.verify(tree.root)
+        assert not pmt.verify(sha256(b"wrong"))
+
+
+def test_partial_merkle_tampered_leaf_fails():
+    leaves = [sha256(bytes([i])) for i in range(8)]
+    tree = MerkleTree.build(leaves)
+    pmt = PartialMerkleTree.build(tree, [2, 5])
+    bad = PartialMerkleTree(
+        pmt.leaf_count,
+        tuple((i, sha256(b"evil")) for i, _ in pmt.included),
+        pmt.branch_hashes,
+    )
+    assert not bad.verify(tree.root)
+
+
+# ------------------------------------------------------------ schemes
+
+@pytest.mark.parametrize("scheme_id", ALL_SIGNING_SCHEMES)
+def test_sign_verify_roundtrip(scheme_id):
+    kp = crypto.generate_keypair(scheme_id)
+    msg = b"the quick brown fox"
+    sig = crypto.sign(kp.private, msg)
+    crypto.verify(kp.public, sig, msg)  # must not raise
+    assert crypto.is_valid(kp.public, sig, msg)
+    assert not crypto.is_valid(kp.public, sig, msg + b"!")
+    # tamper with the signature
+    bad = bytes([sig[0] ^ 1]) + sig[1:]
+    assert not crypto.is_valid(kp.public, bad, msg)
+
+
+@pytest.mark.parametrize(
+    "scheme_id",
+    [crypto.ECDSA_SECP256K1_SHA256, crypto.ECDSA_SECP256R1_SHA256,
+     crypto.EDDSA_ED25519_SHA512, crypto.SPHINCS256_SHA256],
+)
+def test_deterministic_derivation(scheme_id):
+    a = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-1")
+    b = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-1")
+    c = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-2")
+    assert a.public == b.public
+    assert a.public != c.public
+
+
+def test_child_key_derivation():
+    kp = crypto.derive_keypair_from_entropy(crypto.EDDSA_ED25519_SHA512, b"root")
+    child1 = crypto.derive_keypair(kp.private, b"child-1")
+    child2 = crypto.derive_keypair(kp.private, b"child-2")
+    assert child1.public != child2.public != kp.public
+    sig = crypto.sign(child1.private, b"m")
+    assert crypto.is_valid(child1.public, sig, b"m")
+
+
+def test_ecdsa_signatures_are_low_s():
+    kp = crypto.derive_keypair_from_entropy(crypto.ECDSA_SECP256K1_SHA256, b"e")
+    from corda_tpu.crypto.schemes import SECP256K1_N
+
+    for i in range(8):
+        sig = crypto.sign(kp.private, bytes([i]) * 10)
+        s = int.from_bytes(sig[32:], "big")
+        assert s <= SECP256K1_N // 2
+
+
+def test_unknown_scheme_rejected():
+    with pytest.raises(CryptoError):
+        crypto.find_scheme(99)
+    with pytest.raises(CryptoError):
+        crypto.generate_keypair(99)
+
+
+def test_public_key_on_curve():
+    kp = crypto.generate_keypair(crypto.ECDSA_SECP256R1_SHA256)
+    assert crypto.public_key_on_curve(kp.public)
+    bad = crypto.PublicKey(crypto.ECDSA_SECP256R1_SHA256, b"\x02" + b"\x00" * 31)
+    assert not crypto.public_key_on_curve(bad)
+    # x = p-1 on secp256r1: (p-1)^3 - 3(p-1) + b = -1 + 3 + b = b + 2 mod p,
+    # which is a quadratic non-residue, so decompression must fail.
+    p = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+    bad2 = crypto.PublicKey(
+        crypto.ECDSA_SECP256R1_SHA256, b"\x02" + (p - 1).to_bytes(32, "big")
+    )
+    assert not crypto.public_key_on_curve(bad2)
+
+
+# ------------------------------------------------------------ composite keys
+
+def _kp(seed: bytes):
+    return crypto.derive_keypair_from_entropy(crypto.EDDSA_ED25519_SHA512, seed)
+
+
+def test_composite_and_or():
+    a, b = _kp(b"a"), _kp(b"b")
+    both = CompositeKeyBuilder().add(a.public).add(b.public).build()  # AND
+    either = CompositeKeyBuilder().add(a.public).add(b.public).build(threshold=1)
+    assert both.is_fulfilled_by({a.public, b.public})
+    assert not both.is_fulfilled_by({a.public})
+    assert either.is_fulfilled_by({a.public})
+    assert not either.is_fulfilled_by(set())
+
+
+def test_composite_weighted_threshold():
+    a, b, c = _kp(b"a"), _kp(b"b"), _kp(b"c")
+    ck = (
+        CompositeKeyBuilder()
+        .add(a.public, weight=2)
+        .add(b.public, weight=1)
+        .add(c.public, weight=1)
+        .build(threshold=3)
+    )
+    assert ck.is_fulfilled_by({a.public, b.public})
+    assert ck.is_fulfilled_by({a.public, c.public})
+    assert not ck.is_fulfilled_by({b.public, c.public})
+    assert not ck.is_fulfilled_by({a.public})
+
+
+def test_composite_nested_and_wire_roundtrip():
+    a, b, c = _kp(b"a"), _kp(b"b"), _kp(b"c")
+    inner = CompositeKeyBuilder().add(b.public).add(c.public).build(threshold=1)
+    outer = CompositeKeyBuilder().add(a.public).add(inner).build()  # a AND (b OR c)
+    pub = outer.to_public_key()
+    back = crypto.CompositeKey.from_public_key(pub)
+    assert back.is_fulfilled_by({a.public, c.public})
+    assert not back.is_fulfilled_by({b.public, c.public})
+    assert crypto.is_fulfilled_by(pub, {a.public, b.public})
+
+
+def test_composite_invalid_threshold():
+    a = _kp(b"a")
+    with pytest.raises(CryptoError):
+        CompositeKeyBuilder().add(a.public).build(threshold=5)
+    with pytest.raises(CryptoError):
+        CompositeKeyBuilder().add(a.public, weight=0).build()
+
+
+def test_verify_composite_signature_set():
+    a, b = _kp(b"a"), _kp(b"b")
+    ck = CompositeKeyBuilder().add(a.public).add(b.public).build(threshold=1)
+    pub = ck.to_public_key()
+    msg = b"payload"
+    sig_a = crypto.sign(a.private, msg)
+    assert crypto.verify_composite(pub, [(a.public, sig_a)], msg)
+    assert not crypto.verify_composite(pub, [(a.public, sig_a)], msg + b"!")
+    assert not crypto.verify_composite(pub, [], msg)
+
+
+# ------------------------------------------------------------ tx signatures
+
+def test_transaction_signature_binds_metadata():
+    kp = _kp(b"signer")
+    tx_id = sha256(b"tx")
+    ts = crypto.sign_tx_id(kp.private, kp.public, tx_id)
+    assert ts.is_valid_for(tx_id)
+    ts.verify(tx_id)
+    assert not ts.is_valid_for(sha256(b"other-tx"))
+    # metadata tamper (scheme id) must invalidate
+    tampered = TransactionSignature(
+        ts.signature, ts.by, crypto.SignatureMetadata(ts.metadata.platform_version, 3)
+    )
+    assert not tampered.is_valid_for(tx_id)
+    with pytest.raises(CryptoError):
+        tampered.verify(tx_id)
+
+
+def test_signable_payload_is_fixed_width():
+    from corda_tpu.crypto.signatures import SIGNABLE_LEN, SignableData, SignatureMetadata
+
+    payload = SignableData(sha256(b"t"), SignatureMetadata(1, 4)).to_bytes()
+    assert len(payload) == SIGNABLE_LEN == 44
+
+
+# ---------------------------------------------- code-review regression tests
+
+def test_partial_merkle_duplicate_index_rejected():
+    # A duplicate included index must not let an unattested hash ride along.
+    leaves = [sha256(bytes([i])) for i in range(4)]
+    tree = MerkleTree.build(leaves)
+    good = PartialMerkleTree.build(tree, [1])
+    evil = PartialMerkleTree(
+        good.leaf_count,
+        ((1, sha256(b"evil")),) + good.included,
+        good.branch_hashes,
+    )
+    assert not evil.verify(tree.root)
+
+
+def test_partial_merkle_out_of_range_index_returns_false():
+    leaves = [sha256(bytes([i])) for i in range(4)]
+    tree = MerkleTree.build(leaves)
+    good = PartialMerkleTree.build(tree, [1])
+    bad = PartialMerkleTree(good.leaf_count, ((7, good.included[0][1]),), good.branch_hashes)
+    assert not bad.verify(tree.root)  # False, not KeyError
+
+
+def test_partial_merkle_non_hash_garbage_returns_false():
+    leaves = [sha256(bytes([i])) for i in range(4)]
+    tree = MerkleTree.build(leaves)
+    good = PartialMerkleTree.build(tree, [1])
+    bad = PartialMerkleTree(good.leaf_count, ((1, b"not-a-hash"),), good.branch_hashes)
+    assert not bad.verify(tree.root)
+    bad2 = PartialMerkleTree(good.leaf_count, good.included, (b"junk",) * len(good.branch_hashes))
+    assert not bad2.verify(tree.root)
+
+
+def test_malformed_composite_key_is_crypto_error_not_crash():
+    garbage = crypto.PublicKey(crypto.COMPOSITE_KEY, b"\xff\xff\xff")
+    with pytest.raises(CryptoError):
+        crypto.CompositeKey.from_public_key(garbage)
+    assert not crypto.is_fulfilled_by(garbage, set())
+    assert not crypto.verify_composite(garbage, [], b"m")
+    wrong_shape = crypto.PublicKey(
+        crypto.COMPOSITE_KEY, __import__("corda_tpu.serialization", fromlist=["encode"]).encode({"nope": 1})
+    )
+    with pytest.raises(CryptoError):
+        crypto.CompositeKey.from_public_key(wrong_shape)
+
+
+@pytest.mark.parametrize(
+    "scheme_id", [crypto.ECDSA_SECP256K1_SHA256, crypto.ECDSA_SECP256R1_SHA256]
+)
+def test_ecdsa_high_s_twin_rejected(scheme_id):
+    from corda_tpu.crypto.schemes import _order
+
+    kp = crypto.derive_keypair_from_entropy(scheme_id, b"malleability")
+    msg = b"payload"
+    sig = crypto.sign(kp.private, msg)
+    assert crypto.is_valid(kp.public, sig, msg)
+    r = sig[:32]
+    s = int.from_bytes(sig[32:], "big")
+    twin = r + (_order(scheme_id) - s).to_bytes(32, "big")
+    assert not crypto.is_valid(kp.public, twin, msg)
+
+
+def test_sphincs_chain_position_binding():
+    # Chains are position-bound: a signature for digit d must not verify as
+    # a signature for a smaller digit (chain-advance forgery).
+    kp = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"pos")
+    sig = crypto.sign(kp.private, b"m1")
+    assert crypto.is_valid(kp.public, sig, b"m1")
+    assert not crypto.is_valid(kp.public, sig, b"m2")
