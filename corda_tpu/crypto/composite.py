@@ -42,12 +42,12 @@ class CompositeKey:
             if node.weight <= 0:
                 raise CryptoError("composite key weights must be positive")
             total += node.weight
-            marker = (
-                node.key if isinstance(node.key, PublicKey) else id(node.key)
-            )
-            if isinstance(node.key, PublicKey) and marker in seen:
+            # Structural (dataclass) equality: catches duplicate plain keys
+            # AND structurally identical composite subtrees, which would let
+            # one signer double-count its weight.
+            if node.key in seen:
                 raise CryptoError("duplicate child key in composite node")
-            seen.add(marker)
+            seen.add(node.key)
             if isinstance(node.key, CompositeKey):
                 node.key.validate()
         if not (1 <= self.threshold <= total):
@@ -173,7 +173,12 @@ def verify_composite(
     tree (reference: CompositeSignaturesWithKeys + CompositeSignature)."""
     verified: set[PublicKey] = set()
     for signer, sig in sigs:
-        if not is_valid(signer, sig, data):
+        try:
+            if not is_valid(signer, sig, data):
+                return False
+        except CryptoError:
+            # e.g. an adversarial set listing a composite key as an
+            # *individual* signer — unverifiable, not a crash.
             return False
         verified.add(signer)
     return is_fulfilled_by(key, verified)

@@ -3,7 +3,7 @@
 Capability parity with the reference's ``SecureHash`` (core/.../crypto/
 SecureHash.kt:14-50): SHA-256 content addresses, double-SHA-256, the
 zero/all-ones sentinel hashes used for Merkle padding and privacy nonces.
-Device-side batched/tree-mode SHA-256 lives in ``corda_tpu.ops.sha256_jax``.
+Device-side batched/tree-mode SHA-256 lives in ``corda_tpu.ops.sha256``.
 """
 
 from __future__ import annotations

@@ -8,7 +8,7 @@ leaves plus the minimal set of interior hashes needed to recompute the root
 (the mechanism behind FilteredTransaction tear-offs and oracle signing).
 
 The batched device-side tree hash (one level per step, all pairs hashed in a
-single fused kernel) is ``corda_tpu.ops.sha256_jax.merkle_root``; this module
+single fused kernel) is ``corda_tpu.ops.sha256`` (``sha256_pair`` level reduction); this module
 is the canonical host reference the device path is differentially tested
 against.
 """

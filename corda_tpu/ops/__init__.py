@@ -1,0 +1,36 @@
+"""Device kernels (JAX/XLA, TPU-first).
+
+This package is the TPU-native replacement for the reference's JCA provider
+engines (BouncyCastle, i2p EdDSA — the seam at core/.../crypto/
+Crypto.kt:197-207,621-624): batched, fixed-shape, jit-compiled primitives that
+the verifier/notary services dispatch over signature and transaction batches.
+
+Design rules (see SURVEY.md §7 and the pallas guide):
+- batch-first layouts: every kernel takes ``(B, ...)`` arrays and is shape-
+  static so XLA compiles once per bucket size;
+- no 64-bit integers: TPUs have no native int64 multiply, so SHA-512 uses
+  uint32 word pairs and field arithmetic uses sub-16-bit limbs in int32/f32
+  lanes (products stay exact);
+- validity is data, not control flow: verification returns a ``(B,)`` bool
+  mask; the host turns mask failures into exceptions.
+"""
+
+from .sha256 import (
+    sha256_batch,
+    sha256_blocks,
+    sha256_pair,
+    sha256_twice_batch,
+    pad_sha256,
+)
+from .sha512 import sha512_batch, sha512_blocks, pad_sha512
+
+__all__ = [
+    "sha256_batch",
+    "sha256_blocks",
+    "sha256_pair",
+    "sha256_twice_batch",
+    "pad_sha256",
+    "sha512_batch",
+    "sha512_blocks",
+    "pad_sha512",
+]

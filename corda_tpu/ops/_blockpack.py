@@ -1,0 +1,57 @@
+"""Shared host-side block padding/packing for the SHA kernel family.
+
+Merkle–Damgård padding is identical for SHA-256 and SHA-512 up to block size;
+both kernels consume big-endian 32-bit words (SHA-512's 64-bit words travel
+as hi,lo uint32 pairs, which is exactly the big-endian 32-bit word stream).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def pad_md_blocks(
+    messages: list[bytes],
+    block_bytes: int,
+    nblocks: int | None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pad each message to its own final block (0x80, zeros, big-endian bit
+    length in the last 8 bytes); zero-fill trailing blocks to ``nblocks``.
+
+    Returns ``(blocks, counts)``: (B, nblocks, block_bytes//4) uint32 words
+    and (B,) int32 per-message padded block counts.
+    """
+    # the 0x80 byte plus the 8-byte length field must fit after the message
+    min_tail = 9 if block_bytes == 64 else 17  # SHA-512 length field is 16B
+    if nblocks is None:
+        longest = max((len(m) for m in messages), default=0)
+        nblocks = max(1, (longest + min_tail + block_bytes - 1) // block_bytes)
+    out = np.zeros((len(messages), nblocks * block_bytes), dtype=np.uint8)
+    counts = np.zeros(len(messages), dtype=np.int32)
+    for i, m in enumerate(messages):
+        n = (len(m) + min_tail + block_bytes - 1) // block_bytes
+        if n > nblocks:
+            raise ValueError(f"message {i} ({len(m)}B) exceeds {nblocks} blocks")
+        counts[i] = n
+        out[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        out[i, len(m)] = 0x80
+        end = n * block_bytes
+        out[i, end - 8 : end] = np.frombuffer(
+            (len(m) * 8).to_bytes(8, "big"), dtype=np.uint8
+        )
+    words_per_block = block_bytes // 4
+    words = out.reshape(len(messages), nblocks, words_per_block, 4)
+    blocks = (
+        (words[..., 0].astype(np.uint32) << 24)
+        | (words[..., 1].astype(np.uint32) << 16)
+        | (words[..., 2].astype(np.uint32) << 8)
+        | words[..., 3].astype(np.uint32)
+    )
+    return blocks, counts
+
+
+def words_to_bytes(digest: np.ndarray, digest_bytes: int) -> list[bytes]:
+    """(B, digest_bytes//4) uint32 big-endian words → per-row byte strings."""
+    d = np.asarray(digest, dtype=np.uint32)
+    be = d.astype(">u4").tobytes()
+    return [be[i * digest_bytes : (i + 1) * digest_bytes] for i in range(d.shape[0])]

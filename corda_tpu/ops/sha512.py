@@ -1,0 +1,174 @@
+"""Batched SHA-512 as a JAX/XLA kernel with 64-bit words emulated on uint32.
+
+Needed by the ed25519 verify path (h = SHA-512(R ‖ A ‖ M), reference scheme
+EDDSA_ED25519_SHA512, Crypto.kt:115-137). TPUs have no native 64-bit integer
+lanes, so every 64-bit word is an (hi, lo) uint32 pair; add/rot/shift are
+composed from 32-bit ops (carry via unsigned-wraparound compare). Same
+batch-first, static-shape contract as ``sha256.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._blockpack import pad_md_blocks, words_to_bytes
+
+# fmt: off
+_K64 = [
+    0x428a2f98d728ae22, 0x7137449123ef65cd, 0xb5c0fbcfec4d3b2f, 0xe9b5dba58189dbbc,
+    0x3956c25bf348b538, 0x59f111f1b605d019, 0x923f82a4af194f9b, 0xab1c5ed5da6d8118,
+    0xd807aa98a3030242, 0x12835b0145706fbe, 0x243185be4ee4b28c, 0x550c7dc3d5ffb4e2,
+    0x72be5d74f27b896f, 0x80deb1fe3b1696b1, 0x9bdc06a725c71235, 0xc19bf174cf692694,
+    0xe49b69c19ef14ad2, 0xefbe4786384f25e3, 0x0fc19dc68b8cd5b5, 0x240ca1cc77ac9c65,
+    0x2de92c6f592b0275, 0x4a7484aa6ea6e483, 0x5cb0a9dcbd41fbd4, 0x76f988da831153b5,
+    0x983e5152ee66dfab, 0xa831c66d2db43210, 0xb00327c898fb213f, 0xbf597fc7beef0ee4,
+    0xc6e00bf33da88fc2, 0xd5a79147930aa725, 0x06ca6351e003826f, 0x142929670a0e6e70,
+    0x27b70a8546d22ffc, 0x2e1b21385c26c926, 0x4d2c6dfc5ac42aed, 0x53380d139d95b3df,
+    0x650a73548baf63de, 0x766a0abb3c77b2a8, 0x81c2c92e47edaee6, 0x92722c851482353b,
+    0xa2bfe8a14cf10364, 0xa81a664bbc423001, 0xc24b8b70d0f89791, 0xc76c51a30654be30,
+    0xd192e819d6ef5218, 0xd69906245565a910, 0xf40e35855771202a, 0x106aa07032bbd1b8,
+    0x19a4c116b8d2d0c8, 0x1e376c085141ab53, 0x2748774cdf8eeb99, 0x34b0bcb5e19b48a8,
+    0x391c0cb3c5c95a63, 0x4ed8aa4ae3418acb, 0x5b9cca4f7763e373, 0x682e6ff3d6b2b8a3,
+    0x748f82ee5defb2fc, 0x78a5636f43172f60, 0x84c87814a1f0ab72, 0x8cc702081a6439ec,
+    0x90befffa23631e28, 0xa4506cebde82bde9, 0xbef9a3f7b2c67915, 0xc67178f2e372532b,
+    0xca273eceea26619c, 0xd186b8c721c0c207, 0xeada7dd6cde0eb1e, 0xf57d4f7fee6ed178,
+    0x06f067aa72176fba, 0x0a637dc5a2c898a6, 0x113f9804bef90dae, 0x1b710b35131c471b,
+    0x28db77f523047d84, 0x32caab7b40c72493, 0x3c9ebe0a15c9bebc, 0x431d67c49c100d4c,
+    0x4cc5d4becb3e42b6, 0x597f299cfc657e2a, 0x5fcb6fab3ad6faec, 0x6c44198c4a475817,
+]
+
+_H0_64 = [
+    0x6a09e667f3bcc908, 0xbb67ae8584caa73b, 0x3c6ef372fe94f82b, 0xa54ff53a5f1d36f1,
+    0x510e527fade682d1, 0x9b05688c2b3e6c1f, 0x1f83d9abfb41bd6b, 0x5be0cd19137e2179,
+]
+# fmt: on
+
+_KHI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_KLO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+_H0HI = np.array([h >> 32 for h in _H0_64], dtype=np.uint32)
+_H0LO = np.array([h & 0xFFFFFFFF for h in _H0_64], dtype=np.uint32)
+
+# A 64-bit lane is the pair (hi, lo) of uint32 arrays.
+W64 = tuple
+
+
+def _add(a: W64, b: W64) -> W64:
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _xor(a: W64, b: W64) -> W64:
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _and(a: W64, b: W64) -> W64:
+    return (a[0] & b[0], a[1] & b[1])
+
+
+def _not(a: W64) -> W64:
+    return (~a[0], ~a[1])
+
+
+def _rotr(a: W64, n: int) -> W64:
+    hi, lo = a
+    if n == 32:
+        return (lo, hi)
+    if n > 32:
+        hi, lo, n = lo, hi, n - 32
+    nn, inv = np.uint32(n), np.uint32(32 - n)
+    return ((hi >> nn) | (lo << inv), (lo >> nn) | (hi << inv))
+
+
+def _shr(a: W64, n: int) -> W64:
+    hi, lo = a
+    if n >= 32:
+        z = jnp.zeros_like(hi)
+        return (z, hi >> np.uint32(n - 32))
+    nn, inv = np.uint32(n), np.uint32(32 - n)
+    return (hi >> nn, (lo >> nn) | (hi << inv))
+
+
+def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """state: (..., 16) uint32 = 8 (hi,lo) pairs; block: (..., 32) uint32 =
+    16 big-endian 64-bit words as (hi,lo) pairs."""
+    w = [(block[..., 2 * i], block[..., 2 * i + 1]) for i in range(16)]
+    for i in range(16, 80):
+        x = w[i - 15]
+        s0 = _xor(_xor(_rotr(x, 1), _rotr(x, 8)), _shr(x, 7))
+        y = w[i - 2]
+        s1 = _xor(_xor(_rotr(y, 19), _rotr(y, 61)), _shr(y, 6))
+        w.append(_add(_add(w[i - 16], s0), _add(w[i - 7], s1)))
+
+    v = [(state[..., 2 * i], state[..., 2 * i + 1]) for i in range(8)]
+    a, b, c, d, e, f, g, h = v
+    for i in range(80):
+        s1 = _xor(_xor(_rotr(e, 14), _rotr(e, 18)), _rotr(e, 41))
+        ch = _xor(_and(e, f), _and(_not(e), g))
+        k = (jnp.asarray(_KHI[i]), jnp.asarray(_KLO[i]))
+        t1 = _add(_add(_add(h, s1), _add(ch, k)), w[i])
+        s0 = _xor(_xor(_rotr(a, 28), _rotr(a, 34)), _rotr(a, 39))
+        maj = _xor(_xor(_and(a, b), _and(a, c)), _and(b, c))
+        t2 = _add(s0, maj)
+        a, b, c, d, e, f, g, h = _add(t1, t2), a, b, c, _add(d, t1), e, f, g
+    outs = []
+    for old, new in zip(v, [a, b, c, d, e, f, g, h]):
+        s = _add(old, new)
+        outs.extend([s[0], s[1]])
+    return jnp.stack(outs, axis=-1)
+
+
+@jax.jit
+def sha512_blocks(blocks: jax.Array, nblk: jax.Array | None = None) -> jax.Array:
+    """Digest padded messages. blocks: (B, nblk_max, 32) uint32 → (B, 16)
+    uint32 (8 big-endian 64-bit words as hi,lo pairs). ``nblk`` (B,) int32:
+    per-message padded block count; later blocks are inert (mixed-length
+    batches within a bucket)."""
+    b = blocks.shape[0]
+    init = jnp.broadcast_to(
+        jnp.stack(
+            [jnp.asarray(x) for pair in zip(_H0HI, _H0LO) for x in pair]
+        ),
+        (b, 16),
+    )
+    if blocks.shape[1] == 1:
+        return _compress(init, blocks[:, 0])
+
+    def step(state, xs):
+        i, blk = xs
+        new = _compress(state, blk)
+        if nblk is None:
+            return new, None
+        return jnp.where((i < nblk)[:, None], new, state), None
+
+    idx = jnp.arange(blocks.shape[1], dtype=jnp.int32)
+    state, _ = jax.lax.scan(step, init, (idx, jnp.swapaxes(blocks, 0, 1)))
+    return state
+
+
+def pad_sha512(
+    messages: list[bytes], nblocks: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side SHA-512 padding into a fixed-block batch.
+
+    Each message padded to its own final 128-byte block (messages < 2^61
+    bytes, so the upper 64 bits of SHA-512's 128-bit length field are zero);
+    trailing blocks are zero and masked via the returned per-message counts.
+    Returns ``(blocks, counts)``: (B, nblocks, 32) uint32 + (B,) int32.
+    """
+    return pad_md_blocks(messages, 128, nblocks)
+
+
+def digest_words_to_bytes(digest: np.ndarray) -> list[bytes]:
+    """(B, 16) uint32 → list of 64-byte digests."""
+    return words_to_bytes(digest, 64)
+
+
+def sha512_batch(messages: list[bytes]) -> list[bytes]:
+    """Convenience host API: batch-hash arbitrary same-bucket messages."""
+    if not messages:
+        return []
+    blocks, counts = pad_sha512(messages)
+    return digest_words_to_bytes(np.asarray(sha512_blocks(blocks, counts)))

@@ -318,7 +318,9 @@ def _decode(data: bytes, pos: int) -> tuple[Any, int]:
         if type_name in _REGISTRY:
             _, from_fields = _REGISTRY[type_name]
             return from_fields(fields), pos
-        return GenericRecord(type_name, tuple(sorted(fields.items()))), pos
+        # Map decode already enforced canonical key order, so insertion order
+        # IS the encoded order (and mixed-type keys must not crash here).
+        return GenericRecord(type_name, tuple(fields.items())), pos
     raise SerializationError(f"unknown CBE tag 0x{tag:02x}")
 
 

@@ -316,3 +316,31 @@ def test_sphincs_chain_position_binding():
     sig = crypto.sign(kp.private, b"m1")
     assert crypto.is_valid(kp.public, sig, b"m1")
     assert not crypto.is_valid(kp.public, sig, b"m2")
+
+
+class TestReviewRegressions:
+    """Regressions for adversarial cases found in code review."""
+
+    def test_duplicate_composite_subtree_rejected(self):
+        from corda_tpu.crypto import (
+            CompositeKey, CompositeKeyNode, CryptoError, generate_keypair,
+        )
+        import pytest
+
+        k = generate_keypair().public
+        sub = CompositeKey(1, (CompositeKeyNode(1, k),))
+        sub2 = CompositeKey(1, (CompositeKeyNode(1, k),))  # distinct object
+        dup = CompositeKey(2, (CompositeKeyNode(1, sub), CompositeKeyNode(1, sub2)))
+        with pytest.raises(CryptoError):
+            dup.validate()
+
+    def test_composite_key_as_individual_signer_is_false_not_crash(self):
+        from corda_tpu.crypto import (
+            CompositeKeyBuilder, generate_keypair, verify_composite,
+        )
+
+        a, b = generate_keypair(), generate_keypair()
+        ck = CompositeKeyBuilder().add(a.public).add(b.public).build(1)
+        composite_pub = ck.to_public_key()
+        # adversarial: the composite key itself listed as a signer
+        assert verify_composite(composite_pub, [(composite_pub, b"junk")], b"m") is False

@@ -137,3 +137,20 @@ def test_decode_encode_byte_identity_for_canonical_input():
     for v in values:
         data = encode(v)
         assert encode(decode(data)) == data
+
+
+def test_unregistered_obj_with_mixed_type_field_keys():
+    """A T_OBJ for an unknown type whose field map mixes int and str keys must
+    decode to a GenericRecord (fields in encoded order), not crash."""
+    from corda_tpu.serialization.cbe import encode, decode, GenericRecord
+    import corda_tpu.serialization.cbe as cbe
+
+    payload = {1: b"x", "name": "y"}
+    raw = encode(payload)
+    # splice the map into a T_OBJ envelope for a type nobody registered
+    tname = b"com.example.Unknown"
+    buf = bytearray([cbe._T_OBJ])
+    cbe._write_uvarint(buf, len(tname))
+    rec = cbe.decode(bytes(buf) + tname + raw)
+    assert isinstance(rec, GenericRecord)
+    assert dict(rec.fields) == payload
