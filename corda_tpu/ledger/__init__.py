@@ -1,0 +1,70 @@
+"""Ledger data model — layer 1 of the framework (SURVEY.md §1 layer map).
+
+States/commands/amounts, identities, the component-group wire transaction
+with Merkle ids, signed/resolved/filtered transaction forms, and the
+builder. Reference scope: core/.../contracts, core/.../transactions,
+core/.../identity.
+"""
+
+from .identity import (
+    AbstractParty,
+    AnonymousParty,
+    CordaX500Name,
+    NameKeyCertificate,
+    Party,
+    PartyAndCertificate,
+)
+from .states import (
+    AlwaysAcceptAttachmentConstraint,
+    Amount,
+    AttachmentConstraint,
+    Command,
+    CommandWithParties,
+    ContractState,
+    HashAttachmentConstraint,
+    Issued,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionState,
+    TransactionVerificationException,
+    UniqueIdentifier,
+    WhitelistedByZoneAttachmentConstraint,
+    contract_code_hash,
+    register_contract,
+    resolve_contract,
+)
+from .wire import (
+    ComponentGroupType,
+    PrivacySalt,
+    WireTransaction,
+)
+from .signed import (
+    SignaturesMissingException,
+    SignedTransaction,
+)
+from .ledger_tx import InOutGroup, LedgerTransaction
+from .filtered import (
+    FilteredComponent,
+    FilteredGroup,
+    FilteredTransaction,
+    FilteredTransactionVerificationException,
+)
+from .builder import TransactionBuilder
+
+__all__ = [
+    "AbstractParty", "AnonymousParty", "CordaX500Name", "NameKeyCertificate",
+    "Party", "PartyAndCertificate",
+    "AlwaysAcceptAttachmentConstraint", "Amount", "AttachmentConstraint",
+    "Command", "CommandWithParties", "ContractState",
+    "HashAttachmentConstraint", "Issued", "StateAndRef", "StateRef",
+    "TimeWindow", "TransactionState", "TransactionVerificationException",
+    "UniqueIdentifier", "WhitelistedByZoneAttachmentConstraint",
+    "contract_code_hash", "register_contract", "resolve_contract",
+    "ComponentGroupType", "PrivacySalt", "WireTransaction",
+    "SignaturesMissingException", "SignedTransaction",
+    "InOutGroup", "LedgerTransaction",
+    "FilteredComponent", "FilteredGroup", "FilteredTransaction",
+    "FilteredTransactionVerificationException",
+    "TransactionBuilder",
+]

@@ -1,0 +1,170 @@
+"""Batched GF(2^255-19) field arithmetic for TPU lanes.
+
+The limb layout is chosen for what TPUs actually have — wide int32 vector
+lanes, no 64-bit multiplier: each field element is 32 little-endian radix-256
+limbs in an int32 array of shape ``(B, 32)``. An 8-bit × 8-bit product is 16
+bits and a 32-term schoolbook column sum stays under 2^23, so every
+accumulation is exact in int32 with headroom for the ×38 reduction fold
+(2^256 ≡ 38 mod p).
+
+Lazy-carry invariant: public ops accept limbs in [0, 1023] and return limbs
+in [0, 511]; values are congruent mod p but may exceed p. Exact
+canonicalisation (limbs in [0,255], value < p) happens only at encode/compare
+boundaries via short ``lax.scan`` carry/borrow chains.
+
+This is the TPU-native replacement for BouncyCastle/i2p's word-at-a-time
+bignum kernels behind the reference's JCA seam (Crypto.kt:197-207,621-624).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+P = 2**255 - 19
+LIMBS = 32
+
+# 8p expressed in 32 radix-256 limbs with limb values ≤ 1020: added before a
+# subtraction so the result is positive for any minuend under the lazy bound.
+_EIGHT_P = np.full(LIMBS, 1020, dtype=np.int32)
+_EIGHT_P[0] = 872  # 8p = 2^258 - 152 = (2^258 - 4) - 148
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int → (32,) int32 limb vector (host-side, for constants)."""
+    return np.array([(x >> (8 * i)) & 0xFF for i in range(LIMBS)], dtype=np.int32)
+
+
+def limbs_to_int(limbs: np.ndarray) -> int:
+    """(32,) limb vector → Python int (host-side, for tests)."""
+    return sum(int(v) << (8 * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+def _carry_pass(c: jax.Array) -> jax.Array:
+    """One vectorised signed carry pass with the 2^256 ≡ 38 wrap."""
+    q = c >> 8  # arithmetic shift: floor division, correct for negatives
+    r = c - (q << 8)
+    wrap = 38 * q[:, LIMBS - 1 :]
+    return r + jnp.concatenate([wrap, q[:, : LIMBS - 1]], axis=1)
+
+
+def _carry(c: jax.Array, passes: int) -> jax.Array:
+    for _ in range(passes):
+        c = _carry_pass(c)
+    return c
+
+
+# Schoolbook product as one gather + one batched matvec: column k of the
+# 63-limb product is Σ_i a_i · b_{k-i}. _CONV_IDX[i, k] = k - i (clamped),
+# _CONV_MASK kills out-of-range terms. Three HLO ops per field-mul instead of
+# ~100 — compile time matters with thousands of muls inside ladder loops, and
+# dot_general is the shape the MXU wants.
+_CONV_IDX = np.clip(
+    np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None], 0, LIMBS - 1
+).astype(np.int32)
+_CONV_MASK = (
+    (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] >= 0)
+    & (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] < LIMBS)
+)
+
+
+def fe_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(B,32) × (B,32) → (B,32), limbs ≤ ~512 after 4 carry passes."""
+    bmat = jnp.where(jnp.asarray(_CONV_MASK), b[:, _CONV_IDX], 0)  # (B,32,63)
+    c = jnp.einsum(
+        "bi,bik->bk", a, bmat, preferred_element_type=jnp.int32
+    )
+    # fold limbs ≥ 32: limb k contributes 38·2^(8(k-32))
+    lo, hi = c[:, :LIMBS], c[:, LIMBS:]
+    folded = lo + 38 * jnp.pad(hi, ((0, 0), (0, 1)))
+    return _carry(folded, 4)
+
+
+def fe_sq(a: jax.Array) -> jax.Array:
+    return fe_mul(a, a)
+
+
+def fe_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return _carry(a + b, 2)
+
+
+def fe_sub(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a - b + 8p keeps every limb positive for lazy-bounded inputs."""
+    return _carry(a - b + jnp.asarray(_EIGHT_P), 3)
+
+
+def fe_neg(a: jax.Array) -> jax.Array:
+    return fe_sub(jnp.zeros_like(a), a)
+
+
+def fe_mul_small(a: jax.Array, k: int) -> jax.Array:
+    """Multiply by a small scalar constant (k ≤ ~2000)."""
+    return _carry(a * np.int32(k), 3)
+
+
+def fe_pow_const(a: jax.Array, exponent: int) -> jax.Array:
+    """a^exponent for a fixed public exponent (square-and-multiply driven by
+    a compile-time bit array inside one ``fori_loop`` so the graph holds a
+    single iteration body)."""
+    nbits = exponent.bit_length()
+    bits = np.array(
+        [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)], dtype=np.int32
+    )
+    bits_d = jnp.asarray(bits)
+    one = jnp.zeros_like(a).at[:, 0].set(1)
+
+    def body(i, r):
+        r = fe_sq(r)
+        return jnp.where((bits_d[i] == 1), fe_mul(r, a), r)
+
+    return jax.lax.fori_loop(0, nbits, body, one)
+
+
+def fe_inv(a: jax.Array) -> jax.Array:
+    """Fermat inversion a^(p-2); a == 0 maps to 0 (callers gate on validity
+    masks, never on exceptions — invalid lanes compute garbage safely)."""
+    return fe_pow_const(a, P - 2)
+
+
+def fe_canonical(a: jax.Array) -> jax.Array:
+    """Exact reduction: limbs in [0,255], value in [0, p)."""
+
+    def carry_step(carry, limb):
+        v = limb + carry
+        return v >> 8, v & 255
+
+    def exact_carry(c):
+        top, limbs = jax.lax.scan(carry_step, jnp.zeros_like(c[:, 0]), c.T)
+        limbs = limbs.T
+        return limbs.at[:, 0].add(38 * top)  # 2^256 wrap; top is tiny
+
+    c = exact_carry(exact_carry(a))
+    c = exact_carry(c)  # the wrap may ripple once more
+
+    p_limbs = jnp.asarray(int_to_limbs(P))
+
+    def sub_p(v):
+        def borrow_step(borrow, pair):
+            limb, pl = pair
+            d = limb - pl - borrow
+            return (d < 0).astype(jnp.int32), d & 255
+
+        borrow, diff = jax.lax.scan(
+            borrow_step,
+            jnp.zeros_like(v[:, 0]),
+            (v.T, jnp.broadcast_to(p_limbs[:, None], (LIMBS, v.shape[0]))),
+        )
+        return jnp.where((borrow == 0)[:, None], diff.T, v)
+
+    return sub_p(sub_p(c))
+
+
+def fe_eq(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Exact field equality → (B,) bool."""
+    return jnp.all(fe_canonical(a) == fe_canonical(b), axis=1)
+
+
+def fe_is_odd(a: jax.Array) -> jax.Array:
+    """Parity of the canonical representative → (B,) int32 in {0,1}."""
+    return fe_canonical(a)[:, 0] & 1

@@ -92,30 +92,54 @@ def _shr(a: W64, n: int) -> W64:
 
 
 def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """state: (..., 16) uint32 = 8 (hi,lo) pairs; block: (..., 32) uint32 =
-    16 big-endian 64-bit words as (hi,lo) pairs."""
-    w = [(block[..., 2 * i], block[..., 2 * i + 1]) for i in range(16)]
-    for i in range(16, 80):
-        x = w[i - 15]
-        s0 = _xor(_xor(_rotr(x, 1), _rotr(x, 8)), _shr(x, 7))
-        y = w[i - 2]
-        s1 = _xor(_xor(_rotr(y, 19), _rotr(y, 61)), _shr(y, 6))
-        w.append(_add(_add(w[i - 16], s0), _add(w[i - 7], s1)))
+    """state: (B, 16) uint32 = 8 (hi,lo) pairs; block: (B, 32) uint32 =
+    16 big-endian 64-bit words as (hi,lo) pairs.
 
-    v = [(state[..., 2 * i], state[..., 2 * i + 1]) for i in range(8)]
-    a, b, c, d, e, f, g, h = v
-    for i in range(80):
+    Both the message schedule and the 80 rounds run as ``lax.scan`` — the
+    emulated-64-bit round function is ~40 uint32 ops, and unrolling 80 of
+    them made the fused ed25519 verify module pathologically slow to compile;
+    a scan keeps one round body in the graph.
+    """
+    b = block.shape[0]
+    w16 = jnp.swapaxes(block.reshape(b, 16, 2), 0, 1)  # (16, B, 2)
+
+    def pair(buf, i):  # buf (16, B, 2) ring of the last 16 words
+        return (buf[i, :, 0], buf[i, :, 1])
+
+    def sched_step(buf, _):
+        x = pair(buf, 1)   # w[i-15]
+        y = pair(buf, 14)  # w[i-2]
+        s0 = _xor(_xor(_rotr(x, 1), _rotr(x, 8)), _shr(x, 7))
+        s1 = _xor(_xor(_rotr(y, 19), _rotr(y, 61)), _shr(y, 6))
+        new = _add(_add(pair(buf, 0), s0), _add(pair(buf, 9), s1))
+        new_arr = jnp.stack(new, axis=-1)[None]  # (1, B, 2)
+        return jnp.concatenate([buf[1:], new_arr], axis=0), new_arr[0]
+
+    _, extra = jax.lax.scan(sched_step, w16, None, length=64)  # (64, B, 2)
+    w_all = jnp.concatenate([w16, extra], axis=0)  # (80, B, 2)
+    k_all = jnp.stack(
+        [jnp.asarray(_KHI), jnp.asarray(_KLO)], axis=-1
+    )  # (80, 2)
+
+    def round_step(vs, xs):
+        w_i, k_i = xs  # (B, 2), (2,)
+        v = [(vs[:, 2 * i], vs[:, 2 * i + 1]) for i in range(8)]
+        a, b_, c, d, e, f, g, h = v
+        wk = (w_i[:, 0], w_i[:, 1])
+        k = (k_i[0], k_i[1])
         s1 = _xor(_xor(_rotr(e, 14), _rotr(e, 18)), _rotr(e, 41))
         ch = _xor(_and(e, f), _and(_not(e), g))
-        k = (jnp.asarray(_KHI[i]), jnp.asarray(_KLO[i]))
-        t1 = _add(_add(_add(h, s1), _add(ch, k)), w[i])
+        t1 = _add(_add(_add(h, s1), _add(ch, k)), wk)
         s0 = _xor(_xor(_rotr(a, 28), _rotr(a, 34)), _rotr(a, 39))
-        maj = _xor(_xor(_and(a, b), _and(a, c)), _and(b, c))
+        maj = _xor(_xor(_and(a, b_), _and(a, c)), _and(b_, c))
         t2 = _add(s0, maj)
-        a, b, c, d, e, f, g, h = _add(t1, t2), a, b, c, _add(d, t1), e, f, g
+        out = [_add(t1, t2), a, b_, c, _add(d, t1), e, f, g]
+        return jnp.stack([x for p in out for x in p], axis=-1), None
+
+    final, _ = jax.lax.scan(round_step, state, (w_all, k_all))
     outs = []
-    for old, new in zip(v, [a, b, c, d, e, f, g, h]):
-        s = _add(old, new)
+    for i in range(8):
+        s = _add((state[:, 2 * i], state[:, 2 * i + 1]), (final[:, 2 * i], final[:, 2 * i + 1]))
         outs.extend([s[0], s[1]])
     return jnp.stack(outs, axis=-1)
 

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism (SURVEY.md §2.9 — the TPU-native equivalents).
+
+The reference scales verification by competing consumers on a queue
+(P3: Verifier.kt:66-84) and notarisation by Raft/BFT replication (P5/P6).
+Here the intra-host scaling axis is a ``jax.sharding.Mesh``: signature
+batches shard across devices (data parallel over the ``batch`` axis),
+spent-state hashes all-gather over ICI, and wavefront DAG levels dispatch as
+sharded batches.
+"""
+
+from .mesh import (
+    distributed_verify_step,
+    make_mesh,
+    shard_batch,
+)
+
+__all__ = ["distributed_verify_step", "make_mesh", "shard_batch"]

@@ -1,0 +1,77 @@
+"""Mesh construction + the distributed verification step.
+
+TPU-native replacement for the reference's verifier fan-out and notary
+commit round (SURVEY.md §2.9 P3/P5, §2.10): instead of N worker processes
+competing on an Artemis queue (Verifier.kt:66-84) with the node
+re-delivering on death, a batch of signature-verification work is sharded
+over the device mesh with ``shard_map``; each device verifies its shard and
+the spent-state hashes are all-gathered over ICI so every shard holds the
+full consumed-set delta for the notary commit (the "all-gather of
+spent-state hashes" in BASELINE.json's north star).
+
+The mesh axes:
+- ``batch``: data-parallel over signatures/transactions (the only axis a
+  verification workload meaningfully shards over — there is no tensor/
+  pipeline dimension in signature math, so wider meshes simply mean wider
+  batches).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from corda_tpu.ops.ed25519 import ed25519_verify_kernel
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "batch") -> Mesh:
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_batch(mesh: Mesh, arr, axis: str = "batch"):
+    """Place a host array batch-sharded over the mesh."""
+    return jax.device_put(arr, NamedSharding(mesh, P(axis)))
+
+
+def distributed_verify_step(mesh: Mesh):
+    """Build the jitted multi-chip verify step for ``mesh``.
+
+    Returns fn(a_y, a_sign, r_bytes, s_bits, msg_blocks, msg_nblk, precheck,
+    spent_hashes) → (valid_mask, spent_all, total_valid):
+
+    - every input is batch-sharded on axis 0 (batch size must divide the
+      mesh size);
+    - each device runs the ed25519 verify kernel on its shard;
+    - ``spent_hashes`` (B, 8) int32 — the input-state reference hashes the
+      batch consumes — are all-gathered so each shard returns the complete
+      consumed-set delta (the notary-commit collective);
+    - ``total_valid`` is a psum'd scalar (the batch-level accept count).
+    """
+    spec = P("batch")
+
+    def step(a_y, a_sign, r_bytes, s_bits, msg_blocks, msg_nblk, precheck,
+             spent_hashes):
+        mask = ed25519_verify_kernel(
+            a_y, a_sign, r_bytes, s_bits, msg_blocks, msg_nblk, precheck
+        )
+        spent_all = jax.lax.all_gather(
+            spent_hashes, "batch", axis=0, tiled=True
+        )
+        total = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), "batch")
+        return mask, spent_all, total
+
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec,) * 8,
+        out_specs=(spec, P(), P()),
+        check_rep=False,
+    )
+    return jax.jit(sharded)

@@ -10,9 +10,18 @@ bench.py and __graft_entry__.py, which the driver runs on TPU.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the environment ships JAX_PLATFORMS=axon (the real-TPU tunnel)
+# and the axon plugin additionally overrides the jax_platforms *config* at
+# interpreter start, so both the env var and the config must be overwritten
+# — setdefault is not enough, and the config update must land before any
+# backend is touched.
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (import after the env is fixed)
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,393 @@
+"""Ledger data-model tests — the unit-test tier the reference keeps in
+core/src/test/kotlin/net/corda/core/{contracts,transactions,crypto}
+(PartialMerkleTreeTest, TransactionTests, AttachmentConstraint tests), plus
+adversarial tear-off cases."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import CryptoError, generate_keypair, sign_tx_id
+from corda_tpu.ledger import (
+    Amount,
+    Command,
+    ComponentGroupType,
+    CordaX500Name,
+    FilteredTransaction,
+    FilteredTransactionVerificationException,
+    HashAttachmentConstraint,
+    Issued,
+    LedgerTransaction,
+    NameKeyCertificate,
+    Party,
+    PartyAndCertificate,
+    PrivacySalt,
+    SignaturesMissingException,
+    SignedTransaction,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionBuilder,
+    TransactionState,
+    TransactionVerificationException,
+    UniqueIdentifier,
+    contract_code_hash,
+    register_contract,
+)
+from corda_tpu.serialization import deserialize, serialize, register_custom
+
+
+# ----------------------------------------------------------- test fixtures
+
+@dataclasses.dataclass(frozen=True)
+class DummyState:
+    magic: int
+    owner_keys: tuple = ()
+
+    @property
+    def participants(self):
+        return []
+
+
+@dataclasses.dataclass(frozen=True)
+class DummyCommandData:
+    op: str = "move"
+
+
+register_custom(
+    DummyState, "test.DummyState",
+    to_fields=lambda s: {"magic": s.magic, "owner_keys": list(s.owner_keys)},
+    from_fields=lambda d: DummyState(d["magic"], tuple(d["owner_keys"])),
+)
+register_custom(
+    DummyCommandData, "test.DummyCommandData",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: DummyCommandData(d["op"]),
+)
+
+
+@register_contract("test.DummyContract")
+class DummyContract:
+    def verify(self, tx):
+        if any(s.magic == 666 for s in tx.outputs_of_type(DummyState)):
+            raise ValueError("magic 666 forbidden")
+
+
+@pytest.fixture(scope="module")
+def notary():
+    kp = generate_keypair()
+    return Party(CordaX500Name("Notary Corp", "Zurich", "CH"), kp.public), kp
+
+
+@pytest.fixture(scope="module")
+def alice():
+    kp = generate_keypair()
+    return Party(CordaX500Name("Alice Ltd", "London", "GB"), kp.public), kp
+
+
+def build_tx(notary_party, signer_kp, n_outputs=2, salt=None):
+    b = TransactionBuilder(notary=notary_party)
+    for i in range(n_outputs):
+        b.add_output_state(DummyState(i), "test.DummyContract")
+    b.add_command(DummyCommandData(), signer_kp.public)
+    if salt:
+        b.set_privacy_salt(salt)
+    return b
+
+
+# ----------------------------------------------------------------- X.500
+
+class TestIdentity:
+    def test_x500_roundtrip(self):
+        n = CordaX500Name("Mega Corp", "New York", "US", common_name="Mega")
+        assert CordaX500Name.parse(str(n)) == n
+
+    def test_x500_validation(self):
+        with pytest.raises(ValueError):
+            CordaX500Name("", "London", "GB")
+        with pytest.raises(ValueError):
+            CordaX500Name("A" * 200, "London", "GB")
+        with pytest.raises(ValueError):
+            CordaX500Name("Evil,Corp", "London", "GB")
+        with pytest.raises(ValueError):
+            CordaX500Name("Ok Corp", "London", "gbx")
+
+    def test_certificate_chain(self):
+        root = generate_keypair()
+        inter = generate_keypair()
+        leaf = generate_keypair()
+        name = CordaX500Name("Chained Ltd", "Oslo", "NO")
+        inter_name = CordaX500Name("Inter CA", "Oslo", "NO")
+        leaf_cert = NameKeyCertificate.issue(name, leaf.public, inter.public, inter.private)
+        inter_cert = NameKeyCertificate.issue(inter_name, inter.public, root.public, root.private)
+        pac = PartyAndCertificate(Party(name, leaf.public), (leaf_cert, inter_cert))
+        assert pac.verify(root.public)
+        assert not pac.verify(inter.public)  # wrong trust root
+        # tampered chain
+        bad = PartyAndCertificate(Party(name, root.public), (leaf_cert, inter_cert))
+        assert not bad.verify(root.public)
+
+
+# ----------------------------------------------------------------- amounts
+
+class TestAmount:
+    def test_arithmetic(self):
+        usd = "USD"
+        assert (Amount(5, usd) + Amount(3, usd)).quantity == 8
+        assert (Amount(5, usd) - Amount(3, usd)).quantity == 2
+        with pytest.raises(ValueError):
+            Amount(5, usd) - Amount(7, usd)
+        with pytest.raises(ValueError):
+            Amount(5, usd) + Amount(1, "GBP")
+        with pytest.raises(ValueError):
+            Amount(-1, usd)
+
+    def test_time_window(self):
+        tw = TimeWindow.between(100, 200)
+        assert tw.contains(100) and tw.contains(199)
+        assert not tw.contains(200) and not tw.contains(99)
+        with pytest.raises(ValueError):
+            TimeWindow(None, None)
+        with pytest.raises(ValueError):
+            TimeWindow.between(200, 100)
+
+
+# ------------------------------------------------------------------- wire
+
+class TestWireTransaction:
+    def test_id_deterministic_and_salt_sensitive(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        salt = PrivacySalt(b"\x01" * 32)
+        tx1 = build_tx(np_, akp, salt=salt).to_wire_transaction()
+        tx2 = build_tx(np_, akp, salt=salt).to_wire_transaction()
+        assert tx1.id == tx2.id
+        tx3 = build_tx(np_, akp, salt=PrivacySalt(b"\x02" * 32)).to_wire_transaction()
+        assert tx3.id != tx1.id
+
+    def test_id_changes_with_any_component(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        salt = PrivacySalt(b"\x01" * 32)
+        base = build_tx(np_, akp, salt=salt).to_wire_transaction()
+        more = build_tx(np_, akp, n_outputs=3, salt=salt).to_wire_transaction()
+        assert base.id != more.id
+
+    def test_structure_rules(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        with pytest.raises(TransactionVerificationException):
+            # no inputs and no outputs
+            TransactionBuilder(notary=np_).add_command(
+                DummyCommandData(), akp.public
+            ).to_wire_transaction()
+        with pytest.raises(TransactionVerificationException):
+            # no commands
+            b = TransactionBuilder(notary=np_)
+            b.add_output_state(DummyState(1), "test.DummyContract")
+            b.to_wire_transaction()
+
+    def test_serialization_roundtrip(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        wtx = build_tx(np_, akp).to_wire_transaction()
+        wtx2 = deserialize(serialize(wtx))
+        assert wtx2.id == wtx.id
+
+
+# ------------------------------------------------------------------ signed
+
+class TestSignedTransaction:
+    def test_sign_and_verify(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        stx = build_tx(np_, akp).sign_initial_transaction(akp)
+        stx.verify_required_signatures()
+
+    def test_missing_signer_detected(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        other = generate_keypair()
+        b = build_tx(np_, akp)
+        b.add_command(DummyCommandData("extra"), other.public)
+        stx = b.sign_initial_transaction(akp)
+        with pytest.raises(SignaturesMissingException):
+            stx.verify_required_signatures()
+        stx.verify_signatures_except({other.public})  # allowed-missing path
+        stx2 = stx.plus([sign_tx_id(other.private, other.public, stx.id)])
+        stx2.verify_required_signatures()
+
+    def test_corrupted_signature_rejected(self, notary, alice):
+        np_, _ = notary
+        _, akp = alice
+        stx = build_tx(np_, akp).sign_initial_transaction(akp)
+        bad_sig = dataclasses.replace(
+            stx.sigs[0], signature=bytes(64)
+        )
+        bad = dataclasses.replace(stx, sigs=(bad_sig,))
+        with pytest.raises(CryptoError):
+            bad.verify_required_signatures()
+
+    def test_notary_key_required_when_inputs_present(self, notary, alice):
+        np_, nkp = notary
+        _, akp = alice
+        b = build_tx(np_, akp)
+        b.add_input_state(
+            StateAndRef(
+                TransactionState(DummyState(9), "test.DummyContract", np_),
+                StateRef(build_tx(np_, akp).to_wire_transaction().id, 0),
+            )
+        )
+        stx = b.sign_initial_transaction(akp)
+        assert np_.owning_key in stx.required_signing_keys
+        with pytest.raises(SignaturesMissingException):
+            stx.verify_required_signatures()
+        stx.verify_signatures_except({np_.owning_key})
+
+
+# ---------------------------------------------------------------- filtered
+
+class TestFilteredTransaction:
+    def _ftx(self, notary, alice, predicate=None):
+        np_, _ = notary
+        _, akp = alice
+        wtx = build_tx(np_, akp, n_outputs=3).to_wire_transaction()
+        pred = predicate or (
+            lambda c, g: g == ComponentGroupType.COMMANDS
+        )
+        return wtx, FilteredTransaction.build(wtx, pred)
+
+    def test_build_and_verify(self, notary, alice):
+        wtx, ftx = self._ftx(notary, alice)
+        ftx.verify()
+        assert ftx.id == wtx.id
+        cmds = ftx.components_of(ComponentGroupType.COMMANDS)
+        assert len(cmds) == 1 and isinstance(cmds[0].value, DummyCommandData)
+        # hidden group stays hidden
+        assert ftx.components_of(ComponentGroupType.OUTPUTS) == []
+
+    def test_partial_reveal_and_visibility_check(self, notary, alice):
+        wtx, ftx = self._ftx(
+            notary, alice,
+            predicate=lambda c, g: g == ComponentGroupType.OUTPUTS
+            and getattr(getattr(c, "data", None), "magic", None) == 1,
+        )
+        ftx.verify()
+        outs = ftx.components_of(ComponentGroupType.OUTPUTS)
+        assert len(outs) == 1 and outs[0].data.magic == 1
+        with pytest.raises(FilteredTransactionVerificationException):
+            ftx.check_all_components_visible(ComponentGroupType.OUTPUTS)
+        # fully-revealed group passes the visibility check
+        wtx2, ftx2 = self._ftx(
+            notary, alice, predicate=lambda c, g: g == ComponentGroupType.OUTPUTS
+        )
+        ftx2.verify()
+        ftx2.check_all_components_visible(ComponentGroupType.OUTPUTS)
+
+    def test_tampered_component_rejected(self, notary, alice):
+        from corda_tpu.serialization import encode
+
+        wtx, ftx = self._ftx(notary, alice)
+        fg = ftx.filtered_groups[0]
+        forged_cmd = dataclasses.replace(
+            fg.components[0],
+            opaque_bytes=encode(Command(DummyCommandData("forged"), (generate_keypair().public,))),
+        )
+        forged = dataclasses.replace(
+            ftx,
+            filtered_groups=(dataclasses.replace(fg, components=(forged_cmd,)),),
+        )
+        with pytest.raises(FilteredTransactionVerificationException):
+            forged.verify()
+
+    def test_forged_group_root_rejected(self, notary, alice):
+        wtx, ftx = self._ftx(notary, alice)
+        roots = list(ftx.group_roots)
+        roots[0], roots[1] = roots[1], roots[0]
+        forged = dataclasses.replace(ftx, group_roots=tuple(roots))
+        with pytest.raises(FilteredTransactionVerificationException):
+            forged.verify()
+
+
+# ---------------------------------------------------------------- resolved
+
+class TestLedgerTransaction:
+    def _ltx(self, notary, alice, outputs=None, attachments=None):
+        np_, _ = notary
+        _, akp = alice
+        outputs = outputs or [
+            TransactionState(DummyState(1), "test.DummyContract", np_)
+        ]
+        return LedgerTransaction(
+            tx_id=build_tx(np_, akp).to_wire_transaction().id,
+            inputs=(),
+            outputs=tuple(outputs),
+            commands=(Command(DummyCommandData(), (akp.public,)),),
+            attachments=tuple(
+                attachments
+                if attachments is not None
+                else [contract_code_hash("test.DummyContract")]
+            ),
+            notary=np_,
+            time_window=None,
+        )
+
+    def test_verify_passes(self, notary, alice):
+        self._ltx(notary, alice).verify()
+
+    def test_contract_rejection(self, notary, alice):
+        np_, _ = notary
+        bad = self._ltx(
+            notary, alice,
+            outputs=[TransactionState(DummyState(666), "test.DummyContract", np_)],
+        )
+        with pytest.raises(TransactionVerificationException):
+            bad.verify()
+
+    def test_missing_attachment(self, notary, alice):
+        with pytest.raises(TransactionVerificationException):
+            self._ltx(notary, alice, attachments=[]).verify()
+
+    def test_hash_constraint(self, notary, alice):
+        np_, _ = notary
+        good = TransactionState(
+            DummyState(1), "test.DummyContract", np_,
+            constraint=HashAttachmentConstraint(contract_code_hash("test.DummyContract")),
+        )
+        self._ltx(notary, alice, outputs=[good]).verify()
+        bad = TransactionState(
+            DummyState(1), "test.DummyContract", np_,
+            constraint=HashAttachmentConstraint(contract_code_hash("other.Contract")),
+        )
+        with pytest.raises(TransactionVerificationException):
+            self._ltx(notary, alice, outputs=[bad]).verify()
+
+    def test_notary_change_rejected(self, notary, alice):
+        np_, _ = notary
+        other_notary = Party(
+            CordaX500Name("Other Notary", "Paris", "FR"), generate_keypair().public
+        )
+        ltx = dataclasses.replace(
+            self._ltx(notary, alice),
+            inputs=(
+                StateAndRef(
+                    TransactionState(DummyState(5), "test.DummyContract", other_notary),
+                    StateRef(self._ltx(notary, alice).tx_id, 0),
+                ),
+            ),
+        )
+        with pytest.raises(TransactionVerificationException):
+            ltx.verify()
+
+    def test_group_states(self, notary, alice):
+        np_, _ = notary
+        ltx = self._ltx(
+            notary, alice,
+            outputs=[
+                TransactionState(DummyState(1, ("a",)), "test.DummyContract", np_),
+                TransactionState(DummyState(1, ("b",)), "test.DummyContract", np_),
+                TransactionState(DummyState(2, ("c",)), "test.DummyContract", np_),
+            ],
+        )
+        groups = ltx.group_states(DummyState, lambda s: s.magic)
+        assert {g.grouping_key: len(g.outputs) for g in groups} == {1: 2, 2: 1}

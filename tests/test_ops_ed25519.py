@@ -1,0 +1,142 @@
+"""Differential tests: ed25519 batch-verify device kernel vs the host
+OpenSSL oracle (the JCA-vector tier of the reference's crypto tests,
+core/src/test/.../crypto/CryptoUtilsTest.kt / TransactionSignatureTest.kt).
+
+A wrong-accept in a vectorised verifier is a security bug (SURVEY.md §7
+hard-parts (c)), so the adversarial cases are the point: corrupted R/s/A,
+scheme-confused keys, non-canonical field encodings, s ≥ L malleability,
+off-curve points.
+"""
+
+import random
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives.asymmetric import ed25519 as hostlib
+
+from corda_tpu.ops.ed25519 import L, P, ed25519_verify_batch
+
+
+def _gen(n, seed=0, msglen=(1, 200)):
+    rng = random.Random(seed)
+    pks, sigs, msgs = [], [], []
+    for _ in range(n):
+        sk = hostlib.Ed25519PrivateKey.generate()
+        m = rng.randbytes(rng.randint(*msglen))
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    return pks, sigs, msgs
+
+
+class TestValid:
+    def test_batch_of_valid_signatures(self):
+        pks, sigs, msgs = _gen(16, seed=1)
+        assert ed25519_verify_batch(pks, sigs, msgs).all()
+
+    def test_rfc8032_vectors(self):
+        # RFC 8032 §7.1 test vectors 1-3
+        vecs = [
+            ("d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a",
+             "", "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+             "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"),
+            ("3d4017c3e843895a92b70aa74d1b7ebc9c982ccf2ec4968cc0cd55f12af4660c",
+             "72", "92a009a9f0d4cab8720e820b5f642540a2b27b5416503f8fb3762223ebdb69da"
+             "085ac1e43e15996e458f3613d0f11d8c387b2eaeb4302aeeb00d291612bb0c00"),
+            ("fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025",
+             "af82", "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+             "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"),
+        ]
+        pks = [bytes.fromhex(v[0]) for v in vecs]
+        msgs = [bytes.fromhex(v[1]) for v in vecs]
+        sigs = [bytes.fromhex(v[2]) for v in vecs]
+        assert ed25519_verify_batch(pks, sigs, msgs).all()
+
+    def test_empty_batch(self):
+        assert ed25519_verify_batch([], [], []).shape == (0,)
+
+    def test_fixed_bucket(self):
+        pks, sigs, msgs = _gen(4, seed=2, msglen=(10, 40))
+        mask = ed25519_verify_batch(pks, sigs, msgs, nblocks=4)
+        assert mask.all()
+
+
+class TestInvalid:
+    def test_every_corruption_mode(self):
+        pks, sigs, msgs = _gen(8, seed=3)
+        # lane 0: flip a bit in R
+        sigs[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]
+        # lane 1: flip a bit in s
+        sigs[1] = sigs[1][:40] + bytes([sigs[1][40] ^ 0x10]) + sigs[1][41:]
+        # lane 2: corrupt message
+        msgs[2] = msgs[2][:-1] + bytes([msgs[2][-1] ^ 1])
+        # lane 3: wrong public key
+        other = hostlib.Ed25519PrivateKey.generate()
+        pks[3] = other.public_key().public_bytes_raw()
+        # lane 4: truncated signature
+        sigs[4] = sigs[4][:63]
+        # lane 5: truncated pubkey
+        pks[5] = pks[5][:31]
+        mask = ed25519_verify_batch(pks, sigs, msgs)
+        assert mask.tolist() == [False] * 6 + [True, True]
+
+    def test_s_malleability_rejected(self):
+        """s' = s + L verifies in lax verifiers; RFC 8032 (and the host
+        oracle) require s < L."""
+        pks, sigs, msgs = _gen(1, seed=4)
+        s = int.from_bytes(sigs[0][32:], "little")
+        mall = sigs[0][:32] + (s + L).to_bytes(32, "little")
+        assert not ed25519_verify_batch(pks, [mall], msgs).any()
+
+    def test_noncanonical_pubkey_y_rejected(self):
+        """A pubkey whose y ≥ p is non-canonical and must not verify."""
+        pks, sigs, msgs = _gen(1, seed=5)
+        bad_y = (P + 1).to_bytes(32, "little")  # y = p+1, sign bit 0
+        assert not ed25519_verify_batch([bad_y], sigs, msgs).any()
+
+    def test_off_curve_pubkey_rejected(self):
+        """y with no valid x decompression fails the sqrt check."""
+        # find a y (< p) that is not on the curve
+        for y in range(2, 50):
+            yb = y.to_bytes(32, "little")
+            try:
+                hostlib.Ed25519PublicKey.from_public_bytes(yb)
+                # host accepted construction; it may still be off-curve but
+                # the cheap test is whether our kernel agrees with verify
+            except Exception:
+                pass
+        # y=2 is known off-curve for ed25519
+        pks, sigs, msgs = _gen(1, seed=6)
+        assert not ed25519_verify_batch(
+            [(2).to_bytes(32, "little")], sigs, msgs
+        ).any()
+
+    def test_zero_signature_rejected(self):
+        pks, _, msgs = _gen(1, seed=7)
+        assert not ed25519_verify_batch(pks, [b"\x00" * 64], msgs).any()
+
+    def test_garbage_fuzz_never_accepts(self):
+        rng = random.Random(8)
+        pks = [rng.randbytes(32) for _ in range(8)]
+        sigs = [rng.randbytes(64) for _ in range(8)]
+        msgs = [rng.randbytes(50) for _ in range(8)]
+        assert not ed25519_verify_batch(pks, sigs, msgs).any()
+
+
+class TestDifferential:
+    def test_agrees_with_host_oracle_on_mixed_batch(self):
+        """Random mix of valid/corrupted lanes must match OpenSSL verdicts."""
+        rng = random.Random(9)
+        pks, sigs, msgs = _gen(24, seed=9)
+        expected = []
+        for i in range(24):
+            if rng.random() < 0.5:
+                j = rng.randrange(64)
+                sigs[i] = sigs[i][:j] + bytes([sigs[i][j] ^ (1 << rng.randrange(8))]) + sigs[i][j + 1:]
+            try:
+                hostlib.Ed25519PublicKey.from_public_bytes(pks[i]).verify(sigs[i], msgs[i])
+                expected.append(True)
+            except Exception:
+                expected.append(False)
+        got = ed25519_verify_batch(pks, sigs, msgs)
+        assert got.tolist() == expected
