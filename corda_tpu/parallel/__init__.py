@@ -13,5 +13,17 @@ from .mesh import (
     make_mesh,
     shard_batch,
 )
+from .wavefront import (
+    DagVerificationError,
+    DagVerifyResult,
+    DoubleSpendInDagError,
+    UnresolvedStateError,
+    topological_levels,
+    verify_transaction_dag,
+)
 
-__all__ = ["distributed_verify_step", "make_mesh", "shard_batch"]
+__all__ = [
+    "distributed_verify_step", "make_mesh", "shard_batch",
+    "DagVerificationError", "DagVerifyResult", "DoubleSpendInDagError",
+    "UnresolvedStateError", "topological_levels", "verify_transaction_dag",
+]

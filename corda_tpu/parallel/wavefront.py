@@ -1,0 +1,170 @@
+"""Wavefront (topological-level) verification of transaction DAGs.
+
+The reference resolves a back-chain by BFS download, topological sort, then
+a *sequential* depth-first verify-and-record loop — one full transaction
+verification at a time (ResolveTransactionsFlow.kt:38-105). The TPU-native
+design (SURVEY.md §2.9 P7, BASELINE config #4): all transactions at the same
+topological depth are independent, so each level becomes
+
+  1. ONE scheme-bucketed device batch for every signature in the level
+     (corda_tpu.verifier.check_transactions), and
+  2. host-parallel contract-semantics verification per transaction,
+
+with a running consumed-state set rejecting double-spends inside the DAG —
+the host-side mirror of the mesh's all-gathered spent-state hashes
+(parallel/mesh.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from concurrent.futures import ThreadPoolExecutor
+
+from corda_tpu.crypto import SecureHash
+from corda_tpu.ledger import SignedTransaction, StateRef
+from corda_tpu.ledger.states import TransactionVerificationException
+
+
+class DagVerificationError(Exception):
+    pass
+
+
+class DoubleSpendInDagError(DagVerificationError):
+    def __init__(self, ref: StateRef, tx_id: SecureHash):
+        self.ref = ref
+        self.tx_id = tx_id
+        super().__init__(f"state {ref} consumed twice (second spend in {tx_id})")
+
+
+class UnresolvedStateError(DagVerificationError):
+    def __init__(self, ref: StateRef, tx_id: SecureHash):
+        self.ref = ref
+        self.tx_id = tx_id
+        super().__init__(f"tx {tx_id} references unresolvable state {ref}")
+
+
+def topological_levels(deps: dict) -> list[list]:
+    """Kahn's algorithm by level: ``deps[node] = set of parent nodes`` (edges
+    restricted to keys of ``deps``). Returns levels root-first; raises on
+    cycles. Reference analogue: the sort in ResolveTransactionsFlow.kt:38-66,
+    except levels are kept explicit because each level is a device batch."""
+    remaining = {n: {d for d in ds if d in deps} for n, ds in deps.items()}
+    levels: list[list] = []
+    while remaining:
+        ready = [n for n, ds in remaining.items() if not ds]
+        if not ready:
+            raise DagVerificationError("dependency cycle in transaction DAG")
+        levels.append(ready)
+        for n in ready:
+            del remaining[n]
+        ready_set = set(ready)
+        for ds in remaining.values():
+            ds -= ready_set
+    return levels
+
+
+@dataclasses.dataclass
+class DagVerifyResult:
+    order: list          # tx ids in verified order (level-major)
+    levels: list[list]   # tx ids per wavefront level
+    n_sigs: int          # total signatures checked
+    consumed: set        # every StateRef consumed inside the DAG
+
+
+def verify_transaction_dag(
+    stxs: dict,
+    resolve_external=None,
+    allowed_missing_fn=None,
+    *,
+    use_device: bool = True,
+    max_workers: int = 8,
+    check_contracts: bool = True,
+) -> DagVerifyResult:
+    """Verify a set of interdependent SignedTransactions wavefront-parallel.
+
+    ``stxs``: {tx_id: SignedTransaction}. ``resolve_external(ref)`` supplies
+    states created outside the DAG (e.g. from the vault / tx storage); inputs
+    referencing a tx inside the DAG resolve from its verified outputs.
+    ``allowed_missing_fn(stx) -> set`` names keys allowed to be missing
+    (e.g. the notary key during assembly); defaults to none.
+
+    Raises the first verification failure; on success returns the ordering
+    + consumed-set report.
+    """
+    from corda_tpu.verifier import check_transactions
+
+    deps: dict = {}
+    for tid, stx in stxs.items():
+        deps[tid] = {ref.txhash for ref in stx.inputs if ref.txhash in stxs}
+    levels = topological_levels(deps)
+
+    outputs: dict = {}  # StateRef -> TransactionState, from verified txs
+    consumed: set = set()
+    order: list = []
+    n_sigs = 0
+
+    def resolve(ref: StateRef, tid: SecureHash):
+        if ref in outputs:
+            return outputs[ref]
+        if resolve_external is not None:
+            st = resolve_external(ref)
+            if st is not None:
+                return st
+        raise UnresolvedStateError(ref, tid)
+
+    pool = ThreadPoolExecutor(max_workers=max_workers) if check_contracts else None
+    try:
+        for level in levels:
+            level_stxs = [stxs[tid] for tid in level]
+            allowed = [
+                allowed_missing_fn(s) if allowed_missing_fn else set()
+                for s in level_stxs
+            ]
+            report = check_transactions(
+                level_stxs, allowed, use_device=use_device
+            )
+            report.raise_first()
+            n_sigs += report.n_sigs
+
+            # consumed-set update is sequential (cheap set algebra); it is
+            # the correctness gate for double-spends within the DAG
+            for tid in level:
+                for ref in stxs[tid].inputs:
+                    if ref in consumed:
+                        raise DoubleSpendInDagError(ref, tid)
+                    consumed.add(ref)
+
+            if check_contracts:
+                def run_contracts(tid):
+                    stx = stxs[tid]
+                    ltx = stx.tx.to_ledger_transaction(
+                        lambda ref: resolve(ref, tid)
+                    )
+                    ltx.verify()
+
+                for err in pool.map(_trap(run_contracts), level):
+                    if err is not None:
+                        raise err
+
+            # publish outputs only after the whole level verified
+            for tid in level:
+                wtx = stxs[tid].tx
+                for i, ts in enumerate(wtx.outputs):
+                    outputs[StateRef(tid, i)] = ts
+            order.extend(level)
+    finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    return DagVerifyResult(order, levels, n_sigs, consumed)
+
+
+def _trap(fn):
+    def wrapped(arg):
+        try:
+            fn(arg)
+            return None
+        except Exception as e:  # propagated by the caller
+            return e
+
+    return wrapped

@@ -1,0 +1,31 @@
+"""Verification engine — layer 8 (SURVEY.md §2.4, §3.3).
+
+The TPU-native re-design of the reference's verification tier: instead of a
+4-thread in-process pool (InMemoryTransactionVerifierService.kt:11-14) or
+N competing JVM worker processes (Verifier.kt:49-87), signature checks from
+many transactions are flattened into scheme-bucketed device batches
+(`batch.py`), contract semantics run on host, and whole back-chain DAGs
+verify as topological wavefronts (`corda_tpu.parallel.wavefront`).
+"""
+
+from .batch import (
+    BatchVerifyReport,
+    check_transactions,
+    verify_signature_rows,
+)
+from .service import (
+    BatchedVerifierService,
+    InMemoryVerifierService,
+    TransactionVerifierService,
+    VerificationError,
+)
+
+__all__ = [
+    "BatchVerifyReport",
+    "check_transactions",
+    "verify_signature_rows",
+    "BatchedVerifierService",
+    "InMemoryVerifierService",
+    "TransactionVerifierService",
+    "VerificationError",
+]

@@ -1,0 +1,152 @@
+"""Scheme-bucketed batch signature verification.
+
+The core throughput idea of the framework (BASELINE.json north star): the
+reference verifies one signature per JCA call inside a per-transaction loop
+(TransactionWithSignatures.kt:63 → Crypto.doVerify, Crypto.kt:552-555,
+621-624). Here the (key, signature, message) rows of *many* transactions are
+flattened, bucketed by scheme id — mirroring the dispatch switch of
+Crypto.findSignatureScheme (Crypto.kt:236-267) — and each bucket goes to its
+best engine in one shot:
+
+  scheme 4 (ed25519)  → one batched device kernel (ops/ed25519.py)
+  schemes 2/3 (ECDSA) → device kernel when available, host OpenSSL otherwise
+  schemes 1/5 (RSA, SPHINCS — cold paths) → host loop
+
+Bucketing + padding policy is what decides real MXU utilization (SURVEY.md
+§7 hard part (a)): the ed25519 path pads to power-of-two buckets so XLA
+compiles once per bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from corda_tpu.crypto import (
+    EDDSA_ED25519_SHA512,
+    SecureHash,
+    TransactionSignature,
+    is_fulfilled_by,
+    is_valid,
+)
+from corda_tpu.ledger import SignedTransaction
+from corda_tpu.ledger.signed import SignaturesMissingException
+
+# Schemes with a batched device kernel. secp256r1/k1 join once their
+# Jacobian-ladder kernels land (ops/secp256.py).
+_DEVICE_SCHEMES = {EDDSA_ED25519_SHA512}
+
+
+def verify_signature_rows(
+    rows: list[tuple], *, use_device: bool = True
+) -> np.ndarray:
+    """Verify (PublicKey, signature, message) rows → (N,) bool mask.
+
+    One device dispatch per device-capable scheme bucket; host loop for the
+    rest. Row order is preserved.
+    """
+    n = len(rows)
+    out = np.zeros(n, dtype=bool)
+    if n == 0:
+        return out
+
+    buckets: dict[int, list[int]] = {}
+    for i, (key, _sig, _msg) in enumerate(rows):
+        buckets.setdefault(key.scheme_id, []).append(i)
+
+    for scheme_id, idxs in buckets.items():
+        if use_device and scheme_id in _DEVICE_SCHEMES:
+            from corda_tpu.ops.ed25519 import ed25519_verify_batch
+
+            mask = ed25519_verify_batch(
+                [rows[i][0].encoded for i in idxs],
+                [rows[i][1] for i in idxs],
+                [rows[i][2] for i in idxs],
+            )
+            out[idxs] = mask
+        else:
+            for i in idxs:
+                key, sig, msg = rows[i]
+                out[i] = is_valid(key, sig, msg)
+    return out
+
+
+@dataclasses.dataclass
+class BatchVerifyReport:
+    """Per-transaction outcome of a batched signature check."""
+
+    results: list  # Exception | None per transaction (None = ok)
+    n_sigs: int
+    n_device: int
+
+    @property
+    def ok(self) -> bool:
+        return all(r is None for r in self.results)
+
+    def raise_first(self) -> None:
+        for r in self.results:
+            if r is not None:
+                raise r
+
+
+class InvalidSignatureError(Exception):
+    def __init__(self, tx_id: SecureHash, sig: TransactionSignature):
+        self.tx_id = tx_id
+        self.sig = sig
+        super().__init__(f"invalid signature by {sig.by!r} on tx {tx_id}")
+
+
+def check_transactions(
+    stxs: list[SignedTransaction],
+    allowed_missing: list[set] | None = None,
+    *,
+    use_device: bool = True,
+) -> BatchVerifyReport:
+    """Batched equivalent of ``stx.verify_signatures_except(allowed)`` over
+    many transactions: all signature rows flatten into one scheme-bucketed
+    dispatch, then per-tx signer-set algebra (composite-key fulfilment, the
+    host-cheap half of TransactionWithSignatures.kt:29-63) runs on the mask.
+    """
+    if allowed_missing is None:
+        allowed_missing = [set()] * len(stxs)
+    if len(allowed_missing) != len(stxs):
+        raise ValueError("allowed_missing length mismatch")
+
+    rows: list[tuple] = []
+    row_tx: list[int] = []
+    row_sig: list[int] = []
+    for t, stx in enumerate(stxs):
+        for j, (key, sig, msg) in enumerate(stx.signature_triples()):
+            rows.append((key, sig, msg))
+            row_tx.append(t)
+            row_sig.append(j)
+
+    mask = verify_signature_rows(rows, use_device=use_device)
+    n_device = (
+        sum(1 for key, _s, _m in rows if key.scheme_id in _DEVICE_SCHEMES)
+        if use_device
+        else 0
+    )
+
+    results: list = [None] * len(stxs)
+    # first invalid signature per tx wins (matches the sequential reference
+    # loop's first-throw behavior)
+    for i, valid in enumerate(mask):
+        t = row_tx[i]
+        if not valid and results[t] is None:
+            results[t] = InvalidSignatureError(
+                stxs[t].id, stxs[t].sigs[row_sig[i]]
+            )
+    for t, stx in enumerate(stxs):
+        if results[t] is not None:
+            continue
+        signed_by = {s.by for s in stx.sigs}
+        missing = {
+            k
+            for k in stx.required_signing_keys
+            if not is_fulfilled_by(k, signed_by)
+        } - set(allowed_missing[t])
+        if missing:
+            results[t] = SignaturesMissingException(missing, stx.id)
+    return BatchVerifyReport(results, n_sigs=len(rows), n_device=n_device)

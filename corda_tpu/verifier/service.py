@@ -1,0 +1,186 @@
+"""Transaction verifier services.
+
+Parity with the reference's two `TransactionVerifierService` impls
+(node/.../services/transactions/InMemoryTransactionVerifierService.kt:11-14,
+OutOfProcessTransactionVerifierService.kt:20-71) plus the TPU-native third
+tier the north star calls for: a batching dispatcher that accumulates
+concurrent verification requests and flushes them as one device batch
+(signatures) + a host thread pool (contract semantics).
+
+The batching window is the throughput/latency dial of SURVEY.md §7 hard
+part (e): requests flush when either ``max_batch`` is reached or
+``window_s`` elapses since the first queued request.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from corda_tpu.ledger import LedgerTransaction, SignedTransaction
+
+
+class VerificationError(Exception):
+    pass
+
+
+class TransactionVerifierService:
+    """verify() returns a Future completing when verification finishes
+    (reference: TransactionVerifierService.kt:10 returning CordaFuture)."""
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class InMemoryVerifierService(TransactionVerifierService):
+    """Host thread-pool verification — the reference's default 4-thread
+    in-process service, kept as the no-device fallback and the baseline
+    for bench comparisons."""
+
+    def __init__(self, workers: int = 4):
+        self._pool = ThreadPoolExecutor(max_workers=workers)
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        return self._pool.submit(ltx.verify)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class _Pending:
+    __slots__ = ("stx", "resolve_state", "allowed_missing", "future")
+
+    def __init__(self, stx, resolve_state, allowed_missing, future):
+        self.stx = stx
+        self.resolve_state = resolve_state
+        self.allowed_missing = allowed_missing
+        self.future = future
+
+
+class BatchedVerifierService(TransactionVerifierService):
+    """The TPU tier: concurrent verify requests accumulate; a flusher thread
+    drains them into one scheme-bucketed device dispatch for every signature
+    plus host-pool contract verification.
+
+    ``verify_signed`` is the full-tx entry (signatures on device + contract
+    semantics); ``verify`` keeps the reference's LedgerTransaction-only
+    contract (semantics-only, host pool).
+    """
+
+    def __init__(
+        self,
+        *,
+        max_batch: int = 4096,
+        window_s: float = 0.005,
+        workers: int = 8,
+        use_device: bool = True,
+    ):
+        self._max_batch = max_batch
+        self._window_s = window_s
+        self._use_device = use_device
+        self._pool = ThreadPoolExecutor(max_workers=workers)
+        self._lock = threading.Condition()
+        self._queue: list[_Pending] = []
+        self._closed = False
+        self._flusher = threading.Thread(
+            target=self._flush_loop, name="verifier-flusher", daemon=True
+        )
+        self._flusher.start()
+        self.stats = {"batches": 0, "txs": 0, "sigs": 0, "device_sigs": 0}
+
+    # ------------------------------------------------------------- entries
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        return self._pool.submit(ltx.verify)
+
+    def verify_signed(
+        self,
+        stx: SignedTransaction,
+        resolve_state=None,
+        allowed_missing: set | None = None,
+    ) -> Future:
+        """Queue a full verification (device signature batch + host contract
+        run when ``resolve_state`` is given). Completes with None or fails
+        with the verification error."""
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                raise VerificationError("verifier service is shut down")
+            self._queue.append(
+                _Pending(stx, resolve_state, allowed_missing or set(), fut)
+            )
+            self._lock.notify()
+        return fut
+
+    # ------------------------------------------------------------- flusher
+    def _flush_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._lock.wait()
+                if self._closed and not self._queue:
+                    return
+                # batch-accumulate: wait out the window from the first
+                # arrival unless the batch is already full
+                deadline = time.monotonic() + self._window_s
+                while (
+                    len(self._queue) < self._max_batch
+                    and not self._closed
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._lock.wait(timeout=remaining)
+                batch, self._queue = self._queue[: self._max_batch], self._queue[
+                    self._max_batch :
+                ]
+            if batch:
+                self._flush(batch)
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        from .batch import check_transactions
+
+        try:
+            report = check_transactions(
+                [p.stx for p in batch],
+                [p.allowed_missing for p in batch],
+                use_device=self._use_device,
+            )
+        except Exception as e:
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        self.stats["batches"] += 1
+        self.stats["txs"] += len(batch)
+        self.stats["sigs"] += report.n_sigs
+        self.stats["device_sigs"] += report.n_device
+
+        def finish(p: _Pending, sig_err):
+            if sig_err is not None:
+                p.future.set_exception(sig_err)
+                return
+            try:
+                if p.resolve_state is not None:
+                    ltx = p.stx.tx.to_ledger_transaction(p.resolve_state)
+                    ltx.verify()
+                p.future.set_result(None)
+            except Exception as e:
+                p.future.set_exception(e)
+
+        for p, err in zip(batch, report.results):
+            try:
+                self._pool.submit(finish, p, err)
+            except RuntimeError:
+                # pool already shut down (service closing): finish inline so
+                # no caller blocks on an unresolved future
+                finish(p, err)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        self._flusher.join()
+        self._pool.shutdown(wait=True)

@@ -25,3 +25,20 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402  (import after the env is fixed)
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the crypto kernels are big programs (512-bit
+# scalar ladders over 32-limb field ops) and cold-compile in minutes on CPU;
+# cached re-runs load in milliseconds. Kept inside the repo (gitignored) so
+# CI/driver reruns benefit too.
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "device: exercises the JAX device kernels (slow cold-compile)"
+    )

@@ -1,0 +1,302 @@
+"""Tests for the batched verification engine + wavefront DAG scheduler
+(the TPU-native replacements for the reference's verification tier —
+InMemoryTransactionVerifierService / Verifier.kt / ResolveTransactionsFlow).
+
+Device usage is confined to the explicitly-marked tests; everything else
+exercises the same code paths with the host crypto oracle so failures
+localize (kernel correctness has its own differential suite in
+test_ops_ed25519.py).
+"""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sign_tx_id
+from corda_tpu.ledger import (
+    CordaX500Name,
+    Party,
+    SignedTransaction,
+    StateRef,
+    TransactionBuilder,
+)
+from corda_tpu.ledger.signed import SignaturesMissingException
+from corda_tpu.parallel import (
+    DoubleSpendInDagError,
+    UnresolvedStateError,
+    topological_levels,
+    verify_transaction_dag,
+)
+from corda_tpu.serialization import register_custom
+from corda_tpu.verifier import (
+    BatchedVerifierService,
+    check_transactions,
+    verify_signature_rows,
+)
+from corda_tpu.verifier.batch import InvalidSignatureError
+from corda_tpu.ledger.states import register_contract
+
+
+@dataclasses.dataclass(frozen=True)
+class CoinState:
+    value: int
+    owner_key: object = None
+
+    @property
+    def participants(self):
+        return []
+
+
+@dataclasses.dataclass(frozen=True)
+class CoinCommand:
+    op: str
+
+
+register_custom(
+    CoinState, "test.CoinState",
+    to_fields=lambda s: {"value": s.value, "owner_key": s.owner_key or 0},
+    from_fields=lambda d: CoinState(d["value"], d["owner_key"] or None),
+)
+register_custom(
+    CoinCommand, "test.CoinCommand",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: CoinCommand(d["op"]),
+)
+
+
+@register_contract("test.CoinContract")
+class CoinContract:
+    def verify(self, tx):
+        ins = sum(s.value for s in tx.inputs_of_type(CoinState))
+        outs = sum(s.value for s in tx.outputs_of_type(CoinState))
+        cmds = tx.commands_of_type(CoinCommand)
+        if not cmds:
+            raise ValueError("no CoinCommand")
+        op = cmds[0].value.op
+        if op == "issue" and tx.inputs:
+            raise ValueError("issue must not consume")
+        if op == "move" and ins != outs:
+            raise ValueError(f"value not conserved: {ins} -> {outs}")
+
+
+@pytest.fixture(scope="module")
+def notary():
+    kp = generate_keypair()
+    return Party(CordaX500Name("Notary", "Zurich", "CH"), kp.public), kp
+
+
+@pytest.fixture(scope="module")
+def alice():
+    kp = generate_keypair()
+    return Party(CordaX500Name("Alice", "London", "GB"), kp.public), kp
+
+
+def issue_tx(notary, alice, value=100) -> SignedTransaction:
+    b = TransactionBuilder(notary=notary[0])
+    b.add_output_state(CoinState(value), "test.CoinContract")
+    b.add_command(CoinCommand("issue"), alice[1].public)
+    return b.sign_initial_transaction(alice[1])
+
+
+def move_tx(notary, alice, parent: SignedTransaction, idx=0, split=None):
+    """Spend parent's output ``idx`` into one or two outputs."""
+    b = TransactionBuilder(notary=notary[0])
+    parent_state = parent.tx.outputs[idx]
+    b._inputs.append(StateRef(parent.id, idx))
+    b._ensure_attachment(parent_state.contract)
+    value = parent_state.data.value
+    if split:
+        b.add_output_state(CoinState(split), "test.CoinContract")
+        b.add_output_state(CoinState(value - split), "test.CoinContract")
+    else:
+        b.add_output_state(CoinState(value), "test.CoinContract")
+    b.add_command(CoinCommand("move"), alice[1].public)
+    wtx = b.to_wire_transaction()
+    sigs = [
+        sign_tx_id(alice[1].private, alice[1].public, wtx.id),
+        sign_tx_id(notary[1].private, notary[1].public, wtx.id),
+    ]
+    return SignedTransaction.create(wtx, sigs)
+
+
+# -------------------------------------------------------------- batch check
+
+class TestBatchCheck:
+    def test_rows_mixed_validity(self, notary, alice):
+        stx = issue_tx(notary, alice)
+        rows = stx.signature_triples()
+        good = [(k, s, m) for k, s, m in rows]
+        bad = [(k, s[:-1] + bytes([s[-1] ^ 1]), m) for k, s, m in rows]
+        mask = verify_signature_rows(good + bad, use_device=False)
+        assert mask.tolist() == [True] * len(good) + [False] * len(bad)
+
+    def test_check_transactions_ok(self, notary, alice):
+        stxs = [issue_tx(notary, alice, v) for v in (1, 2, 3)]
+        report = check_transactions(stxs, use_device=False)
+        assert report.ok and report.n_sigs == 3
+
+    def test_check_transactions_bad_sig(self, notary, alice):
+        good = issue_tx(notary, alice, 1)
+        victim = issue_tx(notary, alice, 2)
+        sig = victim.sigs[0]
+        forged = dataclasses.replace(
+            victim,
+            sigs=(dataclasses.replace(
+                sig, signature=sig.signature[:-1] + bytes([sig.signature[-1] ^ 1])
+            ),),
+        )
+        report = check_transactions([good, forged], use_device=False)
+        assert report.results[0] is None
+        assert isinstance(report.results[1], InvalidSignatureError)
+        with pytest.raises(InvalidSignatureError):
+            report.raise_first()
+
+    def test_check_transactions_missing_signer(self, notary, alice):
+        stx = move_tx(notary, alice, issue_tx(notary, alice))
+        # strip the notary signature: required (tx has inputs) but absent
+        stripped = dataclasses.replace(stx, sigs=stx.sigs[:1])
+        report = check_transactions([stripped], use_device=False)
+        assert isinstance(report.results[0], SignaturesMissingException)
+        # ...and allowed_missing covering the notary key makes it pass
+        report = check_transactions(
+            [stripped], [{notary[0].owning_key}], use_device=False
+        )
+        assert report.ok
+
+    @pytest.mark.device
+    def test_check_transactions_on_device(self, notary, alice):
+        stxs = [issue_tx(notary, alice, v) for v in (5, 6)]
+        report = check_transactions(stxs, use_device=True)
+        assert report.ok and report.n_device == 2
+
+
+# ----------------------------------------------------------- batched service
+
+class TestBatchedService:
+    def test_batches_concurrent_requests(self, notary, alice):
+        svc = BatchedVerifierService(
+            window_s=0.05, use_device=False, workers=4
+        )
+        try:
+            chain = [issue_tx(notary, alice, 10)]
+            for _ in range(5):
+                chain.append(move_tx(notary, alice, chain[-1]))
+            states = {
+                StateRef(stx.id, i): ts
+                for stx in chain
+                for i, ts in enumerate(stx.tx.outputs)
+            }
+            futs = [
+                svc.verify_signed(stx, states.get, {notary[0].owning_key})
+                for stx in chain
+            ]
+            for f in futs:
+                assert f.result(timeout=30) is None
+            assert svc.stats["txs"] == 6
+            # the window should have coalesced these into few batches
+            assert svc.stats["batches"] <= 3
+        finally:
+            svc.shutdown()
+
+    def test_failure_propagates(self, notary, alice):
+        svc = BatchedVerifierService(window_s=0.01, use_device=False)
+        try:
+            stx = issue_tx(notary, alice)
+            sig = stx.sigs[0]
+            forged = dataclasses.replace(
+                stx,
+                sigs=(dataclasses.replace(
+                    sig,
+                    signature=sig.signature[:-1] + bytes([sig.signature[-1] ^ 1]),
+                ),),
+            )
+            fut = svc.verify_signed(forged)
+            with pytest.raises(InvalidSignatureError):
+                fut.result(timeout=30)
+        finally:
+            svc.shutdown()
+
+
+# -------------------------------------------------------------- wavefront
+
+class TestWavefront:
+    def test_topological_levels(self):
+        deps = {1: set(), 2: {1}, 3: {1}, 4: {2, 3}, 5: {9}}  # 9 external
+        levels = topological_levels(deps)
+        assert levels[0] == sorted(levels[0]) or set(levels[0]) == {1, 5}
+        assert set(levels[0]) == {1, 5}
+        assert set(levels[1]) == {2, 3}
+        assert levels[2] == [4]
+
+    def test_cycle_detected(self):
+        with pytest.raises(Exception, match="cycle"):
+            topological_levels({1: {2}, 2: {1}})
+
+    def _chain(self, notary, alice, depth):
+        chain = [issue_tx(notary, alice, 64)]
+        for _ in range(depth):
+            chain.append(move_tx(notary, alice, chain[-1]))
+        return chain
+
+    def test_chain_verifies_in_levels(self, notary, alice):
+        chain = self._chain(notary, alice, 6)
+        res = verify_transaction_dag(
+            {s.id: s for s in chain}, use_device=False
+        )
+        assert len(res.levels) == 7  # a pure chain gives one tx per level
+        assert res.order[0] == chain[0].id
+        assert res.n_sigs == 1 + 6 * 2
+
+    def test_diamond_dag_parallel_level(self, notary, alice):
+        root = issue_tx(notary, alice, 100)
+        split = move_tx(notary, alice, root, split=40)
+        a = move_tx(notary, alice, split, idx=0)
+        b = move_tx(notary, alice, split, idx=1)
+        res = verify_transaction_dag(
+            {s.id: s for s in (root, split, a, b)}, use_device=False
+        )
+        assert set(res.levels[2]) == {a.id, b.id}  # the wavefront batch
+
+    def test_double_spend_rejected(self, notary, alice):
+        root = issue_tx(notary, alice, 100)
+        s1 = move_tx(notary, alice, root)
+        s2 = move_tx(notary, alice, root, split=1)  # also spends root:0
+        with pytest.raises(DoubleSpendInDagError):
+            verify_transaction_dag(
+                {s.id: s for s in (root, s1, s2)}, use_device=False
+            )
+
+    def test_unresolved_input_rejected(self, notary, alice):
+        orphan = move_tx(notary, alice, issue_tx(notary, alice))
+        with pytest.raises(UnresolvedStateError):
+            verify_transaction_dag({orphan.id: orphan}, use_device=False)
+
+    def test_external_resolution(self, notary, alice):
+        root = issue_tx(notary, alice, 7)
+        child = move_tx(notary, alice, root)
+        states = {
+            StateRef(root.id, i): ts for i, ts in enumerate(root.tx.outputs)
+        }
+        res = verify_transaction_dag(
+            {child.id: child}, resolve_external=states.get, use_device=False
+        )
+        assert res.order == [child.id]
+
+    def test_contract_rejection_surfaces(self, notary, alice):
+        root = issue_tx(notary, alice, 50)
+        bad = move_tx(notary, alice, root)
+        # tamper: rebuild the move with non-conserving outputs
+        b = TransactionBuilder(notary=notary[0])
+        b._inputs.append(StateRef(root.id, 0))
+        b._ensure_attachment("test.CoinContract")
+        b.add_output_state(CoinState(49), "test.CoinContract")
+        b.add_command(CoinCommand("move"), alice[1].public)
+        wtx = b.to_wire_transaction()
+        bad = SignedTransaction.create(wtx, [
+            sign_tx_id(alice[1].private, alice[1].public, wtx.id),
+            sign_tx_id(notary[1].private, notary[1].public, wtx.id),
+        ])
+        with pytest.raises(Exception, match="not conserved"):
+            verify_transaction_dag(
+                {s.id: s for s in (root, bad)}, use_device=False
+            )
