@@ -1,0 +1,47 @@
+"""Flow framework — layer 3 (SURVEY.md §1, §2.1 flows API, §2.3 state machine).
+
+The reference implements durable app-level protocols as Quasar fibers whose
+*entire JVM stack* is serialized at every suspension point
+(FlowStateMachineImpl.kt:464-510, StateMachineManager.kt:419) — the single
+most JVM-specific mechanism in the codebase (SURVEY.md §5.4). This framework
+keeps the capability (flows survive restarts, resume mid-protocol, deliver
+exactly-once effects) with a TPU-host-native mechanism: **deterministic
+replay over an event-sourced op log**.
+
+A flow is ordinary Python in ``FlowLogic.call()``. Every effectful /
+suspending operation (send, receive, entropy, sleep, subflow boundary) is
+numbered; its result is recorded in a persisted op log in the same sqlite
+transaction that makes its effect durable. On restart the flow re-runs from
+the top and recorded ops replay instantly until the first unrecorded op —
+at which point execution is live again. Sends use message ids derived from
+(flow id, op index) so crash-replayed sends dedupe at the recipient
+(at-least-once transport + dedupe = exactly-once effect, the same guarantee
+the reference gets from checkpoint-commit-rides-the-ack-transaction).
+"""
+
+from .api import (
+    FlowException,
+    FlowLogic,
+    FlowSession,
+    InitiatedBy,
+    ProgressTracker,
+    UntrustworthyData,
+)
+from .checkpoints import CheckpointStorage
+from .engine import FlowHandle, StateMachineManager
+from .sessions import (
+    SessionConfirm,
+    SessionData,
+    SessionEnd,
+    SessionInit,
+    SessionReject,
+)
+
+__all__ = [
+    "FlowException", "FlowLogic", "FlowSession", "InitiatedBy",
+    "ProgressTracker", "UntrustworthyData",
+    "CheckpointStorage",
+    "FlowHandle", "StateMachineManager",
+    "SessionConfirm", "SessionData", "SessionEnd", "SessionInit",
+    "SessionReject",
+]

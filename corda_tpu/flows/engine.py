@@ -1,0 +1,538 @@
+"""The flow state machine engine.
+
+Role parity with StateMachineManager + FlowStateMachineImpl
+(node/.../services/statemachine/StateMachineManager.kt:76-565,
+FlowStateMachineImpl.kt:40-510), mechanism re-designed for deterministic
+replay (package docstring):
+
+- every flow runs on its own host thread, executing ``FlowLogic.call()``
+  from the top;
+- each effectful op is numbered; its result is recorded via
+  ``CheckpointStorage.record_op`` the moment it completes;
+- on restore, recorded ops replay instantly (re-registering sessions,
+  re-sending messages under their original deterministic ids — recipients
+  dedupe), and execution turns live at the first unrecorded op;
+- inbound session messages are acked only once consumed into the op log, so
+  an at-least-once transport (messaging.queue) yields exactly-once effects —
+  the guarantee the reference gets from checkpoint-commit riding the ack
+  transaction (StateMachineManager.kt:548).
+
+Session ids are derived ``sha256(flow_id ‖ op_index)`` so a crash-replayed
+open reuses the same id.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+from corda_tpu.ledger import Party
+from corda_tpu.serialization import deserialize, serialize
+
+from .api import (
+    FlowException,
+    FlowLogic,
+    FlowSession,
+    class_path,
+    load_class,
+    responder_for,
+)
+from .checkpoints import CheckpointStorage
+from .sessions import (
+    SESSION_TOPIC,
+    SessionConfirm,
+    SessionData,
+    SessionEnd,
+    SessionInit,
+    SessionReject,
+)
+
+
+class FlowKilledException(Exception):
+    pass
+
+
+class FlowHandle:
+    def __init__(self, flow_id: str, result: Future):
+        self.flow_id = flow_id
+        self.result = result
+
+    def __repr__(self):
+        return f"FlowHandle({self.flow_id})"
+
+
+def _sid_for(flow_id: str, op_index: int) -> int:
+    h = hashlib.sha256(f"{flow_id}:{op_index}".encode()).digest()
+    return int.from_bytes(h[:8], "big") | 1  # nonzero
+
+
+class _SessionState:
+    __slots__ = ("local_sid", "peer", "peer_sid", "inbound", "executor",
+                 "rejected")
+
+    def __init__(self, local_sid: int, peer: Party, executor):
+        self.local_sid = local_sid
+        self.peer = peer
+        self.peer_sid: int | None = None
+        self.inbound: deque = deque()  # ("data"|"end", payload/error, msg_id, ack)
+        self.executor = executor
+        self.rejected: str | None = None
+
+
+class _FlowExecutor:
+    def __init__(self, smm: "StateMachineManager", flow_id: str,
+                 oplog: list, flow: FlowLogic | None,
+                 responder_cls: type | None = None,
+                 init_info: dict | None = None):
+        self.smm = smm
+        self.flow_id = flow_id
+        self.oplog = oplog
+        self.flow = flow                      # None for responders until built
+        self.responder_cls = responder_cls
+        self.init_info = init_info            # live responder spawn only
+        self.op_counter = 0
+        self.result: Future = Future()
+        self.sessions: list[int] = []         # local sids owned
+        self.thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ op core
+    def _do_op(self, effect, replay=None):
+        idx = self.op_counter
+        self.op_counter += 1
+        if idx < len(self.oplog):
+            rec = self.oplog[idx]
+            if replay is not None:
+                replay(idx, rec)
+            return rec
+        rec = effect(idx)
+        self.smm.checkpoints.record_op(self.flow_id, idx, rec)
+        return rec
+
+    # ------------------------------------------------------------ ops
+    def op_entropy(self, n: int) -> bytes:
+        return self._do_op(lambda idx: secrets.token_bytes(n))
+
+    def op_record(self, fn):
+        return self._do_op(lambda idx: fn())
+
+    def op_sleep(self, seconds: float) -> None:
+        rec = self._do_op(lambda idx: {"deadline": time.time() + seconds})
+        remaining = rec["deadline"] - time.time()
+        if remaining > 0:
+            self.smm.wait_or_killed(lambda: False, timeout=remaining)
+
+    def op_send(self, local_sid: int, obj) -> None:
+        payload = serialize(obj)
+
+        def effect(idx):
+            # publish-then-record: a crash in between replays this op live
+            # and re-publishes under the same deterministic msg id, which
+            # the recipient's consumed-set dedupes. A *recorded* send was
+            # durably enqueued, so replay never re-sends.
+            self._send_data(local_sid, payload, idx)
+            return {"i": idx}
+
+        self._do_op(effect)
+
+    def _send_data(self, local_sid: int, payload: bytes, idx: int):
+        sess = self.smm.session(local_sid)
+        if sess.peer_sid is None:
+            raise FlowException("session not confirmed")
+        self.smm.send_to(
+            sess.peer, SessionData(sess.peer_sid, payload),
+            msg_id=f"{self.flow_id}:op{idx}",
+        )
+
+    def op_receive(self, local_sid: int):
+        def effect(idx):
+            sess = self.smm.session(local_sid)
+            item = self.smm.wait_or_killed(
+                lambda: sess.inbound[0] if sess.inbound else None
+            )
+            sess.inbound.popleft()
+            kind, body, msg_id, ack = item
+            if kind == "end":
+                rec = {"end": body if body else "peer ended session"}
+            else:
+                rec = {"payload": body, "msg_id": msg_id}
+            # record BEFORE ack: consumed-and-durable, then delete from queue
+            self.smm.checkpoints.record_op(self.flow_id, idx, rec)
+            if msg_id:
+                self.smm.mark_consumed(msg_id)
+            if ack:
+                ack()
+            return rec
+
+        idx = self.op_counter
+        self.op_counter += 1
+        if idx < len(self.oplog):
+            rec = self.oplog[idx]
+        else:
+            rec = effect(idx)
+            # effect already recorded (pre-ack); skip double record
+        if "end" in rec:
+            raise FlowException(rec["end"])
+        return deserialize(rec["payload"])
+
+    def open_session(self, flow: FlowLogic, party: Party) -> FlowSession:
+        def effect(idx):
+            sid = _sid_for(self.flow_id, idx)
+            sess = self.smm.register_session(sid, party, self)
+            self.smm.send_to(
+                party,
+                SessionInit(sid, class_path(type(flow)), b""),
+                msg_id=f"{self.flow_id}:op{idx}",
+            )
+            self.smm.wait_or_killed(
+                lambda: sess.peer_sid is not None or sess.rejected is not None
+            )
+            if sess.rejected is not None:
+                raise FlowException(f"session rejected: {sess.rejected}")
+            return {"sid": sid, "peer_sid": sess.peer_sid}
+
+        def replay(idx, rec):
+            sess = self.smm.register_session(rec["sid"], party, self)
+            sess.peer_sid = rec["peer_sid"]
+
+        rec = self._do_op(effect, replay)
+        self.sessions.append(rec["sid"])
+        return FlowSession(self, rec["sid"], party)
+
+    def op_accept_session(self) -> FlowSession:
+        """Responder op 0: bind the initiator's session."""
+
+        def effect(idx):
+            info = self.init_info
+            sid = _sid_for(self.flow_id, idx)
+            sess = self.smm.register_session(sid, info["peer"], self)
+            sess.peer_sid = info["peer_sid"]
+            self.smm.send_to(
+                info["peer"],
+                SessionConfirm(info["peer_sid"], sid),
+                msg_id=f"{self.flow_id}:confirm",
+            )
+            return {"sid": sid, "peer_sid": info["peer_sid"],
+                    "peer": info["peer"]}
+
+        def replay(idx, rec):
+            sess = self.smm.register_session(rec["sid"], rec["peer"], self)
+            sess.peer_sid = rec["peer_sid"]
+            self.smm.send_to(
+                rec["peer"], SessionConfirm(rec["peer_sid"], rec["sid"]),
+                msg_id=f"{self.flow_id}:confirm",
+            )
+
+        rec = self._do_op(effect, replay)
+        self.sessions.append(rec["sid"])
+        return FlowSession(self, rec["sid"], rec["peer"])
+
+    def op_end_session(self, local_sid: int, error: str) -> None:
+        def effect(idx):
+            sess = self.smm.session(local_sid)
+            if sess.peer_sid is not None:
+                self.smm.send_to(
+                    sess.peer, SessionEnd(sess.peer_sid, error),
+                    msg_id=f"{self.flow_id}:op{idx}",
+                )
+            return {"i": idx}
+
+        self._do_op(effect)
+
+    def op_wait_ledger_commit(self, tx_id):
+        def effect(idx):
+            stx = self.smm.wait_or_killed(
+                lambda: self.smm.lookup_committed(tx_id)
+            )
+            return {"stx": stx}
+
+        rec = self._do_op(effect)
+        return rec["stx"]
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        self.thread = threading.Thread(
+            target=self._run, name=f"flow-{self.flow_id[:8]}", daemon=True
+        )
+        self.thread.start()
+
+    def _run(self):
+        try:
+            if self.responder_cls is not None:
+                session = self.op_accept_session()
+                self.flow = self.responder_cls(session)
+            self.flow._executor = self
+            self.flow.services = self.smm.services
+            self.flow.our_identity = self.smm.our_identity
+            result = self.flow.call()
+            self._finish(result, None)
+        except FlowKilledException:
+            self.result.cancel()
+        except Exception as e:  # flow failure → future + peers
+            self._finish(None, e)
+
+    def _finish(self, result, error):
+        error_msg = "" if error is None else f"{type(error).__name__}: {error}"
+        if error is not None and not isinstance(error, FlowException):
+            # non-FlowException internals are not leaked to peers, matching
+            # the reference's error propagation rules
+            error_msg = "counterparty flow failed"
+        for sid in self.sessions:
+            try:
+                sess = self.smm.session(sid)
+                if sess.peer_sid is not None:
+                    self.smm.send_to(
+                        sess.peer, SessionEnd(sess.peer_sid, error_msg),
+                        msg_id=f"{self.flow_id}:end{sid}",
+                    )
+            except Exception:
+                pass
+        self.smm.flow_finished(self)
+        if error is None:
+            self.result.set_result(result)
+        else:
+            self.result.set_exception(error)
+
+
+class StateMachineManager:
+    """Owns all running flows of one node; dispatches session messages;
+    restores persisted flows at startup (reference:
+    StateMachineManager.kt:238-265 restoreFibersFromCheckpoints)."""
+
+    def __init__(
+        self,
+        messaging,
+        checkpoints: CheckpointStorage,
+        our_identity: Party,
+        party_resolver=None,
+        services=None,
+    ):
+        self.messaging = messaging
+        self.checkpoints = checkpoints
+        self.our_identity = our_identity
+        self.services = services
+        self._party_resolver = party_resolver or (lambda name: None)
+        self._lock = threading.Condition()
+        self._sessions: dict[int, _SessionState] = {}
+        self._flows: dict[str, _FlowExecutor] = {}
+        self._consumed_msg_ids: set[str] = set()
+        self._committed = {}  # tx_id -> SignedTransaction (ledger hook)
+        self._closed = False
+        messaging.add_handler(SESSION_TOPIC, self._on_message)
+
+    # ------------------------------------------------------------ public
+    def start_flow(self, flow: FlowLogic, flow_id: str | None = None) -> FlowHandle:
+        flow_id = flow_id or secrets.token_hex(16)
+        blob = serialize({
+            "cls": class_path(type(flow)),
+            "fields": flow.flow_fields(),
+            "responder": False,
+        })
+        self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
+                                  time.time())
+        ex = _FlowExecutor(self, flow_id, [], flow)
+        with self._lock:
+            self._flows[flow_id] = ex
+        ex.start()
+        return FlowHandle(flow_id, ex.result)
+
+    def restore(self) -> list[FlowHandle]:
+        """Re-spawn every checkpointed flow; replay brings each to its live
+        point."""
+        handles = []
+        for flow_id, blob, _our, _ts in self.checkpoints.all_flows():
+            with self._lock:
+                if flow_id in self._flows:
+                    continue
+            meta = deserialize(blob)
+            oplog = self.checkpoints.load_oplog(flow_id)
+            # reconstruct consumed-message dedupe set from receive records
+            for rec in oplog:
+                if isinstance(rec, dict) and "msg_id" in rec:
+                    self._consumed_msg_ids.add(rec["msg_id"])
+            cls = load_class(meta["cls"])
+            if meta["responder"]:
+                ex = _FlowExecutor(self, flow_id, oplog, None,
+                                   responder_cls=cls)
+            else:
+                flow = cls.from_flow_fields(meta["fields"])
+                ex = _FlowExecutor(self, flow_id, oplog, flow)
+            with self._lock:
+                self._flows[flow_id] = ex
+            ex.start()
+            handles.append(FlowHandle(flow_id, ex.result))
+        return handles
+
+    def flows_in_progress(self) -> list[str]:
+        with self._lock:
+            return list(self._flows)
+
+    def mark_consumed(self, msg_id: str) -> None:
+        with self._lock:
+            self._consumed_msg_ids.add(msg_id)
+
+    def notify_ledger_commit(self, stx) -> None:
+        with self._lock:
+            self._committed[stx.id] = stx
+            self._lock.notify_all()
+
+    def lookup_committed(self, tx_id):
+        # storage-backed lookup first (survives restarts), then the
+        # in-memory feed
+        if self.services is not None:
+            stored = self.services.validated_transactions.get(tx_id)
+            if stored is not None:
+                return stored
+        return self._committed.get(tx_id)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+        self.messaging.stop()
+
+    # ------------------------------------------------------------ internals
+    def session(self, sid: int) -> _SessionState:
+        with self._lock:
+            sess = self._sessions.get(sid)
+        if sess is None:
+            raise FlowException(f"unknown session {sid}")
+        return sess
+
+    def register_session(self, sid: int, peer: Party, executor) -> _SessionState:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None or sess.executor is not executor:
+                sess = _SessionState(sid, peer, executor)
+                self._sessions[sid] = sess
+            return sess
+
+    def send_to(self, party: Party, obj, *, msg_id: str) -> None:
+        self.messaging.send(str(party.name), SESSION_TOPIC, serialize(obj),
+                            msg_id=msg_id)
+
+    def wait_or_killed(self, predicate, timeout: float | None = None):
+        """Block until predicate() returns non-None/True; FlowKilled on
+        shutdown. Runs under the SMM lock."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                if self._closed:
+                    raise FlowKilledException()
+                val = predicate()
+                if val not in (None, False):
+                    return val
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return None
+                    self._lock.wait(timeout=remaining)
+                else:
+                    self._lock.wait(timeout=0.5)
+
+    def flow_finished(self, ex: _FlowExecutor) -> None:
+        self.checkpoints.remove_flow(ex.flow_id)
+        with self._lock:
+            self._flows.pop(ex.flow_id, None)
+            for sid in ex.sessions:
+                self._sessions.pop(sid, None)
+
+    # ------------------------------------------------------------ dispatch
+    def _on_message(self, msg, ack=None) -> None:
+        with self._lock:
+            if msg.msg_id in self._consumed_msg_ids:
+                if ack:
+                    ack()
+                return
+        obj = deserialize(msg.payload)
+        if isinstance(obj, SessionInit):
+            self._handle_init(msg, obj, ack)
+        elif isinstance(obj, SessionConfirm):
+            with self._lock:
+                sess = self._sessions.get(obj.initiator_session_id)
+                if sess is not None:
+                    sess.peer_sid = obj.responder_session_id
+                    self._lock.notify_all()
+            if ack:
+                ack()
+        elif isinstance(obj, SessionReject):
+            with self._lock:
+                sess = self._sessions.get(obj.initiator_session_id)
+                if sess is not None:
+                    sess.rejected = obj.error
+                    self._lock.notify_all()
+            if ack:
+                ack()
+        elif isinstance(obj, SessionData):
+            self._buffer(obj.recipient_session_id, "data", obj.payload,
+                         msg.msg_id, ack)
+        elif isinstance(obj, SessionEnd):
+            self._buffer(obj.recipient_session_id, "end", obj.error,
+                         msg.msg_id, ack)
+
+    def _buffer(self, sid: int, kind: str, body, msg_id: str, ack) -> None:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                # session may not be re-registered yet during replay; park
+                # by leaving unacked (broker redelivers) or drop on mock
+                return
+            sess.inbound.append((kind, body, msg_id, ack))
+            self._lock.notify_all()
+
+    def _handle_init(self, msg, init: SessionInit, ack) -> None:
+        flow_id = f"resp-{msg.msg_id}"
+        if not self.checkpoints.mark_init_processed(msg.msg_id, flow_id):
+            # duplicate Init (crash-replayed by the initiator). If our
+            # responder is still running, its Confirm may have been lost —
+            # re-send it (dedupe makes it harmless); a completed responder
+            # means the initiator cannot still be waiting on Confirm.
+            with self._lock:
+                ex = self._flows.get(flow_id)
+                resend = None
+                if ex is not None:
+                    for sid in ex.sessions:
+                        sess = self._sessions.get(sid)
+                        if sess is not None and sess.peer_sid == init.initiator_session_id:
+                            resend = (sess.peer, SessionConfirm(sess.peer_sid, sid),
+                                      f"{flow_id}:confirm")
+            if resend is not None:
+                self.send_to(resend[0], resend[1], msg_id=resend[2])
+            if ack:
+                ack()
+            return
+        responder = responder_for(init.flow_name)
+        peer = self._party_resolver(msg.sender)
+        if responder is None or peer is None:
+            reason = (
+                f"no responder registered for {init.flow_name}"
+                if responder is None else f"unknown peer {msg.sender}"
+            )
+            self.messaging.send(
+                msg.sender, SESSION_TOPIC,
+                serialize(SessionReject(init.initiator_session_id, reason)),
+                msg_id=f"reject-{msg.msg_id}",
+            )
+            if ack:
+                ack()
+            return
+        blob = serialize({
+            "cls": class_path(responder),
+            "fields": {},
+            "responder": True,
+        })
+        self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
+                                  time.time())
+        ex = _FlowExecutor(
+            self, flow_id, [], None, responder_cls=responder,
+            init_info={"peer": peer, "peer_sid": init.initiator_session_id,
+                       "first": init.first_payload},
+        )
+        with self._lock:
+            self._flows[flow_id] = ex
+        if ack:
+            ack()  # responder is durable; Init is consumed
+        ex.start()

@@ -1,0 +1,79 @@
+"""Session wire messages.
+
+Parity with the reference's SessionMessage hierarchy
+(node/.../services/statemachine/SessionMessage.kt via
+StateMachineManager.kt:288-353): Init opens a session against a registered
+responder flow, Confirm/Reject answer it, Data carries CBE payloads, End
+closes. All travel topic ``platform.session`` on the messaging layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.serialization import register_custom
+
+SESSION_TOPIC = "platform.session"
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionInit:
+    initiator_session_id: int
+    flow_name: str            # registered initiating flow name
+    first_payload: bytes      # optional piggybacked first send (b"" if none)
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionConfirm:
+    initiator_session_id: int
+    responder_session_id: int
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionReject:
+    initiator_session_id: int
+    error: str
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionData:
+    recipient_session_id: int
+    payload: bytes
+
+
+@dataclasses.dataclass(frozen=True)
+class SessionEnd:
+    recipient_session_id: int
+    error: str                # "" = normal end
+
+
+register_custom(
+    SessionInit, "flows.SessionInit",
+    to_fields=lambda m: {
+        "sid": m.initiator_session_id, "flow": m.flow_name,
+        "first": m.first_payload,
+    },
+    from_fields=lambda d: SessionInit(d["sid"], d["flow"], d["first"]),
+)
+register_custom(
+    SessionConfirm, "flows.SessionConfirm",
+    to_fields=lambda m: {
+        "isid": m.initiator_session_id, "rsid": m.responder_session_id,
+    },
+    from_fields=lambda d: SessionConfirm(d["isid"], d["rsid"]),
+)
+register_custom(
+    SessionReject, "flows.SessionReject",
+    to_fields=lambda m: {"sid": m.initiator_session_id, "error": m.error},
+    from_fields=lambda d: SessionReject(d["sid"], d["error"]),
+)
+register_custom(
+    SessionData, "flows.SessionData",
+    to_fields=lambda m: {"sid": m.recipient_session_id, "payload": m.payload},
+    from_fields=lambda d: SessionData(d["sid"], d["payload"]),
+)
+register_custom(
+    SessionEnd, "flows.SessionEnd",
+    to_fields=lambda m: {"sid": m.recipient_session_id, "error": m.error},
+    from_fields=lambda d: SessionEnd(d["sid"], d["error"]),
+)

@@ -202,10 +202,14 @@ class WireTransaction:
             time_window=self.time_window,
         )
 
-    def out_ref(self, index: int) -> StateRef:
+    def out_ref(self, index: int):
+        """StateAndRef of output ``index`` (same shape as
+        LedgerTransaction.out_ref)."""
+        from .states import StateAndRef
+
         if not (0 <= index < len(self.outputs)):
             raise IndexError(f"output index {index} out of range")
-        return StateRef(self.id, index)
+        return StateAndRef(self.outputs[index], StateRef(self.id, index))
 
     def __str__(self):
         return f"WireTransaction({self.id})"

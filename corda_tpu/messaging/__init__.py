@@ -1,0 +1,39 @@
+"""Messaging — layer 4 (SURVEY.md §1, §2.10).
+
+The reference runs every traffic class (P2P, RPC, out-of-process
+verification, network map) over one embedded Apache Artemis broker per node,
+leaning on its durability, ack/redelivery, and competing-consumer semantics
+(ArtemisMessagingServer.kt:92-376, NodeMessagingClient.kt, VerifierApi.kt).
+
+This package provides the same primitives TPU-host-natively:
+
+- ``DurableQueueBroker`` — named durable queues with at-least-once delivery:
+  explicit ack, visibility-timeout redelivery, competing consumers,
+  publisher dedupe (``queue.py``). Backed by an append-only sqlite log
+  (the same role H2 + Artemis journals play); an optional C++ engine can
+  slot under the identical interface.
+- ``InMemoryMessagingNetwork`` — the deterministic in-process fake used by
+  the MockNetwork test tier (reference: InMemoryMessagingNetwork.kt:47),
+  with manual ``pump`` stepping for race-free protocol tests.
+- ``MessagingClient`` protocol — the node-facing API (send/subscribe/ack),
+  identical over the in-memory fake and the broker.
+"""
+
+from .queue import DurableQueueBroker, Message, QueueClosedError
+from .network import (
+    InMemoryMessagingNetwork,
+    MessagingClient,
+    PeerHandle,
+)
+from .broker_client import BrokerMessagingClient, p2p_queue
+
+__all__ = [
+    "DurableQueueBroker",
+    "Message",
+    "QueueClosedError",
+    "InMemoryMessagingNetwork",
+    "MessagingClient",
+    "PeerHandle",
+    "BrokerMessagingClient",
+    "p2p_queue",
+]

@@ -1,0 +1,204 @@
+"""In-process messaging network + the client protocol.
+
+The deterministic fake-transport tier (reference:
+testing/node-driver/.../InMemoryMessagingNetwork.kt:47 and the MockNetwork
+around it, MockNode.kt:61-80): every node gets an inbound queue in one
+process; delivery happens only when the network is *pumped* — either one
+message at a time (``pump(block=False)`` — race-free protocol stepping) or
+by a background pump thread (``start_pumping``). Per-recipient dedupe by
+message id mirrors the processed-message table of
+NodeMessagingClient.kt:187,429-439.
+
+``MessagingClient`` is the node-facing API; production transports (the
+durable broker of queue.py bridged over TCP/gRPC between hosts) implement
+the same surface, so flow/session code is transport-blind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+
+from .queue import Message
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerHandle:
+    """Network address of a node (reference: SingleMessageRecipient)."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class TopicMessage:
+    topic: str
+    payload: bytes
+    sender: str
+    msg_id: str
+
+
+class MessagingClient:
+    """Topic-addressed node messaging (reference: MessagingService,
+    node/.../services/messaging/Messaging.kt)."""
+
+    @property
+    def me(self) -> PeerHandle:
+        raise NotImplementedError
+
+    def send(
+        self, recipient: PeerHandle | str, topic: str, payload: bytes,
+        *, msg_id: str | None = None,
+    ) -> str:
+        raise NotImplementedError
+
+    def add_handler(self, topic: str, callback) -> None:
+        """callback(TopicMessage) runs on delivery. One handler per topic
+        handles the platform protocols; extra handlers fan out."""
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        pass
+
+
+class _InMemoryNode(MessagingClient):
+    def __init__(self, network: "InMemoryMessagingNetwork", name: str):
+        self._network = network
+        self._name = name
+        self._handlers: dict[str, list] = {}
+        self._inbox: deque[TopicMessage] = deque()
+        self._seen: set[str] = set()
+        self._lock = threading.Lock()
+        self.running = True
+
+    @property
+    def me(self) -> PeerHandle:
+        return PeerHandle(self._name)
+
+    def send(self, recipient, topic, payload, *, msg_id=None) -> str:
+        name = recipient.name if isinstance(recipient, PeerHandle) else recipient
+        msg_id = msg_id or Message.fresh_id()
+        self._network._deliver(
+            name, TopicMessage(topic, payload, self._name, msg_id)
+        )
+        return msg_id
+
+    def add_handler(self, topic, callback) -> None:
+        with self._lock:
+            self._handlers.setdefault(topic, []).append(callback)
+
+    def _enqueue(self, msg: TopicMessage) -> None:
+        with self._lock:
+            if not self.running or msg.msg_id in self._seen:
+                return  # dedupe / dropped-after-stop
+            self._seen.add(msg.msg_id)
+            self._inbox.append(msg)
+
+    def _pump_one(self) -> bool:
+        with self._lock:
+            if not self._inbox:
+                return False
+            msg = self._inbox.popleft()
+            handlers = list(self._handlers.get(msg.topic, ()))
+        if not handlers:
+            # undeliverable: keep it pending until a handler registers
+            # (the reference parks messages for unknown topics the same way)
+            with self._lock:
+                self._inbox.append(msg)
+            return False
+        for h in handlers:
+            h(msg)
+        return True
+
+    def stop(self) -> None:
+        with self._lock:
+            self.running = False
+
+
+class InMemoryMessagingNetwork:
+    """The shared fake transport. Deterministic: messages deliver only on
+    ``pump``; round-robin over nodes keeps ordering reproducible."""
+
+    def __init__(self):
+        self._nodes: dict[str, _InMemoryNode] = {}
+        self._lock = threading.Lock()
+        self._pump_thread: threading.Thread | None = None
+        self._pumping = threading.Event()
+        self.dropped: list[tuple[str, TopicMessage]] = []
+
+    def create_node(self, name: str) -> MessagingClient:
+        with self._lock:
+            if name in self._nodes:
+                raise ValueError(f"node name {name!r} already on network")
+            node = _InMemoryNode(self, name)
+            self._nodes[name] = node
+            return node
+
+    def _deliver(self, recipient: str, msg: TopicMessage) -> None:
+        with self._lock:
+            node = self._nodes.get(recipient)
+        if node is None or not node.running:
+            self.dropped.append((recipient, msg))
+            return
+        node._enqueue(msg)
+        if self._pumping.is_set():
+            pass  # background pump thread will pick it up
+
+    # ------------------------------------------------------------ pumping
+    def pump(self) -> bool:
+        """Deliver at most one message per node; True if anything moved.
+        The manual deterministic stepper (reference: pumpReceive)."""
+        moved = False
+        with self._lock:
+            nodes = list(self._nodes.values())
+        for node in nodes:
+            moved |= node._pump_one()
+        return moved
+
+    def run_until_quiescent(self, max_rounds: int = 10_000) -> int:
+        """Pump until no messages move; returns rounds used."""
+        rounds = 0
+        while self.pump():
+            rounds += 1
+            if rounds >= max_rounds:
+                raise RuntimeError("network did not quiesce (message loop?)")
+        return rounds
+
+    def start_pumping(self, interval_s: float = 0.001) -> None:
+        """Background delivery for integration-style tests."""
+        if self._pump_thread is not None:
+            return
+        self._pumping.set()
+
+        def loop():
+            while self._pumping.is_set():
+                if not self.pump():
+                    time.sleep(interval_s)
+
+        self._pump_thread = threading.Thread(
+            target=loop, name="mock-net-pump", daemon=True
+        )
+        self._pump_thread.start()
+
+    def stop_pumping(self) -> None:
+        self._pumping.clear()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5)
+            self._pump_thread = None
+
+    def stop_node(self, name: str) -> None:
+        """Simulate node death: in-flight messages to it drop."""
+        with self._lock:
+            node = self._nodes.get(name)
+        if node is not None:
+            node.stop()
+
+    def restart_node(self, name: str) -> MessagingClient:
+        """Bring a stopped node back with an empty inbox (its durable state
+        lives in the node's own persistence, not the transport)."""
+        with self._lock:
+            old = self._nodes.pop(name, None)
+        if old is not None:
+            old.stop()
+        return self.create_node(name)

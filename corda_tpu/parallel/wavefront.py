@@ -134,6 +134,13 @@ def verify_transaction_dag(
                         raise DoubleSpendInDagError(ref, tid)
                     consumed.add(ref)
 
+            # structural input resolution is not optional: every input must
+            # resolve inside the DAG or via resolve_external even when
+            # contract semantics are skipped
+            for tid in level:
+                for ref in stxs[tid].inputs:
+                    resolve(ref, tid)
+
             if check_contracts:
                 def run_contracts(tid):
                     stx = stxs[tid]
@@ -154,7 +161,9 @@ def verify_transaction_dag(
             order.extend(level)
     finally:
         if pool is not None:
-            pool.shutdown(wait=False)
+            # wait so no background thread touches the caller's resolver
+            # after we return/raise
+            pool.shutdown(wait=True, cancel_futures=True)
 
     return DagVerifyResult(order, levels, n_sigs, consumed)
 

@@ -25,6 +25,19 @@ class VerificationError(Exception):
     pass
 
 
+def _complete(future: Future, error: Exception | None = None) -> None:
+    """Complete a future, tolerating caller-side cancellation (a cancelled
+    future raising InvalidStateError must not abort completion of the rest
+    of a batch)."""
+    try:
+        if error is None:
+            future.set_result(None)
+        else:
+            future.set_exception(error)
+    except Exception:
+        pass
+
+
 class TransactionVerifierService:
     """verify() returns a Future completing when verification finishes
     (reference: TransactionVerifierService.kt:10 returning CordaFuture)."""
@@ -151,7 +164,7 @@ class BatchedVerifierService(TransactionVerifierService):
             )
         except Exception as e:
             for p in batch:
-                p.future.set_exception(e)
+                _complete(p.future, error=e)
             return
         self.stats["batches"] += 1
         self.stats["txs"] += len(batch)
@@ -160,15 +173,15 @@ class BatchedVerifierService(TransactionVerifierService):
 
         def finish(p: _Pending, sig_err):
             if sig_err is not None:
-                p.future.set_exception(sig_err)
+                _complete(p.future, error=sig_err)
                 return
             try:
                 if p.resolve_state is not None:
                     ltx = p.stx.tx.to_ledger_transaction(p.resolve_state)
                     ltx.verify()
-                p.future.set_result(None)
+                _complete(p.future)
             except Exception as e:
-                p.future.set_exception(e)
+                _complete(p.future, error=e)
 
         for p, err in zip(batch, report.results):
             try:
