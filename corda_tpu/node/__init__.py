@@ -1,0 +1,53 @@
+"""Node services layer (L5/L6/L9 of SURVEY.md §1).
+
+The capability surface of the reference node runtime
+(node/src/main/kotlin/net/corda/node/services/): a ``ServiceHub`` service
+locator composing vault, identity, key-management, attachment, network-map,
+transaction-storage, scheduler and verifier services, plus typed
+configuration and a metrics registry — re-designed host-side (SQLite-backed
+persistence, callback feeds instead of Rx Observables) around the TPU
+verification tier.
+"""
+
+from .config import (
+    NodeConfiguration,
+    NotaryConfig,
+    RaftConfig,
+    BFTConfig,
+    VerifierType,
+    load_config,
+)
+from .identity import IdentityService, KeyManagementService
+from .monitoring import Counter, Gauge, Meter, MetricRegistry, Timer
+from .network_map import (
+    NetworkMapCache,
+    NetworkMapClient,
+    NetworkMapServer,
+    NodeInfo,
+)
+from .scheduler import NodeSchedulerService, ScheduledActivity, SchedulableState
+from .services import ServiceHub, TransactionResolutionError
+from .storage import Attachment, AttachmentStorage, DBTransactionStorage
+from .vault import (
+    NodeVaultService,
+    PageSpecification,
+    QueryCriteria,
+    Sort,
+    SoftLockError,
+    StateStatus,
+    Vault,
+    VaultUpdate,
+)
+
+__all__ = [
+    "NodeConfiguration", "NotaryConfig", "RaftConfig", "BFTConfig",
+    "VerifierType", "load_config",
+    "IdentityService", "KeyManagementService",
+    "Counter", "Gauge", "Meter", "MetricRegistry", "Timer",
+    "NetworkMapCache", "NetworkMapClient", "NetworkMapServer", "NodeInfo",
+    "NodeSchedulerService", "ScheduledActivity", "SchedulableState",
+    "ServiceHub", "TransactionResolutionError",
+    "Attachment", "AttachmentStorage", "DBTransactionStorage",
+    "NodeVaultService", "PageSpecification", "QueryCriteria", "Sort",
+    "SoftLockError", "StateStatus", "Vault", "VaultUpdate",
+]

@@ -1,0 +1,171 @@
+"""Metrics registry.
+
+Parity with the reference's Codahale/Dropwizard ``MonitoringService``
+(node/.../services/api/MonitoringService.kt:11) and the verification
+metrics seam (OutOfProcessTransactionVerifierService.kt:37-48 —
+duration timer, success/failure meters, in-flight gauge). Plain-Python,
+thread-safe, snapshot-able for the RPC/shell observability surface.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    def dec(self, n: int = 1) -> None:
+        self.inc(-n)
+
+    @property
+    def count(self) -> int:
+        return self._v
+
+    def snapshot(self) -> dict:
+        return {"type": "counter", "count": self._v}
+
+
+class Gauge:
+    """A gauge reads a callable at snapshot time (in-flight style)."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    @property
+    def value(self):
+        return self._fn()
+
+    def snapshot(self) -> dict:
+        return {"type": "gauge", "value": self._fn()}
+
+
+class Meter:
+    """Event rate: total count + exponentially-weighted 1-minute rate."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._count = 0
+        self._rate = 0.0
+        self._last = clock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            now = self._clock()
+            dt = now - self._last
+            if dt > 0:
+                alpha = 1.0 - math.exp(-dt / 60.0)
+                inst = n / dt
+                self._rate += alpha * (inst - self._rate)
+                self._last = now
+            self._count += n
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def one_minute_rate(self) -> float:
+        return self._rate
+
+    def snapshot(self) -> dict:
+        return {"type": "meter", "count": self._count, "m1_rate": self._rate}
+
+
+class Timer:
+    """Duration histogram (count / mean / min / max / last)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+        self._min = math.inf
+        self._max = 0.0
+        self._last = 0.0
+
+    class _Ctx:
+        def __init__(self, timer):
+            self._timer = timer
+
+        def __enter__(self):
+            self._t0 = self._timer._clock()
+            return self
+
+        def __exit__(self, *exc):
+            self._timer.update(self._timer._clock() - self._t0)
+            return False
+
+    def time(self) -> "_Ctx":
+        return Timer._Ctx(self)
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self._count += 1
+            self._total += seconds
+            self._min = min(self._min, seconds)
+            self._max = max(self._max, seconds)
+            self._last = seconds
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def mean(self) -> float:
+        return self._total / self._count if self._count else 0.0
+
+    def snapshot(self) -> dict:
+        return {
+            "type": "timer",
+            "count": self._count,
+            "mean_s": self.mean,
+            "min_s": 0.0 if math.isinf(self._min) else self._min,
+            "max_s": self._max,
+            "last_s": self._last,
+        }
+
+
+class MetricRegistry:
+    """Named metric store (reference: com.codahale.metrics.MetricRegistry
+    held by MonitoringService)."""
+
+    def __init__(self):
+        self._metrics: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, factory):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def meter(self, name: str) -> Meter:
+        return self._get(name, Meter)
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def gauge(self, name: str, fn=None) -> Gauge:
+        if fn is not None:
+            with self._lock:
+                self._metrics[name] = Gauge(fn)
+        return self._metrics[name]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {k: v.snapshot() for k, v in sorted(self._metrics.items())}

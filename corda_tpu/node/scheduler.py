@@ -1,0 +1,107 @@
+"""Scheduler: time-triggered state activities.
+
+Parity with the reference's node/.../services/events/
+``NodeSchedulerService`` (NodeSchedulerService.kt:55-170 — earliest-due
+scheduled state wins; rescheduled on vault changes) and
+``ScheduledActivityObserver`` (watches vault updates for
+``SchedulableState`` outputs). Virtual-clock friendly: inject a clock and
+call ``pump()`` for deterministic tests (the reference's TestClock idiom).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import threading
+import time
+from typing import Protocol, runtime_checkable
+
+from corda_tpu.ledger import StateRef
+
+
+@runtime_checkable
+class SchedulableState(Protocol):
+    """(reference: core SchedulableState.nextScheduledActivity)."""
+
+    def next_scheduled_activity(self, ref: StateRef) -> "ScheduledActivity | None":
+        ...
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class ScheduledActivity:
+    """A flow to launch at a time (reference: ScheduledActivity — here the
+    flow is named by class path + args so it survives restarts)."""
+
+    scheduled_at: float  # unix seconds
+    flow_class_path: str = dataclasses.field(compare=False)
+    flow_args: tuple = dataclasses.field(default=(), compare=False)
+
+
+class NodeSchedulerService:
+    """Earliest-deadline scheduler over SchedulableState outputs."""
+
+    def __init__(self, start_flow, clock=time.time):
+        self._start_flow = start_flow  # callable(flow_class_path, args)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._heap: list[tuple[float, str, ScheduledActivity, StateRef]] = []
+        self._cancelled: set[str] = set()
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    def schedule_state_activity(self, ref: StateRef, activity: ScheduledActivity) -> None:
+        with self._lock:
+            key = str(ref)
+            self._cancelled.discard(key)
+            heapq.heappush(self._heap, (activity.scheduled_at, key, activity, ref))
+
+    def unschedule_state_activity(self, ref: StateRef) -> None:
+        with self._lock:
+            self._cancelled.add(str(ref))
+
+    def observe_vault(self, vault) -> None:
+        """Wire to a vault update feed (reference:
+        ScheduledActivityObserver): produced SchedulableStates get
+        scheduled; consumed ones unscheduled."""
+
+        def on_update(update):
+            for sr in update.consumed:
+                self.unschedule_state_activity(sr.ref)
+            for sr in update.produced:
+                data = sr.state.data
+                if isinstance(data, SchedulableState):
+                    activity = data.next_scheduled_activity(sr.ref)
+                    if activity is not None:
+                        self.schedule_state_activity(sr.ref, activity)
+
+        vault.track(on_update)
+
+    def pump(self) -> int:
+        """Run every activity due now; returns how many fired (deterministic
+        test path — production uses start())."""
+        fired = 0
+        now = self._clock()
+        while True:
+            with self._lock:
+                if not self._heap or self._heap[0][0] > now:
+                    return fired
+                _, key, activity, ref = heapq.heappop(self._heap)
+                if key in self._cancelled:
+                    self._cancelled.discard(key)
+                    continue
+            self._start_flow(activity.flow_class_path, activity.flow_args)
+            fired += 1
+
+    def start(self, poll_s: float = 0.05) -> None:
+        def loop():
+            while not self._stop.wait(poll_s):
+                self.pump()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="scheduler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

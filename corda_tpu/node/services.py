@@ -1,0 +1,125 @@
+"""ServiceHub: the node's service locator.
+
+Parity with the reference's ``ServiceHub`` (core/.../node/ServiceHub.kt:62-209
+— vaultService, keyManagementService, identityService, attachments,
+validatedTransactions, networkMapCache, transactionVerifierService, clock,
+``loadState``/``toStateAndRef`` resolution, ``signInitialTransaction``,
+``recordTransactions``) and ``ServiceHubInternal``
+(node/.../services/api/ — + monitoring, scheduler). One concrete class;
+every service injectable for the MockServices test tier.
+"""
+
+from __future__ import annotations
+
+import time
+
+from corda_tpu.crypto import KeyPair, SecureHash
+from corda_tpu.ledger import (
+    Party,
+    SignedTransaction,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+)
+from corda_tpu.verifier import InMemoryVerifierService
+
+from .identity import IdentityService, KeyManagementService
+from .monitoring import MetricRegistry
+from .network_map import NetworkMapCache
+from .storage import AttachmentStorage, DBTransactionStorage
+from .vault import NodeVaultService
+
+
+class TransactionResolutionError(Exception):
+    def __init__(self, tx_id: SecureHash):
+        self.tx_id = tx_id
+        super().__init__(f"transaction {tx_id} not found in storage")
+
+
+class ServiceHub:
+    """The service locator handed to flows and contracts."""
+
+    def __init__(
+        self,
+        my_info=None,
+        key_management_service: KeyManagementService | None = None,
+        identity_service: IdentityService | None = None,
+        vault_service: NodeVaultService | None = None,
+        validated_transactions: DBTransactionStorage | None = None,
+        attachments: AttachmentStorage | None = None,
+        network_map_cache: NetworkMapCache | None = None,
+        verifier_service=None,
+        metrics: MetricRegistry | None = None,
+        clock=time.time,
+    ):
+        self.my_info = my_info
+        self.key_management_service = key_management_service or KeyManagementService()
+        self.identity_service = identity_service or IdentityService()
+        self.validated_transactions = validated_transactions or DBTransactionStorage()
+        self.vault_service = vault_service or NodeVaultService(
+            my_keys=self.key_management_service.keys
+        )
+        self.attachments = attachments or AttachmentStorage()
+        self.network_map_cache = network_map_cache or NetworkMapCache()
+        self.transaction_verifier_service = verifier_service or InMemoryVerifierService()
+        self.metrics = metrics or MetricRegistry()
+        self.clock = clock
+        self.scheduler_service = None  # wired by the node container
+
+    # -- identity conveniences ------------------------------------------------
+
+    @property
+    def my_identity(self) -> Party | None:
+        if self.my_info is None:
+            return None
+        return self.my_info.legal_identity
+
+    # -- state resolution (reference: ServiceHub.loadState/toStateAndRef) -----
+
+    def load_state(self, ref: StateRef) -> TransactionState:
+        stx = self.validated_transactions.get(ref.txhash)
+        if stx is None:
+            raise TransactionResolutionError(ref.txhash)
+        return stx.tx.outputs[ref.index]
+
+    def to_state_and_ref(self, ref: StateRef) -> StateAndRef:
+        return StateAndRef(self.load_state(ref), ref)
+
+    # -- recording (reference: ServiceHub.recordTransactions) -----------------
+
+    def record_transactions(self, *stxs: SignedTransaction) -> None:
+        """Store validated transactions and feed the vault; idempotent on
+        replays (first-write-wins in storage, vault update skipped)."""
+        for stx in stxs:
+            if self.validated_transactions.add_transaction(stx):
+                self.vault_service.record_transaction(stx)
+
+    # -- signing (reference: ServiceHub.signInitialTransaction :187-209) ------
+
+    def _keypair_for(self, public_key=None) -> KeyPair:
+        kms = self.key_management_service
+        if public_key is None:
+            if self.my_identity is not None:
+                public_key = self.my_identity.owning_key
+            else:
+                public_key = next(iter(kms.keys))
+        return kms._require(public_key)
+
+    def sign_initial_transaction(self, builder, public_key=None) -> SignedTransaction:
+        return builder.sign_initial_transaction(self._keypair_for(public_key))
+
+    def add_signature(self, stx: SignedTransaction, public_key=None) -> SignedTransaction:
+        key = public_key or self.my_identity.owning_key
+        sig = self.key_management_service.sign(stx.id, key)
+        return stx.with_additional_signature(sig)
+
+    # -- ledger-tx resolution for verification --------------------------------
+
+    def resolve_to_ledger_transaction(self, stx: SignedTransaction):
+        return stx.tx.to_ledger_transaction(self.load_state)
+
+    def shutdown(self) -> None:
+        self.transaction_verifier_service.shutdown()
+        self.validated_transactions.close()
+        self.vault_service.close()
+        self.attachments.close()

@@ -1,0 +1,375 @@
+"""The vault: the node's view of states it cares about.
+
+Parity with the reference's node/.../services/vault/ —
+``NodeVaultService`` (tracks unconsumed/consumed states from recorded
+transactions, emits ``Vault.Update``s), the query engine
+(``HibernateQueryCriteriaParser`` criteria → SQL; here criteria → SQLite
+over an indexed state table), and ``VaultSoftLockManager`` (flow-scoped
+soft locks so concurrent spenders don't select the same coins).
+
+Schema: one row per output state (tx, index, contract, state class,
+notary, participants, consumed flag, soft-lock id, fungible quantity +
+token for coin selection), with the state object itself CBE-serialized.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import sqlite3
+import threading
+
+from corda_tpu.crypto import SecureHash
+from corda_tpu.ledger import SignedTransaction, StateAndRef, StateRef, TransactionState
+from corda_tpu.ledger.states import Amount
+from corda_tpu.serialization import deserialize, serialize
+
+
+class StateStatus(enum.Enum):
+    UNCONSUMED = "UNCONSUMED"
+    CONSUMED = "CONSUMED"
+    ALL = "ALL"
+
+
+@dataclasses.dataclass(frozen=True)
+class PageSpecification:
+    """(reference: PageSpecification in vault/QueryCriteria.kt —
+    1-based page numbers)."""
+
+    page_number: int = 1
+    page_size: int = 200
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort:
+    """Sort by a recognised column (reference: Sort/SortAttribute)."""
+
+    by: str = "recorded"  # recorded | contract | quantity
+    descending: bool = False
+
+    _COLUMNS = {"recorded": "rowid", "contract": "contract", "quantity": "quantity"}
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryCriteria:
+    """Composable vault query criteria (reference: QueryCriteria.kt —
+    VaultQueryCriteria + FungibleAssetQueryCriteria folded into one
+    dataclass; ``and_``/``or_`` composition is replaced by explicit field
+    conjunction, the dominant real-world use)."""
+
+    status: StateStatus = StateStatus.UNCONSUMED
+    contract_state_types: tuple[type, ...] | None = None
+    state_refs: tuple[StateRef, ...] | None = None
+    notary_names: tuple[str, ...] | None = None
+    participant_keys: tuple | None = None  # PublicKey
+    include_soft_locked: bool = True
+    soft_lock_id: str | None = None  # states locked by this flow also visible
+    quantity_geq: int | None = None  # fungible: quantity >= (coin selection)
+    token_repr: str | None = None  # fungible: exact token match
+
+
+class SoftLockError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class VaultUpdate:
+    """(reference: Vault.Update — consumed/produced sets per tx)."""
+
+    consumed: tuple[StateAndRef, ...]
+    produced: tuple[StateAndRef, ...]
+
+    @property
+    def is_empty(self) -> bool:
+        return not self.consumed and not self.produced
+
+
+@dataclasses.dataclass(frozen=True)
+class Page:
+    """(reference: Vault.Page — results + total count for paging UIs)."""
+
+    states: list[StateAndRef]
+    total_states_available: int
+
+
+class Vault:
+    """Namespace mirror of the reference's ``Vault`` container class."""
+
+    StateStatus = StateStatus
+    Update = VaultUpdate
+    Page = Page
+
+
+def _token_repr(token) -> str:
+    return repr(token)
+
+
+class NodeVaultService:
+    """SQLite-backed vault (reference: NodeVaultService.kt).
+
+    Relevancy: a produced output is recorded iff the node's keys intersect
+    its participants (or ``observe_all`` is set — observer-node mode).
+    """
+
+    def __init__(self, path: str = ":memory:", my_keys=None, observe_all=False):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_states ("
+            " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
+            " contract TEXT NOT NULL, state_class TEXT NOT NULL,"
+            " notary_name TEXT NOT NULL, state_blob BLOB NOT NULL,"
+            " consumed INTEGER NOT NULL DEFAULT 0,"
+            " consumed_by BLOB, lock_id TEXT,"
+            " quantity INTEGER, token TEXT,"
+            " PRIMARY KEY (tx_id, output_index))"
+        )
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS vault_participants ("
+            " tx_id BLOB NOT NULL, output_index INTEGER NOT NULL,"
+            " participant_key BLOB NOT NULL)"
+        )
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS idx_vault_unconsumed"
+            " ON vault_states (consumed, contract)"
+        )
+        self._db.execute(
+            "CREATE INDEX IF NOT EXISTS idx_vault_parts"
+            " ON vault_participants (participant_key)"
+        )
+        self._db.commit()
+        self._lock = threading.RLock()
+        self._my_keys = set(my_keys or [])
+        self._observe_all = observe_all
+        self._subscribers: list = []
+
+    # -- recording ------------------------------------------------------------
+
+    def add_my_key(self, key) -> None:
+        with self._lock:
+            self._my_keys.add(key)
+
+    def _is_relevant(self, state: TransactionState) -> bool:
+        if self._observe_all or not self._my_keys:
+            return True
+        participants = getattr(state.data, "participants", ())
+        for p in participants:
+            key = getattr(p, "owning_key", p)
+            if key in self._my_keys:
+                return True
+        return False
+
+    def record_transaction(self, stx: SignedTransaction) -> VaultUpdate:
+        """Consume inputs we track, record relevant outputs, emit an update
+        (reference: NodeVaultService.notifyAll)."""
+        wtx = stx.tx
+        produced: list[StateAndRef] = []
+        consumed: list[StateAndRef] = []
+        with self._lock:
+            for ref in wtx.inputs:
+                row = self._db.execute(
+                    "SELECT state_blob FROM vault_states"
+                    " WHERE tx_id=? AND output_index=? AND consumed=0",
+                    (ref.txhash.bytes, ref.index),
+                ).fetchone()
+                if row is not None:
+                    self._db.execute(
+                        "UPDATE vault_states SET consumed=1, consumed_by=?, lock_id=NULL"
+                        " WHERE tx_id=? AND output_index=?",
+                        (stx.id.bytes, ref.txhash.bytes, ref.index),
+                    )
+                    consumed.append(StateAndRef(deserialize(row[0]), ref))
+            for idx, tstate in enumerate(wtx.outputs):
+                if not self._is_relevant(tstate):
+                    continue
+                ref = StateRef(stx.id, idx)
+                amount = getattr(tstate.data, "amount", None)
+                quantity = token = None
+                if isinstance(amount, Amount):
+                    quantity, token = amount.quantity, _token_repr(amount.token)
+                self._db.execute(
+                    "INSERT OR IGNORE INTO vault_states"
+                    " (tx_id, output_index, contract, state_class, notary_name,"
+                    "  state_blob, quantity, token)"
+                    " VALUES (?,?,?,?,?,?,?,?)",
+                    (
+                        stx.id.bytes, idx, tstate.contract,
+                        type(tstate.data).__name__, str(tstate.notary.name),
+                        serialize(tstate), quantity, token,
+                    ),
+                )
+                for p in getattr(tstate.data, "participants", ()):
+                    key = getattr(p, "owning_key", p)
+                    self._db.execute(
+                        "INSERT INTO vault_participants VALUES (?,?,?)",
+                        (stx.id.bytes, idx, serialize(key)),
+                    )
+                produced.append(StateAndRef(tstate, ref))
+            self._db.commit()
+            subs = list(self._subscribers)
+        update = VaultUpdate(tuple(consumed), tuple(produced))
+        if not update.is_empty:
+            for cb in subs:
+                cb(update)
+        return update
+
+    # -- querying -------------------------------------------------------------
+
+    def _build_query(self, criteria: QueryCriteria) -> tuple[str, list]:
+        clauses, params = [], []
+        if criteria.status is StateStatus.UNCONSUMED:
+            clauses.append("consumed=0")
+        elif criteria.status is StateStatus.CONSUMED:
+            clauses.append("consumed=1")
+        if criteria.contract_state_types:
+            names = [t.__name__ for t in criteria.contract_state_types]
+            clauses.append(
+                "state_class IN (%s)" % ",".join("?" * len(names))
+            )
+            params.extend(names)
+        if criteria.state_refs:
+            refs = criteria.state_refs
+            ors = " OR ".join("(tx_id=? AND output_index=?)" for _ in refs)
+            clauses.append(f"({ors})")
+            for r in refs:
+                params.extend((r.txhash.bytes, r.index))
+        if criteria.notary_names:
+            clauses.append(
+                "notary_name IN (%s)" % ",".join("?" * len(criteria.notary_names))
+            )
+            params.extend(criteria.notary_names)
+        if criteria.participant_keys:
+            keys = criteria.participant_keys
+            clauses.append(
+                "EXISTS (SELECT 1 FROM vault_participants p WHERE"
+                " p.tx_id=vault_states.tx_id AND p.output_index=vault_states.output_index"
+                " AND p.participant_key IN (%s))" % ",".join("?" * len(keys))
+            )
+            params.extend(serialize(k) for k in keys)
+        if not criteria.include_soft_locked:
+            if criteria.soft_lock_id is not None:
+                clauses.append("(lock_id IS NULL OR lock_id=?)")
+                params.append(criteria.soft_lock_id)
+            else:
+                clauses.append("lock_id IS NULL")
+        if criteria.token_repr is not None:
+            clauses.append("token=?")
+            params.append(criteria.token_repr)
+        if criteria.quantity_geq is not None:
+            clauses.append("quantity>=?")
+            params.append(criteria.quantity_geq)
+        where = " AND ".join(clauses) if clauses else "1=1"
+        return where, params
+
+    def query_by(
+        self,
+        criteria: QueryCriteria = QueryCriteria(),
+        paging: PageSpecification | None = None,
+        sort: Sort = Sort(),
+    ) -> Page:
+        where, params = self._build_query(criteria)
+        col = Sort._COLUMNS[sort.by]
+        order = f"{col} {'DESC' if sort.descending else 'ASC'}"
+        limit = ""
+        if paging is not None:
+            limit = " LIMIT %d OFFSET %d" % (
+                paging.page_size, (paging.page_number - 1) * paging.page_size,
+            )
+        with self._lock:
+            total = self._db.execute(
+                f"SELECT COUNT(*) FROM vault_states WHERE {where}", params
+            ).fetchone()[0]
+            rows = self._db.execute(
+                f"SELECT tx_id, output_index, state_blob FROM vault_states"
+                f" WHERE {where} ORDER BY {order}{limit}",
+                params,
+            ).fetchall()
+        states = [
+            StateAndRef(deserialize(blob), StateRef(SecureHash(tx_id), idx))
+            for tx_id, idx, blob in rows
+        ]
+        return Page(states, total)
+
+    def unconsumed_states(self, state_type: type | None = None) -> list[StateAndRef]:
+        crit = QueryCriteria(
+            contract_state_types=(state_type,) if state_type else None
+        )
+        return self.query_by(crit).states
+
+    def track(self, callback) -> Page:
+        """Snapshot + subscription (reference: vaultTrackBy returning
+        DataFeed<Vault.Page, Vault.Update>)."""
+        with self._lock:
+            snapshot = self.query_by()
+            self._subscribers.append(callback)
+        return snapshot
+
+    # -- soft locking (reference: VaultSoftLockManager.kt) --------------------
+
+    def soft_lock_reserve(self, lock_id: str, refs: list[StateRef]) -> None:
+        """Atomically reserve unconsumed, unlocked states; raises and leaves
+        nothing locked if any ref is unavailable."""
+        with self._lock:
+            for ref in refs:
+                row = self._db.execute(
+                    "SELECT consumed, lock_id FROM vault_states"
+                    " WHERE tx_id=? AND output_index=?",
+                    (ref.txhash.bytes, ref.index),
+                ).fetchone()
+                if (row is None or row[0] != 0
+                        or (row[1] is not None and row[1] != lock_id)):
+                    self._db.rollback()
+                    raise SoftLockError(f"state {ref} unavailable for locking")
+            for ref in refs:
+                self._db.execute(
+                    "UPDATE vault_states SET lock_id=? WHERE tx_id=? AND output_index=?",
+                    (lock_id, ref.txhash.bytes, ref.index),
+                )
+            self._db.commit()
+
+    def soft_lock_release(self, lock_id: str, refs: list[StateRef] | None = None) -> None:
+        with self._lock:
+            if refs is None:
+                self._db.execute(
+                    "UPDATE vault_states SET lock_id=NULL WHERE lock_id=?", (lock_id,)
+                )
+            else:
+                for ref in refs:
+                    self._db.execute(
+                        "UPDATE vault_states SET lock_id=NULL"
+                        " WHERE tx_id=? AND output_index=? AND lock_id=?",
+                        (ref.txhash.bytes, ref.index, lock_id),
+                    )
+            self._db.commit()
+
+    # -- coin selection (reference: CashSelectionH2Impl.kt shape) -------------
+
+    def select_fungible(
+        self, token, required_quantity: int, lock_id: str,
+        state_type: type | None = None,
+    ) -> list[StateAndRef]:
+        """Greedy smallest-first selection of unconsumed fungible states
+        totalling ≥ required_quantity; soft-locks the selection."""
+        crit = QueryCriteria(
+            contract_state_types=(state_type,) if state_type else None,
+            include_soft_locked=False,
+            soft_lock_id=lock_id,
+            token_repr=_token_repr(token),
+        )
+        page = self.query_by(crit, sort=Sort(by="quantity"))
+        picked, total = [], 0
+        for sr in page.states:
+            picked.append(sr)
+            total += sr.state.data.amount.quantity
+            if total >= required_quantity:
+                break
+        if total < required_quantity:
+            raise SoftLockError(
+                f"insufficient funds: have {total}, need {required_quantity}"
+            )
+        self.soft_lock_reserve(lock_id, [sr.ref for sr in picked])
+        return picked
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()
