@@ -21,6 +21,7 @@ This package provides the same primitives TPU-host-natively:
 
 from .queue import DurableQueueBroker, Message, QueueClosedError
 from .network import (
+    auto_ack,
     InMemoryMessagingNetwork,
     MessagingClient,
     PeerHandle,
@@ -28,6 +29,7 @@ from .network import (
 from .broker_client import BrokerMessagingClient, p2p_queue
 
 __all__ = [
+    "auto_ack",
     "DurableQueueBroker",
     "Message",
     "QueueClosedError",

@@ -39,6 +39,20 @@ class TopicMessage:
     msg_id: str
 
 
+def auto_ack(handler):
+    """Adapt a one-argument topic handler to the (msg, ack) calling
+    convention: ack (when the transport provides one) fires after the
+    handler returns. Shared by protocol layers (raft, bft, network map)
+    whose handlers are synchronous."""
+
+    def wrapped(msg, ack=None):
+        handler(msg)
+        if ack:
+            ack()
+
+    return wrapped
+
+
 class MessagingClient:
     """Topic-addressed node messaging (reference: MessagingService,
     node/.../services/messaging/Messaging.kt)."""

@@ -1,0 +1,33 @@
+"""Notary tier — uniqueness consensus (L7 of SURVEY.md §1).
+
+Parity with the reference's node/.../services/transactions/: pluggable
+``UniquenessProvider``s (in-memory, persistent, Raft-replicated,
+BFT-replicated) under notary services (simple non-validating, validating,
+and the TPU-batched validating notary that verifies whole request batches
+as device kernels — BASELINE config #5's target).
+"""
+
+from .uniqueness import (
+    InMemoryUniquenessProvider,
+    NotaryError,
+    PersistentUniquenessProvider,
+    UniquenessConflict,
+    UniquenessProvider,
+)
+from .service import (
+    BatchedNotaryService,
+    NotaryService,
+    SimpleNotaryService,
+    ValidatingNotaryService,
+)
+from .raft import RaftNode, RaftUniquenessProvider
+from .bft import BFTClusterClient, BFTReplica, BFTUniquenessProvider
+
+__all__ = [
+    "InMemoryUniquenessProvider", "NotaryError", "PersistentUniquenessProvider",
+    "UniquenessConflict", "UniquenessProvider",
+    "BatchedNotaryService", "NotaryService", "SimpleNotaryService",
+    "ValidatingNotaryService",
+    "RaftNode", "RaftUniquenessProvider",
+    "BFTClusterClient", "BFTReplica", "BFTUniquenessProvider",
+]

@@ -1,0 +1,318 @@
+"""BFT-replicated uniqueness (Byzantine fault-tolerant notary cluster).
+
+Role parity with the reference's BFT-SMaRt tier
+(node/.../services/transactions/BFTSMaRt.kt:55+ — ``Client`` does
+total-order submission and gathers signed replica replies;
+``BFTNonValidatingNotaryService.Replica.executeCommand`` verifies and
+commits, replying with a per-replica signature over the outcome; the client
+accepts on a cluster signature quorum). The consensus engine the reference
+outsources to the BFT-SMaRt jar is implemented here as PBFT-style
+three-phase total-order broadcast (pre-prepare / prepare / commit with 2f
+and 2f+1 quorums over n = 3f+1 replicas) on this framework's messaging
+layer.
+
+Scope note: view changes are not implemented — safety holds under f
+Byzantine replicas (quorum intersection + signed replies), while liveness
+assumes the view's primary stays up, the same operational posture the
+reference's demo configs run (static view, BFTSMaRtConfig.kt). A client
+that times out surfaces the failure rather than electing a new primary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from concurrent.futures import Future
+
+from corda_tpu.crypto import (
+    KeyPair,
+    PublicKey,
+    sign as host_sign,
+    is_valid as host_verify,
+)
+from corda_tpu.messaging import auto_ack
+from corda_tpu.serialization import deserialize, serialize
+
+from .uniqueness import (
+    InMemoryUniquenessProvider,
+    NotaryError,
+    UniquenessProvider,
+)
+
+T_REQUEST = "bft.request"
+T_PREPREPARE = "bft.preprepare"
+T_PREPARE = "bft.prepare"
+T_COMMIT = "bft.commit"
+T_REPLY = "bft.reply"
+
+
+def _digest(command: bytes) -> bytes:
+    import hashlib
+
+    return hashlib.sha256(command).digest()
+
+
+class BFTReplica:
+    """One PBFT replica executing a deterministic uniqueness state machine.
+
+    ``names`` fixes the cluster membership and view: primary = names[0].
+    f = (n - 1) // 3 replicas may be Byzantine.
+    """
+
+    def __init__(self, name: str, names: list[str], messaging, keypair: KeyPair,
+                 base: UniquenessProvider | None = None):
+        self.name = name
+        self.names = list(names)
+        self.n = len(names)
+        self.f = (self.n - 1) // 3
+        self._messaging = messaging
+        self._keypair = keypair
+        self.base = base or InMemoryUniquenessProvider()
+        self._lock = threading.RLock()
+        self._seq = 0                     # primary: next sequence number
+        self._commands: dict[bytes, bytes] = {}   # digest -> command
+        self._client_of: dict[bytes, str] = {}    # digest -> requesting client
+        self._preprepared: dict[int, bytes] = {}  # seq -> digest
+        # quorum tallies are keyed by (seq, digest): votes for different
+        # commands at the same sequence must never be conflated, or an
+        # equivocating primary could split honest replicas onto divergent
+        # uniqueness maps with both sides reaching "quorum"
+        self._prepares: dict[tuple[int, bytes], set[str]] = defaultdict(set)
+        self._commits: dict[tuple[int, bytes], set[str]] = defaultdict(set)
+        self._next_exec = 0               # execute strictly in sequence order
+        self._exec_queue: dict[int, bytes] = {}
+        for topic, h in (
+            (T_REQUEST, self._on_request), (T_PREPREPARE, self._on_preprepare),
+            (T_PREPARE, self._on_prepare), (T_COMMIT, self._on_commit),
+        ):
+            messaging.add_handler(topic, auto_ack(h))
+
+    @property
+    def is_primary(self) -> bool:
+        return self.name == self.names[0]
+
+    def _multicast(self, topic: str, obj) -> None:
+        payload = serialize(obj)
+        for peer in self.names:
+            if peer != self.name:
+                self._messaging.send(peer, topic, payload)
+
+    # ------------------------------------------------------------ phases
+
+    def _on_request(self, msg) -> None:
+        req = deserialize(msg.payload)
+        command = req["command"]
+        d = _digest(command)
+        with self._lock:
+            self._commands[d] = command
+            self._client_of[d] = req["client"]
+            if not self.is_primary:
+                return
+            seq = self._seq
+            self._seq += 1
+            self._preprepared[seq] = d
+            self._prepares[(seq, d)].add(self.name)
+        self._multicast(T_PREPREPARE, {"seq": seq, "digest": d,
+                                       "command": command,
+                                       "client": req["client"]})
+        self._check_prepared(seq)
+
+    def _on_preprepare(self, msg) -> None:
+        pp = deserialize(msg.payload)
+        if msg.sender != self.names[0]:
+            return  # only the view primary may pre-prepare
+        seq, d = pp["seq"], pp["digest"]
+        if _digest(pp["command"]) != d:
+            return  # Byzantine primary: digest mismatch
+        with self._lock:
+            if seq < self._next_exec:
+                return  # already executed and pruned
+            existing = self._preprepared.get(seq)
+            if existing is not None and existing != d:
+                return  # primary equivocation: keep the first
+            self._preprepared[seq] = d
+            self._commands[d] = pp["command"]
+            self._client_of[d] = pp["client"]
+            self._prepares[(seq, d)].add(self.name)
+            self._prepares[(seq, d)].add(msg.sender)
+        self._multicast(T_PREPARE, {"seq": seq, "digest": d})
+        self._check_prepared(seq)
+
+    def _on_prepare(self, msg) -> None:
+        p = deserialize(msg.payload)
+        seq, d = p["seq"], p["digest"]
+        with self._lock:
+            if seq < self._next_exec:
+                return
+            self._prepares[(seq, d)].add(msg.sender)
+        self._check_prepared(seq)
+
+    def _check_prepared(self, seq: int) -> None:
+        with self._lock:
+            # prepared: our pre-prepare's digest gathered 2f+1 prepares
+            # (incl. own); then cast our commit vote once
+            d = self._preprepared.get(seq)
+            if (d is not None
+                    and len(self._prepares[(seq, d)]) >= 2 * self.f + 1
+                    and self.name not in self._commits[(seq, d)]):
+                self._commits[(seq, d)].add(self.name)
+            else:
+                return
+        self._multicast(T_COMMIT, {"seq": seq, "digest": d})
+        self._check_committed(seq)
+
+    def _on_commit(self, msg) -> None:
+        c = deserialize(msg.payload)
+        seq, d = c["seq"], c["digest"]
+        with self._lock:
+            if seq < self._next_exec:
+                return
+            self._commits[(seq, d)].add(msg.sender)
+        self._check_prepared(seq)
+        self._check_committed(seq)
+
+    def _check_committed(self, seq: int) -> None:
+        with self._lock:
+            d = self._preprepared.get(seq)
+            if (d is not None
+                    and len(self._commits[(seq, d)]) >= 2 * self.f + 1
+                    and seq >= self._next_exec
+                    and seq not in self._exec_queue):
+                self._exec_queue[seq] = d
+            to_run = []
+            while self._next_exec in self._exec_queue:
+                seq_i = self._next_exec
+                d_i = self._exec_queue.pop(seq_i)
+                to_run.append((seq_i, d_i, self._commands[d_i],
+                               self._client_of.get(d_i)))
+                self._next_exec += 1
+                # prune per-sequence protocol state (bounded memory at
+                # sustained notarisation rates)
+                self._preprepared.pop(seq_i, None)
+                for store in (self._prepares, self._commits):
+                    for key in [k for k in store if k[0] == seq_i]:
+                        del store[key]
+                self._commands.pop(d_i, None)
+                self._client_of.pop(d_i, None)
+        for seq_i, d_i, command, client in to_run:
+            self._execute(seq_i, d_i, command, client)
+
+    def _execute(self, seq: int, d: bytes, command: bytes,
+                 client: str | None) -> None:
+        """Apply to the uniqueness map and reply to the client with a
+        signature over the outcome (reference: Replica.verifyAndCommitTx +
+        sign over the tx id, BFTNonValidatingNotaryService.kt:136-158)."""
+        states, tx_id, caller = deserialize(command)
+        try:
+            self.base.commit(states, tx_id, caller)
+            conflict = None
+        except NotaryError as e:
+            conflict = e.conflict
+        outcome = serialize({"tx_id": tx_id, "conflict": conflict})
+        sig = host_sign(self._keypair.private, outcome)
+        client = client or caller
+        self._messaging.send(
+            client, T_REPLY,
+            serialize({"digest": d, "replica": self.name, "outcome": outcome,
+                       "sig": sig, "key": self._keypair.public}),
+        )
+
+
+class BFTClusterClient:
+    """The client side (reference: BFTSMaRt.Client): broadcast the request,
+    accept when f+1 replicas sign the *same* outcome."""
+
+    def __init__(self, name: str, messaging, replica_names: list[str],
+                 replica_keys: dict[str, PublicKey], timeout_s: float = 5.0):
+        self.name = name
+        self._messaging = messaging
+        self._replicas = list(replica_names)
+        self._keys = dict(replica_keys)
+        self.f = (len(replica_names) - 1) // 3
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        # digest -> {outcome_bytes: {replica: sig}}
+        self._replies: dict[bytes, dict[bytes, dict[str, bytes]]] = {}
+        self._futures: dict[bytes, Future] = {}
+        messaging.add_handler(T_REPLY, self._on_reply)
+
+    def _on_reply(self, msg, ack=None) -> None:
+        rep = deserialize(msg.payload)
+        replica, outcome, sig = rep["replica"], rep["outcome"], rep["sig"]
+        key = self._keys.get(replica)
+        if key is None or rep["key"] != key:
+            if ack:
+                ack()
+            return
+        try:
+            if not host_verify(key, sig, outcome):
+                if ack:
+                    ack()
+                return
+        except Exception:
+            if ack:
+                ack()
+            return
+        d = rep["digest"]
+        with self._lock:
+            fut = self._futures.get(d)
+            bucket = self._replies.setdefault(d, {}).setdefault(outcome, {})
+            bucket[replica] = sig
+            if fut is not None and not fut.done() and len(bucket) >= self.f + 1:
+                fut.set_result((outcome, dict(bucket)))
+        if ack:
+            ack()
+
+    def submit(self, states, tx_id, caller: str):
+        """Returns (conflict_or_None, {replica: sig}) after quorum."""
+        command = serialize((list(states), tx_id, caller))
+        d = _digest(command)
+        fut: Future = Future()
+        with self._lock:
+            self._futures[d] = fut
+        payload = serialize({"command": command, "client": self.name})
+        for r in self._replicas:
+            self._messaging.send(r, T_REQUEST, payload)
+        try:
+            outcome_bytes, sigs = fut.result(timeout=self._timeout_s)
+        finally:
+            with self._lock:
+                self._futures.pop(d, None)
+        outcome = deserialize(outcome_bytes)
+        return outcome["conflict"], sigs
+
+
+class BFTUniquenessProvider(UniquenessProvider):
+    """UniquenessProvider face over a BFT cluster client."""
+
+    def __init__(self, client: BFTClusterClient):
+        self.client = client
+
+    def commit(self, states, tx_id, caller_name) -> None:
+        conflict, _sigs = self.client.submit(states, tx_id, caller_name)
+        if conflict is not None:
+            raise NotaryError(
+                f"input states of {tx_id} already consumed", conflict
+            )
+
+    @staticmethod
+    def make_cluster(n: int, network, prefix: str = "bft-replica"):
+        """n = 3f+1 co-located replicas + a client factory."""
+        from corda_tpu.crypto import generate_keypair
+
+        names = [f"{prefix}-{i}" for i in range(n)]
+        keypairs = {name: generate_keypair() for name in names}
+        replicas = [
+            BFTReplica(name, names, network.create_node(name), keypairs[name])
+            for name in names
+        ]
+        keys = {name: kp.public for name, kp in keypairs.items()}
+
+        def make_client(client_name: str) -> BFTUniquenessProvider:
+            client = BFTClusterClient(
+                client_name, network.create_node(client_name), names, keys
+            )
+            return BFTUniquenessProvider(client)
+
+        return replicas, make_client

@@ -1,0 +1,441 @@
+"""Raft-replicated uniqueness provider (CFT notary cluster).
+
+Role parity with the reference's Copycat-based tier
+(node/.../services/transactions/RaftUniquenessProvider.kt:4-17 +
+DistributedImmutableMap.kt — a replicated put-all-or-report-conflicts map
+of consumed states; RaftValidatingNotaryService / RaftNonValidatingNotary-
+Service wrap it). Re-implemented from the Raft paper over this framework's
+messaging layer (leader election with randomized timeouts, log replication,
+majority commit, state-machine apply), because the JVM dependency is the
+engine the reference outsources — here it's a first-class component.
+
+The replicated state machine is the uniqueness map: a committed log entry
+is a (states, tx_id, caller) commit request; apply() settles it against
+the local map, deterministically identical on every replica.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from concurrent.futures import Future
+
+from corda_tpu.messaging import auto_ack
+from corda_tpu.serialization import deserialize, serialize
+
+from .uniqueness import (
+    InMemoryUniquenessProvider,
+    NotaryError,
+    UniquenessProvider,
+)
+
+T_VOTE = "raft.vote"
+T_VOTE_REPLY = "raft.vote-reply"
+T_APPEND = "raft.append"
+T_APPEND_REPLY = "raft.append-reply"
+T_SUBMIT = "raft.submit"
+T_SUBMIT_REPLY = "raft.submit-reply"
+
+
+@dataclasses.dataclass(frozen=True)
+class LogEntry:
+    term: int
+    command: bytes  # serialized (states, tx_id, caller)
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: str | None):
+        self.leader = leader
+        super().__init__(f"not leader; known leader: {leader}")
+
+
+class RaftNode:
+    """One Raft replica. ``apply_fn(command_bytes) -> result_bytes`` is the
+    deterministic state machine."""
+
+    FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+    def __init__(
+        self, name: str, peers: list[str], messaging, apply_fn,
+        election_timeout_s: tuple[float, float] = (0.15, 0.3),
+        heartbeat_s: float = 0.05,
+        rng: random.Random | None = None,
+    ):
+        self.name = name
+        self.peers = [p for p in peers if p != name]
+        self._messaging = messaging
+        self._apply_fn = apply_fn
+        self._timeout_range = election_timeout_s
+        self._heartbeat_s = heartbeat_s
+        self._rng = rng or random.Random(name)
+
+        self._lock = threading.RLock()
+        self.role = RaftNode.FOLLOWER
+        self.current_term = 0
+        self.voted_for: str | None = None
+        self.log: list[LogEntry] = []
+        self.commit_index = -1
+        self.last_applied = -1
+        self.leader: str | None = None
+        # leader volatile state
+        self._next_index: dict[str, int] = {}
+        self._match_index: dict[str, int] = {}
+        self._votes: set[str] = set()
+        # client futures waiting on an index we proposed; the entry object
+        # is kept alongside so a truncate-and-replace at the same index
+        # after a leadership change fails the waiter instead of handing it
+        # another command's result
+        self._waiters: dict[int, tuple[LogEntry, Future]] = {}
+        # remote submissions we're waiting on, by correlation id
+        self._pending_remote: dict[str, Future] = {}
+        self._corr = 0
+
+        self._deadline = 0.0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        for topic, handler in (
+            (T_VOTE, self._on_vote), (T_VOTE_REPLY, self._on_vote_reply),
+            (T_APPEND, self._on_append), (T_APPEND_REPLY, self._on_append_reply),
+            (T_SUBMIT, self._on_submit),
+            (T_SUBMIT_REPLY, self._on_submit_reply),
+        ):
+            messaging.add_handler(topic, auto_ack(handler))
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._reset_timer()
+        self._thread = threading.Thread(
+            target=self._tick_loop, daemon=True, name=f"raft-{self.name}"
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+    def _reset_timer(self) -> None:
+        self._deadline = time.monotonic() + self._rng.uniform(*self._timeout_range)
+
+    def _tick_loop(self) -> None:
+        while not self._stop.wait(0.01):
+            with self._lock:
+                now = time.monotonic()
+                if self.role == RaftNode.LEADER:
+                    if now >= self._deadline:
+                        self._deadline = now + self._heartbeat_s
+                        self._broadcast_append()
+                elif now >= self._deadline:
+                    self._start_election()
+
+    # ------------------------------------------------------------ election
+
+    def _start_election(self) -> None:
+        self.role = RaftNode.CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.name
+        self._votes = {self.name}
+        self.leader = None
+        self._reset_timer()
+        last_idx = len(self.log) - 1
+        last_term = self.log[last_idx].term if last_idx >= 0 else 0
+        req = {"term": self.current_term, "candidate": self.name,
+               "last_log_index": last_idx, "last_log_term": last_term}
+        for p in self.peers:
+            self._messaging.send(p, T_VOTE, serialize(req))
+        self._maybe_win()  # single-node cluster wins immediately
+
+    def _on_vote(self, msg) -> None:
+        req = deserialize(msg.payload)
+        with self._lock:
+            self._observe_term(req["term"])
+            grant = False
+            if req["term"] >= self.current_term and self.voted_for in (None, req["candidate"]):
+                last_idx = len(self.log) - 1
+                last_term = self.log[last_idx].term if last_idx >= 0 else 0
+                up_to_date = (req["last_log_term"], req["last_log_index"]) >= (
+                    last_term, last_idx,
+                )
+                if up_to_date:
+                    grant = True
+                    self.voted_for = req["candidate"]
+                    self._reset_timer()
+            self._messaging.send(
+                msg.sender, T_VOTE_REPLY,
+                serialize({"term": self.current_term, "granted": grant,
+                           "voter": self.name}),
+            )
+
+    def _on_vote_reply(self, msg) -> None:
+        rep = deserialize(msg.payload)
+        with self._lock:
+            self._observe_term(rep["term"])
+            if self.role != RaftNode.CANDIDATE or rep["term"] != self.current_term:
+                return
+            if rep["granted"]:
+                self._votes.add(rep["voter"])
+                self._maybe_win()
+
+    def _maybe_win(self) -> None:
+        if self.role == RaftNode.CANDIDATE and len(self._votes) * 2 > len(self.peers) + 1:
+            self.role = RaftNode.LEADER
+            self.leader = self.name
+            n = len(self.log)
+            self._next_index = {p: n for p in self.peers}
+            self._match_index = {p: -1 for p in self.peers}
+            self._deadline = 0.0  # heartbeat immediately
+            self._broadcast_append()
+
+    def _observe_term(self, term: int) -> None:
+        if term > self.current_term:
+            self.current_term = term
+            self.role = RaftNode.FOLLOWER
+            self.voted_for = None
+            self._votes = set()
+
+    # ------------------------------------------------------------ replication
+
+    def _broadcast_append(self) -> None:
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, peer: str) -> None:
+        nxt = self._next_index.get(peer, len(self.log))
+        prev_idx = nxt - 1
+        prev_term = self.log[prev_idx].term if prev_idx >= 0 else 0
+        entries = [(e.term, e.command) for e in self.log[nxt:]]
+        req = {
+            "term": self.current_term, "leader": self.name,
+            "prev_log_index": prev_idx, "prev_log_term": prev_term,
+            "entries": entries, "leader_commit": self.commit_index,
+        }
+        self._messaging.send(peer, T_APPEND, serialize(req))
+
+    def _on_append(self, msg) -> None:
+        req = deserialize(msg.payload)
+        with self._lock:
+            self._observe_term(req["term"])
+            ok = False
+            match_index = -1
+            if req["term"] == self.current_term:
+                self.role = RaftNode.FOLLOWER
+                self.leader = req["leader"]
+                self._reset_timer()
+                prev_idx = req["prev_log_index"]
+                prev_ok = prev_idx < 0 or (
+                    prev_idx < len(self.log)
+                    and self.log[prev_idx].term == req["prev_log_term"]
+                )
+                if prev_ok:
+                    ok = True
+                    idx = prev_idx + 1
+                    for term, cmd in req["entries"]:
+                        if idx < len(self.log) and self.log[idx].term != term:
+                            del self.log[idx:]
+                            self._fail_waiters_from(idx)
+                        if idx >= len(self.log):
+                            self.log.append(LogEntry(term, cmd))
+                        idx += 1
+                    match_index = prev_idx + len(req["entries"])
+                    if req["leader_commit"] > self.commit_index:
+                        self.commit_index = min(
+                            req["leader_commit"], len(self.log) - 1
+                        )
+                        self._apply_committed()
+            self._messaging.send(
+                msg.sender, T_APPEND_REPLY,
+                serialize({"term": self.current_term, "ok": ok,
+                           "follower": self.name, "match_index": match_index}),
+            )
+
+    def _on_append_reply(self, msg) -> None:
+        rep = deserialize(msg.payload)
+        with self._lock:
+            self._observe_term(rep["term"])
+            if self.role != RaftNode.LEADER or rep["term"] != self.current_term:
+                return
+            p = rep["follower"]
+            if rep["ok"]:
+                self._match_index[p] = max(self._match_index.get(p, -1),
+                                           rep["match_index"])
+                self._next_index[p] = self._match_index[p] + 1
+                self._advance_commit()
+            else:
+                self._next_index[p] = max(0, self._next_index.get(p, 1) - 1)
+                self._send_append(p)
+
+    def _advance_commit(self) -> None:
+        n = len(self.peers) + 1
+        for idx in range(len(self.log) - 1, self.commit_index, -1):
+            if self.log[idx].term != self.current_term:
+                continue
+            votes = 1 + sum(1 for p in self.peers if self._match_index.get(p, -1) >= idx)
+            if votes * 2 > n:
+                self.commit_index = idx
+                self._apply_committed()
+                break
+
+    def _fail_waiters_from(self, idx: int) -> None:
+        """A truncation invalidated every proposal at >= idx."""
+        for i in [i for i in self._waiters if i >= idx]:
+            _entry, fut = self._waiters.pop(i)
+            if not fut.done():
+                fut.set_exception(NotLeaderError(self.leader))
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            entry = self.log[self.last_applied]
+            result = self._apply_fn(entry.command)
+            waiter = self._waiters.pop(self.last_applied, None)
+            if waiter is not None:
+                proposed, fut = waiter
+                if fut.done():
+                    pass
+                elif proposed is entry:
+                    fut.set_result(result)
+                else:  # a different command landed at our index
+                    fut.set_exception(NotLeaderError(self.leader))
+
+    # ------------------------------------------------------------ client API
+
+    def submit(self, command: bytes) -> Future:
+        """Leader-only: append + replicate; future completes with the
+        state-machine apply result once committed."""
+        with self._lock:
+            if self.role != RaftNode.LEADER:
+                raise NotLeaderError(self.leader)
+            entry = LogEntry(self.current_term, command)
+            self.log.append(entry)
+            idx = len(self.log) - 1
+            fut: Future = Future()
+            self._waiters[idx] = (entry, fut)
+            if not self.peers:  # single-node cluster commits immediately
+                self.commit_index = idx
+                self._apply_committed()
+            else:
+                self._broadcast_append()
+            return fut
+
+    def _on_submit(self, msg) -> None:
+        """Remote client submission (any replica accepts; forwards result
+        or redirect)."""
+        req = deserialize(msg.payload)
+        with self._lock:
+            is_leader = self.role == RaftNode.LEADER
+            leader = self.leader
+        if not is_leader:
+            self._messaging.send(
+                msg.sender, T_SUBMIT_REPLY,
+                serialize({"corr": req["corr"], "redirect": leader}),
+            )
+            return
+        fut = self.submit(req["command"])
+
+        def done(f, corr=req["corr"], sender=msg.sender):
+            try:
+                self._messaging.send(
+                    sender, T_SUBMIT_REPLY,
+                    serialize({"corr": corr, "result": f.result()}),
+                )
+            except Exception as e:
+                self._messaging.send(
+                    sender, T_SUBMIT_REPLY,
+                    serialize({"corr": corr, "error": str(e)}),
+                )
+
+        fut.add_done_callback(done)
+
+    def _on_submit_reply(self, msg) -> None:
+        rep = deserialize(msg.payload)
+        with self._lock:
+            fut = self._pending_remote.pop(rep["corr"], None)
+        if fut is None or fut.done():
+            return
+        if "result" in rep:
+            fut.set_result(rep["result"])
+        elif "redirect" in rep:
+            fut.set_exception(NotLeaderError(rep["redirect"]))
+        else:
+            fut.set_exception(NotaryError(rep.get("error", "submit failed")))
+
+    def submit_anywhere(self, command: bytes) -> Future:
+        """Submit locally when leader, else forward to the known leader (or
+        probe a peer) over messaging — the CopycatClient role."""
+        with self._lock:
+            if self.role == RaftNode.LEADER:
+                return self.submit(command)
+            target = self.leader
+            if target is None and self.peers:
+                target = self.peers[self._corr % len(self.peers)]
+            self._corr += 1
+            corr = f"{self.name}-{self._corr}"
+            fut: Future = Future()
+            self._pending_remote[corr] = fut
+        if target is None:
+            fut.set_exception(NotLeaderError(None))
+            return fut
+        self._messaging.send(
+            target, T_SUBMIT, serialize({"corr": corr, "command": command})
+        )
+        return fut
+
+
+class RaftUniquenessProvider(UniquenessProvider):
+    """UniquenessProvider face over a RaftNode whose state machine is a
+    local uniqueness map (reference: RaftUniquenessProvider +
+    DistributedImmutableMap). Use ``RaftUniquenessProvider.make_cluster``
+    to build co-located replicas for tests/demos."""
+
+    def __init__(self, node: RaftNode):
+        self.node = node
+        # retry window covers one election cycle
+        self._retry_s = 2.0
+
+    @staticmethod
+    def state_machine(base: UniquenessProvider | None = None):
+        base = base or InMemoryUniquenessProvider()
+
+        def apply(command: bytes) -> bytes:
+            states, tx_id, caller = deserialize(command)
+            try:
+                base.commit(states, tx_id, caller)
+                return serialize(None)
+            except NotaryError as e:
+                return serialize(e.conflict)
+
+        return apply, base
+
+    def commit(self, states, tx_id, caller_name) -> None:
+        command = serialize((list(states), tx_id, caller_name))
+        deadline = time.monotonic() + self._retry_s
+        while True:
+            try:
+                fut = self.node.submit_anywhere(command)
+                result = deserialize(fut.result(timeout=self._retry_s))
+                break
+            except (NotLeaderError, TimeoutError):
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.02)
+        if result is not None:
+            raise NotaryError(
+                f"input states of {tx_id} already consumed", result
+            )
+
+    @staticmethod
+    def make_cluster(names: list[str], network) -> "list[RaftUniquenessProvider]":
+        """Co-located cluster over an InMemoryMessagingNetwork (the
+        reference's cluster-of-3-in-one-JVM driver test shape)."""
+        providers = []
+        for name in names:
+            apply_fn, _base = RaftUniquenessProvider.state_machine()
+            node = RaftNode(name, list(names), network.create_node(name), apply_fn)
+            providers.append(RaftUniquenessProvider(node))
+        for p in providers:
+            p.node.start()
+        return providers

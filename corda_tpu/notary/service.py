@@ -1,0 +1,286 @@
+"""Notary services: uniqueness attestation over transactions.
+
+Parity with the reference's notary service tier
+(node/.../services/transactions/ + core/.../node/services/NotaryService.kt):
+
+- ``SimpleNotaryService`` — non-validating: accepts a *tear-off*
+  (FilteredTransaction revealing only inputs/timewindow/notary), checks the
+  Merkle proofs, commits, signs (reference: SimpleNotaryService.kt:18 +
+  NonValidatingNotaryFlow).
+- ``ValidatingNotaryService`` — resolves and fully verifies the transaction
+  (signatures minus its own + contracts) before committing (reference:
+  ValidatingNotaryService.kt:11 + ValidatingNotaryFlow.kt:17-51).
+- ``BatchedNotaryService`` — the TPU tier: requests accumulate into a
+  window, all signatures across the batch verify as one scheme-bucketed
+  device dispatch (verifier.check_transactions), inputs commit via one
+  ``commit_batch`` storage round-trip, responses sign per-tx. This is the
+  shape BASELINE config #5 (≥10k notarised tx/sec) measures.
+
+Time-window checking mirrors the reference's ``TimeWindowChecker`` (30 s
+tolerance around the notary clock).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+from corda_tpu.crypto import KeyPair, SecureHash, TransactionSignature, sign_tx_id
+from corda_tpu.ledger import (
+    ComponentGroupType,
+    FilteredTransaction,
+    Party,
+    SignedTransaction,
+    TimeWindow,
+)
+
+from .uniqueness import NotaryError, UniquenessProvider
+
+TIME_TOLERANCE_MICROS = 30 * 1_000_000  # reference: TimeWindowChecker 30s
+
+
+class NotaryInternalException(Exception):
+    pass
+
+
+class NotaryService:
+    """Base: identity + uniqueness + signing + time-window policy."""
+
+    def __init__(
+        self,
+        identity: Party,
+        keypair: KeyPair,
+        uniqueness: UniquenessProvider,
+        clock=time.time,
+    ):
+        if keypair.public != identity.owning_key:
+            raise ValueError("notary keypair must match identity key")
+        self.identity = identity
+        self._keypair = keypair
+        self.uniqueness = uniqueness
+        self._clock = clock
+
+    def sign(self, tx_id: SecureHash) -> TransactionSignature:
+        return sign_tx_id(self._keypair.private, self._keypair.public, tx_id)
+
+    def check_time_window(self, tw: TimeWindow | None) -> None:
+        """Reject if the notary's now (±tolerance) is outside the window
+        (reference: TimeWindowChecker.isValid)."""
+        if tw is None:
+            return
+        now = int(self._clock() * 1_000_000)
+        ok = (
+            tw.from_time is None or now + TIME_TOLERANCE_MICROS >= tw.from_time
+        ) and (
+            tw.until_time is None or now - TIME_TOLERANCE_MICROS < tw.until_time
+        )
+        if not ok:
+            raise NotaryError(f"time window {tw} outside current time")
+
+    def _check_notary(self, notary: Party | None, tx_id) -> None:
+        if notary is None or notary.owning_key != self.identity.owning_key:
+            raise NotaryError(
+                f"transaction {tx_id} names a different notary than this service"
+            )
+
+
+class SimpleNotaryService(NotaryService):
+    """Non-validating: trusts the requester about everything except
+    uniqueness; sees only the tear-off (privacy property the reference's
+    NonValidatingNotaryFlow provides)."""
+
+    def process(self, ftx: FilteredTransaction, caller_name: str) -> TransactionSignature:
+        ftx.verify()  # adversarial input: every proof must chain to ftx.id
+        # inputs, timewindow and notary MUST be fully visible in the
+        # tear-off — a requester hiding the timewindow group would
+        # otherwise bypass expiry checking entirely
+        ftx.check_all_components_visible(ComponentGroupType.INPUTS)
+        ftx.check_all_components_visible(ComponentGroupType.TIMEWINDOW)
+        ftx.check_all_components_visible(ComponentGroupType.NOTARY)
+        inputs = ftx.components_of(ComponentGroupType.INPUTS)
+        tws = ftx.components_of(ComponentGroupType.TIMEWINDOW)
+        notaries = ftx.components_of(ComponentGroupType.NOTARY)
+        self._check_notary(notaries[0] if notaries else None, ftx.id)
+        self.check_time_window(tws[0] if tws else None)
+        self.uniqueness.commit(list(inputs), ftx.id, caller_name)
+        return self.sign(ftx.id)
+
+
+class ValidatingNotaryService(NotaryService):
+    """Validating: full resolution + signature + contract verification
+    before commit (reference: ValidatingNotaryFlow.kt:23-51)."""
+
+    def process(
+        self, stx: SignedTransaction, resolve_state, caller_name: str
+    ) -> TransactionSignature:
+        stx.verify_signatures_except({self.identity.owning_key})
+        wtx = stx.tx
+        self._check_notary(wtx.notary, stx.id)
+        ltx = wtx.to_ledger_transaction(resolve_state)
+        ltx.verify()
+        self.check_time_window(wtx.time_window)
+        self.uniqueness.commit(list(wtx.inputs), stx.id, caller_name)
+        return self.sign(stx.id)
+
+
+class _PendingRequest:
+    __slots__ = ("stx", "resolve_state", "caller", "future")
+
+    def __init__(self, stx, resolve_state, caller):
+        self.stx = stx
+        self.resolve_state = resolve_state
+        self.caller = caller
+        self.future: Future = Future()
+
+
+class BatchedNotaryService(NotaryService):
+    """The TPU-batched validating notary.
+
+    ``request()`` returns a Future[TransactionSignature]; requests flush as
+    one batch when ``max_batch`` accumulate or ``window_s`` elapses since
+    the first pending request. A flush:
+
+    1. verifies ALL signatures of the batch in one bucketed device dispatch
+       (``verifier.check_transactions`` — the per-signature JCA loop of the
+       reference collapsed into vmapped kernels);
+    2. runs contract/constraint verification per-tx on host;
+    3. settles uniqueness via one ``commit_batch`` round-trip;
+    4. signs every accepted tx id.
+
+    ``process_batch`` is the synchronous core, callable directly (the
+    loadtest harness and bench drive it without the window thread).
+    """
+
+    def __init__(
+        self, identity, keypair, uniqueness, *,
+        max_batch: int = 1024, window_s: float = 0.005,
+        use_device: bool = True, validating: bool = True,
+        metrics=None, clock=time.time,
+    ):
+        super().__init__(identity, keypair, uniqueness, clock)
+        self._max_batch = max_batch
+        self._window_s = window_s
+        self._use_device = use_device
+        self._validating = validating
+        self._metrics = metrics
+        self._pending: list[_PendingRequest] = []
+        self._lock = threading.Lock()
+        self._flusher: threading.Thread | None = None
+        self._wake = threading.Event()
+        self._stopped = False
+
+    # ---------------------------------------------------------- sync core
+
+    def process_batch(
+        self, requests: list[tuple[SignedTransaction, object, str]]
+    ) -> list[TransactionSignature | Exception]:
+        """Verify + commit + sign a batch; one result slot per request."""
+        from corda_tpu.verifier import check_transactions
+
+        n = len(requests)
+        results: list = [None] * n
+        stxs = [r[0] for r in requests]
+        report = check_transactions(
+            stxs,
+            [{self.identity.owning_key}] * n,
+            use_device=self._use_device,
+        )
+        live: list[int] = []
+        for i, err in enumerate(report.results):
+            if err is not None:
+                results[i] = NotaryError(f"signature check failed: {err}")
+            else:
+                live.append(i)
+        if self._validating:
+            still_live = []
+            for i in live:
+                stx, resolve_state, _caller = requests[i]
+                try:
+                    self._check_notary(stx.tx.notary, stx.id)
+                    ltx = stx.tx.to_ledger_transaction(resolve_state)
+                    ltx.verify()
+                    self.check_time_window(stx.tx.time_window)
+                    still_live.append(i)
+                except Exception as e:
+                    results[i] = NotaryError(f"validation failed: {e}")
+            live = still_live
+        else:
+            still_live = []
+            for i in live:
+                stx = requests[i][0]
+                try:
+                    self._check_notary(stx.tx.notary, stx.id)
+                    self.check_time_window(stx.tx.time_window)
+                    still_live.append(i)
+                except Exception as e:
+                    results[i] = e
+            live = still_live
+        commit_reqs = [
+            (list(requests[i][0].tx.inputs), requests[i][0].id, requests[i][2])
+            for i in live
+        ]
+        conflicts = self.uniqueness.commit_batch(commit_reqs)
+        for i, conflict in zip(live, conflicts):
+            if conflict is not None:
+                results[i] = NotaryError(
+                    f"input states of {requests[i][0].id} already consumed",
+                    conflict,
+                )
+            else:
+                results[i] = self.sign(requests[i][0].id)
+        if self._metrics is not None:
+            self._metrics.meter("notary.requests").mark(n)
+            self._metrics.meter("notary.committed").mark(
+                sum(1 for r in results if isinstance(r, TransactionSignature))
+            )
+        return results
+
+    # ---------------------------------------------------------- async path
+
+    def request(self, stx: SignedTransaction, resolve_state, caller: str) -> Future:
+        req = _PendingRequest(stx, resolve_state, caller)
+        with self._lock:
+            if self._stopped:
+                raise NotaryInternalException("notary service stopped")
+            self._pending.append(req)
+            if self._flusher is None:
+                self._flusher = threading.Thread(
+                    target=self._flush_loop, daemon=True, name="notary-batcher"
+                )
+                self._flusher.start()
+            if len(self._pending) >= self._max_batch:
+                self._wake.set()
+        return req.future
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._wake.wait(timeout=self._window_s)
+            self._wake.clear()
+            with self._lock:
+                batch, self._pending = self._pending, []
+                stopped = self._stopped
+            if batch:
+                try:
+                    results = self.process_batch(
+                        [(r.stx, r.resolve_state, r.caller) for r in batch]
+                    )
+                except Exception as e:  # batch-level failure fails every req
+                    results = [e] * len(batch)
+                for req, res in zip(batch, results):
+                    try:
+                        if isinstance(res, Exception):
+                            req.future.set_exception(res)
+                        else:
+                            req.future.set_result(res)
+                    except Exception:
+                        pass  # caller cancelled
+            if stopped:
+                return
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._stopped = True
+        self._wake.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=5)

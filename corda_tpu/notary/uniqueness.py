@@ -1,0 +1,168 @@
+"""Uniqueness providers: the consumed-state registry.
+
+Parity with the reference's ``UniquenessProvider``
+(core/.../node/services/UniquenessProvider.kt:15 — ``commit(states,
+txId, callerIdentity)`` raising ``UniquenessException(Conflict)`` listing
+which inputs were already consumed and by what) and
+``PersistentUniquenessProvider`` (node/.../services/transactions/
+PersistentUniquenessProvider.kt:92 — JPA append-only map). SQLite WAL
+append-only table here; the commit is atomic — either all inputs are
+marked consumed by this tx or none are.
+
+The batch path (``commit_batch``) is the TPU-notary addition: N requests
+settle in one storage round-trip, the shape the 10k-notarised-tx/sec
+target needs (SURVEY.md §7 hard part (e)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import sqlite3
+import threading
+
+from corda_tpu.crypto import SecureHash
+from corda_tpu.ledger import StateRef
+from corda_tpu.serialization import cbe_serializable
+
+
+@cbe_serializable(name="notary.ConsumedStateDetails")
+@dataclasses.dataclass(frozen=True)
+class ConsumedStateDetails:
+    """Who consumed a state (reference: UniquenessProvider.ConsumingTx —
+    id, inputIndex, requestingParty)."""
+
+    consuming_tx: SecureHash
+    input_index: int
+    requesting_party_name: str
+
+
+@cbe_serializable(name="notary.UniquenessConflict")
+@dataclasses.dataclass(frozen=True)
+class UniquenessConflict:
+    """(reference: UniquenessProvider.Conflict :21) — per-ref details of
+    the prior consumption."""
+
+    state_history: dict  # StateRef -> ConsumedStateDetails
+
+
+class NotaryError(Exception):
+    """(reference: NotaryException/NotaryError.Conflict)."""
+
+    def __init__(self, message: str, conflict: UniquenessConflict | None = None):
+        super().__init__(message)
+        self.conflict = conflict
+
+
+class UniquenessProvider:
+    def commit(self, states: list[StateRef], tx_id: SecureHash,
+               caller_name: str) -> None:
+        raise NotImplementedError
+
+    def commit_batch(
+        self, requests: list[tuple[list[StateRef], SecureHash, str]]
+    ) -> list[UniquenessConflict | None]:
+        """Default batch = loop; subclasses override with one round-trip.
+        Returns per-request None (committed) or the conflict. Requests
+        within a batch are settled in order, so two requests spending the
+        same input conflict deterministically (first wins)."""
+        out: list[UniquenessConflict | None] = []
+        for states, tx_id, caller in requests:
+            try:
+                self.commit(states, tx_id, caller)
+                out.append(None)
+            except NotaryError as e:
+                out.append(e.conflict)
+        return out
+
+
+def _ref_key(ref: StateRef) -> bytes:
+    return ref.txhash.bytes + ref.index.to_bytes(4, "big")
+
+
+class InMemoryUniquenessProvider(UniquenessProvider):
+    """Dict-backed provider for tests/mock networks."""
+
+    def __init__(self):
+        self._map: dict[bytes, ConsumedStateDetails] = {}
+        self._lock = threading.Lock()
+
+    def commit(self, states, tx_id, caller_name) -> None:
+        with self._lock:
+            conflict = {}
+            for i, ref in enumerate(states):
+                prior = self._map.get(_ref_key(ref))
+                if prior is not None and prior.consuming_tx != tx_id:
+                    conflict[ref] = prior
+            if conflict:
+                raise NotaryError(
+                    f"input states of {tx_id} already consumed",
+                    UniquenessConflict(conflict),
+                )
+            for i, ref in enumerate(states):
+                self._map.setdefault(
+                    _ref_key(ref), ConsumedStateDetails(tx_id, i, caller_name)
+                )
+
+
+class PersistentUniquenessProvider(UniquenessProvider):
+    """SQLite append-only committed-states map (reference:
+    PersistentUniquenessProvider.kt:92). Re-notarisation of the same tx is
+    idempotent — returning success, the reference's behavior, so a client
+    retrying after a lost response gets its signature."""
+
+    def __init__(self, path: str = ":memory:"):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS notary_commits ("
+            " state_key BLOB PRIMARY KEY,"
+            " consuming_tx BLOB NOT NULL, input_index INTEGER NOT NULL,"
+            " caller TEXT NOT NULL)"
+        )
+        self._db.commit()
+        self._lock = threading.Lock()
+
+    def commit(self, states, tx_id, caller_name) -> None:
+        conflicts = self.commit_batch([(states, tx_id, caller_name)])[0]
+        if conflicts is not None:
+            raise NotaryError(
+                f"input states of {tx_id} already consumed", conflicts
+            )
+
+    def commit_batch(self, requests):
+        out = []
+        with self._lock:
+            for states, tx_id, caller in requests:
+                conflict = {}
+                for ref in states:
+                    row = self._db.execute(
+                        "SELECT consuming_tx, input_index, caller"
+                        " FROM notary_commits WHERE state_key=?",
+                        (_ref_key(ref),),
+                    ).fetchone()
+                    if row is not None and row[0] != tx_id.bytes:
+                        conflict[ref] = ConsumedStateDetails(
+                            SecureHash(row[0]), row[1], row[2]
+                        )
+                if conflict:
+                    self._db.rollback()
+                    out.append(UniquenessConflict(conflict))
+                    continue
+                for i, ref in enumerate(states):
+                    self._db.execute(
+                        "INSERT OR IGNORE INTO notary_commits VALUES (?,?,?,?)",
+                        (_ref_key(ref), tx_id.bytes, i, caller),
+                    )
+                self._db.commit()
+                out.append(None)
+        return out
+
+    def committed_count(self) -> int:
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM notary_commits"
+            ).fetchone()[0]
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

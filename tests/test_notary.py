@@ -1,0 +1,378 @@
+"""Notary tier tests — the reference's coverage model:
+PersistentUniquenessProviderTests, NotaryServiceTests /
+ValidatingNotaryServiceTests (wrong-notary, conflict, time-window cases),
+RaftNotaryServiceTests (cluster-of-3 in one process, double-spend across
+cluster), BFTNotaryServiceTests (f faulty replicas tolerated)."""
+
+import dataclasses
+import time
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sha256
+from corda_tpu.ledger import (
+    Amount,
+    ComponentGroupType,
+    CordaX500Name,
+    FilteredTransaction,
+    Party,
+    StateRef,
+    TimeWindow,
+    TransactionBuilder,
+)
+from corda_tpu.messaging import InMemoryMessagingNetwork
+from corda_tpu.notary import (
+    BatchedNotaryService,
+    BFTUniquenessProvider,
+    InMemoryUniquenessProvider,
+    NotaryError,
+    PersistentUniquenessProvider,
+    RaftUniquenessProvider,
+    SimpleNotaryService,
+    ValidatingNotaryService,
+)
+from corda_tpu.serialization import register_custom
+
+
+# ----------------------------------------------------------- fixtures
+
+@dataclasses.dataclass(frozen=True)
+class NState:
+    value: int
+    owner: Party
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class NCommand:
+    op: str = "issue"
+
+
+register_custom(
+    NState, "test.NState",
+    to_fields=lambda s: {"value": s.value, "owner": s.owner},
+    from_fields=lambda d: NState(d["value"], d["owner"]),
+)
+register_custom(
+    NCommand, "test.NCommand",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: NCommand(d["op"]),
+)
+
+from corda_tpu.ledger import register_contract
+
+
+@register_contract("test.NContract")
+class NContract:
+    def verify(self, tx):
+        if any(s.value < 0 for s in tx.outputs_of_type(NState)):
+            raise ValueError("negative value")
+
+
+def _party(name):
+    kp = generate_keypair()
+    return Party(CordaX500Name(name, "London", "GB"), kp.public), kp
+
+
+@pytest.fixture(scope="module")
+def alice():
+    return _party("Alice Corp")
+
+
+@pytest.fixture(scope="module")
+def notary_id():
+    return _party("Notary Service")
+
+
+def _refs(*tags):
+    return [StateRef(sha256(t.encode()), 0) for t in tags]
+
+
+def make_issue(alice, notary, value=10, tw=None):
+    b = TransactionBuilder(notary=notary[0])
+    b.add_output_state(NState(value, alice[0]), "test.NContract")
+    b.add_command(NCommand("issue"), alice[0].owning_key)
+    if tw is not None:
+        b.set_time_window(tw)
+    return b.sign_initial_transaction(alice[1])
+
+
+def make_spend(alice, notary, issue_stx, value=10, tw=None, bad=False):
+    b = TransactionBuilder(notary=notary[0])
+    b.add_input_state(issue_stx.tx.out_ref(0))
+    b.add_output_state(NState(-1 if bad else value, alice[0]), "test.NContract")
+    b.add_command(NCommand("move"), alice[0].owning_key)
+    if tw is not None:
+        b.set_time_window(tw)
+    return b.sign_initial_transaction(alice[1])
+
+
+def resolver_for(*stxs):
+    txs = {stx.id: stx for stx in stxs}
+
+    def resolve(ref):
+        return txs[ref.txhash].tx.outputs[ref.index]
+
+    return resolve
+
+
+# ----------------------------------------------------------- uniqueness
+
+@pytest.mark.parametrize("provider_cls", [
+    InMemoryUniquenessProvider, PersistentUniquenessProvider,
+])
+class TestUniqueness:
+    def test_commit_then_conflict(self, provider_cls):
+        p = provider_cls()
+        tx1, tx2 = sha256(b"tx1"), sha256(b"tx2")
+        p.commit(_refs("a", "b"), tx1, "alice")
+        with pytest.raises(NotaryError) as ei:
+            p.commit(_refs("b", "c"), tx2, "bob")
+        conflict = ei.value.conflict
+        assert _refs("b")[0] in conflict.state_history
+        details = conflict.state_history[_refs("b")[0]]
+        assert details.consuming_tx == tx1
+        assert details.requesting_party_name == "alice"
+        # the failed commit must not have consumed "c"
+        p.commit(_refs("c"), sha256(b"tx3"), "carol")
+
+    def test_idempotent_recommit(self, provider_cls):
+        p = provider_cls()
+        tx1 = sha256(b"tx1")
+        p.commit(_refs("a"), tx1, "alice")
+        p.commit(_refs("a"), tx1, "alice")  # same tx retry succeeds
+
+    def test_batch_first_wins(self, provider_cls):
+        p = provider_cls()
+        results = p.commit_batch([
+            (_refs("a"), sha256(b"t1"), "x"),
+            (_refs("a"), sha256(b"t2"), "y"),
+            (_refs("b"), sha256(b"t3"), "z"),
+        ])
+        assert results[0] is None
+        assert results[1] is not None  # in-batch conflict detected
+        assert results[2] is None
+
+
+# ----------------------------------------------------------- services
+
+class TestSimpleNotary:
+    def _service(self, notary_id, clock=time.time):
+        return SimpleNotaryService(
+            notary_id[0], notary_id[1], InMemoryUniquenessProvider(), clock
+        )
+
+    def _tearoff(self, stx):
+        visible = {
+            ComponentGroupType.INPUTS, ComponentGroupType.TIMEWINDOW,
+            ComponentGroupType.NOTARY,
+        }
+        return FilteredTransaction.build(stx.tx, lambda c, g: g in visible)
+
+    def test_sign_and_double_spend(self, alice, notary_id):
+        svc = self._service(notary_id)
+        issue = make_issue(alice, notary_id)
+        spend1 = make_spend(alice, notary_id, issue)
+        sig = svc.process(self._tearoff(spend1), "alice")
+        sig.verify(spend1.id)
+        spend2 = make_spend(alice, notary_id, issue, value=11)
+        with pytest.raises(NotaryError):
+            svc.process(self._tearoff(spend2), "alice")
+
+    def test_wrong_notary_rejected(self, alice, notary_id):
+        other = _party("Other Notary")
+        svc = self._service(notary_id)
+        spend = make_spend(alice, other, make_issue(alice, other))
+        with pytest.raises(NotaryError):
+            svc.process(self._tearoff(spend), "alice")
+
+    def test_expired_time_window(self, alice, notary_id):
+        svc = self._service(notary_id, clock=lambda: 10_000.0)
+        tw = TimeWindow.until_only(int(1_000.0 * 1e6))  # expired long ago
+        spend = make_spend(alice, notary_id, make_issue(alice, notary_id), tw=tw)
+        with pytest.raises(NotaryError):
+            svc.process(self._tearoff(spend), "alice")
+
+
+class TestValidatingNotary:
+    def test_validates_contracts(self, alice, notary_id):
+        svc = ValidatingNotaryService(
+            notary_id[0], notary_id[1], InMemoryUniquenessProvider()
+        )
+        issue = make_issue(alice, notary_id)
+        good = make_spend(alice, notary_id, issue)
+        sig = svc.process(good, resolver_for(issue), "alice")
+        sig.verify(good.id)
+        # a contract-invalid spend is rejected before any commit
+        bad = make_spend(alice, notary_id, issue, bad=True)
+        with pytest.raises(Exception):
+            svc.process(bad, resolver_for(issue), "alice")
+
+    def test_missing_signature_rejected(self, alice, notary_id):
+        svc = ValidatingNotaryService(
+            notary_id[0], notary_id[1], InMemoryUniquenessProvider()
+        )
+        issue = make_issue(alice, notary_id)
+        spend = make_spend(alice, notary_id, issue)
+        # replace alice's signature with an unrelated party's: required
+        # signer no longer covered
+        mallory = _party("Mallory Inc")
+        from corda_tpu.crypto import sign_tx_id
+
+        wrong_sig = sign_tx_id(mallory[1].private, mallory[1].public, spend.id)
+        import dataclasses as dc
+
+        stripped = dc.replace(spend, sigs=(wrong_sig,))
+        with pytest.raises(Exception):
+            svc.process(stripped, resolver_for(issue), "alice")
+
+
+class TestBatchedNotary:
+    def test_process_batch_mixed(self, alice, notary_id):
+        svc = BatchedNotaryService(
+            notary_id[0], notary_id[1], PersistentUniquenessProvider(),
+            use_device=False,
+        )
+        issues = [make_issue(alice, notary_id, value=i) for i in range(4)]
+        spends = [make_spend(alice, notary_id, s, value=20 + i)
+                  for i, s in enumerate(issues)]
+        double = make_spend(alice, notary_id, issues[0], value=99)
+        resolve = resolver_for(*issues)
+        reqs = [(s, resolve, "alice") for s in spends]
+        reqs.append((double, resolve, "alice"))
+        results = svc.process_batch(reqs)
+        for s, r in zip(spends, results[:4]):
+            r.verify(s.id)  # TransactionSignature
+        assert isinstance(results[4], NotaryError)
+        assert results[4].conflict is not None
+
+    def test_async_window_flush(self, alice, notary_id):
+        svc = BatchedNotaryService(
+            notary_id[0], notary_id[1], InMemoryUniquenessProvider(),
+            use_device=False, window_s=0.01, max_batch=64,
+        )
+        issue = make_issue(alice, notary_id)
+        spend = make_spend(alice, notary_id, issue)
+        fut = svc.request(spend, resolver_for(issue), "alice")
+        sig = fut.result(timeout=5)
+        sig.verify(spend.id)
+        svc.shutdown()
+
+
+# ----------------------------------------------------------- raft
+
+class TestRaft:
+    def test_cluster_commit_and_conflict(self):
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            providers = RaftUniquenessProvider.make_cluster(
+                ["r0", "r1", "r2"], net
+            )
+            # wait for a leader
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if any(p.node.role == "leader" for p in providers):
+                    break
+                time.sleep(0.02)
+            leader = next(p for p in providers if p.node.role == "leader")
+            leader.commit(_refs("a", "b"), sha256(b"tx1"), "alice")
+            # double spend via a *different* replica (forwarded to leader)
+            follower = next(p for p in providers if p.node.role != "leader")
+            with pytest.raises(NotaryError):
+                follower.commit(_refs("b"), sha256(b"tx2"), "bob")
+            # all replicas applied the committed entry
+            deadline = time.monotonic() + 2
+            while time.monotonic() < deadline:
+                if all(p.node.last_applied >= 0 for p in providers):
+                    break
+                time.sleep(0.02)
+            assert all(p.node.last_applied >= 0 for p in providers)
+            for p in providers:
+                p.node.stop()
+        finally:
+            net.stop_pumping()
+
+    def test_leader_failover(self):
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            providers = RaftUniquenessProvider.make_cluster(
+                ["f0", "f1", "f2"], net
+            )
+            deadline = time.monotonic() + 5
+            leader = None
+            while time.monotonic() < deadline and leader is None:
+                leader = next(
+                    (p for p in providers if p.node.role == "leader"), None
+                )
+                time.sleep(0.02)
+            assert leader is not None
+            leader.commit(_refs("x"), sha256(b"tx1"), "alice")
+            # kill the leader: survivors elect a new one and still serve
+            leader.node.stop()
+            net.stop_node(leader.node.name)
+            survivors = [p for p in providers if p is not leader]
+            deadline = time.monotonic() + 5
+            new_leader = None
+            while time.monotonic() < deadline and new_leader is None:
+                new_leader = next(
+                    (p for p in survivors if p.node.role == "leader"), None
+                )
+                time.sleep(0.02)
+            assert new_leader is not None
+            # committed data survives the failover
+            with pytest.raises(NotaryError):
+                new_leader.commit(_refs("x"), sha256(b"tx9"), "mallory")
+            new_leader.commit(_refs("y"), sha256(b"tx2"), "bob")
+            for p in survivors:
+                p.node.stop()
+        finally:
+            net.stop_pumping()
+
+
+# ----------------------------------------------------------- bft
+
+class TestBFT:
+    def test_cluster_commit_conflict_and_crash(self):
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            replicas, make_client = BFTUniquenessProvider.make_cluster(4, net)
+            provider = make_client("client-1")
+            provider.commit(_refs("a", "b"), sha256(b"tx1"), "alice")
+            with pytest.raises(NotaryError) as ei:
+                provider.commit(_refs("b"), sha256(b"tx2"), "bob")
+            assert ei.value.conflict is not None
+            # crash one non-primary replica (f=1): cluster keeps working
+            net.stop_node(replicas[3].name)
+            provider.commit(_refs("c"), sha256(b"tx3"), "carol")
+        finally:
+            net.stop_pumping()
+
+    def test_equivocating_primary_cannot_split_quorum(self):
+        """Votes for different digests at one sequence must not conflate:
+        inject a forged commit vote for a digest that was never
+        pre-prepared locally — it must not count toward the real digest's
+        quorum."""
+        from corda_tpu.notary.bft import BFTReplica, T_COMMIT, _digest
+        from corda_tpu.serialization import serialize as ser
+
+        net = InMemoryMessagingNetwork()
+        replicas, make_client = BFTUniquenessProvider.make_cluster(4, net)
+        r0 = replicas[0]
+        command_a = ser((_refs("a"), sha256(b"txA"), "alice"))
+        command_b = ser((_refs("a"), sha256(b"txB"), "bob"))
+        da, db = _digest(command_a), _digest(command_b)
+        with r0._lock:
+            r0._preprepared[0] = da
+            r0._commands[da] = command_a
+            r0._prepares[(0, da)].add(r0.name)
+        # forged commits for digest B land at seq 0
+        for sender in ("bft-replica-1", "bft-replica-2", "bft-replica-3"):
+            r0._commits[(0, db)].add(sender)
+        r0._check_committed(0)
+        assert r0._next_exec == 0  # B-votes did not commit digest A
