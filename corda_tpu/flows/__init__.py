@@ -29,6 +29,22 @@ from .api import (
 )
 from .checkpoints import CheckpointStorage
 from .engine import FlowHandle, StateMachineManager
+from .protocols import (
+    AbstractStateReplacementFlow,
+    BroadcastTransactionFlow,
+    CollectSignaturesFlow,
+    ContractUpgradeFlow,
+    FetchRequest,
+    FinalityFlow,
+    NotaryChangeFlow,
+    NotaryException,
+    NotaryFlowClient,
+    NotaryServiceFlow,
+    ReceiveTransactionFlow,
+    ResolveTransactionsFlow,
+    SendTransactionFlow,
+    SignTransactionFlow,
+)
 from .sessions import (
     SessionConfirm,
     SessionData,
@@ -42,6 +58,11 @@ __all__ = [
     "ProgressTracker", "UntrustworthyData",
     "CheckpointStorage",
     "FlowHandle", "StateMachineManager",
+    "AbstractStateReplacementFlow", "BroadcastTransactionFlow",
+    "CollectSignaturesFlow", "ContractUpgradeFlow", "FetchRequest",
+    "FinalityFlow", "NotaryChangeFlow", "NotaryException",
+    "NotaryFlowClient", "NotaryServiceFlow", "ReceiveTransactionFlow",
+    "ResolveTransactionsFlow", "SendTransactionFlow", "SignTransactionFlow",
     "SessionConfirm", "SessionData", "SessionEnd", "SessionInit",
     "SessionReject",
 ]

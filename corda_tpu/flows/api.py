@@ -36,9 +36,31 @@ from typing import Any, Callable
 from corda_tpu.ledger import Party
 
 
+# FlowException subclasses auto-register so a propagated error re-raises as
+# the same type on the counterparty (the reference serializes the actual
+# exception object across sessions; we carry "ClassName: message")
+_FLOW_EXCEPTION_TYPES: dict[str, type] = {}
+
+
 class FlowException(Exception):
     """Errors that propagate across sessions to the counterparty
     (reference: core/.../flows/FlowException.kt)."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        _FLOW_EXCEPTION_TYPES[cls.__name__] = cls
+
+
+_FLOW_EXCEPTION_TYPES["FlowException"] = FlowException
+
+
+def rehydrate_flow_exception(message: str) -> FlowException:
+    """Rebuild the typed FlowException a counterparty propagated."""
+    name, sep, rest = message.partition(": ")
+    cls = _FLOW_EXCEPTION_TYPES.get(name)
+    if sep and cls is not None:
+        return cls(rest)
+    return FlowException(message)
 
 
 class UntrustworthyData:

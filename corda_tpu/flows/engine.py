@@ -39,6 +39,7 @@ from .api import (
     FlowSession,
     class_path,
     load_class,
+    rehydrate_flow_exception,
     responder_for,
 )
 from .checkpoints import CheckpointStorage
@@ -175,7 +176,7 @@ class _FlowExecutor:
             rec = effect(idx)
             # effect already recorded (pre-ack); skip double record
         if "end" in rec:
-            raise FlowException(rec["end"])
+            raise rehydrate_flow_exception(rec["end"])
         return deserialize(rec["payload"])
 
     def open_session(self, flow: FlowLogic, party: Party) -> FlowSession:

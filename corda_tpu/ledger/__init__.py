@@ -23,12 +23,14 @@ from .states import (
     ContractState,
     HashAttachmentConstraint,
     Issued,
+    NotaryChangeCommand,
     StateAndRef,
     StateRef,
     TimeWindow,
     TransactionState,
     TransactionVerificationException,
     UniqueIdentifier,
+    UpgradeCommand,
     WhitelistedByZoneAttachmentConstraint,
     contract_code_hash,
     register_contract,
@@ -57,9 +59,11 @@ __all__ = [
     "Party", "PartyAndCertificate",
     "AlwaysAcceptAttachmentConstraint", "Amount", "AttachmentConstraint",
     "Command", "CommandWithParties", "ContractState",
-    "HashAttachmentConstraint", "Issued", "StateAndRef", "StateRef",
+    "HashAttachmentConstraint", "Issued", "NotaryChangeCommand",
+    "StateAndRef", "StateRef",
     "TimeWindow", "TransactionState", "TransactionVerificationException",
-    "UniqueIdentifier", "WhitelistedByZoneAttachmentConstraint",
+    "UniqueIdentifier", "UpgradeCommand",
+    "WhitelistedByZoneAttachmentConstraint",
     "contract_code_hash", "register_contract", "resolve_contract",
     "ComponentGroupType", "PrivacySalt", "WireTransaction",
     "SignaturesMissingException", "SignedTransaction",

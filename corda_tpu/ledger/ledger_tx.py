@@ -21,11 +21,13 @@ from .identity import Party
 from .states import (
     Command,
     CommandWithParties,
+    NotaryChangeCommand,
     StateAndRef,
     StateRef,
     TimeWindow,
     TransactionState,
     TransactionVerificationException,
+    UpgradeCommand,
     contract_code_hash,
     resolve_contract,
 )
@@ -152,11 +154,116 @@ class LedgerTransaction:
     def verify(self) -> None:
         """Full semantic verification (reference: LedgerTransaction.verify,
         :77-128). Signature checking lives on SignedTransaction; this is the
-        contract-semantics half the out-of-process verifier runs."""
+        contract-semantics half the out-of-process verifier runs.
+
+        Notary-change and contract-upgrade transactions are special forms
+        (the reference models them as distinct wire-transaction types exempt
+        from contract code); they verify structurally instead."""
+        if self.commands_of_type(NotaryChangeCommand):
+            self._verify_notary_change()
+            return
+        if self.commands_of_type(UpgradeCommand):
+            self._verify_contract_upgrade()
+            return
         self.check_no_notary_change()
         self.check_encumbrances()
         self.verify_constraints()
         self.verify_contracts()
+
+    # ------------------------------------------------ special tx forms
+    def _check_participants_are_signers(self, cmd: Command) -> None:
+        """Every participant of every consumed state must be a required
+        signer — without this anyone could re-point or upgrade someone
+        else's state (the reference enforces it via the state-replacement
+        tx's required signing keys)."""
+        signers = set(cmd.signers)
+        for sr in self.inputs:
+            for p in sr.state.data.participants:
+                key = getattr(p, "owning_key", p)
+                if key not in signers:
+                    raise TransactionVerificationException(
+                        self.tx_id,
+                        "state-replacement command is missing a participant "
+                        "signer",
+                    )
+
+    def _verify_notary_change(self) -> None:
+        """Inputs re-notarised verbatim: same data, same contract, new
+        notary on every output (reference: NotaryChangeWireTransaction —
+        exempt from contract verification by construction)."""
+        cmds = self.commands_of_type(NotaryChangeCommand)
+        if len(self.commands) != 1 or len(cmds) != 1:
+            raise TransactionVerificationException(
+                self.tx_id, "notary-change tx must carry exactly one command"
+            )
+        new_notary = cmds[0].value.new_notary
+        self._check_participants_are_signers(cmds[0])
+        if len(self.inputs) == 0 or len(self.inputs) != len(self.outputs):
+            raise TransactionVerificationException(
+                self.tx_id, "notary-change tx must map each input to one output"
+            )
+        for sr, out in zip(self.inputs, self.outputs):
+            # everything except the notary must be preserved VERBATIM —
+            # comparing only data would let the tx silently drop an
+            # encumbrance or swap the attachment constraint
+            if dataclasses.replace(sr.state, notary=new_notary) != out:
+                raise TransactionVerificationException(
+                    self.tx_id,
+                    "notary-change tx altered more than the notary",
+                )
+
+    def _verify_contract_upgrade(self) -> None:
+        """Each output must be exactly ``NewContract.upgrade(input)`` with
+        ``NewContract.legacy_contract`` naming the old contract (reference:
+        ContractUpgradeFlow.kt upgrade validation)."""
+        cmds = self.commands_of_type(UpgradeCommand)
+        if len(self.commands) != 1 or len(cmds) != 1:
+            raise TransactionVerificationException(
+                self.tx_id, "upgrade tx must carry exactly one command"
+            )
+        new_name = cmds[0].value.upgraded_contract
+        self._check_participants_are_signers(cmds[0])
+        new_cls = resolve_contract(new_name)
+        legacy = getattr(new_cls, "legacy_contract", None)
+        if legacy is None:
+            raise TransactionVerificationException(
+                self.tx_id,
+                f"contract {new_name} does not declare legacy_contract",
+            )
+        if len(self.inputs) == 0 or len(self.inputs) != len(self.outputs):
+            raise TransactionVerificationException(
+                self.tx_id, "upgrade tx must map each input to one output"
+            )
+        for sr, out in zip(self.inputs, self.outputs):
+            if sr.state.contract != legacy:
+                raise TransactionVerificationException(
+                    self.tx_id,
+                    f"input contract {sr.state.contract} is not the declared "
+                    f"legacy contract {legacy}",
+                )
+            if out.contract != new_name:
+                raise TransactionVerificationException(
+                    self.tx_id, "upgrade output not under the new contract"
+                )
+            expected = new_cls.upgrade(sr.state.data)
+            if out.data != expected:
+                raise TransactionVerificationException(
+                    self.tx_id, "upgrade output is not upgrade(input)"
+                )
+            if out.notary != sr.state.notary:
+                raise TransactionVerificationException(
+                    self.tx_id, "upgrade tx must not change the notary"
+                )
+            # encumbrance and constraint carry over verbatim — an upgrade
+            # must not be a loophole for shedding either
+            if out.encumbrance != sr.state.encumbrance:
+                raise TransactionVerificationException(
+                    self.tx_id, "upgrade tx must not change the encumbrance"
+                )
+            if out.constraint != sr.state.constraint:
+                raise TransactionVerificationException(
+                    self.tx_id, "upgrade tx must not change the constraint"
+                )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -78,6 +78,13 @@ def contract_code_hash(name: str) -> SecureHash:
     return sha256(b"CTCONTRACT" + name.encode())
 
 
+def registered_contract_code_hashes() -> set:
+    """Code hashes of every locally-registered contract — the set of
+    pseudo-attachments that are satisfied by the contract registry rather
+    than by a stored attachment blob."""
+    return {contract_code_hash(n) for n in _CONTRACT_REGISTRY}
+
+
 @dataclasses.dataclass(frozen=True)
 class UniqueIdentifier:
     """External id + uuid for linear states (reference: UniqueIdentifier)."""
@@ -169,6 +176,28 @@ class Command:
     def __post_init__(self):
         if not self.signers:
             raise ValueError("command must have at least one signer")
+
+
+@dataclasses.dataclass(frozen=True)
+class NotaryChangeCommand:
+    """Marks a transaction as a notary-change: inputs are re-pointed at
+    ``new_notary`` with state data unchanged. The reference models this as a
+    distinct wire-transaction type (NotaryChangeWireTransaction) exempt from
+    contract verification; here it is a built-in command that switches
+    LedgerTransaction.verify onto a structural equality check instead."""
+
+    new_notary: Party
+
+
+@dataclasses.dataclass(frozen=True)
+class UpgradeCommand:
+    """Marks a contract-upgrade transaction (reference: UpgradeCommand in
+    ContractUpgradeFlow.kt). The upgraded contract class must declare
+    ``legacy_contract`` (the old registered name) and a static
+    ``upgrade(old_state) -> new_state``; verification checks every output is
+    exactly the upgrade image of its input."""
+
+    upgraded_contract: str
 
 
 @dataclasses.dataclass(frozen=True)
@@ -318,6 +347,16 @@ register_custom(
     Command, "ledger.Command",
     to_fields=lambda c: {"value": c.value, "signers": list(c.signers)},
     from_fields=lambda d: Command(d["value"], tuple(d["signers"])),
+)
+register_custom(
+    NotaryChangeCommand, "ledger.NotaryChangeCommand",
+    to_fields=lambda c: {"new_notary": c.new_notary},
+    from_fields=lambda d: NotaryChangeCommand(d["new_notary"]),
+)
+register_custom(
+    UpgradeCommand, "ledger.UpgradeCommand",
+    to_fields=lambda c: {"upgraded_contract": c.upgraded_contract},
+    from_fields=lambda d: UpgradeCommand(d["upgraded_contract"]),
 )
 register_custom(
     Issued, "ledger.Issued",

@@ -17,11 +17,14 @@ the same surface, so flow/session code is transport-blind.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from collections import deque
 
 from .queue import Message
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,7 +125,18 @@ class _InMemoryNode(MessagingClient):
                 self._inbox.append(msg)
             return False
         for h in handlers:
-            h(msg)
+            try:
+                h(msg)
+            except Exception:
+                # a handler crashing on one (possibly hostile) message must
+                # not kill delivery for the whole network — a Byzantine
+                # replica sending garbage would otherwise stop the shared
+                # pump thread, a total liveness loss. Mirrors the broker's
+                # per-message error isolation.
+                logger.exception(
+                    "handler for topic %r failed on message from %s",
+                    msg.topic, msg.sender,
+                )
         return True
 
     def stop(self) -> None:

@@ -55,6 +55,7 @@ class NetworkMapCache:
         self._lock = threading.RLock()
         self._nodes: dict[CordaX500Name, NodeInfo] = {}
         self._notaries: list[Party] = []
+        self._validating_notaries: set = set()  # owning_key set
         self._subscribers: list = []
 
     def add_node(self, info: NodeInfo) -> None:
@@ -98,10 +99,14 @@ class NetworkMapCache:
 
     # -- notaries -------------------------------------------------------------
 
-    def add_notary(self, party: Party) -> None:
+    def add_notary(self, party: Party, validating: bool = True) -> None:
         with self._lock:
             if all(n.owning_key != party.owning_key for n in self._notaries):
                 self._notaries.append(party)
+            if validating:
+                self._validating_notaries.add(party.owning_key)
+            else:
+                self._validating_notaries.discard(party.owning_key)
 
     @property
     def notary_identities(self) -> list[Party]:
@@ -120,6 +125,14 @@ class NetworkMapCache:
     def is_notary(self, party: Party) -> bool:
         with self._lock:
             return any(n.owning_key == party.owning_key for n in self._notaries)
+
+    def is_validating_notary(self, party: Party) -> bool:
+        """Whether the notary runs the validating protocol — decides what
+        the client sends it: the full SignedTransaction (validating) or a
+        privacy-preserving tear-off (non-validating). Reference: the service
+        type advertised in the network map entry."""
+        with self._lock:
+            return party.owning_key in self._validating_notaries
 
 
 class NetworkMapClient:

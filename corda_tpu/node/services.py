@@ -51,6 +51,7 @@ class ServiceHub:
         verifier_service=None,
         metrics: MetricRegistry | None = None,
         clock=time.time,
+        notary_service=None,
     ):
         self.my_info = my_info
         self.key_management_service = key_management_service or KeyManagementService()
@@ -65,6 +66,9 @@ class ServiceHub:
         self.metrics = metrics or MetricRegistry()
         self.clock = clock
         self.scheduler_service = None  # wired by the node container
+        # the NotaryService this node runs, if it is a notary (reference:
+        # AbstractNode.makeCoreNotaryService, AbstractNode.kt:615-632)
+        self.notary_service = notary_service
 
     # -- identity conveniences ------------------------------------------------
 

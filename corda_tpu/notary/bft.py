@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from concurrent.futures import Future
 
 from corda_tpu.crypto import (
@@ -82,6 +82,11 @@ class BFTReplica:
         self._commits: dict[tuple[int, bytes], set[str]] = defaultdict(set)
         self._next_exec = 0               # execute strictly in sequence order
         self._exec_queue: dict[int, bytes] = {}
+        # recently-executed digests (bounded): a late/duplicate T_REQUEST
+        # for an already-executed command must not re-insert _commands
+        # entries that nothing will ever prune
+        self._executed_digests: deque = deque(maxlen=4096)
+        self._executed_set: set[bytes] = set()
         for topic, h in (
             (T_REQUEST, self._on_request), (T_PREPREPARE, self._on_preprepare),
             (T_PREPARE, self._on_prepare), (T_COMMIT, self._on_commit),
@@ -91,6 +96,19 @@ class BFTReplica:
     @property
     def is_primary(self) -> bool:
         return self.name == self.names[0]
+
+    MAX_PENDING_COMMANDS = 10_000
+
+    def _bound_pending(self) -> None:
+        """Cap _commands/_client_of (caller holds the lock): requests the
+        primary never orders (primary down, client gave up) must not grow
+        memory forever. Evicts oldest-inserted first; a legitimately
+        pending command that gets evicted is restored by the client's
+        retry broadcast."""
+        while len(self._commands) > self.MAX_PENDING_COMMANDS:
+            oldest = next(iter(self._commands))
+            self._commands.pop(oldest, None)
+            self._client_of.pop(oldest, None)
 
     def _multicast(self, topic: str, obj) -> None:
         payload = serialize(obj)
@@ -105,8 +123,11 @@ class BFTReplica:
         command = req["command"]
         d = _digest(command)
         with self._lock:
+            if d in self._executed_set:
+                return  # late duplicate of an executed command
             self._commands[d] = command
             self._client_of[d] = req["client"]
+            self._bound_pending()
             if not self.is_primary:
                 return
             seq = self._seq
@@ -184,8 +205,14 @@ class BFTReplica:
             while self._next_exec in self._exec_queue:
                 seq_i = self._next_exec
                 d_i = self._exec_queue.pop(seq_i)
-                to_run.append((seq_i, d_i, self._commands[d_i],
-                               self._client_of.get(d_i)))
+                # a client retry can order the same digest under two
+                # sequence numbers; the first execution pruned the command,
+                # so the duplicate slot is a no-op (commit is idempotent
+                # per tx anyway)
+                command_i = self._commands.get(d_i)
+                if command_i is not None:
+                    to_run.append((seq_i, d_i, command_i,
+                                   self._client_of.get(d_i)))
                 self._next_exec += 1
                 # prune per-sequence protocol state (bounded memory at
                 # sustained notarisation rates)
@@ -195,6 +222,12 @@ class BFTReplica:
                         del store[key]
                 self._commands.pop(d_i, None)
                 self._client_of.pop(d_i, None)
+                if d_i not in self._executed_set:
+                    if (len(self._executed_digests)
+                            == self._executed_digests.maxlen):
+                        self._executed_set.discard(self._executed_digests[0])
+                    self._executed_digests.append(d_i)
+                    self._executed_set.add(d_i)
         for seq_i, d_i, command, client in to_run:
             self._execute(seq_i, d_i, command, client)
 
@@ -235,34 +268,30 @@ class BFTClusterClient:
         # digest -> {outcome_bytes: {replica: sig}}
         self._replies: dict[bytes, dict[bytes, dict[str, bytes]]] = {}
         self._futures: dict[bytes, Future] = {}
-        messaging.add_handler(T_REPLY, self._on_reply)
+        messaging.add_handler(T_REPLY, auto_ack(self._on_reply))
 
-    def _on_reply(self, msg, ack=None) -> None:
+    def _on_reply(self, msg) -> None:
         rep = deserialize(msg.payload)
         replica, outcome, sig = rep["replica"], rep["outcome"], rep["sig"]
         key = self._keys.get(replica)
         if key is None or rep["key"] != key:
-            if ack:
-                ack()
             return
         try:
             if not host_verify(key, sig, outcome):
-                if ack:
-                    ack()
                 return
         except Exception:
-            if ack:
-                ack()
             return
         d = rep["digest"]
         with self._lock:
             fut = self._futures.get(d)
+            if fut is None:
+                # late reply for an already-settled (or unknown) request —
+                # don't recreate reply buckets for it (unbounded growth)
+                return
             bucket = self._replies.setdefault(d, {}).setdefault(outcome, {})
             bucket[replica] = sig
-            if fut is not None and not fut.done() and len(bucket) >= self.f + 1:
+            if not fut.done() and len(bucket) >= self.f + 1:
                 fut.set_result((outcome, dict(bucket)))
-        if ack:
-            ack()
 
     def submit(self, states, tx_id, caller: str):
         """Returns (conflict_or_None, {replica: sig}) after quorum."""
@@ -279,6 +308,7 @@ class BFTClusterClient:
         finally:
             with self._lock:
                 self._futures.pop(d, None)
+                self._replies.pop(d, None)
         outcome = deserialize(outcome_bytes)
         return outcome["conflict"], sigs
 

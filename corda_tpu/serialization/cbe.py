@@ -115,7 +115,20 @@ def cbe_serializable(cls=None, *, name: str | None = None):
 
 
 def register_custom(cls: type, name: str, to_fields, from_fields) -> None:
-    """Register a non-dataclass type with explicit field mappers."""
+    """Register a non-dataclass type with explicit field mappers.
+
+    Re-registering a name with a *different* class is rejected: the registry
+    is the wire-format whitelist (the reference's CordaClassResolver refuses
+    unregistered/ambiguous classes for the same reason), and a silent
+    overwrite would let one component's encoder feed another's decoder.
+    """
+    existing = _REGISTRY.get(name)
+    if existing is not None and existing[0] is not cls:
+        raise SerializationError(
+            f"serialization name {name!r} already registered for "
+            f"{existing[0].__qualname__}; refusing to rebind to "
+            f"{cls.__qualname__}"
+        )
     _REGISTRY[name] = (cls, from_fields)
     _ENCODERS[cls] = (name, to_fields)
     cls.__cbe_name__ = name

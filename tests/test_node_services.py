@@ -62,13 +62,13 @@ class CoinCommand:
 
 
 register_custom(
-    CoinState, "test.CoinState",
+    CoinState, "test.ns.CoinState",
     to_fields=lambda s: {"amount_q": s.amount.quantity,
                          "token": s.amount.token, "owner": s.owner},
     from_fields=lambda d: CoinState(Amount(d["amount_q"], d["token"]), d["owner"]),
 )
 register_custom(
-    CoinCommand, "test.CoinCommand",
+    CoinCommand, "test.ns.CoinCommand",
     to_fields=lambda c: {"op": c.op},
     from_fields=lambda d: CoinCommand(d["op"]),
 )
@@ -76,11 +76,11 @@ register_custom(
 try:
     from corda_tpu.ledger.states import resolve_contract
 
-    resolve_contract("test.CoinContract")
+    resolve_contract("test.ns.CoinContract")
 except Exception:
     from corda_tpu.ledger import register_contract
 
-    @register_contract("test.CoinContract")
+    @register_contract("test.ns.CoinContract")
     class CoinContract:
         def verify(self, tx):
             pass
@@ -110,7 +110,7 @@ def issue_tx(owner, notary_party, notary_kp, quantity=100, token="GBP", n_output
     b = TransactionBuilder(notary=notary_party)
     for _ in range(n_outputs):
         b.add_output_state(
-            CoinState(Amount(quantity, token), owner), "test.CoinContract"
+            CoinState(Amount(quantity, token), owner), "test.ns.CoinContract"
         )
     b.add_command(CoinCommand("issue"), owner.owning_key)
     return b.sign_initial_transaction(notary_kp)
@@ -196,7 +196,7 @@ class TestVault:
         sr = vault.unconsumed_states(CoinState)[0]
         b.add_input_state(sr)
         b.add_output_state(
-            CoinState(Amount(100, "GBP"), bob[0]), "test.CoinContract"
+            CoinState(Amount(100, "GBP"), bob[0]), "test.ns.CoinContract"
         )
         b.add_command(CoinCommand("move"), alice[0].owning_key)
         spend = b.sign_initial_transaction(alice[1])
@@ -422,7 +422,7 @@ class TestScheduler:
         from corda_tpu.node.vault import VaultUpdate
 
         ref = StateRef(sha256(b"timer"), 0)
-        tstate = TransactionState(TimerState(150.0), "test.CoinContract", notary[0])
+        tstate = TransactionState(TimerState(150.0), "test.ns.CoinContract", notary[0])
         vault.cb(VaultUpdate((), (StateAndRef(tstate, ref),)))
         now[0] = 200.0
         assert sched.pump() == 1 and fired == ["flows.Timer"]
@@ -493,7 +493,7 @@ class TestServiceHub:
         b = TransactionBuilder(notary=notary[0])
         b.add_input_state(hub.to_state_and_ref(ref))
         b.add_output_state(
-            CoinState(Amount(100, "GBP"), alice[0]), "test.CoinContract"
+            CoinState(Amount(100, "GBP"), alice[0]), "test.ns.CoinContract"
         )
         b.add_command(CoinCommand("move"), alice[0].owning_key)
         spend = hub.sign_initial_transaction(b, alice[0].owning_key)

@@ -1,0 +1,398 @@
+"""Protocol-flow tier tests: the multi-node protocols the reference covers
+with MockNetwork tests (TwoPartyTradeFlowTests, NotaryServiceTests,
+CollectSignaturesFlowTests, ContractUpgradeFlowTest, NotaryChangeTests) —
+finality + notarisation round-trips, back-chain resolution on receive,
+multi-party signing, notary change and contract upgrade."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair
+from corda_tpu.flows import (
+    CheckpointStorage,
+    CollectSignaturesFlow,
+    ContractUpgradeFlow,
+    FinalityFlow,
+    FlowException,
+    FlowLogic,
+    InitiatedBy,
+    NotaryChangeFlow,
+    NotaryException,
+    SignTransactionFlow,
+    StateMachineManager,
+)
+from corda_tpu.ledger import (
+    CordaX500Name,
+    Party,
+    StateRef,
+    TransactionBuilder,
+    register_contract,
+)
+from corda_tpu.messaging import InMemoryMessagingNetwork
+from corda_tpu.node import NetworkMapCache, NodeInfo, ServiceHub
+from corda_tpu.node.identity import IdentityService, KeyManagementService
+from corda_tpu.notary import InMemoryUniquenessProvider
+from corda_tpu.notary.service import SimpleNotaryService, ValidatingNotaryService
+from corda_tpu.serialization import register_custom
+
+
+# ----------------------------------------------------------- test contract
+
+@dataclasses.dataclass(frozen=True)
+class Bond:
+    face: int
+    owner: Party
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class BondV2:
+    face: int
+    owner: Party
+    series: str = "A"
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class BondCommand:
+    op: str = "issue"
+
+
+register_custom(
+    Bond, "test.pf.Bond",
+    to_fields=lambda s: {"face": s.face, "owner": s.owner},
+    from_fields=lambda d: Bond(d["face"], d["owner"]),
+)
+register_custom(
+    BondV2, "test.pf.BondV2",
+    to_fields=lambda s: {"face": s.face, "owner": s.owner, "series": s.series},
+    from_fields=lambda d: BondV2(d["face"], d["owner"], d["series"]),
+)
+register_custom(
+    BondCommand, "test.pf.BondCommand",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: BondCommand(d["op"]),
+)
+
+
+@register_contract("test.pf.BondContract")
+class BondContract:
+    def verify(self, tx):
+        cmds = tx.commands_of_type(BondCommand)
+        if not cmds:
+            raise ValueError("no BondCommand")
+        op = cmds[0].value.op
+        ins = tx.inputs_of_type(Bond)
+        outs = tx.outputs_of_type(Bond)
+        if op == "issue" and ins:
+            raise ValueError("issue consumes nothing")
+        if op == "move":
+            if sum(b.face for b in ins) != sum(b.face for b in outs):
+                raise ValueError("face value not conserved")
+            signer_keys = set(cmds[0].signers)
+            for b in ins:
+                if b.owner.owning_key not in signer_keys:
+                    raise ValueError("input owner must sign a move")
+
+
+@register_contract("test.pf.BondContractV2")
+class BondContractV2:
+    legacy_contract = "test.pf.BondContract"
+
+    @staticmethod
+    def upgrade(old: Bond) -> BondV2:
+        return BondV2(old.face, old.owner, "A")
+
+    def verify(self, tx):
+        pass
+
+
+# ----------------------------------------------------------- the mock net
+
+class Node:
+    def __init__(self, net, name: str, network_map: NetworkMapCache,
+                 resolver, notary_service_factory=None):
+        self.kp = generate_keypair()
+        self.party = Party(CordaX500Name(name, "London", "GB"), self.kp.public)
+        identity_service = IdentityService()
+        kms = KeyManagementService([self.kp], identity_service)
+        info = NodeInfo(("inmem:" + name,), (self.party,))
+        notary_service = None
+        if notary_service_factory is not None:
+            notary_service = notary_service_factory(self.party, self.kp)
+        self.services = ServiceHub(
+            my_info=info,
+            key_management_service=kms,
+            identity_service=identity_service,
+            network_map_cache=network_map,
+            notary_service=notary_service,
+        )
+        self.smm = StateMachineManager(
+            net.create_node(str(self.party.name)),
+            CheckpointStorage(),
+            self.party,
+            resolver,
+            services=self.services,
+        )
+
+    def run(self, flow, timeout=60):
+        return self.smm.start_flow(flow).result.result(timeout=timeout)
+
+
+class ProtocolNet:
+    """Alice + Bob + one validating and one simple notary, sharing a
+    network-map cache (the reference's MockNetwork shape)."""
+
+    def __init__(self):
+        self.net = InMemoryMessagingNetwork()
+        self.net.start_pumping()
+        self.nmap = NetworkMapCache()
+        self.parties: dict[str, Party] = {}
+        resolver = self.parties.get
+
+        def validating(party, kp):
+            return ValidatingNotaryService(
+                party, kp, InMemoryUniquenessProvider()
+            )
+
+        def simple(party, kp):
+            return SimpleNotaryService(party, kp, InMemoryUniquenessProvider())
+
+        self.alice = Node(self.net, "Alice", self.nmap, resolver)
+        self.bob = Node(self.net, "Bob", self.nmap, resolver)
+        self.vnotary = Node(self.net, "VNotary", self.nmap, resolver, validating)
+        self.snotary = Node(self.net, "SNotary", self.nmap, resolver, simple)
+        for n in (self.alice, self.bob, self.vnotary, self.snotary):
+            self.parties[str(n.party.name)] = n.party
+            self.nmap.add_node(n.services.my_info)
+        self.nmap.add_notary(self.vnotary.party, validating=True)
+        self.nmap.add_notary(self.snotary.party, validating=False)
+
+    def stop(self):
+        for n in (self.alice, self.bob, self.vnotary, self.snotary):
+            n.smm.stop()
+        self.net.stop_pumping()
+
+
+@pytest.fixture
+def pnet():
+    net = ProtocolNet()
+    yield net
+    net.stop()
+
+
+def issue_bond(node: Node, notary: Party, face=100):
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(Bond(face, node.party), "test.pf.BondContract")
+    b.add_command(BondCommand("issue"), node.party.owning_key)
+    stx = node.services.sign_initial_transaction(b)
+    return node.run(FinalityFlow(stx))
+
+
+def move_bond(node: Node, ref_stx, new_owner: Party):
+    sar = node.services.to_state_and_ref(StateRef(ref_stx.id, 0))
+    b = TransactionBuilder(notary=sar.state.notary)
+    b.add_input_state(sar)
+    b.add_output_state(
+        Bond(sar.state.data.face, new_owner), "test.pf.BondContract"
+    )
+    b.add_command(BondCommand("move"), node.party.owning_key)
+    stx = node.services.sign_initial_transaction(b)
+    return node.run(FinalityFlow(stx))
+
+
+class TestFinality:
+    def test_issue_and_move_validating_notary(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        moved = move_bond(pnet.alice, issued, pnet.bob.party)
+        # notary signature present and valid
+        notary_keys = {s.by for s in moved.sigs}
+        assert pnet.vnotary.party.owning_key in notary_keys
+        moved.verify_required_signatures()
+        # bob received the move AND its back-chain via broadcast+resolve
+        assert pnet.bob.services.validated_transactions.get(moved.id)
+        assert pnet.bob.services.validated_transactions.get(issued.id)
+        # bob's vault now owns the bond
+        bonds = pnet.bob.services.vault_service.unconsumed_states(Bond)
+        assert len(bonds) == 1 and bonds[0].state.data.face == 100
+
+    def test_double_spend_rejected(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        move_bond(pnet.alice, issued, pnet.bob.party)
+        with pytest.raises(NotaryException):
+            move_bond(pnet.alice, issued, pnet.alice.party)
+
+    def test_simple_notary_tearoff(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.snotary.party)
+        moved = move_bond(pnet.alice, issued, pnet.bob.party)
+        assert pnet.snotary.party.owning_key in {s.by for s in moved.sigs}
+        # the non-validating notary never saw the full transaction, but
+        # still blocks the double spend
+        with pytest.raises(NotaryException):
+            move_bond(pnet.alice, issued, pnet.alice.party)
+
+    def test_issue_needs_no_notarisation(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        # issue transactions (no inputs, no timewindow) skip the notary
+        assert {s.by for s in issued.sigs} == {pnet.alice.party.owning_key}
+
+
+# ----------------------------------------------------- collect signatures
+
+@dataclasses.dataclass
+class TwoPartyIssueFlow(FlowLogic):
+    """Issue a bond co-owned arrangement: requires both parties' sigs."""
+
+    other_name: str
+    face: int
+
+    def call(self):
+        other = self.services.network_map_cache.get_node_by_legal_name(
+            CordaX500Name(self.other_name, "London", "GB")
+        ).legal_identity
+        notary = self.services.network_map_cache.get_notary()
+        b = TransactionBuilder(notary=notary)
+        b.add_output_state(Bond(self.face, other), "test.pf.BondContract")
+        b.add_command(
+            BondCommand("issue"),
+            self.our_identity.owning_key, other.owning_key,
+        )
+        stx = self.services.sign_initial_transaction(b)
+        session = self.initiate_flow(other)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        return self.sub_flow(FinalityFlow(stx))
+
+
+@InitiatedBy(TwoPartyIssueFlow)
+class TwoPartyIssueResponder(SignTransactionFlow):
+    def check_transaction(self, stx):
+        outs = [ts.data for ts in stx.tx.outputs if isinstance(ts.data, Bond)]
+        if not outs:
+            raise FlowException("expected a bond output")
+        if any(b.face > 1000 for b in outs):
+            raise FlowException("face value too large")
+
+
+class TestCollectSignatures:
+    def test_two_party_signing(self, pnet):
+        stx = pnet.alice.run(TwoPartyIssueFlow("Bob", 500))
+        assert {s.by for s in stx.sigs} >= {
+            pnet.alice.party.owning_key, pnet.bob.party.owning_key,
+        }
+        stx.verify_required_signatures()
+        assert pnet.bob.services.validated_transactions.get(stx.id)
+
+    def test_responder_rejects(self, pnet):
+        with pytest.raises(FlowException, match="face value too large"):
+            pnet.alice.run(TwoPartyIssueFlow("Bob", 5000))
+
+
+# ------------------------------------------------- notary change / upgrade
+
+# the sender (victim) side: an honest SendTransactionFlow wrapper
+@dataclasses.dataclass
+class VendTargetFlow(FlowLogic):
+    other_name: str
+
+    def call(self):
+        from corda_tpu.flows import SendTransactionFlow
+
+        other = self.services.network_map_cache.get_node_by_legal_name(
+            CordaX500Name(self.other_name, "London", "GB")
+        ).legal_identity
+        notary = self.services.network_map_cache.get_notary()
+        b = TransactionBuilder(notary=notary)
+        b.add_output_state(Bond(1, other), "test.pf.BondContract")
+        b.add_command(BondCommand("issue"), self.our_identity.owning_key)
+        stx = self.services.sign_initial_transaction(b)
+        session = self.initiate_flow(other)
+        self.sub_flow(SendTransactionFlow(session, stx))
+
+
+PROBE: dict = {}  # secret hash the evil responder probes for
+
+
+@InitiatedBy(VendTargetFlow)
+class EvilProbeResponder(FlowLogic):
+    """Instead of resolving the received tx's chain, probe the sender for
+    an unrelated private transaction."""
+
+    def __init__(self, session):
+        self.session = session
+
+    def call(self):
+        from corda_tpu.flows import FetchRequest
+        from corda_tpu.ledger import SignedTransaction
+
+        self.session.receive(SignedTransaction)
+        items = self.session.send_and_receive(
+            list, FetchRequest("tx", (PROBE["hash"],))
+        ).unwrap(lambda xs: xs)
+        PROBE["leaked"] = items
+        return True
+
+
+class TestVendingAuthorisation:
+    def test_unrelated_tx_not_served(self, pnet):
+        """A counterparty probing for transactions outside the back-chain
+        being sent gets rejected (DataVendingFlow authorisation)."""
+        secret = issue_bond(pnet.alice, pnet.vnotary.party, face=42)
+        PROBE.clear()
+        PROBE["hash"] = secret.id
+        h = pnet.alice.smm.start_flow(VendTargetFlow("Bob"))
+        with pytest.raises(FlowException, match="not in the back-chain"):
+            h.result.result(timeout=30)
+        assert "leaked" not in PROBE
+
+
+class TestStateReplacement:
+    def test_notary_change(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        sar = pnet.alice.services.to_state_and_ref(StateRef(issued.id, 0))
+        new_sar = pnet.alice.run(
+            NotaryChangeFlow(sar, pnet.snotary.party)
+        )
+        assert new_sar.state.notary == pnet.snotary.party
+        assert new_sar.state.data == sar.state.data
+        # the state now spends under the NEW notary
+        stx = pnet.alice.services.validated_transactions.get(
+            new_sar.ref.txhash
+        )
+        moved = move_bond(pnet.alice, stx, pnet.bob.party)
+        assert pnet.snotary.party.owning_key in {s.by for s in moved.sigs}
+
+    def test_notary_change_requires_participant_signers(self, pnet):
+        """A notary-change tx whose command omits a participant's key is
+        structurally invalid — nobody can re-point someone else's state."""
+        from corda_tpu.ledger import NotaryChangeCommand, TransactionVerificationException
+
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        sar = pnet.alice.services.to_state_and_ref(StateRef(issued.id, 0))
+        b = TransactionBuilder(notary=pnet.vnotary.party)
+        b.add_input_state(sar)
+        b.add_output_state(sar.state.data, sar.state.contract,
+                           notary=pnet.snotary.party)
+        # signed only by BOB — alice (the participant) never agreed
+        b.add_command(NotaryChangeCommand(pnet.snotary.party),
+                      pnet.bob.party.owning_key)
+        stx = pnet.bob.services.sign_initial_transaction(b)
+        ltx = stx.tx.to_ledger_transaction(pnet.alice.services.load_state)
+        with pytest.raises(TransactionVerificationException,
+                           match="missing a participant signer"):
+            ltx.verify()
+
+    def test_contract_upgrade(self, pnet):
+        issued = issue_bond(pnet.alice, pnet.vnotary.party)
+        sar = pnet.alice.services.to_state_and_ref(StateRef(issued.id, 0))
+        new_sar = pnet.alice.run(
+            ContractUpgradeFlow(sar, "test.pf.BondContractV2")
+        )
+        assert new_sar.state.contract == "test.pf.BondContractV2"
+        assert new_sar.state.data == BondV2(100, pnet.alice.party, "A")
