@@ -1,0 +1,176 @@
+"""Confidential identities (reference: confidential-identities/src/main/
+kotlin/net/corda/confidential/ — SwapIdentitiesFlow.kt, IdentitySyncFlow.kt).
+
+- ``SwapIdentitiesFlow`` — both parties mint a fresh anonymous key with a
+  certificate signed by their well-known identity key and exchange them, so
+  a transaction can be built between per-tx keys unlinkable to the legal
+  identities by third parties.
+- ``IdentitySyncFlow`` — after building a transaction containing anonymous
+  participants, push the anonymous→well-known certificates the
+  counterparty is missing so it can resolve every participant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.flows import FlowException, FlowLogic, FlowSession, InitiatedBy
+from corda_tpu.ledger import (
+    AnonymousParty,
+    NameKeyCertificate,
+    Party,
+    PartyAndCertificate,
+    SignedTransaction,
+)
+from corda_tpu.serialization import cbe_serializable
+
+
+@cbe_serializable(name="confidential.IdentityOffer")
+@dataclasses.dataclass(frozen=True)
+class IdentityOffer:
+    """One side's freshly-minted confidential identity."""
+
+    anonymous: AnonymousParty
+    certificate: NameKeyCertificate
+
+
+def _mint_confidential(flow: FlowLogic) -> IdentityOffer:
+    me = flow.our_identity
+    kms = flow.services.key_management_service
+    anon, cert = flow.record(lambda: kms.fresh_key_and_cert(
+        PartyAndCertificate(me, ()), kms._require(me.owning_key)
+    ))
+    return IdentityOffer(anon, cert)
+
+
+def _accept_offer(flow: FlowLogic, offer: IdentityOffer,
+                  counterparty: Party) -> AnonymousParty:
+    cert = offer.certificate
+    if (cert.subject_key != offer.anonymous.owning_key
+            or cert.issuer_key != counterparty.owning_key
+            or cert.name != counterparty.name
+            or not cert.verify()):
+        raise FlowException(
+            "counterparty's confidential identity certificate is invalid"
+        )
+    flow.services.identity_service.register_anonymous_identity(
+        offer.anonymous, counterparty, cert
+    )
+    return offer.anonymous
+
+
+class SwapIdentitiesFlow(FlowLogic):
+    """Exchange fresh confidential identities with one counterparty;
+    returns {well_known_party: anonymous_party} for both sides
+    (reference: SwapIdentitiesFlow.kt)."""
+
+    def __init__(self, other_party: Party):
+        self.other_party = other_party
+
+    def flow_fields(self):
+        return {"other_party": self.other_party}
+
+    @classmethod
+    def from_flow_fields(cls, fields):
+        return cls(fields["other_party"])
+
+    def call(self) -> dict:
+        mine = _mint_confidential(self)
+        session = self.initiate_flow(self.other_party)
+        theirs = session.send_and_receive(IdentityOffer, mine).unwrap(
+            lambda o: o
+        )
+        their_anon = _accept_offer(self, theirs, self.other_party)
+        return {self.our_identity: mine.anonymous,
+                self.other_party: their_anon}
+
+
+@InitiatedBy(SwapIdentitiesFlow)
+class SwapIdentitiesResponder(FlowLogic):
+    def __init__(self, session: FlowSession):
+        self.session = session
+
+    def call(self) -> dict:
+        theirs = self.session.receive(IdentityOffer).unwrap(lambda o: o)
+        their_anon = _accept_offer(
+            self, theirs, self.session.counterparty
+        )
+        mine = _mint_confidential(self)
+        self.session.send(mine)
+        return {self.our_identity: mine.anonymous,
+                self.session.counterparty: their_anon}
+
+
+class IdentitySyncFlow(FlowLogic):
+    """Send the anonymous→well-known certificates for every anonymous
+    participant of ``stx`` that we can resolve, over an existing session
+    (reference: IdentitySyncFlow.Send/Receive)."""
+
+    def __init__(self, session: FlowSession, stx: SignedTransaction):
+        self.session = session
+        self.stx = stx
+
+    def call(self):
+        identity_service = self.services.identity_service
+        offers = []
+        seen: set = set()
+        states = [ts.data for ts in self.stx.tx.outputs]
+        # inputs matter too: a consumed state's anonymous owner may be
+        # unknown to the counterparty (reference IdentitySyncFlow.Send
+        # extracts identities from inputs AND outputs)
+        for ref in self.stx.inputs:
+            states.append(self.services.load_state(ref).data)
+        for data in states:
+            for p in data.participants:
+                if isinstance(p, Party) or p.owning_key in seen:
+                    continue
+                seen.add(p.owning_key)
+                binding = identity_service.anonymous_binding(p)
+                if binding is not None:
+                    offers.append(AnonymousBinding(*binding))
+        self.session.send(offers)
+
+
+class IdentitySyncReceive(FlowLogic):
+    """Counter-side of IdentitySyncFlow: register each received binding
+    after validating its certificate."""
+
+    def __init__(self, session: FlowSession):
+        self.session = session
+
+    def call(self) -> int:
+        offers = self.session.receive(list).unwrap(lambda xs: xs)
+        identity_service = self.services.identity_service
+        network_map = self.services.network_map_cache
+        n = 0
+        for offer in offers:
+            if not isinstance(offer, AnonymousBinding):
+                raise FlowException("expected an AnonymousBinding")
+            # the claimed well-known party must match OUR view of that
+            # legal name — otherwise a counterparty could bind an anonymous
+            # key to Party(name="Big Bank", key=attacker_key) and have us
+            # resolve payments to the attacker
+            claimed = offer.well_known
+            ours = identity_service.party_from_name(claimed.name)
+            if ours is None:
+                info = network_map.get_node_by_legal_name(claimed.name)
+                ours = info.legal_identity if info is not None else None
+            if ours is None or ours.owning_key != claimed.owning_key:
+                raise FlowException(
+                    f"cannot validate well-known identity {claimed.name}"
+                )
+            identity_service.register_anonymous_identity(
+                offer.anonymous, claimed, offer.certificate
+            )
+            n += 1
+        return n
+
+
+@cbe_serializable(name="confidential.AnonymousBinding")
+@dataclasses.dataclass(frozen=True)
+class AnonymousBinding:
+    """A (anonymous key → well-known party) link plus its certificate."""
+
+    anonymous: AnonymousParty
+    well_known: Party
+    certificate: NameKeyCertificate

@@ -1,0 +1,42 @@
+"""Finance CorDapp — the contract/flow library the reference ships in
+`finance/` (SURVEY.md §2.6): Cash, CommercialPaper, Obligation, Commodity
+contracts plus the cash issue/pay/exit flows that the trader-demo and the
+benchmark configs are built from."""
+
+from .contracts import (
+    CASH_PROGRAM_ID,
+    COMMODITY_PROGRAM_ID,
+    CP_PROGRAM_ID,
+    OBLIGATION_PROGRAM_ID,
+    Cash,
+    CashState,
+    CommercialPaper,
+    CommercialPaperState,
+    Commodity,
+    CommodityState,
+    Exit,
+    Issue,
+    Move,
+    Obligation,
+    ObligationState,
+    Redeem,
+    Settle,
+    fungible_move_rows,
+    verify_fungible_asset,
+)
+from .flows import (
+    CashExitFlow,
+    CashIssueFlow,
+    CashPaymentFlow,
+    select_cash,
+)
+
+__all__ = [
+    "CASH_PROGRAM_ID", "COMMODITY_PROGRAM_ID", "CP_PROGRAM_ID",
+    "OBLIGATION_PROGRAM_ID",
+    "Cash", "CashState", "CommercialPaper", "CommercialPaperState",
+    "Commodity", "CommodityState", "Exit", "Issue", "Move",
+    "Obligation", "ObligationState", "Redeem", "Settle",
+    "fungible_move_rows", "verify_fungible_asset",
+    "CashExitFlow", "CashIssueFlow", "CashPaymentFlow", "select_cash",
+]

@@ -1,0 +1,425 @@
+"""Finance contract library: Cash, CommercialPaper, Obligation, Commodity.
+
+Capability parity with the reference's finance CorDapp
+(finance/src/main/kotlin/net/corda/finance/contracts/):
+
+- ``Cash`` — fungible currency claims against an issuer
+  (asset/Cash.kt:108; ``verify`` :199 groups states by (currency, issuer)
+  via groupStates :202 and checks issue/move/exit per group).
+- ``CommercialPaper`` — debt instrument with face value and maturity
+  (CommercialPaper.kt: issue/move/redeem clauses).
+- ``Obligation`` — an IOU from an obligor, settleable with cash
+  (asset/Obligation.kt, simplified to issue/move/settle).
+- ``Commodity`` — non-currency fungible (asset/CommodityContract.kt),
+  sharing the fungible-asset verifier with Cash.
+
+States are frozen dataclasses; amounts are integer quantities of an
+``Issued(PartyAndReference, product)`` token. Verification is pure host
+logic — contract semantics are the host-bound half of the verification
+split (SURVEY.md §7.4); signature/hash math runs in the batched device
+path. A vectorizable fast path for Cash-shaped fungible moves feeds the
+batched verifier via ``fungible_move_rows`` (quantities + group keys as
+arrays), mirroring the specialised Cash path called for in SURVEY.md §7
+hard part (f).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.ledger import (
+    Amount,
+    Issued,
+    PartyAndReference,
+    register_contract,
+)
+from corda_tpu.serialization import cbe_serializable
+
+CASH_PROGRAM_ID = "finance.Cash"
+CP_PROGRAM_ID = "finance.CommercialPaper"
+OBLIGATION_PROGRAM_ID = "finance.Obligation"
+COMMODITY_PROGRAM_ID = "finance.Commodity"
+
+
+# ------------------------------------------------------------------ states
+
+@cbe_serializable(name="finance.CashState")
+@dataclasses.dataclass(frozen=True)
+class CashState:
+    """An amount of issued currency owned by a key (reference:
+    Cash.State, asset/Cash.kt:129-150)."""
+
+    amount: Amount  # token = Issued(PartyAndReference, currency: str)
+    owner: object   # Party | AnonymousParty
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+    @property
+    def exit_keys(self):
+        return {self.owner.owning_key, self.amount.token.issuer.party.owning_key}
+
+    def with_new_owner(self, new_owner) -> "CashState":
+        return dataclasses.replace(self, owner=new_owner)
+
+
+@cbe_serializable(name="finance.CommodityState")
+@dataclasses.dataclass(frozen=True)
+class CommodityState:
+    """Issued commodity holdings (reference: CommodityContract.State)."""
+
+    amount: Amount  # token = Issued(PartyAndReference, commodity_code: str)
+    owner: object
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+    @property
+    def exit_keys(self):
+        return {self.owner.owning_key, self.amount.token.issuer.party.owning_key}
+
+    def with_new_owner(self, new_owner) -> "CommodityState":
+        return dataclasses.replace(self, owner=new_owner)
+
+
+@cbe_serializable(name="finance.CommercialPaperState")
+@dataclasses.dataclass(frozen=True)
+class CommercialPaperState:
+    """A promise by the issuer to pay face value at maturity (reference:
+    CommercialPaper.State)."""
+
+    issuance: PartyAndReference
+    owner: object
+    face_value: Amount          # token = Issued(issuance, currency)
+    maturity_date: float        # epoch seconds
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+    def with_new_owner(self, new_owner) -> "CommercialPaperState":
+        return dataclasses.replace(self, owner=new_owner)
+
+
+@cbe_serializable(name="finance.ObligationState")
+@dataclasses.dataclass(frozen=True)
+class ObligationState:
+    """An IOU: obligor owes the owner an amount, payable before due date
+    (reference: Obligation.State, simplified)."""
+
+    obligor: object
+    amount: Amount              # token = Issued(PartyAndReference, currency)
+    owner: object
+    due_before: float           # epoch seconds
+
+    @property
+    def participants(self):
+        return [self.obligor, self.owner]
+
+
+# ---------------------------------------------------------------- commands
+
+@cbe_serializable(name="finance.Issue")
+@dataclasses.dataclass(frozen=True)
+class Issue:
+    pass
+
+
+@cbe_serializable(name="finance.Move")
+@dataclasses.dataclass(frozen=True)
+class Move:
+    pass
+
+
+@cbe_serializable(name="finance.Exit")
+@dataclasses.dataclass(frozen=True)
+class Exit:
+    """Remove the amount from the ledger (reference: Cash.Commands.Exit)."""
+
+    amount: Amount
+
+
+@cbe_serializable(name="finance.Redeem")
+@dataclasses.dataclass(frozen=True)
+class Redeem:
+    pass
+
+
+@cbe_serializable(name="finance.Settle")
+@dataclasses.dataclass(frozen=True)
+class Settle:
+    """Settle (part of) an obligation with cash (reference:
+    Obligation.Commands.Settle)."""
+
+    amount: Amount
+
+
+# ------------------------------------------------- fungible verification
+
+def _require(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ValueError(msg)
+
+
+def _signers_of(tx, command_cls) -> set:
+    keys: set = set()
+    for cmd in tx.commands_of_type(command_cls):
+        keys.update(cmd.signers)
+    return keys
+
+
+def verify_fungible_asset(tx, state_cls) -> None:
+    """Shared issue/move/exit verifier for Cash-like assets (reference:
+    Cash.verify, asset/Cash.kt:199-236: groupStates by token, then clause
+    dispatch per group)."""
+    issue_signers = _signers_of(tx, Issue)
+    move_signers = _signers_of(tx, Move)
+    exit_cmds = tx.commands_of_type(Exit)
+    exit_signers = _signers_of(tx, Exit)
+
+    groups = tx.group_states(state_cls, lambda s: s.amount.token)
+    _require(bool(groups), f"no {state_cls.__name__} groups in transaction")
+    for group in groups:
+        token = group.grouping_key
+        in_total = sum(s.amount.quantity for s in group.inputs)
+        out_total = sum(s.amount.quantity for s in group.outputs)
+
+        if not group.inputs:
+            # issuance of this token (reference: verifyIssueCommand)
+            _require(bool(group.outputs), "issue group has no outputs")
+            _require(out_total > 0, "cannot issue zero value")
+            issuer_key = token.issuer.party.owning_key
+            _require(
+                issuer_key in issue_signers,
+                "issuer must sign an issuance",
+            )
+            continue
+
+        exit_amount = sum(
+            c.value.amount.quantity for c in exit_cmds
+            if c.value.amount.token == token
+        )
+        _require(
+            in_total == out_total + exit_amount,
+            f"value not conserved for {token}: {in_total} -> "
+            f"{out_total} (+{exit_amount} exited)",
+        )
+        owner_keys = {s.owner.owning_key for s in group.inputs}
+        if exit_amount:
+            # exits need owner AND issuer consent (reference: exit clause —
+            # exitKeys covers both)
+            required = owner_keys | {token.issuer.party.owning_key}
+            _require(
+                required <= exit_signers,
+                "exit requires the owners' and issuer's signatures",
+            )
+        if out_total:
+            _require(
+                owner_keys <= move_signers or (exit_amount and owner_keys <= exit_signers),
+                "input owners must sign a move",
+            )
+        elif not exit_amount:
+            _require(False, "inputs fully consumed with no outputs and no exit")
+
+
+def fungible_move_rows(ltxs, state_cls=None):
+    """Vectorizable fast path: extract (tx_index, group_key_hash, in_qty,
+    out_qty) rows across MANY ledger transactions so conservation checks
+    run as one array reduction instead of per-tx Python. Feeds
+    verifier.batch alongside the signature rows."""
+    import hashlib
+
+    import numpy as np
+
+    state_cls = state_cls or CashState
+    tx_idx, key_hash, in_q, out_q = [], [], [], []
+    for i, ltx in enumerate(ltxs):
+        for group in ltx.group_states(state_cls, lambda s: s.amount.token):
+            h = hashlib.sha256(repr(group.grouping_key).encode()).digest()[:8]
+            tx_idx.append(i)
+            key_hash.append(int.from_bytes(h, "big", signed=False) >> 1)
+            in_q.append(sum(s.amount.quantity for s in group.inputs))
+            out_q.append(sum(s.amount.quantity for s in group.outputs))
+    return (
+        np.asarray(tx_idx, dtype=np.int32),
+        np.asarray(key_hash, dtype=np.int64),
+        np.asarray(in_q, dtype=np.int64),
+        np.asarray(out_q, dtype=np.int64),
+    )
+
+
+# ---------------------------------------------------------------- contracts
+
+@register_contract(CASH_PROGRAM_ID)
+class Cash:
+    """reference: finance/.../asset/Cash.kt:108."""
+
+    def verify(self, tx):
+        verify_fungible_asset(tx, CashState)
+
+
+@register_contract(COMMODITY_PROGRAM_ID)
+class Commodity:
+    """reference: finance/.../asset/CommodityContract.kt."""
+
+    def verify(self, tx):
+        verify_fungible_asset(tx, CommodityState)
+
+
+@register_contract(CP_PROGRAM_ID)
+class CommercialPaper:
+    """reference: finance/.../contracts/CommercialPaper.kt."""
+
+    def verify(self, tx):
+        groups = tx.group_states(
+            CommercialPaperState,
+            lambda s: (s.issuance, s.face_value, s.maturity_date),
+        )
+        _require(bool(groups), "no commercial paper in transaction")
+        issue_signers = _signers_of(tx, Issue)
+        move_signers = _signers_of(tx, Move)
+        redeem_signers = _signers_of(tx, Redeem)
+        tw = tx.time_window
+        # redemption cash accounting is GLOBAL across groups: each cash
+        # output can pay for one face value only — per-group counting would
+        # let N identical papers redeem against a single payment
+        owed: dict = {}
+        for group in groups:
+            ins, outs = group.inputs, group.outputs
+            if not ins:
+                _require(len(outs) >= 1, "issue must create paper")
+                paper = outs[0]
+                _require(
+                    paper.issuance.party.owning_key in issue_signers,
+                    "issuer must sign a paper issuance",
+                )
+                _require(
+                    tw is not None and tw.until_time is not None
+                    and tw.until_time / 1_000_000 < paper.maturity_date,
+                    "paper must be issued before its maturity (needs a "
+                    "time window)",
+                )
+            elif tx.commands_of_type(Redeem):
+                # paper consumed; owner must be paid face value in cash
+                _require(not outs, "redeemed paper must not be re-issued")
+                _require(
+                    tw is not None and tw.from_time is not None
+                    and tw.from_time / 1_000_000 >= ins[0].maturity_date,
+                    "paper may only be redeemed after maturity",
+                )
+                for paper in ins:
+                    key = (paper.owner.owning_key, paper.face_value.token)
+                    owed[key] = owed.get(key, 0) + paper.face_value.quantity
+                    _require(
+                        paper.owner.owning_key in redeem_signers,
+                        "paper owner must sign a redemption",
+                    )
+            else:
+                _require(
+                    len(ins) == 1 and len(outs) == 1,
+                    "move is one paper in, one paper out",
+                )
+                _require(
+                    outs[0] == ins[0].with_new_owner(outs[0].owner),
+                    "move may only change the owner",
+                )
+                _require(
+                    ins[0].owner.owning_key in move_signers,
+                    "paper owner must sign a move",
+                )
+        # settle the global redemption account: cash outputs to each owner
+        # must cover the sum of face values of ALL their redeemed papers
+        for (owner_key, token), total in owed.items():
+            received = sum(
+                c.amount.quantity for c in tx.outputs_of_type(CashState)
+                if c.owner.owning_key == owner_key and c.amount.token == token
+            )
+            _require(
+                received >= total,
+                "redemption must pay the face value to the owner",
+            )
+
+
+@register_contract(OBLIGATION_PROGRAM_ID)
+class Obligation:
+    """reference: finance/.../asset/Obligation.kt (simplified: issue,
+    move, settle-with-cash)."""
+
+    def verify(self, tx):
+        groups = tx.group_states(
+            ObligationState,
+            lambda s: (s.obligor.owning_key, s.amount.token),
+        )
+        _require(bool(groups), "no obligations in transaction")
+        issue_signers = _signers_of(tx, Issue)
+        move_signers = _signers_of(tx, Move)
+        settle_cmds = tx.commands_of_type(Settle)
+        settle_signers = _signers_of(tx, Settle)
+        # settlement accounting is GLOBAL: total reduction per token must
+        # equal the Settle command totals, and cash to each beneficiary
+        # must cover their summed reductions — per-group counting would let
+        # one payment settle obligations from several obligors
+        settle_totals: dict = {}
+        for c in settle_cmds:
+            tok = c.value.amount.token
+            settle_totals[tok] = settle_totals.get(tok, 0) + c.value.amount.quantity
+        reduced_by_token: dict = {}
+        owed: dict = {}
+        for group in groups:
+            ins, outs = group.inputs, group.outputs
+            in_total = sum(s.amount.quantity for s in ins)
+            out_total = sum(s.amount.quantity for s in outs)
+            if not ins:
+                _require(out_total > 0, "cannot issue a zero obligation")
+                _require(
+                    all(s.obligor.owning_key in issue_signers for s in outs),
+                    "obligor must sign an obligation issuance",
+                )
+                continue
+            token = ins[0].amount.token
+            reduction = in_total - out_total
+            if reduction > 0:
+                _require(
+                    token in settle_totals,
+                    "obligation reduced without a Settle command",
+                )
+                owner_keys = {s.owner.owning_key for s in ins}
+                _require(
+                    len(owner_keys) == 1,
+                    "a settle group must have a single beneficiary",
+                )
+                owner_key = next(iter(owner_keys))
+                reduced_by_token[token] = (
+                    reduced_by_token.get(token, 0) + reduction
+                )
+                key = (owner_key, token)
+                owed[key] = owed.get(key, 0) + reduction
+                _require(
+                    {s.obligor.owning_key for s in ins} <= settle_signers,
+                    "obligor must sign a settlement",
+                )
+            else:
+                _require(
+                    in_total == out_total,
+                    "obligation amount not conserved by a move",
+                )
+                _require(
+                    {s.owner.owning_key for s in ins} <= move_signers,
+                    "beneficiary must sign an obligation move",
+                )
+        for token, total in settle_totals.items():
+            _require(
+                reduced_by_token.get(token, 0) == total,
+                "settled amount must equal the obligation reduction",
+            )
+        for (owner_key, token), amount in owed.items():
+            paid = sum(
+                c.amount.quantity for c in tx.outputs_of_type(CashState)
+                if c.owner.owning_key == owner_key and c.amount.token == token
+            )
+            _require(
+                paid >= amount,
+                "settlement must pay the beneficiary in matching cash",
+            )

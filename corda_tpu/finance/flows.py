@@ -1,0 +1,180 @@
+"""Cash flows: issue, pay, exit (reference: finance/src/main/kotlin/net/
+corda/finance/flows/CashIssueFlow.kt, CashPaymentFlow.kt, CashExitFlow.kt,
+AbstractCashFlow.kt).
+
+Coin selection mirrors the reference's currency-level selection
+(CashSelectionH2Impl.kt picks unconsumed cash rows by currency across
+issuers): candidates come from the vault query engine, are filtered by
+currency, soft-locked under the flow id, then spent with change back to
+the sender.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.flows import FinalityFlow, FlowException, FlowLogic
+from corda_tpu.ledger import (
+    Amount,
+    Issued,
+    Party,
+    PartyAndReference,
+    TransactionBuilder,
+)
+from corda_tpu.node import QueryCriteria, Sort, SoftLockError
+
+from .contracts import CASH_PROGRAM_ID, CashState, Exit, Issue, Move
+
+
+def select_cash(flow: FlowLogic, currency: str, quantity: int) -> list:
+    """Currency-level coin selection over the vault: unconsumed, UNLOCKED
+    CashStates of any issuer in ``currency``, smallest-first, soft-locked
+    under the flow id (reference:
+    CashSelectionH2Impl.unconsumedCashStatesForSpending)."""
+    vault = flow.services.vault_service
+    page = vault.query_by(
+        QueryCriteria(
+            contract_state_types=(CashState,),
+            include_soft_locked=False,          # concurrent spends must not
+            soft_lock_id=flow.flow_id,          # collide on locked refs
+        ),
+        sort=Sort(by="quantity"),
+    )
+    candidates = [
+        sr for sr in page.states
+        if sr.state.data.amount.token.product == currency
+    ]
+    picked, total = [], 0
+    for sr in candidates:
+        picked.append(sr)
+        total += sr.state.data.amount.quantity
+        if total >= quantity:
+            break
+    if total < quantity:
+        raise FlowException(
+            f"insufficient spendable cash: have {total}, need {quantity} {currency}"
+        )
+    try:
+        vault.soft_lock_reserve(flow.flow_id, [sr.ref for sr in picked])
+    except SoftLockError as e:
+        # lost a race with a concurrent spend between query and reserve
+        raise FlowException(f"cash selection conflict, retry: {e}") from e
+    return picked
+
+
+@dataclasses.dataclass
+class CashIssueFlow(FlowLogic):
+    """Issue cash to ourselves (reference: CashIssueFlow.kt — the issuer
+    node mints against its own identity, then typically pays it away)."""
+
+    quantity: int
+    currency: str
+    issuer_ref: bytes
+    notary: Party
+
+    def call(self):
+        me = self.our_identity
+        token = Issued(PartyAndReference(me, self.issuer_ref), self.currency)
+        builder = TransactionBuilder(notary=self.notary)
+        builder.add_output_state(
+            CashState(Amount(self.quantity, token), me), CASH_PROGRAM_ID
+        )
+        builder.add_command(Issue(), me.owning_key)
+        stx = self.services.sign_initial_transaction(builder)
+        return self.sub_flow(FinalityFlow(stx))
+
+
+@dataclasses.dataclass
+class CashPaymentFlow(FlowLogic):
+    """Pay an amount of a currency to a recipient, with change back to us
+    (reference: CashPaymentFlow.kt)."""
+
+    quantity: int
+    currency: str
+    recipient: Party
+
+    def call(self):
+        me = self.our_identity
+        # record the selected refs (replay-safe: the selection is the
+        # nondeterministic step), then re-derive the StateAndRefs. The lock
+        # is held from selection to finality — everything after selection
+        # sits under the release-finally so a failure cannot leak locks.
+        refs = self.record(lambda: [
+            sr.ref for sr in select_cash(self, self.currency, self.quantity)
+        ])
+        try:
+            selected = [self.services.to_state_and_ref(r) for r in refs]
+            notary = selected[0].state.notary
+            builder = TransactionBuilder(notary=notary)
+            remaining = self.quantity
+            signers = set()
+            # spend per (issuer) token bucket, paying the recipient up to
+            # the requested quantity and returning change per-token
+            for sr in selected:
+                state = sr.state.data
+                builder.add_input_state(sr)
+                signers.add(state.owner.owning_key)
+                pay = min(remaining, state.amount.quantity)
+                remaining -= pay
+                if pay > 0:
+                    builder.add_output_state(
+                        CashState(Amount(pay, state.amount.token),
+                                  self.recipient),
+                        CASH_PROGRAM_ID,
+                    )
+                change = state.amount.quantity - pay
+                if change > 0:
+                    builder.add_output_state(
+                        CashState(Amount(change, state.amount.token), me),
+                        CASH_PROGRAM_ID,
+                    )
+            builder.add_command(Move(), *sorted(
+                signers, key=lambda k: (k.scheme_id, k.encoded)
+            ))
+            stx = self.services.sign_initial_transaction(builder)
+            return self.sub_flow(FinalityFlow(stx))
+        finally:
+            self.services.vault_service.soft_lock_release(self.flow_id)
+
+
+@dataclasses.dataclass
+class CashExitFlow(FlowLogic):
+    """Withdraw cash we issued from the ledger (reference:
+    CashExitFlow.kt — issuer redeems its own liability)."""
+
+    quantity: int
+    currency: str
+    issuer_ref: bytes
+
+    def call(self):
+        me = self.our_identity
+        token = Issued(PartyAndReference(me, self.issuer_ref), self.currency)
+        vault = self.services.vault_service
+        refs = self.record(lambda: [
+            sr.ref for sr in vault.select_fungible(
+                token, self.quantity, self.flow_id, CashState
+            )
+        ])
+        try:
+            selected = [self.services.to_state_and_ref(r) for r in refs]
+            notary = selected[0].state.notary
+            builder = TransactionBuilder(notary=notary)
+            total = 0
+            signers = {me.owning_key}
+            for sr in selected:
+                builder.add_input_state(sr)
+                total += sr.state.data.amount.quantity
+                signers.add(sr.state.data.owner.owning_key)
+            if total > self.quantity:
+                builder.add_output_state(
+                    CashState(Amount(total - self.quantity, token), me),
+                    CASH_PROGRAM_ID,
+                )
+            builder.add_command(
+                Exit(Amount(self.quantity, token)),
+                *sorted(signers, key=lambda k: (k.scheme_id, k.encoded)),
+            )
+            stx = self.services.sign_initial_transaction(builder)
+            return self.sub_flow(FinalityFlow(stx))
+        finally:
+            vault.soft_lock_release(self.flow_id)

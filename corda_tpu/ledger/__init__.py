@@ -24,6 +24,7 @@ from .states import (
     HashAttachmentConstraint,
     Issued,
     NotaryChangeCommand,
+    PartyAndReference,
     StateAndRef,
     StateRef,
     TimeWindow,
@@ -60,6 +61,7 @@ __all__ = [
     "AlwaysAcceptAttachmentConstraint", "Amount", "AttachmentConstraint",
     "Command", "CommandWithParties", "ContractState",
     "HashAttachmentConstraint", "Issued", "NotaryChangeCommand",
+    "PartyAndReference",
     "StateAndRef", "StateRef",
     "TimeWindow", "TransactionState", "TransactionVerificationException",
     "UniqueIdentifier", "UpgradeCommand",

@@ -213,10 +213,23 @@ class CommandWithParties:
 # ---------------------------------------------------------------- amounts
 
 @dataclasses.dataclass(frozen=True, order=True)
+class PartyAndReference:
+    """A party plus an opaque issuer reference (reference:
+    PartyAndReference in Structures.kt) — disambiguates multiple issuances
+    by the same party."""
+
+    party: Any  # Party | AnonymousParty
+    reference: bytes
+
+    def __str__(self):
+        return f"{self.party}[{self.reference.hex()}]"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
 class Issued:
     """Asset type qualified by issuer reference (reference: Issued<P>)."""
 
-    issuer: Any  # PartyAndReference-ish: (Party, bytes)
+    issuer: Any  # PartyAndReference
     product: Any
 
     def __str__(self):
@@ -357,6 +370,11 @@ register_custom(
     UpgradeCommand, "ledger.UpgradeCommand",
     to_fields=lambda c: {"upgraded_contract": c.upgraded_contract},
     from_fields=lambda d: UpgradeCommand(d["upgraded_contract"]),
+)
+register_custom(
+    PartyAndReference, "ledger.PartyAndReference",
+    to_fields=lambda p: {"party": p.party, "reference": p.reference},
+    from_fields=lambda d: PartyAndReference(d["party"], d["reference"]),
 )
 register_custom(
     Issued, "ledger.Issued",

@@ -54,6 +54,7 @@ class IdentityService:
         self._by_name: dict[CordaX500Name, PartyAndCertificate] = {}
         # anonymous key → well-known party it belongs to
         self._anonymous: dict[PublicKey, Party] = {}
+        self._anon_certs: dict[PublicKey, NameKeyCertificate] = {}
         for pc in well_known or []:
             self.register_identity(pc)
 
@@ -74,10 +75,24 @@ class IdentityService:
         if certificate is not None:
             if (certificate.subject_key != anonymous.owning_key
                     or certificate.issuer_key != well_known.owning_key
+                    or certificate.name != well_known.name
                     or not certificate.verify()):
                 raise CryptoError("anonymous identity certificate invalid")
         with self._lock:
             self._anonymous[anonymous.owning_key] = well_known
+            if certificate is not None:
+                self._anon_certs[anonymous.owning_key] = certificate
+
+    def anonymous_binding(self, anonymous) -> tuple | None:
+        """(anonymous, well_known, certificate) for a registered
+        confidential key we hold the certificate for — the unit
+        IdentitySyncFlow ships to counterparties."""
+        with self._lock:
+            well_known = self._anonymous.get(anonymous.owning_key)
+            cert = self._anon_certs.get(anonymous.owning_key)
+        if well_known is None or cert is None:
+            return None
+        return (anonymous, well_known, cert)
 
     def party_from_key(self, key: PublicKey) -> Party | None:
         with self._lock:

@@ -1,0 +1,15 @@
+"""Test infrastructure tier (SURVEY.md §4, reference: testing/test-utils +
+testing/node-driver): in-process mock network of full nodes, MockServices,
+deterministic test identities, the declarative ledger DSL, and the
+random-valid-ledger generator used for fuzz-style verifier tests."""
+
+from .mocknet import MockNetworkNodes, MockNode, make_test_party
+from .constants import ALICE_NAME, BOB_NAME, CHARLIE_NAME, DUMMY_NOTARY_NAME
+from .dsl import LedgerDSL, ledger
+from .generated_ledger import GeneratedLedger
+
+__all__ = [
+    "MockNetworkNodes", "MockNode", "make_test_party",
+    "ALICE_NAME", "BOB_NAME", "CHARLIE_NAME", "DUMMY_NOTARY_NAME",
+    "LedgerDSL", "ledger", "GeneratedLedger",
+]

@@ -1,0 +1,34 @@
+"""Deterministic test identities (reference: testing/test-utils/.../
+TestConstants.kt — ALICE/BOB/CHARLIE/DUMMY_NOTARY with fixed entropy keys).
+
+Keys derive from fixed entropy so test vectors and ledger fixtures are
+reproducible across runs (reference: entropyToKeyPair, Crypto.kt:811-834).
+"""
+
+from __future__ import annotations
+
+from corda_tpu.crypto import derive_keypair_from_entropy
+from corda_tpu.crypto.schemes import DEFAULT_SIGNATURE_SCHEME
+from corda_tpu.ledger import CordaX500Name, Party
+
+ALICE_NAME = CordaX500Name("Alice Corp", "Madrid", "ES")
+BOB_NAME = CordaX500Name("Bob Plc", "Rome", "IT")
+CHARLIE_NAME = CordaX500Name("Charlie Ltd", "Athens", "GR")
+DUMMY_NOTARY_NAME = CordaX500Name("Notary Service", "Zurich", "CH")
+
+
+def test_keypair(seed: int):
+    """Reproducible keypair from an integer seed."""
+    entropy = seed.to_bytes(8, "big") * 4
+    return derive_keypair_from_entropy(DEFAULT_SIGNATURE_SCHEME, entropy)
+
+
+def test_party(name: CordaX500Name, seed: int):
+    kp = test_keypair(seed)
+    return Party(name, kp.public), kp
+
+
+ALICE, ALICE_KEY = test_party(ALICE_NAME, 10)
+BOB, BOB_KEY = test_party(BOB_NAME, 20)
+CHARLIE, CHARLIE_KEY = test_party(CHARLIE_NAME, 30)
+DUMMY_NOTARY, DUMMY_NOTARY_KEY = test_party(DUMMY_NOTARY_NAME, 40)

@@ -1,0 +1,166 @@
+"""Declarative ledger DSL for contract unit tests.
+
+Capability parity with the reference's test DSL
+(testing/test-utils/.../TestDSL.kt, LedgerDSLInterpreter.kt,
+TransactionDSLInterpreter.kt):
+
+    with ledger(notary=DUMMY_NOTARY) as l:
+        with l.transaction() as tx:
+            tx.output(CASH_PROGRAM_ID, "alice's cash", state)
+            tx.command(Issue(), issuer_key)
+            tx.verifies()
+        with l.transaction() as tx:
+            tx.input("alice's cash")
+            tx.output(CASH_PROGRAM_ID, "bob's cash", moved)
+            tx.command(Move(), alice_key)
+            tx.fails_with("owners must sign")
+
+Transactions build REAL WireTransactions (ids are Merkle roots), so the
+DSL exercises the same verification path production uses; labelled outputs
+resolve across transactions inside the ledger block.
+"""
+
+from __future__ import annotations
+
+import re
+
+from corda_tpu.ledger import (
+    Party,
+    StateAndRef,
+    StateRef,
+    TimeWindow,
+    TransactionBuilder,
+    TransactionVerificationException,
+)
+
+
+class DslAssertionError(AssertionError):
+    pass
+
+
+class TransactionDSL:
+    def __init__(self, ledger_dsl: "LedgerDSL"):
+        self._ledger = ledger_dsl
+        self._builder = TransactionBuilder(notary=ledger_dsl.notary)
+        self._labels: list[tuple[str, int]] = []  # (label, output index)
+        self._n_outputs = 0
+        self._verified = False
+
+    # ------------------------------------------------------------- builders
+    def input(self, label_or_ref) -> "TransactionDSL":
+        if isinstance(label_or_ref, str):
+            sar = self._ledger.resolve_label(label_or_ref)
+        elif isinstance(label_or_ref, StateAndRef):
+            sar = label_or_ref
+        else:
+            raise TypeError("input() takes a label or StateAndRef")
+        self._builder.add_input_state(sar)
+        return self
+
+    def output(self, contract: str, label: str | None, data,
+               **kwargs) -> "TransactionDSL":
+        self._builder.add_output_state(data, contract, **kwargs)
+        if label is not None:
+            self._labels.append((label, self._n_outputs))
+        self._n_outputs += 1
+        return self
+
+    def command(self, value, *signers) -> "TransactionDSL":
+        self._builder.add_command(value, *signers)
+        return self
+
+    def time_window(self, from_time=None, until_time=None) -> "TransactionDSL":
+        self._builder.set_time_window(TimeWindow(from_time, until_time))
+        return self
+
+    # ------------------------------------------------------------ verdicts
+    def _ledger_tx(self):
+        wtx = self._builder.to_wire_transaction()
+        return wtx, wtx.to_ledger_transaction(self._ledger.resolve_state)
+
+    def verifies(self) -> "TransactionDSL":
+        """Assert the transaction verifies, then commit its outputs to the
+        ledger block so later transactions can consume them."""
+        wtx, ltx = self._ledger_tx()
+        ltx.verify()
+        self._ledger.commit(wtx, self._labels)
+        self._verified = True
+        return self
+
+    def fails(self) -> "TransactionDSL":
+        return self.fails_with("")
+
+    def fails_with(self, pattern: str) -> "TransactionDSL":
+        wtx, ltx = self._ledger_tx()
+        try:
+            ltx.verify()
+        except TransactionVerificationException as e:
+            if pattern and not re.search(pattern, str(e)):
+                raise DslAssertionError(
+                    f"transaction failed, but with {e!r} instead of "
+                    f"/{pattern}/"
+                ) from e
+            self._verified = True
+            return self
+        raise DslAssertionError(
+            f"transaction unexpectedly verified (wanted /{pattern}/)"
+        )
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *exc):
+        if exc_type is None and not self._verified:
+            raise DslAssertionError(
+                "transaction block ended without verifies()/fails_with()"
+            )
+        return False
+
+
+class LedgerDSL:
+    """Holds committed outputs; resolves labels and StateRefs for the
+    transactions declared inside the block."""
+
+    def __init__(self, notary: Party):
+        self.notary = notary
+        self._outputs: dict[StateRef, object] = {}    # ref -> TransactionState
+        self._by_label: dict[str, StateAndRef] = {}
+
+    def transaction(self) -> TransactionDSL:
+        return TransactionDSL(self)
+
+    # ------------------------------------------------------------ plumbing
+    def commit(self, wtx, labels) -> None:
+        for i, ts in enumerate(wtx.outputs):
+            self._outputs[StateRef(wtx.id, i)] = ts
+        for label, idx in labels:
+            if label in self._by_label:
+                raise DslAssertionError(f"duplicate output label {label!r}")
+            self._by_label[label] = StateAndRef(
+                wtx.outputs[idx], StateRef(wtx.id, idx)
+            )
+
+    def resolve_label(self, label: str) -> StateAndRef:
+        try:
+            return self._by_label[label]
+        except KeyError:
+            raise DslAssertionError(f"unknown output label {label!r}") from None
+
+    def resolve_state(self, ref: StateRef):
+        try:
+            return self._outputs[ref]
+        except KeyError:
+            raise DslAssertionError(f"unresolvable input {ref}") from None
+
+    def retrieve_output(self, label: str) -> StateAndRef:
+        return self.resolve_label(label)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def ledger(notary: Party) -> LedgerDSL:
+    return LedgerDSL(notary)

@@ -1,0 +1,365 @@
+"""Finance CorDapp tests — mirrors the reference's finance/src/test tier
+(CashTests, CommercialPaperTests, ObligationTests via the ledger DSL) and
+flow tests (CashIssueFlowTests, CashPaymentFlowTests over MockNetwork)."""
+
+import time
+
+import pytest
+
+from corda_tpu.finance import (
+    CASH_PROGRAM_ID,
+    CP_PROGRAM_ID,
+    OBLIGATION_PROGRAM_ID,
+    CashExitFlow,
+    CashIssueFlow,
+    CashPaymentFlow,
+    CashState,
+    CommercialPaperState,
+    Exit,
+    Issue,
+    Move,
+    ObligationState,
+    Redeem,
+    Settle,
+)
+from corda_tpu.ledger import Amount, Issued, PartyAndReference
+from corda_tpu.testing import MockNetworkNodes, ledger
+from corda_tpu.testing.constants import (
+    ALICE,
+    ALICE_KEY,
+    BOB,
+    BOB_KEY,
+    CHARLIE,
+    DUMMY_NOTARY,
+)
+
+GBP_REF = PartyAndReference(CHARLIE, b"\x01")
+GBP = Issued(GBP_REF, "GBP")
+ISSUER_KEY = CHARLIE.owning_key
+
+
+def cash(q, owner, token=GBP):
+    return CashState(Amount(q, token), owner)
+
+
+class TestCashContract:
+    def test_issue_verifies(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "c", cash(100, ALICE))
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+
+    def test_issue_needs_issuer_signature(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, None, cash(100, ALICE))
+                tx.command(Issue(), ALICE.owning_key)
+                tx.fails_with("issuer must sign")
+
+    def test_move_conserves_value(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "a", cash(100, ALICE))
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.output(CASH_PROGRAM_ID, None, cash(60, BOB))
+                tx.output(CASH_PROGRAM_ID, None, cash(40, ALICE))
+                tx.command(Move(), ALICE.owning_key)
+                tx.verifies()
+
+    def test_move_inflation_rejected(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "a", cash(100, ALICE))
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.output(CASH_PROGRAM_ID, None, cash(150, BOB))
+                tx.command(Move(), ALICE.owning_key)
+                tx.fails_with("not conserved")
+
+    def test_move_needs_owner_signature(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "a", cash(100, ALICE))
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.output(CASH_PROGRAM_ID, None, cash(100, BOB))
+                tx.command(Move(), BOB.owning_key)
+                tx.fails_with("owners must sign")
+
+    def test_exit_needs_issuer_and_owner(self):
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "a", cash(100, ALICE))
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.command(Exit(Amount(100, GBP)), ALICE.owning_key)
+                tx.fails_with("issuer")
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.command(
+                    Exit(Amount(100, GBP)), ALICE.owning_key, ISSUER_KEY
+                )
+                tx.verifies()
+
+    def test_mixed_issuers_grouped_independently(self):
+        other = Issued(PartyAndReference(BOB, b"\x02"), "GBP")
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CASH_PROGRAM_ID, "a", cash(100, ALICE))
+                tx.output(CASH_PROGRAM_ID, "b", cash(50, ALICE, other))
+                tx.command(Issue(), ISSUER_KEY, BOB.owning_key)
+                tx.verifies()
+            # cross-issuer "conservation" must NOT be allowed
+            with l.transaction() as tx:
+                tx.input("a")
+                tx.input("b")
+                tx.output(CASH_PROGRAM_ID, None, cash(150, BOB))
+                tx.command(Move(), ALICE.owning_key)
+                tx.fails_with("not conserved")
+
+
+NOW = time.time()
+PAPER = CommercialPaperState(
+    issuance=GBP_REF, owner=CHARLIE,
+    face_value=Amount(1000, GBP), maturity_date=NOW + 30 * 86400,
+)
+
+
+class TestCommercialPaper:
+    def test_lifecycle(self):
+        us = int(NOW * 1_000_000)
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CP_PROGRAM_ID, "paper", PAPER)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(until_time=us)
+                tx.verifies()
+            with l.transaction() as tx:  # move to alice
+                tx.input("paper")
+                tx.output(CP_PROGRAM_ID, "alice paper",
+                          PAPER.with_new_owner(ALICE))
+                tx.command(Move(), CHARLIE.owning_key)
+                tx.verifies()
+            # redeem before maturity fails
+            with l.transaction() as tx:
+                tx.input("alice paper")
+                tx.output(CASH_PROGRAM_ID, None, cash(1000, ALICE))
+                tx.command(Redeem(), ALICE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)  # cash for payment
+                tx.time_window(from_time=us)
+                tx.fails_with("after maturity")
+            # redeem at maturity with full payment verifies
+            mature_us = int((PAPER.maturity_date + 1) * 1_000_000)
+            with l.transaction() as tx:
+                tx.input("alice paper")
+                tx.output(CASH_PROGRAM_ID, None, cash(1000, ALICE))
+                tx.command(Redeem(), ALICE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(from_time=mature_us)
+                tx.verifies()
+
+    def test_two_papers_cannot_share_one_payment(self):
+        """Global redemption accounting: N papers need N face values of
+        cash, not one payment counted N times."""
+        us = int(NOW * 1_000_000)
+        mature_us = int((PAPER.maturity_date + 1) * 1_000_000)
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CP_PROGRAM_ID, "p1", PAPER)
+                tx.output(CP_PROGRAM_ID, "p2", PAPER)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(until_time=us)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("p1")
+                tx.input("p2")
+                tx.output(CASH_PROGRAM_ID, None, cash(1000, CHARLIE))
+                tx.command(Redeem(), CHARLIE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(from_time=mature_us)
+                tx.fails_with("face value")
+            with l.transaction() as tx:
+                tx.input("p1")
+                tx.input("p2")
+                tx.output(CASH_PROGRAM_ID, None, cash(2000, CHARLIE))
+                tx.command(Redeem(), CHARLIE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(from_time=mature_us)
+                tx.verifies()
+
+    def test_redeem_underpayment_rejected(self):
+        us = int(NOW * 1_000_000)
+        mature_us = int((PAPER.maturity_date + 1) * 1_000_000)
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CP_PROGRAM_ID, "paper", PAPER)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(until_time=us)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("paper")
+                tx.output(CASH_PROGRAM_ID, None, cash(400, CHARLIE))
+                tx.command(Redeem(), CHARLIE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(from_time=mature_us)
+                tx.fails_with("face value")
+
+
+class TestObligation:
+    def test_settle_with_cash(self):
+        iou = ObligationState(
+            obligor=BOB, amount=Amount(500, GBP), owner=ALICE,
+            due_before=NOW + 86400,
+        )
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(OBLIGATION_PROGRAM_ID, "iou", iou)
+                tx.command(Issue(), BOB.owning_key)
+                tx.verifies()
+            # settle without paying the beneficiary fails
+            with l.transaction() as tx:
+                tx.input("iou")
+                tx.command(Settle(Amount(500, GBP)), BOB.owning_key)
+                tx.fails_with("pay the beneficiary")
+            # full settlement with matching cash to alice verifies
+            with l.transaction() as tx:
+                tx.input("iou")
+                tx.output(CASH_PROGRAM_ID, None, cash(500, ALICE))
+                tx.command(Settle(Amount(500, GBP)), BOB.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+
+
+    def test_two_obligors_cannot_share_one_payment(self):
+        """Global settlement accounting: settling IOUs from two obligors
+        needs cash covering both reductions."""
+        iou_bob = ObligationState(BOB, Amount(500, GBP), ALICE, NOW + 86400)
+        iou_charlie = ObligationState(
+            CHARLIE, Amount(500, GBP), ALICE, NOW + 86400
+        )
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(OBLIGATION_PROGRAM_ID, "iou1", iou_bob)
+                tx.output(OBLIGATION_PROGRAM_ID, "iou2", iou_charlie)
+                tx.command(Issue(), BOB.owning_key, CHARLIE.owning_key)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("iou1")
+                tx.input("iou2")
+                tx.output(CASH_PROGRAM_ID, None, cash(500, ALICE))
+                tx.command(Settle(Amount(1000, GBP)),
+                           BOB.owning_key, CHARLIE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.fails_with("pay the beneficiary")
+            with l.transaction() as tx:
+                tx.input("iou1")
+                tx.input("iou2")
+                tx.output(CASH_PROGRAM_ID, None, cash(1000, ALICE))
+                tx.command(Settle(Amount(1000, GBP)),
+                           BOB.owning_key, CHARLIE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.verifies()
+
+
+# ------------------------------------------------------------ flow tests
+
+@pytest.fixture
+def net():
+    with MockNetworkNodes() as mnet:
+        mnet.create_node("Alice")
+        mnet.create_node("Bob")
+        mnet.create_notary_node("Notary", validating=True)
+        yield mnet
+
+
+class TestCashFlows:
+    def test_issue_pay_change(self, net):
+        alice, bob = net.nodes["Alice"], net.nodes["Bob"]
+        notary = net.nodes["Notary"].party
+        alice.run_flow(CashIssueFlow(1000, "GBP", b"\x01", notary))
+        stx = alice.run_flow(CashPaymentFlow(250, "GBP", bob.party))
+        # bob's vault sees 250, alice keeps 750 change
+        bob_cash = bob.services.vault_service.unconsumed_states(CashState)
+        assert sum(
+            sr.state.data.amount.quantity for sr in bob_cash
+        ) == 250
+        alice_cash = alice.services.vault_service.unconsumed_states(CashState)
+        assert sum(
+            sr.state.data.amount.quantity for sr in alice_cash
+        ) == 750
+        # the payment was notarised
+        assert notary.owning_key in {s.by for s in stx.sigs}
+
+    def test_insufficient_funds(self, net):
+        from corda_tpu.flows import FlowException
+
+        alice, bob = net.nodes["Alice"], net.nodes["Bob"]
+        notary = net.nodes["Notary"].party
+        alice.run_flow(CashIssueFlow(100, "GBP", b"\x01", notary))
+        with pytest.raises(FlowException, match="insufficient"):
+            alice.run_flow(CashPaymentFlow(250, "GBP", bob.party))
+
+    def test_exit(self, net):
+        alice = net.nodes["Alice"]
+        notary = net.nodes["Notary"].party
+        alice.run_flow(CashIssueFlow(1000, "GBP", b"\x07", notary))
+        alice.run_flow(CashExitFlow(400, "GBP", b"\x07"))
+        remaining = alice.services.vault_service.unconsumed_states(CashState)
+        assert sum(sr.state.data.amount.quantity for sr in remaining) == 600
+
+
+class TestConfidentialIdentities:
+    def test_swap_identities(self, net):
+        from corda_tpu.confidential import SwapIdentitiesFlow
+
+        alice, bob = net.nodes["Alice"], net.nodes["Bob"]
+        mapping = alice.run_flow(SwapIdentitiesFlow(bob.party))
+        anon_alice = mapping[alice.party]
+        anon_bob = mapping[bob.party]
+        assert anon_alice.owning_key != alice.party.owning_key
+        assert anon_bob.owning_key != bob.party.owning_key
+        # both sides resolve the anon keys to well-known parties
+        assert alice.services.identity_service.well_known_party_from_anonymous(
+            anon_bob
+        ) == bob.party
+        assert alice.services.identity_service.well_known_party_from_anonymous(
+            anon_alice
+        ) == alice.party
+
+
+class TestGeneratedLedger:
+    def test_generated_dag_verifies(self):
+        from corda_tpu.parallel import verify_transaction_dag
+        from corda_tpu.testing import GeneratedLedger
+
+        gen = GeneratedLedger(seed=7, n_parties=3)
+        txs = gen.generate(60)
+        assert len(txs) == 60
+        result = verify_transaction_dag(
+            txs,
+            allowed_missing_fn=lambda stx: {gen.notary.owning_key},
+            use_device=False,
+        )
+        assert len(result.order) == 60
+        assert len(result.levels) >= 2  # real DAG depth, not one flat level
+
+    def test_generated_ledger_deterministic(self):
+        from corda_tpu.testing import GeneratedLedger
+
+        # same seed -> same DAG shape (ids differ: fresh keys/salts)
+        a = GeneratedLedger(seed=3).generate(20)
+        b = GeneratedLedger(seed=3).generate(20)
+        shape = lambda txs: sorted(
+            (len(stx.inputs), len(stx.tx.outputs)) for stx in txs.values()
+        )
+        assert shape(a) == shape(b)
