@@ -19,7 +19,6 @@ from corda_tpu.ledger import (
     AnonymousParty,
     NameKeyCertificate,
     Party,
-    PartyAndCertificate,
     SignedTransaction,
 )
 from corda_tpu.serialization import cbe_serializable
@@ -37,9 +36,7 @@ class IdentityOffer:
 def _mint_confidential(flow: FlowLogic) -> IdentityOffer:
     me = flow.our_identity
     kms = flow.services.key_management_service
-    anon, cert = flow.record(lambda: kms.fresh_key_and_cert(
-        PartyAndCertificate(me, ()), kms._require(me.owning_key)
-    ))
+    anon, cert = flow.record(lambda: kms.fresh_confidential_identity(me))
     return IdentityOffer(anon, cert)
 
 

@@ -301,9 +301,14 @@ class CommercialPaper:
                     "paper must be issued before its maturity (needs a "
                     "time window)",
                 )
-            elif tx.commands_of_type(Redeem):
-                # paper consumed; owner must be paid face value in cash
-                _require(not outs, "redeemed paper must not be re-issued")
+            elif not outs:
+                # clause dispatch is PER GROUP by shape (the reference's
+                # grouped clause matching): consumed-without-reissue is a
+                # redemption of this group, even if other groups move
+                _require(
+                    bool(tx.commands_of_type(Redeem)),
+                    "paper consumed without a Redeem command",
+                )
                 _require(
                     tw is not None and tw.from_time is not None
                     and tw.from_time / 1_000_000 >= ins[0].maturity_date,

@@ -44,15 +44,32 @@ def select_cash(flow: FlowLogic, currency: str, quantity: int) -> list:
         sr for sr in page.states
         if sr.state.data.amount.token.product == currency
     ]
-    picked, total = [], 0
+    # a transaction's inputs must share one notary — select within the
+    # notary bucket that can cover the amount (cross-notary spends need an
+    # explicit NotaryChangeFlow first, as in the reference)
+    buckets: dict = {}
     for sr in candidates:
-        picked.append(sr)
-        total += sr.state.data.amount.quantity
-        if total >= quantity:
-            break
+        buckets.setdefault(sr.state.notary.owning_key, []).append(sr)
+    picked, total = [], 0
+    best_total = 0
+    for bucket in buckets.values():
+        bucket_total = sum(
+            sr.state.data.amount.quantity for sr in bucket
+        )
+        best_total = max(best_total, bucket_total)
+        if bucket_total < quantity:
+            continue
+        picked, total = [], 0
+        for sr in bucket:  # already smallest-first from the sorted query
+            picked.append(sr)
+            total += sr.state.data.amount.quantity
+            if total >= quantity:
+                break
+        break
     if total < quantity:
         raise FlowException(
-            f"insufficient spendable cash: have {total}, need {quantity} {currency}"
+            f"insufficient spendable cash under a single notary: best "
+            f"notary covers {best_total}, need {quantity} {currency}"
         )
     try:
         vault.soft_lock_reserve(flow.flow_id, [sr.ref for sr in picked])

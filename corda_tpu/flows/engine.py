@@ -99,6 +99,7 @@ class _FlowExecutor:
         self.result: Future = Future()
         self.sessions: list[int] = []         # local sids owned
         self.thread: threading.Thread | None = None
+        self.killed = False                   # set by SMM.kill_flow
 
     # ------------------------------------------------------------ op core
     def _do_op(self, effect, replay=None):
@@ -124,7 +125,8 @@ class _FlowExecutor:
         rec = self._do_op(lambda idx: {"deadline": time.time() + seconds})
         remaining = rec["deadline"] - time.time()
         if remaining > 0:
-            self.smm.wait_or_killed(lambda: False, timeout=remaining)
+            self.smm.wait_or_killed(lambda: False, timeout=remaining,
+                                    executor=self)
 
     def op_send(self, local_sid: int, obj) -> None:
         payload = serialize(obj)
@@ -152,7 +154,8 @@ class _FlowExecutor:
         def effect(idx):
             sess = self.smm.session(local_sid)
             item = self.smm.wait_or_killed(
-                lambda: sess.inbound[0] if sess.inbound else None
+                lambda: sess.inbound[0] if sess.inbound else None,
+                executor=self,
             )
             sess.inbound.popleft()
             kind, body, msg_id, ack = item
@@ -189,7 +192,8 @@ class _FlowExecutor:
                 msg_id=f"{self.flow_id}:op{idx}",
             )
             self.smm.wait_or_killed(
-                lambda: sess.peer_sid is not None or sess.rejected is not None
+                lambda: sess.peer_sid is not None or sess.rejected is not None,
+                executor=self,
             )
             if sess.rejected is not None:
                 raise FlowException(f"session rejected: {sess.rejected}")
@@ -246,7 +250,8 @@ class _FlowExecutor:
     def op_wait_ledger_commit(self, tx_id):
         def effect(idx):
             stx = self.smm.wait_or_killed(
-                lambda: self.smm.lookup_committed(tx_id)
+                lambda: self.smm.lookup_committed(tx_id),
+                executor=self,
             )
             return {"stx": stx}
 
@@ -271,7 +276,14 @@ class _FlowExecutor:
             result = self.flow.call()
             self._finish(result, None)
         except FlowKilledException:
-            self.result.cancel()
+            if self.killed:
+                # explicit kill: tell counterparties (SessionEnd), surface
+                # a failed result, drop checkpoint + session state — all
+                # via the normal finish path. An SMM *shutdown* instead
+                # cancels quietly and preserves checkpoints for restore.
+                self._finish(None, FlowException("flow was killed"))
+            else:
+                self.result.cancel()
         except Exception as e:  # flow failure → future + peers
             self._finish(None, e)
 
@@ -371,6 +383,24 @@ class StateMachineManager:
         with self._lock:
             return list(self._flows)
 
+    def handle_of(self, flow_id: str) -> FlowHandle | None:
+        """Handle for a running flow (None once finished and pruned)."""
+        with self._lock:
+            ex = self._flows.get(flow_id)
+        return FlowHandle(flow_id, ex.result) if ex is not None else None
+
+    def kill_flow(self, flow_id: str) -> bool:
+        """Terminate one running flow (reference: CordaRPCOps.killFlow).
+        The flow's next suspension point raises; its checkpoint is
+        removed."""
+        with self._lock:
+            ex = self._flows.get(flow_id)
+            if ex is None:
+                return False
+            ex.killed = True
+            self._lock.notify_all()
+        return True
+
     def mark_consumed(self, msg_id: str) -> None:
         with self._lock:
             self._consumed_msg_ids.add(msg_id)
@@ -415,13 +445,15 @@ class StateMachineManager:
         self.messaging.send(str(party.name), SESSION_TOPIC, serialize(obj),
                             msg_id=msg_id)
 
-    def wait_or_killed(self, predicate, timeout: float | None = None):
+    def wait_or_killed(self, predicate, timeout: float | None = None,
+                       executor=None):
         """Block until predicate() returns non-None/True; FlowKilled on
-        shutdown. Runs under the SMM lock."""
+        shutdown or when this flow was explicitly killed. Runs under the
+        SMM lock."""
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
             while True:
-                if self._closed:
+                if self._closed or (executor is not None and executor.killed):
                     raise FlowKilledException()
                 val = predicate()
                 if val not in (None, False):

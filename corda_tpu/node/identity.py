@@ -177,6 +177,17 @@ class KeyManagementService:
             )
         return anon, cert
 
+    def fresh_confidential_identity(
+        self, identity: Party, scheme_id: int = DEFAULT_SIGNATURE_SCHEME,
+    ) -> tuple[AnonymousParty, NameKeyCertificate]:
+        """Mint a fresh anonymous key certified by ``identity``'s key,
+        which must be one of ours (the public face of fresh_key_and_cert
+        for swap-identities flows)."""
+        kp = self._require(identity.owning_key)
+        return self.fresh_key_and_cert(
+            PartyAndCertificate(identity, ()), kp, scheme_id
+        )
+
     def _require(self, key: PublicKey) -> KeyPair:
         with self._lock:
             kp = self._keys.get(key)

@@ -92,6 +92,13 @@ class NetworkMapCache:
         with self._lock:
             return list(self._nodes.values())
 
+    def untrack(self, callback) -> None:
+        with self._lock:
+            try:
+                self._subscribers.remove(callback)
+            except ValueError:
+                pass
+
     def track(self, callback) -> list[NodeInfo]:
         with self._lock:
             self._subscribers.append(callback)

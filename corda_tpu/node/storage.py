@@ -67,6 +67,13 @@ class DBTransactionStorage:
             ).fetchone()
         return row is not None
 
+    def untrack(self, callback) -> None:
+        with self._lock:
+            try:
+                self._subscribers.remove(callback)
+            except ValueError:
+                pass
+
     def track(self, callback) -> list[SignedTransaction]:
         """Subscribe to future additions; returns the current snapshot
         (reference: DataFeed<List<SignedTransaction>, SignedTransaction>)."""

@@ -22,7 +22,7 @@ import threading
 from corda_tpu.crypto import SecureHash
 from corda_tpu.ledger import SignedTransaction, StateAndRef, StateRef, TransactionState
 from corda_tpu.ledger.states import Amount
-from corda_tpu.serialization import deserialize, serialize
+from corda_tpu.serialization import deserialize, register_custom, serialize
 
 
 class StateStatus(enum.Enum):
@@ -222,7 +222,11 @@ class NodeVaultService:
         elif criteria.status is StateStatus.CONSUMED:
             clauses.append("consumed=1")
         if criteria.contract_state_types:
-            names = [t.__name__ for t in criteria.contract_state_types]
+            # accept classes or class-name strings — RPC clients send names
+            names = [
+                t if isinstance(t, str) else t.__name__
+                for t in criteria.contract_state_types
+            ]
             clauses.append(
                 "state_class IN (%s)" % ",".join("?" * len(names))
             )
@@ -295,6 +299,13 @@ class NodeVaultService:
             contract_state_types=(state_type,) if state_type else None
         )
         return self.query_by(crit).states
+
+    def untrack(self, callback) -> None:
+        with self._lock:
+            try:
+                self._subscribers.remove(callback)
+            except ValueError:
+                pass
 
     def track(self, callback) -> Page:
         """Snapshot + subscription (reference: vaultTrackBy returning
@@ -373,3 +384,72 @@ class NodeVaultService:
     def close(self) -> None:
         with self._lock:
             self._db.close()
+
+
+# -------------------------------------------------- wire registrations
+# Query/page types travel over RPC (vault_query_by args and results);
+# state types inside criteria are encoded by class NAME (the column the
+# vault filters on), so clients need not hold the classes.
+
+register_custom(
+    QueryCriteria, "vault.QueryCriteria",
+    to_fields=lambda c: {
+        "status": c.status.value,
+        "types": [
+            t if isinstance(t, str) else t.__name__
+            for t in (c.contract_state_types or [])
+        ] or 0,
+        "state_refs": list(c.state_refs) if c.state_refs else 0,
+        "notary_names": list(c.notary_names) if c.notary_names else 0,
+        "participant_keys": (
+            list(c.participant_keys) if c.participant_keys else 0
+        ),
+        "include_soft_locked": 1 if c.include_soft_locked else 0,
+        "soft_lock_id": c.soft_lock_id or "",
+        "quantity_geq": -1 if c.quantity_geq is None else c.quantity_geq,
+        "token_repr": c.token_repr or "",
+    },
+    from_fields=lambda d: QueryCriteria(
+        status=StateStatus(d["status"]),
+        contract_state_types=tuple(d["types"]) if d["types"] != 0 else None,
+        state_refs=tuple(d["state_refs"]) if d["state_refs"] != 0 else None,
+        notary_names=(
+            tuple(d["notary_names"]) if d["notary_names"] != 0 else None
+        ),
+        participant_keys=(
+            tuple(d["participant_keys"])
+            if d["participant_keys"] != 0 else None
+        ),
+        include_soft_locked=bool(d["include_soft_locked"]),
+        soft_lock_id=d["soft_lock_id"] or None,
+        quantity_geq=None if d["quantity_geq"] == -1 else d["quantity_geq"],
+        token_repr=d["token_repr"] or None,
+    ),
+)
+register_custom(
+    PageSpecification, "vault.PageSpecification",
+    to_fields=lambda p: {"page_number": p.page_number, "page_size": p.page_size},
+    from_fields=lambda d: PageSpecification(d["page_number"], d["page_size"]),
+)
+register_custom(
+    Sort, "vault.Sort",
+    to_fields=lambda s: {"by": s.by, "descending": 1 if s.descending else 0},
+    from_fields=lambda d: Sort(d["by"], bool(d["descending"])),
+)
+register_custom(
+    Page, "vault.Page",
+    to_fields=lambda p: {
+        "states": list(p.states),
+        "total": p.total_states_available,
+    },
+    from_fields=lambda d: Page(list(d["states"]), d["total"]),
+)
+register_custom(
+    VaultUpdate, "vault.Update",
+    to_fields=lambda u: {
+        "consumed": list(u.consumed), "produced": list(u.produced),
+    },
+    from_fields=lambda d: VaultUpdate(
+        tuple(d["consumed"]), tuple(d["produced"])
+    ),
+)

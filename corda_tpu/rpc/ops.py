@@ -1,0 +1,152 @@
+"""The node's remote operations surface.
+
+Capability parity with ``CordaRPCOps`` (core/.../messaging/CordaRPCOps.kt:54):
+flow start (:204 startFlowDynamic), vault query/track (:94/:135), network
+map snapshot/feed (:197), state machine feed (:69), transaction feed,
+notary identities, node info, attachments, registered flows, time.
+
+This class is transport-free — the RPCServer exposes it remotely; in-process
+callers (shell, webserver, tests) can use it directly, like the reference's
+``CordaRPCOpsImpl`` (node/.../internal/CordaRPCOpsImpl.kt).
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from corda_tpu.flows import FlowLogic
+from corda_tpu.flows.api import load_class
+from corda_tpu.node import PageSpecification, QueryCriteria, Sort
+
+
+class PermissionException(Exception):
+    """RPC user lacks the permission for an operation (reference:
+    net.corda.node.services.messaging.RPCOps permission checks)."""
+
+
+def start_flow_permission(flow_cls_or_path) -> str:
+    """Permission string guarding a flow start (reference:
+    startFlowPermission<T>())."""
+    if isinstance(flow_cls_or_path, str):
+        return f"StartFlow.{flow_cls_or_path}"
+    from corda_tpu.flows.api import class_path
+
+    return f"StartFlow.{class_path(flow_cls_or_path)}"
+
+
+class CordaRPCOps:
+    """All operations a client may invoke on the node."""
+
+    MAX_RETAINED_HANDLES = 4096
+
+    def __init__(self, services, smm, registered_flow_names=None):
+        self._services = services
+        self._smm = smm
+        self._registered_flows = list(registered_flow_names or [])
+        # RPC-started flow handles are retained (bounded) so flow_result
+        # works even after the flow finished and the SMM pruned it
+        self._handles: dict = {}
+
+    # ------------------------------------------------------------- flows
+    def start_flow_dynamic(self, flow_class_path: str, *args, **kwargs):
+        """Start a flow by class path; returns the flow id (reference:
+        CordaRPCOps.startFlowDynamic :204). The result is retrieved via
+        ``flow_result``/the state machine feed — RPC calls never block on
+        flow completion."""
+        cls = load_class(flow_class_path)
+        if not (isinstance(cls, type) and issubclass(cls, FlowLogic)):
+            raise PermissionException(
+                f"{flow_class_path} is not a startable flow"
+            )
+        handle = self._smm.start_flow(cls(*args, **kwargs))
+        self._handles[handle.flow_id] = handle
+        while len(self._handles) > self.MAX_RETAINED_HANDLES:
+            self._handles.pop(next(iter(self._handles)))
+        return handle.flow_id
+
+    def flow_result(self, flow_id: str, timeout: float | None = None):
+        """Block for a started flow's result (the client-side handle's
+        ``returnValue`` future in the reference)."""
+        handle = self._handles.get(flow_id) or self._smm.handle_of(flow_id)
+        if handle is None:
+            raise KeyError(f"unknown flow {flow_id}")
+        return handle.result.result(timeout=timeout)
+
+    def state_machines_snapshot(self) -> list[str]:
+        return self._smm.flows_in_progress()
+
+    def registered_flows(self) -> list[str]:
+        return list(self._registered_flows)
+
+    def kill_flow(self, flow_id: str) -> bool:
+        return self._smm.kill_flow(flow_id)
+
+    # ------------------------------------------------------------- vault
+    def vault_query_by(self, criteria: QueryCriteria | None = None,
+                       paging: PageSpecification | None = None,
+                       sorting: Sort | None = None):
+        crit = criteria or QueryCriteria()
+        return self._services.vault_service.query_by(
+            crit, paging=paging, sort=sorting or Sort()
+        )
+
+    def vault_track(self, callback):
+        """Current page + future updates pushed to ``callback`` (reference:
+        vaultTrackBy :135). Over RPC the server bridges the callback into
+        an Observation stream."""
+        return self._services.vault_service.track(callback)
+
+    # ------------------------------------------------------- transactions
+    def transaction(self, tx_id):
+        return self._services.validated_transactions.get(tx_id)
+
+    def transaction_count(self) -> int:
+        return self._services.validated_transactions.count()
+
+    def validated_transactions_track(self, callback):
+        return self._services.validated_transactions.track(callback)
+
+    # -------------------------------------------------------- network map
+    def network_map_snapshot(self) -> list:
+        return self._services.network_map_cache.all_nodes()
+
+    def network_map_feed(self, callback) -> list:
+        return self._services.network_map_cache.track(callback)
+
+    def notary_identities(self) -> list:
+        return self._services.network_map_cache.notary_identities
+
+    def node_info(self):
+        return self._services.my_info
+
+    def well_known_party_from_x500_name(self, name):
+        info = self._services.network_map_cache.get_node_by_legal_name(name)
+        return info.legal_identity if info else None
+
+    # -------------------------------------------------------- attachments
+    def attachment_exists(self, attachment_id) -> bool:
+        return self._services.attachments.has_attachment(attachment_id)
+
+    def upload_attachment(self, data: bytes):
+        return self._services.attachments.import_attachment(data)
+
+    def open_attachment(self, attachment_id) -> bytes | None:
+        att = self._services.attachments.open_attachment(attachment_id)
+        return att.data if att else None
+
+    def untrack(self, callback) -> None:
+        """Detach a feed callback from every trackable service (server-side
+        unsubscribe cleanup)."""
+        self._services.vault_service.untrack(callback)
+        self._services.validated_transactions.untrack(callback)
+        self._services.network_map_cache.untrack(callback)
+
+    # -------------------------------------------------------------- misc
+    def current_node_time(self) -> float:
+        return (
+            self._services.clock() if callable(self._services.clock)
+            else _time.time()
+        )
+
+    def ping(self) -> str:
+        return "pong"

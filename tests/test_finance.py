@@ -240,6 +240,41 @@ class TestObligation:
                 tx.verifies()
 
 
+    def test_combined_move_and_redeem(self):
+        """One tx can redeem mature paper A while moving unmatured paper B
+        — clause dispatch is per group, not transaction-global."""
+        us = int(NOW * 1_000_000)
+        mature = CommercialPaperState(
+            issuance=GBP_REF, owner=ALICE,
+            face_value=Amount(1000, GBP), maturity_date=NOW - 86400,
+        )
+        unmatured = CommercialPaperState(
+            issuance=PartyAndReference(CHARLIE, b"\x09"), owner=ALICE,
+            face_value=Amount(500, GBP), maturity_date=NOW + 60 * 86400,
+        )
+        with ledger(DUMMY_NOTARY) as l:
+            with l.transaction() as tx:
+                tx.output(CP_PROGRAM_ID, "mature", mature)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(until_time=int((NOW - 2 * 86400) * 1_000_000))
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.output(CP_PROGRAM_ID, "unmatured", unmatured)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(until_time=us)
+                tx.verifies()
+            with l.transaction() as tx:
+                tx.input("mature")
+                tx.input("unmatured")
+                tx.output(CASH_PROGRAM_ID, None, cash(1000, ALICE))
+                tx.output(CP_PROGRAM_ID, None,
+                          unmatured.with_new_owner(BOB))
+                tx.command(Redeem(), ALICE.owning_key)
+                tx.command(Move(), ALICE.owning_key)
+                tx.command(Issue(), ISSUER_KEY)
+                tx.time_window(from_time=us)
+                tx.verifies()
+
     def test_two_obligors_cannot_share_one_payment(self):
         """Global settlement accounting: settling IOUs from two obligors
         needs cash covering both reductions."""

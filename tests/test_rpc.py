@@ -1,0 +1,214 @@
+"""RPC tier tests — the reference's client/rpc test coverage model
+(CordaRPCClientTest, RPCStabilityTests subset): auth, permissions, flow
+start via class path, vault query over the wire, feeds (vault track),
+unknown-method and malformed-request handling."""
+
+import dataclasses
+import time
+
+import pytest
+
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
+from corda_tpu.flows import FlowLogic
+from corda_tpu.flows.api import class_path
+from corda_tpu.node import QueryCriteria
+from corda_tpu.node.config import RpcUser
+from corda_tpu.rpc import CordaRPCClient, CordaRPCOps, RPCServer
+from corda_tpu.rpc.client import RPCException
+from corda_tpu.rpc.ops import start_flow_permission
+from corda_tpu.testing import MockNetworkNodes
+
+
+@dataclasses.dataclass
+class EchoFlow(FlowLogic):
+    value: int
+
+    def call(self):
+        return self.value * 2
+
+
+@dataclasses.dataclass
+class SleepyFlow(FlowLogic):
+    def call(self):
+        self.sleep(60)
+        return "done"
+
+
+ECHO_PATH = class_path(EchoFlow)
+ISSUE_PATH = class_path(CashIssueFlow)
+PAY_PATH = class_path(CashPaymentFlow)
+
+USERS = (
+    RpcUser("admin", "admin-pw", ("ALL",)),
+    RpcUser("issuer", "issuer-pw", (
+        start_flow_permission(CashIssueFlow),
+        "InvokeRpc.flow_result",
+        "InvokeRpc.vault_query_by",
+    )),
+    RpcUser("nobody", "nobody-pw", ()),
+)
+
+
+@pytest.fixture
+def rig():
+    with MockNetworkNodes() as net:
+        alice = net.create_node("Alice")
+        net.create_node("Bob")
+        net.create_notary_node("Notary")
+        ops = CordaRPCOps(
+            alice.services, alice.smm,
+            registered_flow_names=[ECHO_PATH, ISSUE_PATH, PAY_PATH],
+        )
+        RPCServer(ops, alice.smm.messaging, rpc_users=USERS)
+        client_endpoint = net.net.create_node("rpc-client-1")
+        client = CordaRPCClient(client_endpoint, str(alice.party.name))
+        yield net, client
+
+
+class TestRPC:
+    def test_ping_and_node_info(self, rig):
+        net, client = rig
+        conn = client.start("admin", "admin-pw")
+        assert conn.proxy.ping() == "pong"
+        info = conn.proxy.node_info()
+        assert info.legal_identity == net.nodes["Alice"].party
+        assert conn.proxy.notary_identities() == [net.nodes["Notary"].party]
+        conn.close()
+
+    def test_bad_credentials_rejected(self, rig):
+        _, client = rig
+        conn = client.start("admin", "wrong")
+        with pytest.raises(RPCException, match="credentials"):
+            conn.proxy.ping()
+
+    def test_start_flow_and_result(self, rig):
+        _, client = rig
+        conn = client.start("admin", "admin-pw")
+        flow_id = conn.proxy.start_flow_dynamic(ECHO_PATH, 21)
+        assert conn.proxy.flow_result(flow_id, 30) == 42
+
+    def test_flow_permissions_enforced(self, rig):
+        net, client = rig
+        notary = net.nodes["Notary"].party
+        conn = client.start("issuer", "issuer-pw")
+        fid = conn.proxy.start_flow_dynamic(
+            ISSUE_PATH, 500, "GBP", b"\x01", notary
+        )
+        conn.proxy.flow_result(fid, 30)
+        # issuer may NOT start payments or call unlisted methods
+        with pytest.raises(RPCException, match="may not start"):
+            conn.proxy.start_flow_dynamic(
+                PAY_PATH, 100, "GBP", net.nodes["Bob"].party
+            )
+        with pytest.raises(RPCException, match="may not call"):
+            conn.proxy.transaction_count()
+        # but open methods work
+        assert conn.proxy.ping() == "pong"
+
+    def test_nobody_cannot_start_flows(self, rig):
+        _, client = rig
+        conn = client.start("nobody", "nobody-pw")
+        with pytest.raises(RPCException, match="may not start"):
+            conn.proxy.start_flow_dynamic(ECHO_PATH, 1)
+
+    def test_vault_query_over_wire(self, rig):
+        net, client = rig
+        notary = net.nodes["Notary"].party
+        conn = client.start("admin", "admin-pw")
+        fid = conn.proxy.start_flow_dynamic(
+            ISSUE_PATH, 777, "GBP", b"\x02", notary
+        )
+        conn.proxy.flow_result(fid, 30)
+        page = conn.proxy.vault_query_by(
+            QueryCriteria(contract_state_types=("CashState",))
+        )
+        assert page.total_states_available == 1
+        assert page.states[0].state.data.amount.quantity == 777
+
+    def test_unknown_method_rejected(self, rig):
+        _, client = rig
+        conn = client.start("admin", "admin-pw")
+        with pytest.raises(RPCException, match="unknown RPC method"):
+            conn.proxy.definitely_not_a_method()
+
+    def test_vault_track_feed(self, rig):
+        net, client = rig
+        notary = net.nodes["Notary"].party
+        conn = client.start("admin", "admin-pw")
+        obs = conn.proxy.vault_track()
+        assert obs.snapshot.total_states_available == 0
+        fid = conn.proxy.start_flow_dynamic(
+            ISSUE_PATH, 123, "GBP", b"\x03", notary
+        )
+        conn.proxy.flow_result(fid, 30)
+        update = obs.poll(timeout=10)
+        assert update is not None
+        produced = update.produced if hasattr(update, "produced") else update
+        assert produced[0].state.data.amount.quantity == 123
+        obs.close()
+        # after unsubscribe no more pushes arrive
+        fid = conn.proxy.start_flow_dynamic(
+            ISSUE_PATH, 5, "GBP", b"\x04", notary
+        )
+        conn.proxy.flow_result(fid, 30)
+        time.sleep(0.2)
+        assert obs.poll(timeout=0.2) is None
+
+    def test_kill_flow(self, rig):
+        _, client = rig
+        conn = client.start("admin", "admin-pw")
+        fid = conn.proxy.start_flow_dynamic(class_path(SleepyFlow))
+        time.sleep(0.2)
+        assert conn.proxy.kill_flow(fid) is True
+        deadline = time.monotonic() + 10
+        while fid in conn.proxy.state_machines_snapshot():
+            assert time.monotonic() < deadline, "flow did not die"
+            time.sleep(0.05)
+
+
+class TestRPCConcurrency:
+    def test_flow_result_while_flow_needs_messaging(self, rig):
+        """flow_result must not block message delivery: a payment flow
+        started over RPC needs notarisation round-trips WHILE the client
+        blocks in flow_result (the dispatch-on-pump-thread deadlock)."""
+        net, client = rig
+        notary = net.nodes["Notary"].party
+        conn = client.start("admin", "admin-pw")
+        fid = conn.proxy.start_flow_dynamic(
+            ISSUE_PATH, 300, "GBP", b"\x05", notary
+        )
+        conn.proxy.flow_result(fid, 30)
+        fid = conn.proxy.start_flow_dynamic(
+            PAY_PATH, 100, "GBP", net.nodes["Bob"].party
+        )
+        conn.proxy.flow_result(fid, 30)  # would deadlock on pump thread
+        bob_cash = net.nodes["Bob"].services.vault_service.unconsumed_states(
+            CashState
+        )
+        assert sum(sr.state.data.amount.quantity for sr in bob_cash) == 100
+
+
+class TestMixedNotarySelection:
+    def test_payment_selects_single_notary_bucket(self, rig):
+        """Cash held under two notaries: payment must spend within one
+        notary's bucket, not build an unverifiable mixed-notary tx."""
+        net, client = rig
+        alice = net.nodes["Alice"]
+        n2 = net.create_notary_node("Notary2", validating=True)
+        conn = client.start("admin", "admin-pw")
+        for notary, amt in ((net.nodes["Notary"].party, 100), (n2.party, 100)):
+            fid = conn.proxy.start_flow_dynamic(
+                ISSUE_PATH, amt, "GBP", b"\x06", notary
+            )
+            conn.proxy.flow_result(fid, 30)
+        # 80 fits in one bucket -> works
+        fid = conn.proxy.start_flow_dynamic(
+            PAY_PATH, 80, "GBP", net.nodes["Bob"].party
+        )
+        conn.proxy.flow_result(fid, 30)
+        # 150 needs both buckets -> clean refusal, not a broken tx
+        with pytest.raises(RPCException, match="single notary"):
+            fid = conn.proxy.start_flow_dynamic(
+                PAY_PATH, 150, "GBP", net.nodes["Bob"].party
+            )
+            conn.proxy.flow_result(fid, 30)
