@@ -26,6 +26,7 @@ from .network_map import (
     NodeInfo,
 )
 from .scheduler import NodeSchedulerService, ScheduledActivity, SchedulableState
+from .node import Node
 from .services import ServiceHub, TransactionResolutionError
 from .storage import Attachment, AttachmentStorage, DBTransactionStorage
 from .vault import (
@@ -46,6 +47,7 @@ __all__ = [
     "Counter", "Gauge", "Meter", "MetricRegistry", "Timer",
     "NetworkMapCache", "NetworkMapClient", "NetworkMapServer", "NodeInfo",
     "NodeSchedulerService", "ScheduledActivity", "SchedulableState",
+    "Node",
     "ServiceHub", "TransactionResolutionError",
     "Attachment", "AttachmentStorage", "DBTransactionStorage",
     "NodeVaultService", "PageSpecification", "QueryCriteria", "Sort",

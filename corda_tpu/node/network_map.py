@@ -27,6 +27,9 @@ class NodeInfo:
     legal_identities: tuple[Party, ...]
     platform_version: int = 1
     serial: int = 0
+    # "validating" | "simple" | "" — advertised notary service, so peers
+    # learn notaries (and their protocol mode) from map registration alone
+    notary_mode: str = ""
 
     @property
     def legal_identity(self) -> Party:
@@ -40,9 +43,11 @@ register_custom(
         "identities": list(n.legal_identities),
         "pv": n.platform_version,
         "serial": n.serial,
+        "notary_mode": n.notary_mode,
     },
     from_fields=lambda d: NodeInfo(
-        tuple(d["addresses"]), tuple(d["identities"]), d["pv"], d["serial"]
+        tuple(d["addresses"]), tuple(d["identities"]), d["pv"], d["serial"],
+        d.get("notary_mode", ""),
     ),
 )
 
@@ -66,10 +71,28 @@ class NetworkMapCache:
                 return  # stale update (last-write-wins by serial)
             self._nodes[name] = info
             subs = list(self._subscribers)
+        if info.notary_mode:
+            self.add_notary(
+                info.legal_identity,
+                validating=(info.notary_mode == "validating"),
+            )
+        else:
+            # a re-registration without a notary service decommissions any
+            # previous notary entry for this identity
+            self._remove_notary(info.legal_identity)
         for cb in subs:
             cb("ADD", info)
 
+    def _remove_notary(self, party: Party) -> None:
+        with self._lock:
+            self._notaries = [
+                n for n in self._notaries
+                if n.owning_key != party.owning_key
+            ]
+            self._validating_notaries.discard(party.owning_key)
+
     def remove_node(self, info: NodeInfo) -> None:
+        self._remove_notary(info.legal_identity)
         with self._lock:
             self._nodes.pop(info.legal_identity.name, None)
             subs = list(self._subscribers)

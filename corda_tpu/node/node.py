@@ -1,0 +1,194 @@
+"""The node container: configuration → a running node.
+
+Capability parity with the reference's boot path (node/.../Corda.kt:7 →
+NodeStartup.kt:30 → AbstractNode.start(), AbstractNode.kt:202-255): from a
+``NodeConfiguration``, assemble persistence, services (vault, identity,
+keys, attachments, network map, scheduler), the verifier service selected
+by ``verifierType``, the notary service selected by the notary config
+(simple / validating / batched / Raft / BFT —
+AbstractNode.makeCoreNotaryService :615-632), the flow state machine, and
+the RPC server; register with the network map; start the scheduler;
+restore checkpointed flows.
+
+Transport is injected (an ``InMemoryMessagingNetwork`` for in-process
+ensembles — the driver/demo mode — or a ``DurableQueueBroker`` client for
+crash-durable messaging; a gRPC transport slots in the same interface for
+multi-host DCN deployment).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+from corda_tpu.crypto import generate_keypair
+from corda_tpu.flows import CheckpointStorage, StateMachineManager
+from corda_tpu.ledger import CordaX500Name, Party
+from corda_tpu.verifier import BatchedVerifierService, InMemoryVerifierService
+
+from .config import NodeConfiguration, VerifierType
+from .identity import IdentityService, KeyManagementService
+from .network_map import NetworkMapCache, NodeInfo
+from .scheduler import NodeSchedulerService
+from .services import ServiceHub
+from .storage import AttachmentStorage, DBTransactionStorage
+from .vault import NodeVaultService
+
+logger = logging.getLogger(__name__)
+
+
+class Node:
+    """A fully-assembled node (reference: AbstractNode + Node)."""
+
+    def __init__(
+        self,
+        config: NodeConfiguration,
+        messaging,
+        network_map: NetworkMapCache | None = None,
+        party_resolver=None,
+        keypair=None,
+        persistent: bool = False,
+    ):
+        self.config = config
+        self.messaging = messaging
+        name = CordaX500Name.parse(config.my_legal_name) if isinstance(
+            config.my_legal_name, str
+        ) else config.my_legal_name
+        self.keypair = keypair or generate_keypair()
+        self.party = Party(name, self.keypair.public)
+        notary_mode = ""
+        if config.notary is not None:
+            notary_mode = "validating" if config.notary.validating else "simple"
+        self.info = NodeInfo(
+            (config.p2p_address,), (self.party,), notary_mode=notary_mode
+        )
+        # peers address us by the canonical X.500 string — a transport
+        # endpoint registered under anything else silently receives nothing
+        expected = str(self.party.name)
+        if messaging.me.name != expected:
+            raise ValueError(
+                f"messaging endpoint is {messaging.me.name!r} but peers "
+                f"will address {expected!r} — create the transport node "
+                "with str(CordaX500Name.parse(config.my_legal_name))"
+            )
+
+        base = Path(config.base_directory)
+        if persistent:
+            base.mkdir(parents=True, exist_ok=True)
+        db = (lambda f: str(base / f)) if persistent else (lambda f: ":memory:")
+
+        network_map = network_map or NetworkMapCache()
+        identity_service = IdentityService()
+        kms = KeyManagementService([self.keypair], identity_service)
+        self._notary_uniqueness = None
+        notary_service = self._make_notary_service(db)
+        self.services = ServiceHub(
+            my_info=self.info,
+            key_management_service=kms,
+            identity_service=identity_service,
+            vault_service=NodeVaultService(
+                db("vault.db"), my_keys=kms.keys
+            ),
+            validated_transactions=DBTransactionStorage(db("transactions.db")),
+            attachments=AttachmentStorage(db("attachments.db")),
+            network_map_cache=network_map,
+            verifier_service=self._make_verifier_service(),
+            notary_service=notary_service,
+        )
+        if party_resolver is None:
+            def party_resolver(sender_name: str):
+                info = network_map.get_node_by_legal_name(
+                    CordaX500Name.parse(sender_name)
+                )
+                return info.legal_identity if info else None
+        self.smm = StateMachineManager(
+            messaging,
+            CheckpointStorage(db("checkpoints.db")),
+            self.party,
+            party_resolver,
+            services=self.services,
+        )
+        # imported here, not at module level: rpc depends on node.vault,
+        # so a module-level import would make corda_tpu.rpc unimportable
+        # on its own (circular) — deferred, both import orders work
+        from corda_tpu.rpc import CordaRPCOps, RPCServer
+
+        self.rpc_ops = CordaRPCOps(self.services, self.smm)
+        self.rpc_server = RPCServer(
+            self.rpc_ops, messaging, rpc_users=config.rpc_users
+        )
+        self.scheduler = NodeSchedulerService(self._start_scheduled_flow)
+        self.services.scheduler_service = self.scheduler
+        self._started = False
+
+    # ------------------------------------------------------------ assembly
+    def _make_verifier_service(self):
+        vt = self.config.verifier_type
+        if vt is VerifierType.DeviceBatched:
+            return BatchedVerifierService(
+                max_batch=self.config.verification_batch_max,
+                window_s=self.config.verification_window_ms / 1000.0,
+            )
+        # OutOfProcess wiring (queue to external verifier workers) rides the
+        # broker transport; in-process pool is the compatible default
+        return InMemoryVerifierService()
+
+    def _make_notary_service(self, db):
+        """reference: AbstractNode.makeCoreNotaryService
+        (AbstractNode.kt:615-632) — notary flavor from config."""
+        cfg = self.config.notary
+        if cfg is None:
+            return None
+        from corda_tpu.notary import PersistentUniquenessProvider
+        from corda_tpu.notary.service import (
+            SimpleNotaryService,
+            ValidatingNotaryService,
+        )
+
+        # Raft/BFT clusters are wired externally (they span processes);
+        # the container builds the single-replica tiers
+        uniqueness = PersistentUniquenessProvider(db("notary.db"))
+        self._notary_uniqueness = uniqueness
+        cls = ValidatingNotaryService if cfg.validating else SimpleNotaryService
+        return cls(self.party, self.keypair, uniqueness)
+
+    def set_notary_uniqueness_provider(self, provider) -> None:
+        """Swap in a replicated (Raft/BFT) uniqueness provider built by the
+        cluster driver before ``start()``."""
+        if self.services.notary_service is None:
+            raise ValueError("node has no notary service")
+        self.services.notary_service.uniqueness = provider
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Node":
+        # add_node also registers us as a notary when info.notary_mode is
+        # set — single source of truth for the mode
+        self.services.network_map_cache.add_node(self.info)
+        self.scheduler.start()
+        restored = self.smm.restore()
+        if restored:
+            logger.info(
+                "%s: restored %d checkpointed flow(s)",
+                self.party.name, len(restored),
+            )
+        self._started = True
+        return self
+
+    def _start_scheduled_flow(self, flow_class_path: str, args):
+        from corda_tpu.flows.api import load_class
+
+        cls = load_class(flow_class_path)
+        return self.smm.start_flow(cls(*args))
+
+    def run_flow(self, flow, timeout: float = 60):
+        return self.smm.start_flow(flow).result.result(timeout=timeout)
+
+    def stop(self) -> None:
+        self.scheduler.stop()
+        self.rpc_server.stop()
+        self.smm.stop()
+        self.services.shutdown()
+        self._started = False
+
+    def __repr__(self):
+        return f"Node({self.party.name}, started={self._started})"

@@ -1,0 +1,114 @@
+"""Node process entry point.
+
+Capability parity with the reference's boot path (node/.../Corda.kt:7 main
+→ NodeStartup.kt:30: banner, config load, node assembly, run-until-exit).
+
+Standalone processes on one host share a sqlite-file DurableQueueBroker as
+the message fabric (the role the reference's Artemis broker + localhost
+bridges play in driver deployments); one node additionally runs the
+network-map service, and every node registers with it on boot
+(reference: registerWithNetworkMapIfConfigured, AbstractNode.kt:245).
+
+    python -m corda_tpu.node.startup --config node.conf --broker shared.db
+
+Config is the HOCON subset of node/.../reference.conf (see config.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+BANNER = r"""
+   ______                __        ______  __  __
+  / ____/___  _________/ /___ _  /_  __/ / / / / /
+ / /   / __ \/ ___/ __  / __ `/   / /   / /_/ / / /
+/ /___/ /_/ / /  / /_/ / /_/ /   / /   / ____/ /_/
+\____/\____/_/   \__,_/\__,_/   /_/   /_/    (_)
+        distributed ledger, TPU-native
+"""
+
+
+def build_node(config, broker_path: str, is_network_map: bool = False):
+    """Assemble a node over the shared-broker fabric."""
+    from corda_tpu.messaging import BrokerMessagingClient, DurableQueueBroker
+    from corda_tpu.node.network_map import (
+        NetworkMapCache,
+        NetworkMapClient,
+        NetworkMapServer,
+    )
+    from corda_tpu.node.node import Node
+
+    from corda_tpu.ledger import CordaX500Name
+
+    import dataclasses as _dc
+    import re as _re
+
+    canonical = str(CordaX500Name.parse(config.my_legal_name))
+    if config.base_directory == ".":
+        # multiple nodes on one host must not share vault/checkpoint
+        # files — default the base dir to a per-identity subdirectory
+        safe = _re.sub(r"[^A-Za-z0-9._-]+", "_", canonical)
+        config = _dc.replace(config, base_directory=f"./{safe}")
+    broker = DurableQueueBroker(broker_path)
+    messaging = BrokerMessagingClient(broker, canonical)
+    cache = NetworkMapCache()
+    node = Node(
+        config, messaging, network_map=cache,
+        persistent=broker_path != ":memory:",
+    )
+    if is_network_map:
+        node.network_map_server = NetworkMapServer(messaging, cache)
+    node.network_map_client = NetworkMapClient(messaging, cache)
+    node.start()
+    if config.network_map_address:
+        node.network_map_client.register(config.network_map_address, node.info)
+    return node
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="corda-tpu-node",
+        description="Run a corda_tpu node (reference: NodeStartup)",
+    )
+    parser.add_argument("--config", required=True, help="HOCON node config")
+    parser.add_argument(
+        "--broker", default="broker.db",
+        help="shared durable-broker sqlite file (the host message fabric)",
+    )
+    parser.add_argument(
+        "--network-map", action="store_true",
+        help="also run the network-map service on this node",
+    )
+    parser.add_argument("--no-banner", action="store_true")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)-7s [%(name)s] %(message)s",
+    )
+    if not args.no_banner:
+        print(BANNER)
+
+    from corda_tpu.node.config import load_config
+
+    config = load_config(args.config)
+    node = build_node(config, args.broker, is_network_map=args.network_map)
+    print(f"Node {node.party.name} started. RPC users: "
+          f"{[u.username for u in config.rpc_users]}")
+    sys.stdout.flush()
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    print("Shutting down…")
+    node.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

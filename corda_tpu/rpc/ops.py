@@ -16,7 +16,7 @@ import time as _time
 
 from corda_tpu.flows import FlowLogic
 from corda_tpu.flows.api import load_class
-from corda_tpu.node import PageSpecification, QueryCriteria, Sort
+from corda_tpu.node.vault import PageSpecification, QueryCriteria, Sort
 
 
 class PermissionException(Exception):

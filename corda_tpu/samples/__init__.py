@@ -1,0 +1,16 @@
+"""Sample CorDapps (SURVEY.md §2.6, reference: samples/):
+
+- ``trader_demo`` — two-party DvP of commercial paper against cash
+  (samples/trader-demo — baseline config #1 shape).
+- ``notary_demo`` — drives single / Raft / BFT notary clusters
+  (samples/notary-demo — baseline config #5 shape).
+- ``oracle_demo`` — interest-rate-style oracle signing over
+  FilteredTransaction tear-offs (samples/irs-demo NodeInterestRates.kt:79).
+- ``attachment_demo`` — attachment upload + propagation through the
+  back-chain protocol (samples/attachment-demo).
+- ``bank_demo`` — issuer node serving cash issuance over RPC
+  (samples/bank-of-corda-demo).
+
+Each module exposes its flows plus a ``run_demo()`` entry returning a
+result summary (and is runnable via ``python -m corda_tpu.samples.<name>``).
+"""

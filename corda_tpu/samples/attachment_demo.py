@@ -1,0 +1,121 @@
+"""Attachment demo: attach a document to a transaction and have the
+recipient pull it through the back-chain protocol.
+
+Capability parity with the reference's attachment demo
+(samples/attachment-demo/.../AttachmentDemo.kt): the sender imports a zip
+into attachment storage, references its hash from a transaction, and sends
+the transaction; the recipient's ResolveTransactionsFlow detects the
+unknown attachment hash, fetches the blob over the same session, verifies
+the hash, and stores it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from corda_tpu.flows import FinalityFlow, FlowLogic
+from corda_tpu.ledger import Party, TransactionBuilder
+from corda_tpu.node.storage import make_test_attachment
+from corda_tpu.serialization import register_custom
+
+
+@dataclasses.dataclass(frozen=True)
+class DocumentState:
+    """A state pointing at an attached document."""
+
+    description: str
+    owner: Party
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class DocumentCommand:
+    op: str = "publish"
+
+
+register_custom(
+    DocumentState, "samples.DocumentState",
+    to_fields=lambda s: {"description": s.description, "owner": s.owner},
+    from_fields=lambda d: DocumentState(d["description"], d["owner"]),
+)
+register_custom(
+    DocumentCommand, "samples.DocumentCommand",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: DocumentCommand(d["op"]),
+)
+
+from corda_tpu.ledger import register_contract  # noqa: E402
+
+DOC_CONTRACT_ID = "samples.DocumentContract"
+
+
+@register_contract(DOC_CONTRACT_ID)
+class DocumentContract:
+    def verify(self, tx):
+        if not tx.commands_of_type(DocumentCommand):
+            raise ValueError("no DocumentCommand")
+
+
+@dataclasses.dataclass
+class PublishDocumentFlow(FlowLogic):
+    """Attach a blob, reference it from a state owned by the recipient,
+    finalise (broadcast pulls the attachment to the recipient)."""
+
+    recipient: Party
+    notary: Party
+    document: bytes
+    description: str = "agreement"
+
+    def call(self):
+        att_id = self.record(
+            lambda: self.services.attachments.import_or_get(self.document)
+        )
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(
+            DocumentState(self.description, self.recipient), DOC_CONTRACT_ID
+        )
+        b.add_command(DocumentCommand(), self.our_identity.owning_key)
+        b.add_attachment(att_id)
+        stx = self.services.sign_initial_transaction(b)
+        self.sub_flow(FinalityFlow(stx))
+        return att_id
+
+
+def run_demo(verbose: bool = True) -> dict:
+    from corda_tpu.testing import MockNetworkNodes
+
+    t0 = time.time()
+    with MockNetworkNodes() as net:
+        alice = net.create_node("Alice")
+        bob = net.create_node("Bob")
+        notary = net.create_notary_node("Notary")
+        blob = make_test_attachment({
+            "agreement.txt": b"the parties agree to disagree\n" * 100,
+        })
+        att_id = alice.run_flow(PublishDocumentFlow(
+            bob.party, notary.party, blob
+        ))
+        # bob received the attachment via the back-chain fetch
+        att = bob.services.attachments.open_attachment(att_id)
+        fetched = att is not None
+        content_ok = (
+            fetched
+            and att.extract_file("agreement.txt").startswith(b"the parties")
+        )
+        summary = {
+            "attachment_id": str(att_id)[:16],
+            "recipient_fetched": fetched,
+            "content_verified": bool(content_ok),
+            "elapsed_s": round(time.time() - t0, 3),
+        }
+    if verbose:
+        print(f"attachment-demo: {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    run_demo()

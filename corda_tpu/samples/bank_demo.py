@@ -1,0 +1,84 @@
+"""Bank-of-corda demo: an issuer node serving cash issuance requests over
+RPC (reference: samples/bank-of-corda-demo — the BankOfCorda node issues
+cash to requesting parties via IssuerFlow, driven by RPC clients)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
+from corda_tpu.flows import FlowLogic
+from corda_tpu.flows.api import class_path
+from corda_tpu.ledger import Party
+
+
+@dataclasses.dataclass
+class IssueAndPayFlow(FlowLogic):
+    """The bank issues to itself, then pays the requester (reference:
+    IssuerFlow — issue + transfer in one logical operation)."""
+
+    quantity: int
+    currency: str
+    issuer_ref: bytes
+    requester: Party
+    notary: Party
+
+    def call(self):
+        self.sub_flow(CashIssueFlow(
+            self.quantity, self.currency, self.issuer_ref, self.notary
+        ))
+        return self.sub_flow(CashPaymentFlow(
+            self.quantity, self.currency, self.requester
+        ))
+
+
+def run_demo(n_requests: int = 3, verbose: bool = True) -> dict:
+    from corda_tpu.node.config import RpcUser
+    from corda_tpu.rpc import CordaRPCClient, CordaRPCOps, RPCServer
+    from corda_tpu.rpc.ops import start_flow_permission
+    from corda_tpu.testing import MockNetworkNodes
+
+    t0 = time.time()
+    with MockNetworkNodes() as net:
+        bank = net.create_node("Bank of Corda")
+        customer = net.create_node("Big Corporation")
+        notary = net.create_notary_node("Notary")
+        users = (RpcUser("bankUser", "test", (
+            start_flow_permission(IssueAndPayFlow),
+            "InvokeRpc.flow_result",
+        )),)
+        server = RPCServer(
+            CordaRPCOps(bank.services, bank.smm),
+            bank.smm.messaging, rpc_users=users,
+        )
+        conn = CordaRPCClient(
+            net.net.create_node("bank-rpc-client"), str(bank.party.name)
+        ).start("bankUser", "test")
+        for i in range(n_requests):
+            fid = conn.proxy.start_flow_dynamic(
+                class_path(IssueAndPayFlow),
+                1000 * (i + 1), "USD", bytes([i + 1]),
+                customer.party, notary.party,
+            )
+            conn.proxy.flow_result(fid, 60)
+        total = sum(
+            sr.state.data.amount.quantity
+            for sr in customer.services.vault_service.unconsumed_states(
+                CashState
+            )
+        )
+        conn.close()
+        server.stop()
+        summary = {
+            "requests": n_requests,
+            "customer_balance": total,
+            "elapsed_s": round(time.time() - t0, 3),
+        }
+    if verbose:
+        print(f"bank-demo: {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    run_demo()

@@ -1,0 +1,258 @@
+"""Oracle demo: a rates oracle signing transaction tear-offs.
+
+Capability parity with the reference's IRS-demo oracle
+(samples/irs-demo/.../api/NodeInterestRates.kt:79 — ``Oracle`` with
+``query(fixes)`` answering rate requests and ``sign(ftx)`` :126 signing a
+FilteredTransaction iff every visible command is a Fix the oracle agrees
+with). The privacy property: the oracle sees ONLY the fix commands —
+inputs, outputs and every other component stay hidden behind the Merkle
+tear-off, yet its signature covers the whole transaction id.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from corda_tpu.crypto import KeyPair, TransactionSignature, sign_tx_id
+from corda_tpu.flows import FlowException, FlowLogic, FlowSession, InitiatedBy
+from corda_tpu.ledger import (
+    Command,
+    ComponentGroupType,
+    FilteredTransaction,
+    Party,
+)
+from corda_tpu.serialization import cbe_serializable
+
+
+@cbe_serializable(name="samples.FixOf")
+@dataclasses.dataclass(frozen=True)
+class FixOf:
+    """What rate is wanted: e.g. ('LIBOR', '2026-07-30', '3M')."""
+
+    name: str
+    for_day: str
+    tenor: str
+
+
+@cbe_serializable(name="samples.Fix")
+@dataclasses.dataclass(frozen=True)
+class Fix:
+    """An answered rate — used as a transaction command whose integrity the
+    oracle attests (reference: Fix in FinanceTypes)."""
+
+    of: FixOf
+    value_bp: int  # basis points (integer — device-friendly fixed point)
+
+
+class RatesOracle:
+    """The oracle service held by the oracle node (reference:
+    NodeInterestRates.Oracle)."""
+
+    def __init__(self, identity: Party, keypair: KeyPair,
+                 rates: dict | None = None):
+        if keypair.public != identity.owning_key:
+            raise ValueError("oracle keypair must match identity")
+        self.identity = identity
+        self._keypair = keypair
+        self._rates: dict[FixOf, int] = dict(rates or {})
+
+    def add_rate(self, of: FixOf, value_bp: int) -> None:
+        self._rates[of] = value_bp
+
+    def query(self, queries: list[FixOf]) -> list[Fix]:
+        out = []
+        for q in queries:
+            if q not in self._rates:
+                raise KeyError(f"unknown fix {q}")
+            out.append(Fix(q, self._rates[q]))
+        return out
+
+    def sign(self, ftx: FilteredTransaction) -> TransactionSignature:
+        """Sign iff the tear-off is sound and EVERY visible component is a
+        Fix command naming us that matches our rates (reference:
+        Oracle.sign, NodeInterestRates.kt:126)."""
+        ftx.verify()  # adversarial input: proofs must chain to the id
+        commands = ftx.components_of(ComponentGroupType.COMMANDS)
+        if not commands:
+            raise ValueError("no commands visible to the oracle")
+        for group in ftx.filtered_groups:
+            if group.group != int(ComponentGroupType.COMMANDS):
+                raise ValueError(
+                    "tear-off reveals more than commands to the oracle"
+                )
+        for cmd in commands:
+            if not isinstance(cmd, Command) or not isinstance(cmd.value, Fix):
+                raise ValueError("visible command is not a Fix")
+            if self.identity.owning_key not in cmd.signers:
+                raise ValueError("fix command does not name the oracle")
+            known = self._rates.get(cmd.value.of)
+            if known != cmd.value.value_bp:
+                raise ValueError(
+                    f"incorrect fix {cmd.value.of}: {cmd.value.value_bp}"
+                )
+        return sign_tx_id(self._keypair.private, self._keypair.public, ftx.id)
+
+
+# ------------------------------------------------------------------ flows
+
+@cbe_serializable(name="samples.OracleRequest")
+@dataclasses.dataclass(frozen=True)
+class OracleRequest:
+    kind: str                   # "query" | "sign"
+    queries: tuple = ()         # FixOf for query
+    ftx: object = 0             # FilteredTransaction for sign
+
+
+@dataclasses.dataclass
+class FixQueryFlow(FlowLogic):
+    """Ask the oracle for rates (reference: RatesFixFlow.FixQueryFlow)."""
+
+    oracle: Party
+    queries: tuple
+
+    def call(self) -> list:
+        session = self.initiate_flow(self.oracle)
+        return session.send_and_receive(
+            list, OracleRequest("query", tuple(self.queries))
+        ).unwrap(lambda fixes: fixes)
+
+
+@dataclasses.dataclass
+class FixSignFlow(FlowLogic):
+    """Send the oracle a tear-off for signature (reference:
+    RatesFixFlow.FixSignFlow). The caller builds the FilteredTransaction
+    revealing only the Fix commands."""
+
+    oracle: Party
+    ftx: FilteredTransaction
+
+    def call(self) -> TransactionSignature:
+        session = self.initiate_flow(self.oracle)
+        sig = session.send_and_receive(
+            TransactionSignature, OracleRequest("sign", ftx=self.ftx)
+        ).unwrap(lambda s: s)
+        sig.verify(self.ftx.id)
+        if sig.by != self.oracle.owning_key:
+            raise FlowException("signature is not from the oracle")
+        return sig
+
+
+@InitiatedBy(FixQueryFlow)
+class OracleQueryResponder(FlowLogic):
+    def __init__(self, session: FlowSession):
+        self.session = session
+
+    def call(self):
+        oracle = self.services.oracle
+        req = self.session.receive(OracleRequest).unwrap(lambda r: r)
+        if req.kind != "query":
+            raise FlowException("expected a query")
+        try:
+            fixes = oracle.query(list(req.queries))
+        except KeyError as e:
+            raise FlowException(f"unknown fix: {e}") from e
+        self.session.send(fixes)
+
+
+@InitiatedBy(FixSignFlow)
+class OracleSignResponder(FlowLogic):
+    def __init__(self, session: FlowSession):
+        self.session = session
+
+    def call(self):
+        oracle = self.services.oracle
+        req = self.session.receive(OracleRequest).unwrap(lambda r: r)
+        if req.kind != "sign" or not isinstance(
+            req.ftx, FilteredTransaction
+        ):
+            raise FlowException("expected a tear-off to sign")
+        try:
+            sig = self.record(lambda: oracle.sign(req.ftx))
+        except ValueError as e:
+            raise FlowException(f"oracle refused to sign: {e}") from e
+        self.session.send(sig)
+
+
+# ------------------------------------------------------------------ demo
+
+def run_demo(verbose: bool = True) -> dict:
+    """A rate-dependent trade: the deal value comes from the oracle's fix,
+    and the oracle signs a tear-off that shows it nothing but the fix."""
+    import time as _time
+
+    from corda_tpu.crypto import generate_keypair
+    from corda_tpu.finance import CashIssueFlow
+    from corda_tpu.ledger import TransactionBuilder
+    from corda_tpu.serialization import register_custom
+    from corda_tpu.testing import MockNetworkNodes
+
+    t0 = _time.time()
+    with MockNetworkNodes() as net:
+        alice = net.create_node("Alice")
+        oracle_node = net.create_node("Rates Oracle")
+        notary = net.create_notary_node("Notary")
+        oracle = RatesOracle(oracle_node.party, oracle_node.keypair)
+        oracle_node.services.oracle = oracle
+        fix_of = FixOf("LIBOR", "2026-07-30", "3M")
+        oracle.add_rate(fix_of, 525)
+
+        # 1. query
+        fixes = alice.run_flow(FixQueryFlow(oracle_node.party, (fix_of,)))
+        assert fixes[0].value_bp == 525
+
+        # 2. build a deal embedding the fix; oracle must co-sign
+        alice.run_flow(CashIssueFlow(1000, "GBP", b"\x01", notary.party))
+        from corda_tpu.finance import CASH_PROGRAM_ID, CashState, Move
+        from corda_tpu.ledger import Amount
+
+        sar = alice.services.vault_service.unconsumed_states(CashState)[0]
+        b = TransactionBuilder(notary=notary.party)
+        b.add_input_state(sar)
+        b.add_output_state(sar.state.data, CASH_PROGRAM_ID)
+        b.add_command(Move(), alice.party.owning_key)
+        b.add_command(fixes[0], oracle_node.party.owning_key)
+        stx = alice.services.sign_initial_transaction(b)
+
+        # 3. tear-off revealing ONLY Fix commands; oracle signs
+        ftx = FilteredTransaction.build(
+            stx.tx,
+            lambda comp, group: group is ComponentGroupType.COMMANDS
+            and isinstance(getattr(comp, "value", None), Fix),
+        )
+        visible = sum(len(g.components) for g in ftx.filtered_groups)
+        sig = alice.run_flow(FixSignFlow(oracle_node.party, ftx))
+        stx = stx.with_additional_signature(sig)
+        stx.verify_signatures_except({notary.party.owning_key})
+
+        # 4. a tear-off with a WRONG rate is refused
+        b2 = TransactionBuilder(notary=notary.party)
+        b2.add_input_state(sar)
+        b2.add_output_state(sar.state.data, CASH_PROGRAM_ID)
+        b2.add_command(Move(), alice.party.owning_key)
+        b2.add_command(Fix(fix_of, 999), oracle_node.party.owning_key)
+        stx2 = alice.services.sign_initial_transaction(b2)
+        ftx2 = FilteredTransaction.build(
+            stx2.tx,
+            lambda comp, group: group is ComponentGroupType.COMMANDS
+            and isinstance(getattr(comp, "value", None), Fix),
+        )
+        refused = False
+        try:
+            alice.run_flow(FixSignFlow(oracle_node.party, ftx2))
+        except Exception:
+            refused = True
+
+        summary = {
+            "fix_bp": fixes[0].value_bp,
+            "oracle_saw_components": visible,
+            "oracle_signed": True,
+            "wrong_rate_refused": refused,
+            "elapsed_s": round(_time.time() - t0, 3),
+        }
+    if verbose:
+        print(f"oracle-demo: {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    run_demo()

@@ -1,0 +1,120 @@
+"""Sample CorDapp + node-container tests — the reference's samples/ test
+coverage (TraderDemoTest, attachment-demo tests, NodeInterestRatesTest
+oracle tear-off tests, notary-demo) plus AbstractNode assembly."""
+
+import pytest
+
+from corda_tpu.samples import (
+    attachment_demo,
+    bank_demo,
+    notary_demo,
+    oracle_demo,
+    trader_demo,
+)
+
+
+class TestDemos:
+    def test_trader_demo(self):
+        r = trader_demo.run_demo(verbose=False)
+        assert r["buyer_papers"] == 1
+        assert r["seller_cash"] == 900
+
+    def test_attachment_demo(self):
+        r = attachment_demo.run_demo(verbose=False)
+        assert r["recipient_fetched"] and r["content_verified"]
+
+    def test_bank_demo(self):
+        r = bank_demo.run_demo(n_requests=2, verbose=False)
+        assert r["customer_balance"] == 3000
+
+    def test_oracle_demo(self):
+        r = oracle_demo.run_demo(verbose=False)
+        assert r["oracle_signed"]
+        assert r["wrong_rate_refused"]
+        # the privacy property: the oracle saw exactly one component
+        assert r["oracle_saw_components"] == 1
+
+    def test_notary_demo_all_tiers(self):
+        r = notary_demo.run_demo(n_txs=10, verbose=False)
+        for mode in ("single", "raft", "bft"):
+            assert r[mode]["double_spend_rejected"], r
+            assert r[mode]["notarised"] > 0
+
+
+class TestNodeContainer:
+    def test_assembly_and_flow(self):
+        """Node built from NodeConfiguration runs the full cash path
+        (reference: AbstractNode.start + NodePerformanceTests shape)."""
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
+        from corda_tpu.ledger import CordaX500Name
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node import NetworkMapCache, Node, NodeConfiguration
+        from corda_tpu.node.config import NotaryConfig, VerifierType
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        nmap = NetworkMapCache()
+
+        def mk(name, notary=None):
+            legal = f"O={name},L=City,C=GB"
+            cfg = NodeConfiguration(
+                my_legal_name=legal, notary=notary,
+                verifier_type=VerifierType.InMemory,
+            )
+            endpoint = net.create_node(str(CordaX500Name.parse(legal)))
+            return Node(cfg, endpoint, network_map=nmap).start()
+
+        alice = mk("Alice")
+        bob = mk("Bob")
+        notary = mk("Notary", NotaryConfig(validating=True))
+        try:
+            # notary advertised through the map with its mode
+            assert nmap.is_validating_notary(notary.party)
+            alice.run_flow(CashIssueFlow(500, "GBP", b"\x01", notary.party))
+            alice.run_flow(CashPaymentFlow(200, "GBP", bob.party))
+            got = sum(
+                sr.state.data.amount.quantity
+                for sr in bob.services.vault_service.unconsumed_states(
+                    CashState
+                )
+            )
+            assert got == 200
+        finally:
+            for n in (alice, bob, notary):
+                n.stop()
+            net.stop_pumping()
+
+    def test_wrong_transport_name_rejected(self):
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node import Node, NodeConfiguration
+
+        net = InMemoryMessagingNetwork()
+        cfg = NodeConfiguration(my_legal_name="O=Alice,L=City,C=GB")
+        with pytest.raises(ValueError, match="address"):
+            Node(cfg, net.create_node("wrong-name"))
+
+    def test_config_file_to_node(self, tmp_path):
+        """HOCON config file → assembled node (reference: NodeStartup
+        loadConfigFile path)."""
+        from corda_tpu.ledger import CordaX500Name
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node import Node
+        from corda_tpu.node.config import load_config
+
+        conf = tmp_path / "node.conf"
+        conf.write_text("""
+            myLegalName = "O=Config Node,L=Paris,C=FR"
+            notary { validating = true }
+            rpcUsers = [{ username = "u", password = "p", permissions = ["ALL"] }]
+        """)
+        cfg = load_config(conf)
+        net = InMemoryMessagingNetwork()
+        endpoint = net.create_node(
+            str(CordaX500Name.parse(cfg.my_legal_name))
+        )
+        node = Node(cfg, endpoint).start()
+        try:
+            assert node.services.notary_service is not None
+            assert node.config.rpc_users[0].username == "u"
+        finally:
+            node.stop()
