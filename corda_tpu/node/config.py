@@ -87,6 +87,10 @@ class NodeConfiguration:
     verification_batch_max: int = 1024
     verification_window_ms: float = 5.0
     database_path: str | None = None  # None → <base_directory>/node.db
+    # python packages imported at boot so their contracts/flows/serializers
+    # register — the reference's CordappLoader plugins-directory scan
+    # (node/.../internal/cordapp/CordappLoader.kt:41) as explicit config
+    cordapp_packages: tuple[str, ...] = ()
 
     @property
     def db_path(self) -> str:
@@ -235,6 +239,7 @@ def config_from_dict(d: dict) -> NodeConfiguration:
         ),
         flow_timeout_seconds=float(d.get("flowTimeoutSeconds", 120.0)),
         verification_batch_max=int(d.get("verificationBatchMax", 1024)),
+        cordapp_packages=tuple(d.get("cordappPackages", [])),
         verification_window_ms=float(d.get("verificationWindowMs", 5.0)),
         database_path=d.get("databasePath"),
     )

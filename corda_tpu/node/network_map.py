@@ -70,16 +70,17 @@ class NetworkMapCache:
             if existing is not None and existing.serial > info.serial:
                 return  # stale update (last-write-wins by serial)
             self._nodes[name] = info
+            # the notary side effect stays under the lock so the serial
+            # last-write-wins check above also orders notary updates — a
+            # stale registration must not re-promote a decommissioned notary
+            if info.notary_mode:
+                self.add_notary(
+                    info.legal_identity,
+                    validating=(info.notary_mode == "validating"),
+                )
+            else:
+                self._remove_notary(info.legal_identity)
             subs = list(self._subscribers)
-        if info.notary_mode:
-            self.add_notary(
-                info.legal_identity,
-                validating=(info.notary_mode == "validating"),
-            )
-        else:
-            # a re-registration without a notary service decommissions any
-            # previous notary entry for this identity
-            self._remove_notary(info.legal_identity)
         for cb in subs:
             cb("ADD", info)
 

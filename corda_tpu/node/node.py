@@ -51,6 +51,12 @@ class Node:
     ):
         self.config = config
         self.messaging = messaging
+        # CorDapp loading (reference: CordappLoader.kt:41) — importing the
+        # package registers its contracts, responder flows and wire types
+        import importlib
+
+        for pkg in config.cordapp_packages:
+            importlib.import_module(pkg)
         name = CordaX500Name.parse(config.my_legal_name) if isinstance(
             config.my_legal_name, str
         ) else config.my_legal_name
@@ -154,9 +160,14 @@ class Node:
 
     def set_notary_uniqueness_provider(self, provider) -> None:
         """Swap in a replicated (Raft/BFT) uniqueness provider built by the
-        cluster driver before ``start()``."""
+        cluster driver before ``start()``. The container-built local
+        provider is closed and fully replaced."""
         if self.services.notary_service is None:
             raise ValueError("node has no notary service")
+        old = self._notary_uniqueness
+        if old is not None and hasattr(old, "close"):
+            old.close()
+        self._notary_uniqueness = provider
         self.services.notary_service.uniqueness = provider
 
     # ------------------------------------------------------------ lifecycle
@@ -188,6 +199,10 @@ class Node:
         self.rpc_server.stop()
         self.smm.stop()
         self.services.shutdown()
+        if self._notary_uniqueness is not None and hasattr(
+            self._notary_uniqueness, "close"
+        ):
+            self._notary_uniqueness.close()
         self._started = False
 
     def __repr__(self):

@@ -103,6 +103,11 @@ class InMemoryUniquenessProvider(UniquenessProvider):
                     _ref_key(ref), ConsumedStateDetails(tx_id, i, caller_name)
                 )
 
+    def committed_txs(self) -> int:
+        """Distinct transactions committed (ops/loadtest observability)."""
+        with self._lock:
+            return len({d.consuming_tx for d in self._map.values()})
+
 
 class PersistentUniquenessProvider(UniquenessProvider):
     """SQLite append-only committed-states map (reference:
@@ -184,6 +189,13 @@ class PersistentUniquenessProvider(UniquenessProvider):
         with self._lock:
             return self._db.execute(
                 "SELECT COUNT(*) FROM notary_commits"
+            ).fetchone()[0]
+
+    def committed_txs(self) -> int:
+        """Distinct transactions committed (ops/loadtest observability)."""
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(DISTINCT consuming_tx) FROM notary_commits"
             ).fetchone()[0]
 
     def close(self) -> None:

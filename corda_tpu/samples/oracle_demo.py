@@ -180,10 +180,8 @@ def run_demo(verbose: bool = True) -> dict:
     and the oracle signs a tear-off that shows it nothing but the fix."""
     import time as _time
 
-    from corda_tpu.crypto import generate_keypair
     from corda_tpu.finance import CashIssueFlow
     from corda_tpu.ledger import TransactionBuilder
-    from corda_tpu.serialization import register_custom
     from corda_tpu.testing import MockNetworkNodes
 
     t0 = _time.time()

@@ -7,9 +7,11 @@ from .mocknet import MockNetworkNodes, MockNode, make_test_party
 from .constants import ALICE_NAME, BOB_NAME, CHARLIE_NAME, DUMMY_NOTARY_NAME
 from .dsl import LedgerDSL, ledger
 from .generated_ledger import GeneratedLedger
+from .driver import DriverDSL, NodeHandle, driver
 
 __all__ = [
     "MockNetworkNodes", "MockNode", "make_test_party",
     "ALICE_NAME", "BOB_NAME", "CHARLIE_NAME", "DUMMY_NOTARY_NAME",
     "LedgerDSL", "ledger", "GeneratedLedger",
+    "DriverDSL", "NodeHandle", "driver",
 ]

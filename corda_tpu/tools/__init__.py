@@ -1,0 +1,10 @@
+"""Tooling & ops tier (SURVEY.md §1 layer 12, §2.7, reference: tools/ +
+webserver/ + node/.../shell/):
+
+- ``loadtest`` — generate/interpret/execute/gather load harness with
+  disruption injection (tools/loadtest/.../LoadTest.kt:37-69,
+  Disruption.kt).
+- ``shell`` — interactive node shell over RPC (node/.../shell/
+  InteractiveShell.kt).
+- ``webserver`` — REST gateway per node (webserver/.../NodeWebServer.kt).
+"""

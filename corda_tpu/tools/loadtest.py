@@ -1,0 +1,227 @@
+"""Load-test harness.
+
+Capability parity with the reference's loadtest tool
+(tools/loadtest/.../LoadTest.kt:37-69): a load test is four functions —
+
+- ``generate(state, parallelism)`` → list of commands to inject,
+- ``interpret(state, command)`` → the expected next state,
+- ``execute(command)`` → perform it against the cluster,
+- ``gather()`` → observed state, checked against the interpreted one —
+
+run for N generations with a bounded injector pool, optionally under
+*disruptions* (kill/restart a node mid-run — Disruption.kt's
+kill/restart/strain model) to prove the invariants hold through failures.
+
+The reference drives a deployed cluster over SSH; here the cluster handle
+is any object exposing the same operations (an in-process
+``MockNetworkNodes`` ensemble or RPC connections to real node processes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LoadTest:
+    """One test definition (reference: LoadTest<T, S>)."""
+
+    name: str
+    generate: Callable[[Any, int], list]       # (state, parallelism) -> cmds
+    interpret: Callable[[Any, Any], Any]       # (state, cmd) -> state'
+    execute: Callable[[Any], None]             # cmd -> effect on cluster
+    gather: Callable[[], Any]                  # () -> observed state
+    initial_state: Any = None
+
+
+@dataclasses.dataclass
+class RunParameters:
+    """(reference: LoadTest.RunParameters :61)."""
+
+    parallelism: int = 4
+    generate_count: int = 10
+    execution_frequency_hz: float | None = 20.0   # None = as fast as possible
+    gather_frequency: int = 5                     # check every N generations
+
+
+@dataclasses.dataclass
+class Disruption:
+    """A failure injected while load runs (reference: Disruption.kt)."""
+
+    name: str
+    apply: Callable[[], Callable[[], None] | None]  # returns undo (or None)
+    at_generation: int = 1
+
+
+class LoadTestError(AssertionError):
+    pass
+
+
+class LoadTestRunner:
+    def __init__(self, test: LoadTest, params: RunParameters | None = None,
+                 disruptions: list[Disruption] | None = None,
+                 rng: random.Random | None = None):
+        self.test = test
+        self.params = params or RunParameters()
+        self.disruptions = list(disruptions or [])
+        self.rng = rng or random.Random(0)
+        self.metrics = {"executed": 0, "failed": 0, "gathers": 0,
+                        "disruptions": 0}
+
+    def run(self) -> dict:
+        state = self.test.initial_state
+        undos: list = []
+        pool = ThreadPoolExecutor(max_workers=self.params.parallelism)
+        interval = (
+            1.0 / self.params.execution_frequency_hz
+            if self.params.execution_frequency_hz else 0.0
+        )
+        try:
+            for generation in range(self.params.generate_count):
+                for d in self.disruptions:
+                    if d.at_generation == generation:
+                        logger.info("injecting disruption %r", d.name)
+                        undo = d.apply()
+                        if undo:
+                            undos.append(undo)
+                        self.metrics["disruptions"] += 1
+                commands = self.test.generate(state, self.params.parallelism)
+                # interpret first: expected state is defined by the model,
+                # not by what happened to succeed
+                for cmd in commands:
+                    state = self.test.interpret(state, cmd)
+                futures = []
+                for cmd in commands:
+                    futures.append(pool.submit(self._execute_one, cmd))
+                    if interval:
+                        time.sleep(interval)
+                for f in futures:
+                    f.result()
+                if (generation + 1) % self.params.gather_frequency == 0:
+                    self._gather_and_check(state)
+            self._gather_and_check(state)
+        finally:
+            for undo in undos:
+                try:
+                    undo()
+                except Exception:
+                    logger.exception("disruption undo failed")
+            pool.shutdown(wait=True)
+        return dict(self.metrics, final_state=state)
+
+    def _execute_one(self, cmd) -> None:
+        try:
+            self.test.execute(cmd)
+            self.metrics["executed"] += 1
+        except Exception:
+            logger.exception("command execution failed")
+            self.metrics["failed"] += 1
+
+    def _gather_and_check(self, expected) -> None:
+        observed = self.test.gather()
+        self.metrics["gathers"] += 1
+        if observed != expected:
+            raise LoadTestError(
+                f"{self.test.name}: observed state diverged.\n"
+                f"  expected: {expected}\n  observed: {observed}"
+            )
+
+
+# ------------------------------------------------- built-in test shapes
+
+def self_issue_test(nodes: dict, notary, amounts=(100, 1000)) -> LoadTest:
+    """Every command issues cash on a random node; the model tracks each
+    node's expected balance (reference: SelfIssueTest.kt)."""
+    from corda_tpu.finance import CashIssueFlow, CashState
+
+    rng = random.Random(7)
+    names = sorted(nodes)
+
+    def generate(state, parallelism):
+        return [
+            (rng.choice(names), rng.randrange(*amounts))
+            for _ in range(parallelism)
+        ]
+
+    def interpret(state, cmd):
+        name, qty = cmd
+        state = dict(state)
+        state[name] = state.get(name, 0) + qty
+        return state
+
+    def execute(cmd):
+        name, qty = cmd
+        nodes[name].run_flow(CashIssueFlow(qty, "GBP", b"\x11", notary))
+
+    def gather():
+        return {
+            name: sum(
+                sr.state.data.amount.quantity
+                for sr in node.services.vault_service.unconsumed_states(
+                    CashState
+                )
+            )
+            for name, node in nodes.items()
+            if name in gathered_names(nodes)
+        }
+
+    def gathered_names(nodes):
+        return set(nodes)
+
+    return LoadTest(
+        name="SelfIssue",
+        generate=generate, interpret=interpret, execute=execute,
+        gather=gather, initial_state={},
+    )
+
+
+def notarisation_storm_test(nodes: dict, notary_party) -> LoadTest:
+    """Issue+move pairs through FinalityFlow — the notary-storm shape
+    (reference: NotaryTest.kt:22-50). The model counts notarised moves;
+    gather reads the notary's committed-state count."""
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+
+    rng = random.Random(13)
+    names = sorted(nodes)
+
+    def generate(state, parallelism):
+        out = []
+        for _ in range(parallelism):
+            a, b = rng.sample(names, 2)
+            out.append((a, b, rng.randrange(10, 100)))
+        return out
+
+    def interpret(state, cmd):
+        return state + 1
+
+    def execute(cmd):
+        src, dst, qty = cmd
+        nodes[src].run_flow(
+            CashIssueFlow(qty, "GBP", b"\x12", notary_party)
+        )
+        nodes[src].run_flow(
+            CashPaymentFlow(qty, "GBP", nodes[dst].party)
+        )
+
+    def gather():
+        # moves notarised so far == model count (issues skip the notary)
+        notary_node = next(
+            n for n in nodes.values()
+            if n.services.notary_service is not None
+        )
+        return notary_node.services.notary_service.uniqueness.committed_txs()
+
+    return LoadTest(
+        name="NotarisationStorm",
+        generate=generate, interpret=interpret, execute=execute,
+        gather=gather, initial_state=0,
+    )

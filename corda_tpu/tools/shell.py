@@ -1,0 +1,131 @@
+"""Interactive node shell.
+
+Capability parity with the reference's CRaSH-based shell
+(node/.../shell/InteractiveShell.kt:36-40): operators start flows, inspect
+the vault and state machines, and run RPC ops from a console attached to
+the node. Commands:
+
+    flow start <ClassPath> [args…]   start a flow and wait for its result
+    flow list                        registered flow class paths
+    flow watch                       in-progress state machines
+    run <op> [args…]                 invoke any RPC operation
+    vault query [StateClass]         unconsumed states
+    peers                            network map snapshot
+    notaries                         notary identities
+    time / help / quit
+
+Arguments parse as Python literals when possible (ints, byte strings,
+quoted strings), else stay strings.
+"""
+
+from __future__ import annotations
+
+import ast
+import shlex
+import sys
+
+
+def _parse_arg(token: str):
+    try:
+        return ast.literal_eval(token)
+    except (ValueError, SyntaxError):
+        return token
+
+
+class InteractiveShell:
+    """Drives a CordaRPCOps-shaped object (local or an RPC connection
+    proxy)."""
+
+    def __init__(self, ops, out=sys.stdout):
+        self._ops = ops
+        self._out = out
+
+    # ------------------------------------------------------------- output
+    def _p(self, *lines) -> None:
+        for line in lines:
+            print(line, file=self._out)
+
+    # ------------------------------------------------------------ command
+    def run_command(self, line: str) -> bool:
+        """Execute one command; returns False when the shell should exit."""
+        try:
+            tokens = shlex.split(line)
+        except ValueError as e:
+            self._p(f"parse error: {e}")
+            return True
+        if not tokens:
+            return True
+        cmd, args = tokens[0], tokens[1:]
+        try:
+            if cmd in ("quit", "exit", "bye"):
+                return False
+            elif cmd == "help":
+                self._p(__doc__)
+            elif cmd == "time":
+                self._p(self._ops.current_node_time())
+            elif cmd == "peers":
+                for info in self._ops.network_map_snapshot():
+                    self._p(f"  {info.legal_identity.name}  {info.addresses}")
+            elif cmd == "notaries":
+                for party in self._ops.notary_identities():
+                    self._p(f"  {party.name}")
+            elif cmd == "flow":
+                self._flow(args)
+            elif cmd == "vault":
+                self._vault(args)
+            elif cmd == "run":
+                if not args:
+                    self._p("usage: run <op> [args…]")
+                else:
+                    fn = getattr(self._ops, args[0])
+                    self._p(fn(*[_parse_arg(a) for a in args[1:]]))
+            else:
+                self._p(f"unknown command {cmd!r} — try 'help'")
+        except Exception as e:
+            self._p(f"error: {type(e).__name__}: {e}")
+        return True
+
+    def _flow(self, args) -> None:
+        if not args:
+            self._p("usage: flow start|list|watch")
+            return
+        sub = args[0]
+        if sub == "list":
+            for name in self._ops.registered_flows():
+                self._p(f"  {name}")
+        elif sub == "watch":
+            for fid in self._ops.state_machines_snapshot():
+                self._p(f"  {fid}")
+        elif sub == "start":
+            if len(args) < 2:
+                self._p("usage: flow start <ClassPath> [args…]")
+                return
+            flow_id = self._ops.start_flow_dynamic(
+                args[1], *[_parse_arg(a) for a in args[2:]]
+            )
+            self._p(f"started {flow_id}; waiting…")
+            result = self._ops.flow_result(flow_id, 120)
+            self._p(f"result: {result}")
+        else:
+            self._p(f"unknown flow subcommand {sub!r}")
+
+    def _vault(self, args) -> None:
+        from corda_tpu.node.vault import QueryCriteria
+
+        crit = QueryCriteria()
+        if args and args[0] == "query" and len(args) > 1:
+            crit = QueryCriteria(contract_state_types=(args[1],))
+        page = self._ops.vault_query_by(crit)
+        self._p(f"{page.total_states_available} unconsumed state(s)")
+        for sr in page.states:
+            self._p(f"  {sr.ref}: {sr.state.data}")
+
+    # ------------------------------------------------------------- loop
+    def repl(self, in_stream=sys.stdin) -> None:
+        self._p("corda_tpu shell — 'help' for commands")
+        while True:
+            self._out.write(">>> ")
+            self._out.flush()
+            line = in_stream.readline()
+            if not line or not self.run_command(line.strip()):
+                break

@@ -1,0 +1,177 @@
+"""REST gateway per node.
+
+Capability parity with the reference's webserver module
+(webserver/.../WebServer.kt + internal/NodeWebServer.kt: a Jetty/Jersey
+HTTP server exposing node operations as REST endpoints backed by RPC).
+Endpoints:
+
+    GET  /api/status                 node identity + time
+    GET  /api/peers                  network map snapshot
+    GET  /api/notaries               notary identities
+    GET  /api/vault?state=<Class>    unconsumed states
+    GET  /api/flows                  in-progress flows
+    GET  /api/flows/registered       registered flow class paths
+    POST /api/flows/<ClassPath>      start a flow; JSON body = args list;
+                                     ?wait=1 blocks for the result
+    GET  /api/attachments/<hash>     download an attachment
+
+Uses the standard-library HTTP server (the runtime has no web framework);
+JSON rendering covers the platform types.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+def _jsonable(obj):
+    from corda_tpu.crypto import SecureHash
+    from corda_tpu.ledger import Party
+
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, SecureHash):
+        return str(obj)
+    if isinstance(obj, Party):
+        return {"name": str(obj.name), "key": obj.owning_key.to_string_short()}
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple, set)):
+        return [_jsonable(x) for x in obj]
+    if hasattr(obj, "__dataclass_fields__"):
+        import dataclasses
+
+        return {
+            f.name: _jsonable(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    return repr(obj)
+
+
+class NodeWebServer:
+    """HTTP façade over a CordaRPCOps-shaped object."""
+
+    def __init__(self, ops, host: str = "127.0.0.1", port: int = 0):
+        self._ops = ops
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, payload) -> None:
+                body = json.dumps(_jsonable(payload)).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_bytes(self, data: bytes) -> None:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as e:
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self):
+                try:
+                    outer._post(self)
+                except Exception as e:
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ routing
+    def _get(self, req) -> None:
+        url = urlparse(req.path)
+        parts = [p for p in url.path.split("/") if p]
+        query = parse_qs(url.query)
+        if parts[:2] == ["api", "status"]:
+            info = self._ops.node_info()
+            req._reply(200, {
+                "identity": info.legal_identity,
+                "addresses": list(info.addresses),
+                "time": self._ops.current_node_time(),
+            })
+        elif parts[:2] == ["api", "peers"]:
+            req._reply(200, [
+                i.legal_identity for i in self._ops.network_map_snapshot()
+            ])
+        elif parts[:2] == ["api", "notaries"]:
+            req._reply(200, self._ops.notary_identities())
+        elif parts[:2] == ["api", "vault"]:
+            from corda_tpu.node.vault import QueryCriteria
+
+            crit = QueryCriteria()
+            if "state" in query:
+                crit = QueryCriteria(
+                    contract_state_types=(query["state"][0],)
+                )
+            page = self._ops.vault_query_by(crit)
+            req._reply(200, {
+                "total": page.total_states_available,
+                "states": [
+                    {"ref": str(sr.ref), "data": sr.state.data}
+                    for sr in page.states
+                ],
+            })
+        elif parts == ["api", "flows"]:
+            req._reply(200, self._ops.state_machines_snapshot())
+        elif parts == ["api", "flows", "registered"]:
+            req._reply(200, self._ops.registered_flows())
+        elif parts[:2] == ["api", "attachments"] and len(parts) == 3:
+            from corda_tpu.crypto import SecureHash
+
+            data = self._ops.open_attachment(
+                SecureHash(bytes.fromhex(parts[2]))
+            )
+            if data is None:
+                req._reply(404, {"error": "no such attachment"})
+            else:
+                req._reply_bytes(data)
+        else:
+            req._reply(404, {"error": f"no route for {url.path}"})
+
+    def _post(self, req) -> None:
+        url = urlparse(req.path)
+        parts = [p for p in url.path.split("/") if p]
+        query = parse_qs(url.query)
+        if parts[:2] == ["api", "flows"] and len(parts) == 3:
+            length = int(req.headers.get("Content-Length", 0))
+            body = req.rfile.read(length) if length else b"[]"
+            args = json.loads(body or b"[]")
+            flow_id = self._ops.start_flow_dynamic(parts[2], *args)
+            if query.get("wait", ["0"])[0] == "1":
+                result = self._ops.flow_result(flow_id, 120)
+                req._reply(200, {"flow_id": flow_id,
+                                 "result": _jsonable(result)})
+            else:
+                req._reply(202, {"flow_id": flow_id})
+        else:
+            req._reply(404, {"error": f"no route for {url.path}"})
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "NodeWebServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="webserver", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -42,3 +42,6 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "device: exercises the JAX device kernels (slow cold-compile)"
     )
+    config.addinivalue_line(
+        "markers", "slow: spawns real node subprocesses (seconds per boot)"
+    )

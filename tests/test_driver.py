@@ -1,0 +1,47 @@
+"""Driver (process) tier test — the reference's integration/smoke tiers
+(Driver.kt spawning real nodes, NodeProcess black-box RPC): real node
+subprocesses over the shared durable fabric, exercised only via RPC.
+Slow (seconds per process boot) — marked accordingly."""
+
+import time
+
+import pytest
+
+from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+from corda_tpu.flows.api import class_path
+from corda_tpu.ledger import CordaX500Name
+from corda_tpu.testing import driver
+
+
+@pytest.mark.slow
+class TestDriver:
+    def test_three_process_cluster_with_notarised_payment(self, tmp_path):
+        with driver(str(tmp_path)) as dsl:
+            dsl.start_node("O=Notary,L=Zurich,C=CH", notary=True)
+            alice = dsl.start_node("O=Alice,L=London,C=GB")
+            bob = dsl.start_node("O=Bob,L=Rome,C=IT")
+            conn = dsl.rpc(alice)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                notaries = conn.proxy.notary_identities()
+                if notaries and len(conn.proxy.network_map_snapshot()) >= 3:
+                    break
+                time.sleep(0.3)
+            assert len(notaries) == 1
+            fid = conn.proxy.start_flow_dynamic(
+                class_path(CashIssueFlow), 100, "GBP", b"\x01", notaries[0]
+            )
+            conn.proxy.flow_result(fid, 60)
+            bob_party = conn.proxy.well_known_party_from_x500_name(
+                CordaX500Name.parse("O=Bob,L=Rome,C=IT")
+            )
+            fid = conn.proxy.start_flow_dynamic(
+                class_path(CashPaymentFlow), 40, "GBP", bob_party
+            )
+            conn.proxy.flow_result(fid, 90)
+            bconn = dsl.rpc(bob)
+            assert bconn.proxy.vault_query_by().total_states_available == 1
+            # black-box crash: kill bob's process; the cluster keeps serving
+            bob_handle = dsl.nodes[-1]
+            bob_handle.kill()
+            assert conn.proxy.ping() == "pong"
