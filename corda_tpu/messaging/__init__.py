@@ -27,6 +27,12 @@ from .network import (
     PeerHandle,
 )
 from .broker_client import BrokerMessagingClient, p2p_queue
+from .native_queue import (
+    NativeEngineUnavailable,
+    NativeQueueBroker,
+    make_broker,
+    native_engine_available,
+)
 
 __all__ = [
     "auto_ack",
@@ -38,4 +44,6 @@ __all__ = [
     "PeerHandle",
     "BrokerMessagingClient",
     "p2p_queue",
+    "NativeEngineUnavailable", "NativeQueueBroker", "make_broker",
+    "native_engine_available",
 ]

@@ -43,6 +43,8 @@ class Message:
 
 
 class DurableQueueBroker:
+    ACKED_CACHE_MAX = 100_000  # Artemis-style bounded duplicate-ID cache
+
     """All queues of one host process; thread-safe.
 
     ``consume(queue)`` leases the oldest available message to the caller for
@@ -73,8 +75,17 @@ class DurableQueueBroker:
         self._db.execute(
             "CREATE INDEX IF NOT EXISTS idx_queue ON messages(queue, seq)"
         )
+        # acked ids persist so a crash-replayed duplicate of an already
+        # processed message is dropped even after its row is deleted —
+        # BOUNDED like Artemis's circular duplicate-ID cache (rowid FIFO)
+        self._db.execute(
+            """CREATE TABLE IF NOT EXISTS acked_ids (
+                 rid INTEGER PRIMARY KEY AUTOINCREMENT,
+                 msg_id TEXT UNIQUE)"""
+        )
         self._db.commit()
         self._closed = False
+        self._acks_since_trim = 0
 
     # ------------------------------------------------------------ publish
     def publish(
@@ -93,8 +104,12 @@ class DurableQueueBroker:
             self._db.execute(
                 """INSERT OR IGNORE INTO messages
                    (queue, msg_id, payload, sender, reply_to, enqueued_at)
-                   VALUES (?,?,?,?,?,?)""",
-                (queue, msg_id, payload, sender, reply_to, time.time()),
+                   SELECT ?,?,?,?,?,?
+                   WHERE NOT EXISTS (
+                     SELECT 1 FROM acked_ids WHERE msg_id=?
+                   )""",
+                (queue, msg_id, payload, sender, reply_to, time.time(),
+                 msg_id),
             )
             self._db.commit()
             self._lock.notify_all()
@@ -152,6 +167,18 @@ class DurableQueueBroker:
         with self._lock:
             self._check_open()
             self._db.execute("DELETE FROM messages WHERE msg_id=?", (msg_id,))
+            self._db.execute(
+                "INSERT OR IGNORE INTO acked_ids (msg_id) VALUES (?)",
+                (msg_id,),
+            )
+            self._acks_since_trim += 1
+            if self._acks_since_trim >= 4096:
+                self._acks_since_trim = 0
+                self._db.execute(
+                    """DELETE FROM acked_ids WHERE rid <=
+                         (SELECT MAX(rid) FROM acked_ids) - ?""",
+                    (self.ACKED_CACHE_MAX,),
+                )
             self._db.commit()
 
     def nack(self, msg_id: str) -> None:

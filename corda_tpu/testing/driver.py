@@ -150,16 +150,17 @@ class DriverDSL:
         endpoint = BrokerMessagingClient(
             broker, f"driver-rpc-{secrets.token_hex(4)}"
         )
-        self._rpc_endpoints.append(endpoint)
+        self._rpc_endpoints.append((endpoint, broker))
         client = CordaRPCClient(endpoint, node.name)
         return client.start(username or user, password or pw,
                             timeout_s=timeout_s)
 
     # ---------------------------------------------------------- teardown
     def shutdown(self) -> None:
-        for endpoint in self._rpc_endpoints:
+        for endpoint, broker in self._rpc_endpoints:
             try:
                 endpoint.stop()
+                broker.close()
             except Exception:
                 pass
         for handle in reversed(self.nodes):

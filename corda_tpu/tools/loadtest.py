@@ -74,6 +74,7 @@ class LoadTestRunner:
         self.params = params or RunParameters()
         self.disruptions = list(disruptions or [])
         self.rng = rng or random.Random(0)
+        self._metrics_lock = threading.Lock()
         self.metrics = {"executed": 0, "failed": 0, "gathers": 0,
                         "disruptions": 0}
 
@@ -121,10 +122,12 @@ class LoadTestRunner:
     def _execute_one(self, cmd) -> None:
         try:
             self.test.execute(cmd)
-            self.metrics["executed"] += 1
+            with self._metrics_lock:
+                self.metrics["executed"] += 1
         except Exception:
             logger.exception("command execution failed")
-            self.metrics["failed"] += 1
+            with self._metrics_lock:
+                self.metrics["failed"] += 1
 
     def _gather_and_check(self, expected) -> None:
         observed = self.test.gather()
@@ -171,11 +174,7 @@ def self_issue_test(nodes: dict, notary, amounts=(100, 1000)) -> LoadTest:
                 )
             )
             for name, node in nodes.items()
-            if name in gathered_names(nodes)
         }
-
-    def gathered_names(nodes):
-        return set(nodes)
 
     return LoadTest(
         name="SelfIssue",
