@@ -160,10 +160,13 @@ class NativeQueueBroker:
         reply_to = take().decode()
         redelivered = raw[pos] == 1
         pos += 1
+        enqueued_us = int.from_bytes(raw[pos:pos + 8], "little")
+        pos += 8
         payload = take()
         return Message(
             queue=queue, payload=payload, msg_id=msg_id, sender=sender,
-            reply_to=reply_to, redelivered=redelivered,
+            reply_to=reply_to, enqueued_at=enqueued_us / 1e6,
+            redelivered=redelivered,
         )
 
     # --------------------------------------------------------------- ack

@@ -43,8 +43,6 @@ class Message:
 
 
 class DurableQueueBroker:
-    ACKED_CACHE_MAX = 100_000  # Artemis-style bounded duplicate-ID cache
-
     """All queues of one host process; thread-safe.
 
     ``consume(queue)`` leases the oldest available message to the caller for
@@ -53,6 +51,8 @@ class DurableQueueBroker:
     like Artemis redelivery on consumer death). ``publish`` is idempotent on
     ``msg_id``.
     """
+
+    ACKED_CACHE_MAX = 100_000  # Artemis-style bounded duplicate-ID cache
 
     def __init__(self, path: str = ":memory:", visibility_s: float = 30.0):
         self._visibility_s = visibility_s

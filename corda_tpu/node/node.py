@@ -135,8 +135,23 @@ class Node:
                 max_batch=self.config.verification_batch_max,
                 window_s=self.config.verification_window_ms / 1000.0,
             )
-        # OutOfProcess wiring (queue to external verifier workers) rides the
-        # broker transport; in-process pool is the compatible default
+        if vt is VerifierType.OutOfProcess:
+            # external workers compete on the broker's verifier.requests
+            # queue (reference: Node.makeTransactionVerifierService →
+            # NodeMessagingClient.verifierService, Node.kt:103)
+            broker = getattr(self.messaging, "_broker", None)
+            if broker is not None:
+                from corda_tpu.verifier.worker import (
+                    OutOfProcessVerifierService,
+                )
+
+                return OutOfProcessVerifierService(
+                    broker, str(self.party.name)
+                )
+            logger.warning(
+                "verifierType=OutOfProcess needs a broker transport; "
+                "falling back to the in-process pool"
+            )
         return InMemoryVerifierService()
 
     def _make_notary_service(self, db):

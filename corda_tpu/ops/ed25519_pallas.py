@@ -1,0 +1,352 @@
+"""Pallas TPU kernel for batched ed25519 verification.
+
+The XLA path (`ed25519.ed25519_verify_core`) expresses the scalar ladder as
+jnp ops; even fully fused, every loop iteration round-trips its point state
+through HBM. This kernel keeps the ENTIRE verification pipeline — point
+decompression, the joint 256-bit Straus/Shamir ladder, inversion and
+compression — in VMEM per batch block, with a limb-major ``(32, BLK)``
+layout so the last axis is lane-aligned (int32 tile (8,128); BLK is a
+multiple of 128 and the 32-limb axis packs sublanes exactly).
+
+Field math mirrors `fe25519` (radix-256 limbs, lazy carries, ×38 fold),
+transposed to limb-major. Curve/field constants ride in as a dedicated
+kernel input (pallas forbids captured array constants) shared by every
+grid block. Grid = batch blocks; each grid step verifies BLK signatures
+with zero HBM traffic between point operations.
+
+STATUS: EXPERIMENTAL — NOT yet wired into any production path.
+`ed25519.ed25519_verify_batch` uses the XLA core; this kernel currently
+trips a Mosaic compiler crash ("Check failed: limits[i] <= dim(i)") under
+the tunneled v5e toolchain that is still being bisected (size-1-dim blocks
+and dynamic-offset constraints have been eliminated as causes; see the
+static pow unroll and 8-aligned chunked bit loads below, which Mosaic
+accepts in isolation). Kept as the integration target for the VMEM-resident
+ladder; do not call it from production code until a differential test
+passes on real hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ed25519 import _BT_L, _BX_L, _BY_L, _D2_L, _D_L, _SQRT_M1_L, P
+from .fe25519 import LIMBS, int_to_limbs
+
+# ---------------------------------------------------------- host constants
+# one (10, 32) int32 matrix: limb constants the kernel needs, one per row
+_EIGHT_P = np.full(LIMBS, 1020, dtype=np.int32)
+_EIGHT_P[0] = 872
+
+# padded to a clean (16, 128) int32 tile — odd-shaped VMEM blocks crash
+# or pessimize Mosaic's windowing
+_CONSTS_HOST = np.zeros((16, 128), dtype=np.int32)
+for _row, _vec in enumerate([
+    _EIGHT_P,                 # 0: 8p (for lazy subtraction)
+    _D_L,                     # 1: d
+    _D2_L,                    # 2: 2d
+    _SQRT_M1_L,               # 3: sqrt(-1)
+    _BX_L,                    # 4: base point x
+    _BY_L,                    # 5: base point y
+    _BT_L,                    # 6: base point t
+    int_to_limbs(P),          # 7: p (for canonical reduction)
+]):
+    _CONSTS_HOST[_row, :LIMBS] = _vec
+
+# square-and-multiply bit schedules (MSB-first), padded to 256
+_SQRT_EXP = (P - 5) // 8
+_INV_EXP = P - 2
+
+
+
+
+
+@dataclasses.dataclass
+class Env:
+    """Per-block constants loaded from the consts input."""
+
+    eight_p: jax.Array    # (32, blk)
+    p_limbs: jax.Array    # (32, blk)
+    d: jax.Array          # (32, blk)
+    d2: jax.Array
+    sqrt_m1: jax.Array
+    base: tuple
+
+
+# ------------------------------------------------- limb-major field ops
+
+def _one_hot_first(blk):
+    return jnp.concatenate([
+        jnp.ones((1, blk), jnp.int32), jnp.zeros((LIMBS - 1, blk), jnp.int32)
+    ], axis=0)
+
+
+def _carry_pass(c):
+    q = c >> 8
+    r = c - (q << 8)
+    wrap = 38 * q[LIMBS - 1:LIMBS, :]
+    return r + jnp.concatenate([wrap, q[:LIMBS - 1, :]], axis=0)
+
+
+def _carry(c, passes):
+    for _ in range(passes):
+        c = _carry_pass(c)
+    return c
+
+
+def fe_mul(a, b):
+    blk = a.shape[1]
+    c = jnp.zeros((2 * LIMBS - 1, blk), dtype=jnp.int32)
+    for i in range(LIMBS):
+        # static pad-shift: pallas TPU lowers neither scatter nor
+        # dynamic_slice, so the shifted accumulate is a pad + add
+        c = c + jnp.pad(a[i:i + 1, :] * b, ((i, LIMBS - 1 - i), (0, 0)))
+    lo, hi = c[:LIMBS], c[LIMBS:]
+    folded = lo + 38 * jnp.pad(hi, ((0, 1), (0, 0)))
+    return _carry(folded, 4)
+
+
+def fe_sq(a):
+    return fe_mul(a, a)
+
+
+def fe_add(a, b):
+    return _carry(a + b, 2)
+
+
+def fe_sub(env, a, b):
+    return _carry(a - b + env.eight_p, 3)
+
+
+def fe_neg(env, a):
+    return fe_sub(env, jnp.zeros_like(a), a)
+
+
+def fe_mul_small(a, k):
+    return _carry(a * np.int32(k), 3)
+
+
+def fe_pow_const(a, exponent: int):
+    """a^e for a COMPILE-TIME exponent: square-and-multiply unrolled in
+    Python — no bit lookups at run time, so nothing needs the dynamic
+    indexing Mosaic restricts. The sqrt/inversion exponents are fixed
+    field constants, so the unroll happens exactly twice per kernel."""
+    n = exponent.bit_length()
+    r = None
+    for i in range(n):
+        if r is not None:
+            r = fe_sq(r)
+        if (exponent >> (n - 1 - i)) & 1:
+            r = a if r is None else fe_mul(r, a)
+    assert r is not None
+    return r
+
+
+def fe_canonical(env, a):
+    # statically-unrolled carry/borrow chains (32 steps each): sequential
+    # over limbs but vectorized over lanes, pallas-lowerable as-is
+    def exact_carry(c):
+        rows = []
+        carry = jnp.zeros_like(c[0:1, :])
+        for i in range(LIMBS):
+            v = c[i:i + 1, :] + carry
+            rows.append(v & 255)
+            carry = v >> 8
+        out = jnp.concatenate(rows, axis=0)
+        return out + jnp.pad(38 * carry, ((0, LIMBS - 1), (0, 0)))
+
+    c = exact_carry(exact_carry(a))
+    c = exact_carry(c)
+
+    def sub_p(v):
+        rows = []
+        borrow = jnp.zeros_like(v[0:1, :])
+        for i in range(LIMBS):
+            d = v[i:i + 1, :] - env.p_limbs[i:i + 1, :] - borrow
+            rows.append(d & 255)
+            borrow = (d < 0).astype(jnp.int32)
+        diff = jnp.concatenate(rows, axis=0)
+        return jnp.where(borrow == 0, diff, v)
+
+    return sub_p(sub_p(c))
+
+
+def fe_eq(env, a, b):
+    return jnp.all(fe_canonical(env, a) == fe_canonical(env, b), axis=0)
+
+
+def fe_is_odd(env, a):
+    return fe_canonical(env, a)[0, :] & 1
+
+
+# --------------------------------------------------- limb-major points
+
+def identity_point(blk):
+    zero = jnp.zeros((LIMBS, blk), dtype=jnp.int32)
+    one = _one_hot_first(blk)
+    return (zero, one, one, zero)
+
+
+def point_add(env, p, q):
+    px, py, pz, pt = p
+    qx, qy, qz, qt = q
+    a = fe_mul(fe_sub(env, py, px), fe_sub(env, qy, qx))
+    bb = fe_mul(fe_add(py, px), fe_add(qy, qx))
+    c = fe_mul(fe_mul(pt, env.d2), qt)
+    d = fe_mul_small(fe_mul(pz, qz), 2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_add(d, c)
+    h = fe_add(bb, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def point_double(env, p):
+    px, py, pz, pt = p
+    a = fe_sq(px)
+    b = fe_sq(py)
+    c = fe_mul_small(fe_sq(pz), 2)
+    h = fe_add(a, b)
+    e = fe_sub(env, h, fe_sq(fe_add(px, py)))
+    g = fe_sub(env, a, b)
+    f = fe_add(c, g)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def point_neg(env, p):
+    px, py, pz, pt = p
+    return (fe_neg(env, px), py, pz, fe_neg(env, pt))
+
+
+def point_select(mask_row, p, q):
+    m = mask_row[None, :]
+    return tuple(jnp.where(m, a, b) for a, b in zip(p, q))
+
+
+def decompress(env, y, sign_row):
+    one = _one_hot_first(y.shape[1])
+    y2 = fe_sq(y)
+    u = fe_sub(env, y2, one)
+    v = fe_add(fe_mul(env.d, y2), one)
+    v3 = fe_mul(fe_sq(v), v)
+    v7 = fe_mul(fe_sq(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), _SQRT_EXP))
+    vx2 = fe_mul(v, fe_sq(x))
+    root_ok = fe_eq(env, vx2, u)
+    flip_ok = fe_eq(env, vx2, fe_neg(env, u))
+    x = jnp.where(flip_ok[None, :], fe_mul(x, env.sqrt_m1), x)
+    ok = root_ok | flip_ok
+    x_is_zero = fe_eq(env, x, jnp.zeros_like(x))
+    ok = ok & ~(x_is_zero & (sign_row == 1))
+    x = jnp.where((fe_is_odd(env, x) != sign_row)[None, :], fe_neg(env, x), x)
+    return (x, y, one, fe_mul(x, y)), ok
+
+
+def compress(env, p):
+    px, py, pz, _ = p
+    zinv = fe_pow_const(pz, _INV_EXP)
+    x = fe_canonical(env, fe_mul(px, zinv))
+    y = fe_canonical(env, fe_mul(py, zinv))
+    sign_byte = y[LIMBS - 1:, :] + (((x[0:1, :] & 1) << 7))
+    return jnp.concatenate([y[:LIMBS - 1, :], sign_byte], axis=0)
+
+
+# ------------------------------------------------------------- kernel
+
+def _verify_kernel(consts_ref, a_y_ref, a_sign_ref, r_ref,
+                   s_bits_ref, h_bits_ref, pre_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    blk = a_y_ref.shape[1]
+    consts = consts_ref[:, :]          # (16, 128); row r cols 0:32 = limbs
+
+    def cfull(i):
+        # full-lane broadcast: size-1 lane dims trip Mosaic's windowing
+        return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
+
+    env = Env(
+        eight_p=cfull(0), p_limbs=cfull(7),
+        d=cfull(1), d2=cfull(2), sqrt_m1=cfull(3),
+        base=(cfull(4), cfull(5), _one_hot_first(blk), cfull(6)),
+    )
+
+    a_pt, a_ok = decompress(env, a_y_ref[:, :], a_sign_ref[0, :])  # row 0 of the 8-row pad
+    minus_a = point_neg(env, a_pt)
+    t_both = point_add(env, env.base, minus_a)
+    ident = identity_point(blk)
+
+    def chunk_body(j, acc):
+        # dynamic sublane offsets must be 8-aligned: walk the 256 bit rows
+        # MSB-first in chunks of 8, unrolling the chunk statically
+        base_row = 8 * (31 - j)
+        s_chunk = s_bits_ref[pl.ds(base_row, 8), :]   # (8, blk)
+        h_chunk = h_bits_ref[pl.ds(base_row, 8), :]
+        for k in range(7, -1, -1):
+            acc = point_double(env, acc)
+            sb = s_chunk[k, :]
+            hb = h_chunk[k, :]
+            addend = point_select(
+                (sb == 1) & (hb == 1), t_both,
+                point_select(
+                    sb == 1, env.base,
+                    point_select(hb == 1, minus_a, ident)
+                ),
+            )
+            acc = point_add(env, acc, addend)
+        return acc
+
+    result = jax.lax.fori_loop(0, 32, chunk_body, identity_point(blk))
+    encoded = compress(env, result)
+    match = jnp.all(encoded == r_ref[:, :], axis=0)
+    verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
+    # output block is 8 sublanes (1-row vector blocks crash Mosaic's
+    # windowing); every row carries the verdict, caller reads row 0
+    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, verdict.shape[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+def ed25519_verify_pallas(
+    a_y_t: jax.Array,      # (32, B) pubkey y limbs, limb-major
+    a_sign: jax.Array,     # (1, B)
+    r_t: jax.Array,        # (32, B) R bytes, limb-major
+    s_bits_t: jax.Array,   # (256, B)
+    h_bits_t: jax.Array,   # (256, B)
+    precheck: jax.Array,   # (1, B) int32
+    interpret: bool = False,
+    block: int = 512,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+
+    b = a_y_t.shape[1]
+    assert b % block == 0, (b, block)
+    assert a_sign.shape[0] == 8 and precheck.shape[0] == 8, (
+        "pass sign/precheck padded to 8 rows (row 0 = data)"
+    )
+    grid = (b // block,)
+
+    def col_spec(rows):
+        return pl.BlockSpec((rows, block), lambda i: (0, i))
+
+    def const_spec(shape):
+        return pl.BlockSpec(shape, lambda i: (0, 0))
+
+    mask = pl.pallas_call(
+        _verify_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
+        grid=grid,
+        in_specs=[
+            const_spec(_CONSTS_HOST.shape),
+            col_spec(LIMBS), col_spec(8), col_spec(LIMBS),
+            col_spec(256), col_spec(256), col_spec(8),
+        ],
+        out_specs=col_spec(8),
+        interpret=interpret,
+    )(
+        jnp.asarray(_CONSTS_HOST),
+        a_y_t, a_sign, r_t, s_bits_t, h_bits_t, precheck,
+    )
+    return mask[0] != 0

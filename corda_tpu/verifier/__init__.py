@@ -19,6 +19,11 @@ from .service import (
     TransactionVerifierService,
     VerificationError,
 )
+from .worker import (
+    OutOfProcessVerifierService,
+    VerificationFailedError,
+    VerifierWorker,
+)
 
 __all__ = [
     "BatchVerifyReport",
@@ -28,4 +33,6 @@ __all__ = [
     "InMemoryVerifierService",
     "TransactionVerifierService",
     "VerificationError",
+    "OutOfProcessVerifierService", "VerificationFailedError",
+    "VerifierWorker",
 ]

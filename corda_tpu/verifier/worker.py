@@ -1,0 +1,242 @@
+"""Out-of-process verification worker + the node-side service that feeds it.
+
+Capability parity with the reference's verifier module (verifier/src/main/
+kotlin/net/corda/verifier/Verifier.kt:49-94) and the node side
+(node/.../transactions/OutOfProcessTransactionVerifierService.kt:20-71,
+wire contract node-api/.../VerifierApi.kt:10-59):
+
+- stateless workers consume ``verifier.requests`` from the durable broker,
+  verify the carried transaction, reply to the request's reply queue, ack;
+- N workers are competing consumers on one queue — the broker's
+  visibility-timeout redelivery re-assigns un-acked work when a worker
+  dies (the elasticity property VerifierTests.kt:55-113 proves);
+- the node publishes requests tagged with a nonce and completes the
+  matching future when the response arrives; responses are idempotent.
+
+A worker verifies the full semantic package: every signature present and
+required (minus the notary's during assembly) and the contract semantics
+via ``LedgerTransaction.verify`` — signature math goes through the batched
+device path when a device is available.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import traceback
+from concurrent.futures import Future
+
+from corda_tpu.ledger import LedgerTransaction, SignedTransaction
+from corda_tpu.serialization import cbe_serializable, deserialize, serialize
+
+logger = logging.getLogger(__name__)
+
+VERIFICATION_REQUESTS_QUEUE = "verifier.requests"
+VERIFICATION_RESPONSES_QUEUE_PREFIX = "verifier.responses."
+
+
+@cbe_serializable(name="verifier.Request")
+@dataclasses.dataclass(frozen=True)
+class VerificationRequest:
+    """reference: VerifierApi.VerificationRequest (:17-38) — nonce, the
+    transaction to verify, and where to reply. The signed form travels too
+    so workers check signatures, not just contracts."""
+
+    nonce: int
+    stx: SignedTransaction
+    ltx: LedgerTransaction
+    reply_to: str
+
+
+@cbe_serializable(name="verifier.Response")
+@dataclasses.dataclass(frozen=True)
+class VerificationResponse:
+    """reference: VerifierApi.VerificationResponse (:40-58)."""
+
+    nonce: int
+    error: str = ""   # empty = verified
+
+
+class VerifierWorker:
+    """One stateless worker process/thread (reference: Verifier.main loop
+    :66-84)."""
+
+    def __init__(self, broker, use_device: bool = False,
+                 worker_name: str = "verifier-worker"):
+        self._broker = broker
+        self._use_device = use_device
+        self.name = worker_name
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.verified = 0
+        self.failed = 0
+
+    # ------------------------------------------------------------ serving
+    def serve_one(self, timeout: float = 0.5) -> bool:
+        """Consume and process one request; returns False on timeout."""
+        msg = self._broker.consume(VERIFICATION_REQUESTS_QUEUE, timeout=timeout)
+        if msg is None:
+            return False
+        try:
+            req = deserialize(msg.payload)
+            error = self._verify(req)
+        except Exception as e:  # malformed request: reply if we can
+            logger.exception("malformed verification request")
+            self._broker.ack(msg.msg_id)
+            return True
+        response = VerificationResponse(req.nonce, error)
+        # reply THEN ack: a crash in between redelivers the request and the
+        # node dedupes the duplicate response by nonce (at-least-once)
+        self._broker.publish(
+            req.reply_to, serialize(response),
+            msg_id=f"vresp-{req.nonce}", sender=self.name,
+        )
+        self._broker.ack(msg.msg_id)
+        if error:
+            self.failed += 1
+        else:
+            self.verified += 1
+        return True
+
+    def _verify(self, req: VerificationRequest) -> str:
+        try:
+            if req.stx is not None and req.stx != 0:
+                from corda_tpu.verifier.batch import check_transactions
+
+                report = check_transactions(
+                    [req.stx],
+                    [({req.ltx.notary.owning_key}
+                      if req.ltx.notary is not None else set())],
+                    use_device=self._use_device,
+                )
+                report.raise_first()
+            req.ltx.verify()
+            return ""
+        except Exception as e:
+            return f"{type(e).__name__}: {e}"
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "VerifierWorker":
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        from corda_tpu.messaging.queue import QueueClosedError
+
+        while not self._stop.is_set():
+            try:
+                self.serve_one()
+            except QueueClosedError:
+                return
+            except Exception:
+                logger.exception("verifier worker iteration failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+class OutOfProcessVerifierService:
+    """Node-side TransactionVerifierService publishing to the worker queue
+    (reference: OutOfProcessTransactionVerifierService.kt — nonce→future
+    map :32, response consumer :44-60, sendRequest :64-71)."""
+
+    def __init__(self, broker, node_name: str = "node"):
+        self._broker = broker
+        self.reply_queue = VERIFICATION_RESPONSES_QUEUE_PREFIX + node_name
+        self._lock = threading.Lock()
+        self._pending: dict[int, Future] = {}
+        self._nonce = 0
+        self._stop = threading.Event()
+        self._consumer = threading.Thread(
+            target=self._consume_responses, name="verifier-responses",
+            daemon=True,
+        )
+        self._consumer.start()
+
+    def verify_stx(self, stx: SignedTransaction, resolve_state) -> Future:
+        ltx = stx.tx.to_ledger_transaction(resolve_state)
+        return self._submit(stx, ltx)
+
+    def verify(self, ltx: LedgerTransaction) -> Future:
+        """TransactionVerifierService face (contracts only, like the
+        reference's LedgerTransaction-carrying requests)."""
+        return self._submit(None, ltx)
+
+    def _submit(self, stx, ltx) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._nonce += 1
+            nonce = self._nonce
+            self._pending[nonce] = fut
+        self._broker.publish(
+            VERIFICATION_REQUESTS_QUEUE,
+            serialize(VerificationRequest(
+                nonce, stx if stx is not None else 0, ltx, self.reply_queue
+            )),
+            msg_id=f"vreq-{self.reply_queue}-{nonce}",
+        )
+        return fut
+
+    def _consume_responses(self) -> None:
+        from corda_tpu.messaging.queue import QueueClosedError
+
+        while not self._stop.is_set():
+            try:
+                msg = self._broker.consume(self.reply_queue, timeout=0.5)
+            except QueueClosedError:
+                return
+            if msg is None:
+                continue
+            try:
+                resp = deserialize(msg.payload)
+                with self._lock:
+                    fut = self._pending.pop(resp.nonce, None)
+                if fut is not None and not fut.done():
+                    if resp.error:
+                        fut.set_exception(
+                            VerificationFailedError(resp.error)
+                        )
+                    else:
+                        fut.set_result(None)
+            except Exception:
+                logger.exception("bad verification response dropped")
+            self._broker.ack(msg.msg_id)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+
+class VerificationFailedError(Exception):
+    pass
+
+
+def run_worker(broker_path: str, use_device: bool = True) -> None:
+    """Process entry: ``python -m corda_tpu.verifier.worker <broker.db>``
+    (reference: Verifier.main)."""
+    from corda_tpu.messaging import DurableQueueBroker
+
+    broker = DurableQueueBroker(broker_path)
+    worker = VerifierWorker(broker, use_device=use_device)
+    logger.info("verifier worker serving %s", VERIFICATION_REQUESTS_QUEUE)
+    try:
+        while True:
+            worker.serve_one(timeout=1.0)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    import sys
+
+    logging.basicConfig(level=logging.INFO)
+    run_worker(sys.argv[1] if len(sys.argv) > 1 else "broker.db")

@@ -38,6 +38,7 @@
 
 #ifndef _WIN32
 #include <unistd.h>
+#include <sys/types.h>
 #endif
 
 namespace {
@@ -105,12 +106,24 @@ class Broker {
         : path_(path), visibility_s_(visibility_s), fsync_each_(fsync_each) {
         in_memory_ = path.empty() || path == ":memory:";
         if (!in_memory_) {
-            // replay existing journal, then append
+            // replay existing journal, then append. A crash can leave a
+            // torn tail record; appending after it would make the NEXT
+            // replay misparse everything that follows, so truncate to the
+            // last well-formed record first.
+            long good_end = 0;
             std::FILE* f = std::fopen(path.c_str(), "rb");
             if (f) {
-                replay(f);
+                good_end = replay(f);
                 std::fclose(f);
             }
+#ifndef _WIN32
+            if (good_end >= 0) {
+                if (truncate(path.c_str(), good_end) != 0) {
+                    // fall through: reopen append still works; worst case
+                    // the torn tail persists and the next open retries
+                }
+            }
+#endif
             log_ = std::fopen(path.c_str(), "ab");
             if (!log_) throw std::runtime_error("cannot open journal");
         }
@@ -124,7 +137,7 @@ class Broker {
                  const std::string& sender, const std::string& reply_to,
                  const std::string& payload) {
         std::unique_lock<std::mutex> lk(mu_);
-        if (closed_) return false;
+        if (closed_ || failed_) return false;
         // dedupe: still-pending or recently-acked ids are silent no-ops
         if (by_id_.count(msg_id) || acked_set_.count(msg_id)) return true;
         auto msg = std::make_shared<Pending>();
@@ -154,7 +167,7 @@ class Broker {
 
     // Returns a malloc'd packed message or nullptr on timeout/closed.
     // Layout: u32 idlen,id; u32 slen,sender; u32 rlen,reply; u8 redelivered;
-    //         u32 plen,payload
+    //         u64 enqueued_us; u32 plen,payload
     char* consume(const std::string& queue, double timeout_s,
                   uint32_t* out_len) {
         std::unique_lock<std::mutex> lk(mu_);
@@ -173,6 +186,7 @@ class Broker {
 
     bool ack(const std::string& msg_id) {
         std::unique_lock<std::mutex> lk(mu_);
+        if (failed_) return false;
         auto it = by_id_.find(msg_id);
         if (it == by_id_.end()) return false;
         auto msg = it->second;
@@ -262,6 +276,7 @@ class Broker {
         put_u32(b, (uint32_t)m->reply_to.size());
         b.append(m->reply_to);
         b.push_back(m->delivery_count > 1 ? 1 : 0);
+        put_u64(b, m->enqueued_us);
         put_u32(b, (uint32_t)m->payload.size());
         b.append(m->payload);
         char* out = (char*)std::malloc(b.size());
@@ -284,28 +299,34 @@ class Broker {
     }
 
     void write_record(uint8_t kind, const std::string& body) {
-        std::fwrite(&kind, 1, 1, log_);
+        // a short write (disk full, I/O error) must NOT be reported as
+        // durable success: flag the broker failed so publish/ack refuse
+        // further work instead of silently diverging from the journal
         uint32_t len = (uint32_t)body.size();
-        std::fwrite(&len, 4, 1, log_);
-        std::fwrite(body.data(), 1, body.size(), log_);
-        std::fflush(log_);
-        if (fsync_each_) {
+        bool ok = std::fwrite(&kind, 1, 1, log_) == 1
+            && std::fwrite(&len, 4, 1, log_) == 1
+            && std::fwrite(body.data(), 1, body.size(), log_) == body.size()
+            && std::fflush(log_) == 0;
+        if (ok && fsync_each_) {
 #ifndef _WIN32
-            fsync(fileno(log_));
+            ok = fsync(fileno(log_)) == 0;
 #endif
         }
+        if (!ok) failed_ = true;
     }
 
-    void replay(std::FILE* f) {
+    long replay(std::FILE* f) {
         std::vector<char> buf;
+        long good_end = 0;
         while (true) {
             uint8_t kind;
             uint32_t len;
             if (std::fread(&kind, 1, 1, f) != 1) break;
             if (std::fread(&len, 4, 1, f) != 1) break;
+            if (len > (64u << 20)) break;  // garbage length: stop at tear
             buf.resize(len);
             if (len && std::fread(buf.data(), 1, len, f) != len)
-                break;  // torn tail record: ignore (crash mid-append)
+                break;  // torn tail record: truncated by the caller
             Reader r{buf.data(), buf.data() + len};
             if (kind == 1) {
                 auto msg = std::make_shared<Pending>();
@@ -336,7 +357,9 @@ class Broker {
             } else {
                 break;  // unknown kind: stop at corruption
             }
+            good_end = std::ftell(f);
         }
+        return good_end;
     }
 
     std::string path_;
@@ -344,6 +367,7 @@ class Broker {
     bool fsync_each_;
     bool in_memory_ = false;
     bool closed_ = false;
+    bool failed_ = false;
     std::FILE* log_ = nullptr;
     std::mutex mu_;
     std::condition_variable cv_;

@@ -57,7 +57,7 @@ class TestValid:
 
     def test_fixed_bucket(self):
         pks, sigs, msgs = _gen(4, seed=2, msglen=(10, 40))
-        mask = ed25519_verify_batch(pks, sigs, msgs, nblocks=4)
+        mask = ed25519_verify_batch(pks, sigs, msgs)
         assert mask.all()
 
 
