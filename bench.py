@@ -69,22 +69,31 @@ def bench_host(pubkeys, sigs, msgs) -> float:
 
 
 def bench_device(pubkeys, sigs, msgs) -> float:
-    """Batched device verify → sigs/sec (steady-state, post-compile)."""
-    import jax
+    """Batched device verify → sigs/sec (pipelined steady state).
 
-    from corda_tpu.ops.ed25519 import ed25519_verify_batch
+    Measures the verifier service's production loop shape: dispatch batch
+    k+1 (host parse/hash, async device enqueue) while batch k's ladder
+    runs, then collect. Async dispatch overlaps host prep with device
+    compute, so throughput ≈ max(host-prep rate, device rate) rather than
+    their serial sum."""
+    import numpy as np
 
+    from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+
+    n = len(sigs)
     # warmup/compile
-    mask = ed25519_verify_batch(pubkeys, sigs, msgs)
+    mask = np.asarray(ed25519_verify_dispatch(pubkeys, sigs, msgs))[:n]
     assert mask.all(), "device kernel rejected valid sigs"
 
-    times = []
-    for _ in range(DEVICE_REPS):
-        t0 = time.perf_counter()
-        mask = ed25519_verify_batch(pubkeys, sigs, msgs)
-        times.append(time.perf_counter() - t0)
-    assert mask.all()
-    return len(sigs) / min(times)
+    t0 = time.perf_counter()
+    pending = ed25519_verify_dispatch(pubkeys, sigs, msgs)
+    for _ in range(DEVICE_REPS - 1):
+        nxt = ed25519_verify_dispatch(pubkeys, sigs, msgs)
+        assert np.asarray(pending)[:n].all()
+        pending = nxt
+    assert np.asarray(pending)[:n].all()
+    dt = time.perf_counter() - t0
+    return n * DEVICE_REPS / dt
 
 
 def main() -> None:

@@ -10,6 +10,41 @@ from __future__ import annotations
 import numpy as np
 
 
+def _min_tail(block_bytes: int) -> int:
+    """Mandatory padding tail: the 0x80 byte plus the length field (8 bytes
+    for SHA-256's 64B blocks, 16 for SHA-512's 128B blocks)."""
+    return 9 if block_bytes == 64 else 17
+
+
+def pow2_at_least(n: int, floor: int = 1) -> int:
+    """Smallest power of two ≥ max(n, floor) — the shared bucket rule that
+    keeps every device kernel at one compile per bucket, not per shape."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def bucket_batch(
+    messages: list[bytes], block_bytes: int, min_batch: int = 8
+) -> tuple[list[bytes], int]:
+    """Round a hash batch up to power-of-two buckets in BOTH axes.
+
+    Returns ``(padded_messages, nblocks)``: the message list extended with
+    ``b""`` pad lanes to a power-of-two batch, and the power-of-two block
+    count covering the longest message. Callers slice the digest list back
+    to the original length.
+    """
+    b = pow2_at_least(len(messages), min_batch)
+    padded = list(messages) + [b""] * (b - len(messages))
+    tail = _min_tail(block_bytes)
+    need = max(
+        1,
+        max((len(m) + tail + block_bytes - 1) // block_bytes for m in padded),
+    )
+    return padded, pow2_at_least(need)
+
+
 def pad_md_blocks(
     messages: list[bytes],
     block_bytes: int,
@@ -21,8 +56,8 @@ def pad_md_blocks(
     Returns ``(blocks, counts)``: (B, nblocks, block_bytes//4) uint32 words
     and (B,) int32 per-message padded block counts.
     """
-    # the 0x80 byte plus the 8-byte length field must fit after the message
-    min_tail = 9 if block_bytes == 64 else 17  # SHA-512 length field is 16B
+    # the 0x80 byte plus the length field must fit after the message
+    min_tail = _min_tail(block_bytes)
     if nblocks is None:
         longest = max((len(m) for m in messages), default=0)
         nblocks = max(1, (longest + min_tail + block_bytes - 1) // block_bytes)

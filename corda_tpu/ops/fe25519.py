@@ -55,23 +55,45 @@ def _carry(c: jax.Array, passes: int) -> jax.Array:
     return c
 
 
-# Schoolbook product as 32 statically-shifted multiply-accumulates. This is
-# deliberately NOT a gather+dot_general: a dot_general is a fusion barrier
-# that materializes a (B,32,63) operand in HBM per multiply, and inside the
-# scalar-mul ladders (thousands of muls) that made the kernel HBM-bound —
-# measured 3.3x slower than this pure-elementwise form, which XLA fuses
-# into the surrounding point-operation loop nests (TPU v5e, batch 8192).
+# Two schoolbook-product forms, chosen by backend at trace time:
+#
+# - TPU: 32 statically-shifted multiply-accumulates — deliberately NOT a
+#   gather+dot_general, which is a fusion barrier materializing a
+#   (B,32,63) operand in HBM per multiply; inside the scalar-mul ladders
+#   (thousands of muls) that made the kernel HBM-bound. The elementwise
+#   form fuses into the point-operation loop nests and measured 3.3x
+#   faster (TPU v5e, batch 8192).
+# - CPU (the test tier): the gather+einsum form — XLA:CPU compiles the
+#   shifted-accumulate chains pathologically slowly (tens of minutes for
+#   the 256-iteration ladder body), while the einsum compiles in seconds
+#   and test batches are tiny anyway.
+_CONV_IDX = np.clip(
+    np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None], 0, LIMBS - 1
+).astype(np.int32)
+_CONV_MASK = (
+    (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] >= 0)
+    & (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] < LIMBS)
+)
 
 
-def fe_mul(a: jax.Array, b: jax.Array) -> jax.Array:
-    """(B,32) × (B,32) → (B,32), limbs ≤ ~512 after 4 carry passes."""
-    c = jnp.zeros((a.shape[0], 2 * LIMBS - 1), dtype=jnp.int32)
-    for i in range(LIMBS):  # column k gets Σ_i a_i · b_{k-i}
-        c = c.at[:, i:i + LIMBS].add(a[:, i:i + 1] * b)
+def _fold_carry(c: jax.Array) -> jax.Array:
     # fold limbs ≥ 32: limb k contributes 38·2^(8(k-32))
     lo, hi = c[:, :LIMBS], c[:, LIMBS:]
     folded = lo + 38 * jnp.pad(hi, ((0, 0), (0, 1)))
     return _carry(folded, 4)
+
+
+def fe_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """(B,32) × (B,32) → (B,32), limbs ≤ ~512 after 4 carry passes."""
+    if jax.default_backend() == "cpu":
+        bmat = jnp.where(jnp.asarray(_CONV_MASK), b[:, _CONV_IDX], 0)
+        c = jnp.einsum("bi,bik->bk", a, bmat,
+                       preferred_element_type=jnp.int32)
+        return _fold_carry(c)
+    c = jnp.zeros((a.shape[0], 2 * LIMBS - 1), dtype=jnp.int32)
+    for i in range(LIMBS):  # column k gets Σ_i a_i · b_{k-i}
+        c = c.at[:, i:i + LIMBS].add(a[:, i:i + 1] * b)
+    return _fold_carry(c)
 
 
 def fe_sq(a: jax.Array) -> jax.Array:

@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._blockpack import pad_md_blocks, words_to_bytes
+from ._blockpack import bucket_batch, pad_md_blocks, words_to_bytes
 
 # fmt: off
 _K = np.array([
@@ -159,8 +159,15 @@ def bytes_to_digest_words(digests: list[bytes]) -> np.ndarray:
 
 
 def sha256_batch(messages: list[bytes]) -> list[bytes]:
-    """Convenience host API: batch-hash arbitrary same-bucket messages."""
+    """Convenience host API: batch-hash arbitrary messages.
+
+    Batch size and block count round up to power-of-two buckets so the
+    kernel compiles once per bucket pair instead of once per exact shape
+    (the dominant cost on cold compilation caches); pad lanes hash zeros
+    and are sliced off."""
     if not messages:
         return []
-    blocks, counts = pad_sha256(messages)
-    return digest_words_to_bytes(np.asarray(sha256_blocks(blocks, counts)))
+    padded, nblocks = bucket_batch(messages, 64)
+    blocks, counts = pad_sha256(padded, nblocks=nblocks)
+    out = digest_words_to_bytes(np.asarray(sha256_blocks(blocks, counts)))
+    return out[: len(messages)]

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._blockpack import pad_md_blocks, words_to_bytes
+from ._blockpack import bucket_batch, pad_md_blocks, words_to_bytes
 
 # fmt: off
 _K64 = [
@@ -191,8 +191,15 @@ def digest_words_to_bytes(digest: np.ndarray) -> list[bytes]:
 
 
 def sha512_batch(messages: list[bytes]) -> list[bytes]:
-    """Convenience host API: batch-hash arbitrary same-bucket messages."""
+    """Convenience host API: batch-hash arbitrary messages.
+
+    Batch size and block count round up to power-of-two buckets so the
+    kernel compiles once per bucket pair instead of once per exact shape
+    (the dominant cost on cold compilation caches); pad lanes hash zeros
+    and are sliced off."""
     if not messages:
         return []
-    blocks, counts = pad_sha512(messages)
-    return digest_words_to_bytes(np.asarray(sha512_blocks(blocks, counts)))
+    padded, nblocks = bucket_batch(messages, 128)
+    blocks, counts = pad_sha512(padded, nblocks=nblocks)
+    out = digest_words_to_bytes(np.asarray(sha512_blocks(blocks, counts)))
+    return out[: len(messages)]
