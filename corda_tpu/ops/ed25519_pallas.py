@@ -1,27 +1,45 @@
-"""Pallas TPU kernel for batched ed25519 verification.
+"""Pallas TPU kernel for batched ed25519 verification (radix-4096, windowed).
 
-The XLA path (`ed25519.ed25519_verify_core`) expresses the scalar ladder as
-jnp ops; even fully fused, every loop iteration round-trips its point state
-through HBM. This kernel keeps the ENTIRE verification pipeline — point
-decompression, the joint 256-bit Straus/Shamir ladder, inversion and
-compression — in VMEM per batch block, with a limb-major ``(32, BLK)``
-layout so the last axis is lane-aligned (int32 tile (8,128); BLK is a
-multiple of 128 and the 32-limb axis packs sublanes exactly).
+The device kernel behind scheme 4 (the reference's default tx-signing
+scheme, Crypto.kt:115-137; hot loop TransactionWithSignatures.kt:63 →
+Crypto.kt:621-624): verifies a block of signatures per grid step with the
+whole pipeline — point decompression, scalar ladder, inversion, canonical
+compare — resident in VMEM.
 
-Field math mirrors `fe25519` (radix-256 limbs, lazy carries, ×38 fold),
-transposed to limb-major. Curve/field constants ride in as a dedicated
-kernel input (pallas forbids captured array constants) shared by every
-grid block. Grid = batch blocks; each grid step verifies BLK signatures
-with zero HBM traffic between point operations.
+Two design choices set the op count (~2.6x fewer VPU ops than the v1
+radix-256 bit-serial kernel):
 
-STATUS: PRODUCTION at block=128 — `ed25519.ed25519_verify_batch` routes
-through this kernel on the TPU backend (measured 55.5k sigs/s on v5e,
-7.1x the fused-XLA core at batch 8192). Blocks of 256+ still SIGABRT the
-Mosaic compiler under the tunneled v5e toolchain (the kernel's live set —
-four extended-coordinate field elements plus the two precomputed addends
-and both bit planes — exceeds what Mosaic will window at wider lane
-tiles), so the block size is pinned at 128 and batches stream through the
-grid dimension instead.
+- **Radix-4096 field elements**: 22 little-endian 12-bit limbs in int32
+  lanes, limb-major ``(22, BLK)``. A 12×12-bit product is 24 bits and a
+  22-term schoolbook column stays under 2^31 for the lazy bounds below, so
+  multiplication is 22 shifted multiply-accumulates instead of 32 — and
+  every carry chain is 22 rows instead of 32. The 2^264 ≡ 9728 (mod p)
+  wrap is split as 9728 = 2·4096 + 1536 across limbs 0 and 1 so wrap
+  carries cannot overflow int32.
+
+- **Dual 4-bit-window Straus ladder**: 64 windows × (4 doubles + 2 table
+  adds) = 256 doubles + 128 adds, versus 256 doubles + 256 adds for the
+  bit-serial joint ladder. The fixed-base table (multiples 0..15 of B) is
+  a compile-time constant in precomputed ``(y−x, y+x, 2dt)`` form (7-mul
+  mixed adds); the variable-base table (multiples 0..15 of −A) is built
+  per block (15 point ops) and pre-transformed to ``(Y−X, Y+X, 2dT, 2Z)``
+  form (8-mul adds). Doubles that feed another double skip the T output
+  (dbl-2008-hwcd never reads T1): 7 muls instead of 8.
+
+Lazy-carry invariants (values congruent mod p, limbs bounded):
+  M  = mul/sub output:   limb0 ≤ 5631, limbs 1..21 ≤ 4116
+  A2 = add of two M:     limb0 ≤ 11262, rest ≤ 8232  (adds never carry)
+  A3 = add of M and A2:  carried one pass → limb0 ≤ 8703, rest ≤ 4100
+Schoolbook columns at these bounds stay ≤ 21·8232² + 11262² < 2^31; the
+first carry pass runs on the raw 44 columns (no wrap), then the split
+fold maps columns 22..43 down with ×1536/×2 terms bounded < 2^29.
+
+Validity is data, not control flow: invalid lanes compute garbage
+harmlessly and wrong-accept is impossible because the final compare is
+against exact canonical limbs (value < p, limbs < 4096).
+
+STATUS: production path for `ed25519.ed25519_verify_batch` on the TPU
+backend at block 128; batches stream through the grid dimension.
 """
 
 from __future__ import annotations
@@ -33,62 +51,116 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .ed25519 import _BT_L, _BX_L, _BY_L, _D2_L, _D_L, _SQRT_M1_L, P
-from .fe25519 import LIMBS, int_to_limbs
+from .ed25519 import _BX, _BY, _D, _SQRT_M1, P
 
-# ---------------------------------------------------------- host constants
-# one (10, 32) int32 matrix: limb constants the kernel needs, one per row
-_EIGHT_P = np.full(LIMBS, 1020, dtype=np.int32)
-_EIGHT_P[0] = 872
+LIMBS = 22
+RADIX = 12
+MASK = (1 << RADIX) - 1  # 4095
+# 2^264 ≡ 9728 (mod p); 9728 = 2·4096 + 1536 → wrap adds 1536·q to limb 0
+# and 2·q to limb 1 (exact split, each term < 2^31 for all bounded carries)
+_WRAP_LO = 1536
+_WRAP_HI = 2
 
-# padded to a clean (16, 128) int32 tile — odd-shaped VMEM blocks crash
-# or pessimize Mosaic's windowing
-_CONSTS_HOST = np.zeros((16, 128), dtype=np.int32)
-for _row, _vec in enumerate([
-    _EIGHT_P,                 # 0: 8p (for lazy subtraction)
-    _D_L,                     # 1: d
-    _D2_L,                    # 2: 2d
-    _SQRT_M1_L,               # 3: sqrt(-1)
-    _BX_L,                    # 4: base point x
-    _BY_L,                    # 5: base point y
-    _BT_L,                    # 6: base point t
-    int_to_limbs(P),          # 7: p (for canonical reduction)
-]):
-    _CONSTS_HOST[_row, :LIMBS] = _vec
+_D2 = (2 * _D) % P
 
-# square-and-multiply bit schedules (MSB-first), padded to 256
+# square-and-multiply exponents (compile-time unrolled)
 _SQRT_EXP = (P - 5) // 8
 _INV_EXP = P - 2
 
 
+def int_to_limbs12(x: int) -> np.ndarray:
+    """Python int → (22,) int32 radix-4096 limb vector (host-side)."""
+    return np.array(
+        [(x >> (RADIX * i)) & MASK for i in range(LIMBS)], dtype=np.int32
+    )
 
+
+def limbs12_to_int(limbs) -> int:
+    """(22,) limb vector → Python int (host-side, for tests)."""
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+# K2 = 1024·p expressed with every limb ≥ 14336 > any subtrahend limb under
+# the lazy bounds: start from the all-16380 vector (= 2^266 − 4 ≡ 38908),
+# subtract 38908 = 9·4096 + 2044 from limbs 0 and 1.
+_K2 = np.full(LIMBS, 16380, dtype=np.int32)
+_K2[0] = 16380 - 2044   # 14336
+_K2[1] = 16380 - 9      # 16371
+assert limbs12_to_int(_K2) % P == 0
+
+_P12 = int_to_limbs12(P)
+
+
+def _inv_host(x: int) -> int:
+    return pow(x, P - 2, P)
+
+
+def _affine_add(p1, p2):
+    """Host-side affine Edwards add over Python ints (for the B table)."""
+    x1, y1 = p1
+    x2, y2 = p2
+    dxy = _D * x1 * x2 % P * y1 % P * y2 % P
+    x3 = (x1 * y2 + x2 * y1) * _inv_host(1 + dxy) % P
+    y3 = (y1 * y2 + x1 * x2) * _inv_host((1 - dxy) % P) % P
+    return (x3, y3)
+
+
+def _b_table_host() -> list[tuple[int, int, int]]:
+    """(y−x, y+x, 2d·x·y) mod p for i·B, i = 0..15; i=0 is the identity."""
+    rows = []
+    pt = (0, 1)
+    for _ in range(16):
+        x, y = pt
+        rows.append(((y - x) % P, (y + x) % P, 2 * _D * x % P * y % P))
+        pt = _affine_add(pt, (_BX, _BY))
+    return rows
+
+
+# ------------------------------------------------- consts matrix (64, 128)
+# row 0: K2 (subtraction offset)    row 1: p    row 2: d    row 3: 2d
+# row 4: sqrt(-1)                   rows 8+3i..10+3i: B-table entry i
+_CONSTS_HOST = np.zeros((64, 128), dtype=np.int32)
+_CONSTS_HOST[0, :LIMBS] = _K2
+_CONSTS_HOST[1, :LIMBS] = _P12
+_CONSTS_HOST[2, :LIMBS] = int_to_limbs12(_D)
+_CONSTS_HOST[3, :LIMBS] = int_to_limbs12(_D2)
+_CONSTS_HOST[4, :LIMBS] = int_to_limbs12(_SQRT_M1)
+for _i, _row in enumerate(_b_table_host()):
+    for _c in range(3):
+        _CONSTS_HOST[8 + 3 * _i + _c, :LIMBS] = int_to_limbs12(_row[_c])
 
 
 @dataclasses.dataclass
 class Env:
-    """Per-block constants loaded from the consts input."""
+    """Per-block constants broadcast to (22, blk)."""
 
-    eight_p: jax.Array    # (32, blk)
-    p_limbs: jax.Array    # (32, blk)
-    d: jax.Array          # (32, blk)
+    k2: jax.Array        # subtraction offset (≡ 0 mod p)
+    p_limbs: jax.Array
+    d: jax.Array
     d2: jax.Array
     sqrt_m1: jax.Array
-    base: tuple
+    b_table: tuple       # 16 × (ymx, ypx, t2d) const planes
 
 
 # ------------------------------------------------- limb-major field ops
 
 def _one_hot_first(blk):
-    return jnp.concatenate([
-        jnp.ones((1, blk), jnp.int32), jnp.zeros((LIMBS - 1, blk), jnp.int32)
-    ], axis=0)
+    return jnp.concatenate(
+        [jnp.ones((1, blk), jnp.int32), jnp.zeros((LIMBS - 1, blk), jnp.int32)],
+        axis=0,
+    )
 
 
 def _carry_pass(c):
-    q = c >> 8
-    r = c - (q << 8)
-    wrap = 38 * q[LIMBS - 1:LIMBS, :]
-    return r + jnp.concatenate([wrap, q[:LIMBS - 1, :]], axis=0)
+    """One radix-4096 carry pass with the split 2^264 wrap."""
+    q = c >> RADIX
+    r = c - (q << RADIX)
+    top = q[LIMBS - 1 : LIMBS, :]
+    shifted = jnp.concatenate(
+        [_WRAP_LO * top, q[0:1, :] + _WRAP_HI * top, q[1 : LIMBS - 1, :]],
+        axis=0,
+    )
+    return r + shifted
 
 
 def _carry(c, passes):
@@ -98,15 +170,31 @@ def _carry(c, passes):
 
 
 def fe_mul(a, b):
+    """(22, blk) × (22, blk) → (22, blk) in the M bound.
+
+    Schoolbook into 44 columns (static pad-shifts: pallas TPU lowers
+    neither scatter nor dynamic_slice), one raw carry pass over all 44
+    columns, split fold of columns 22..43 (weight 2^264 ≡ 2·4096 + 1536),
+    then three wrap passes."""
     blk = a.shape[1]
-    c = jnp.zeros((2 * LIMBS - 1, blk), dtype=jnp.int32)
+    c = jnp.zeros((2 * LIMBS, blk), dtype=jnp.int32)
     for i in range(LIMBS):
-        # static pad-shift: pallas TPU lowers neither scatter nor
-        # dynamic_slice, so the shifted accumulate is a pad + add
-        c = c + jnp.pad(a[i:i + 1, :] * b, ((i, LIMBS - 1 - i), (0, 0)))
+        c = c + jnp.pad(a[i : i + 1, :] * b, ((i, LIMBS - i), (0, 0)))
+    # raw pass: no wrap, carry out of column k goes to column k+1 (column
+    # 43 starts at zero, so nothing is carried off the top)
+    q = c >> RADIX
+    r = c - (q << RADIX)
+    c = r + jnp.concatenate([jnp.zeros((1, blk), jnp.int32), q[:-1]], axis=0)
+    # split fold: column 22+j (j ≤ 20) has weight 2^(264+12j) ≡
+    # (1536 + 2·2^12)·2^(12j) → 1536·hi_j at limb j plus 2·hi_j at limb j+1;
+    # j = 21 wraps again: 2·2^264 ≡ 19456 = 4·4096 + 3072 → limbs 0 and 1
     lo, hi = c[:LIMBS], c[LIMBS:]
-    folded = lo + 38 * jnp.pad(hi, ((0, 1), (0, 0)))
-    return _carry(folded, 4)
+    top = hi[LIMBS - 1 :, :]
+    t2 = jnp.concatenate([3072 * top, _WRAP_HI * hi[: LIMBS - 1]], axis=0)
+    folded = lo + _WRAP_LO * hi + t2 + jnp.concatenate(
+        [jnp.zeros((1, blk), jnp.int32), 4 * top,
+         jnp.zeros((LIMBS - 2, blk), jnp.int32)], axis=0)
+    return _carry(folded, 3)
 
 
 def fe_sq(a):
@@ -114,11 +202,18 @@ def fe_sq(a):
 
 
 def fe_add(a, b):
-    return _carry(a + b, 2)
+    """Lazy add: no carry (sum of two M-bounded values stays in-bounds)."""
+    return a + b
 
 
 def fe_sub(env, a, b):
-    return _carry(a - b + env.eight_p, 3)
+    """a − b + K2 (≡ a − b mod p), two carry passes → M bound."""
+    return _carry(a - b + env.k2, 2)
+
+
+def fe_carry1(c):
+    """One pass for A3-bounded adds that feed a multiply."""
+    return _carry_pass(c)
 
 
 def fe_neg(env, a):
@@ -126,14 +221,13 @@ def fe_neg(env, a):
 
 
 def fe_mul_small(a, k):
-    return _carry(a * np.int32(k), 3)
+    """×2 only (lazy: doubles the bound, callers track it)."""
+    return a * np.int32(k)
 
 
 def fe_pow_const(a, exponent: int):
-    """a^e for a COMPILE-TIME exponent: square-and-multiply unrolled in
-    Python — no bit lookups at run time, so nothing needs the dynamic
-    indexing Mosaic restricts. The sqrt/inversion exponents are fixed
-    field constants, so the unroll happens exactly twice per kernel."""
+    """a^e for a compile-time exponent, square-and-multiply unrolled in
+    Python (no dynamic indexing — Mosaic restriction)."""
     n = exponent.bit_length()
     r = None
     for i in range(n):
@@ -146,27 +240,45 @@ def fe_pow_const(a, exponent: int):
 
 
 def fe_canonical(env, a):
-    # statically-unrolled carry/borrow chains (32 steps each): sequential
-    # over limbs but vectorized over lanes, pallas-lowerable as-is
+    """Exact reduction: limbs in [0, 4095], value in [0, p).
+
+    Statically-unrolled carry chains (sequential over 22 limbs, vector over
+    lanes). A lazy 22-limb value spans up to ~2^265 ≈ 1024p, so after the
+    carry rounds the bits ≥ 2^255 are folded down twice (2^255 ≡ 19), then
+    at most one conditional subtract of p is needed (value < p + 38)."""
+
+    blk = a.shape[1]
+
     def exact_carry(c):
         rows = []
         carry = jnp.zeros_like(c[0:1, :])
         for i in range(LIMBS):
-            v = c[i:i + 1, :] + carry
-            rows.append(v & 255)
-            carry = v >> 8
+            v = c[i : i + 1, :] + carry
+            rows.append(v & MASK)
+            carry = v >> RADIX
         out = jnp.concatenate(rows, axis=0)
-        return out + jnp.pad(38 * carry, ((0, LIMBS - 1), (0, 0)))
+        # 2^264 wrap of the top carry (carry is small here: ≤ a few)
+        return out + jnp.concatenate(
+            [_WRAP_LO * carry, _WRAP_HI * carry,
+             jnp.zeros((LIMBS - 2, blk), jnp.int32)], axis=0)
+
+    def fold_255(c):
+        # bits 255.. live in limb 21 >> 3; 2^255 ≡ 19
+        t = c[LIMBS - 1 :, :] >> 3
+        return jnp.concatenate(
+            [c[0:1, :] + 19 * t, c[1 : LIMBS - 1, :], c[LIMBS - 1 :, :] & 7],
+            axis=0)
 
     c = exact_carry(exact_carry(a))
-    c = exact_carry(c)
+    c = exact_carry(fold_255(c))
+    c = exact_carry(fold_255(c))
 
     def sub_p(v):
         rows = []
         borrow = jnp.zeros_like(v[0:1, :])
         for i in range(LIMBS):
-            d = v[i:i + 1, :] - env.p_limbs[i:i + 1, :] - borrow
-            rows.append(d & 255)
+            d = v[i : i + 1, :] - env.p_limbs[i : i + 1, :] - borrow
+            rows.append(d & MASK)
             borrow = (d < 0).astype(jnp.int32)
         diff = jnp.concatenate(rows, axis=0)
         return jnp.where(borrow == 0, diff, v)
@@ -183,6 +295,8 @@ def fe_is_odd(env, a):
 
 
 # --------------------------------------------------- limb-major points
+# Extended twisted-Edwards (X:Y:Z:T); unified add-2008-hwcd-3 (complete
+# for ed25519, identity included — validity never branches).
 
 def identity_point(blk):
     zero = jnp.zeros((LIMBS, blk), dtype=jnp.int32)
@@ -190,29 +304,73 @@ def identity_point(blk):
     return (zero, one, one, zero)
 
 
+def point_double(env, p, want_t: bool = True):
+    """dbl-2008-hwcd; never reads p's T, and T3 is skipped when the next
+    operation is another double (saves one mul)."""
+    px, py, pz, _ = p
+    a = fe_sq(px)
+    b = fe_sq(py)
+    c = fe_mul_small(fe_sq(pz), 2)          # A2 bound
+    h = fe_add(a, b)                        # A2
+    e = fe_sub(env, h, fe_sq(fe_add(px, py)))
+    g = fe_sub(env, a, b)
+    f = fe_carry1(fe_add(c, g))             # A3 → one pass
+    t = fe_mul(e, h) if want_t else p[3]
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), t)
+
+
 def point_add(env, p, q):
+    """Generic unified add (9 muls), q in plain (X,Y,Z,T) coords."""
     px, py, pz, pt = p
     qx, qy, qz, qt = q
     a = fe_mul(fe_sub(env, py, px), fe_sub(env, qy, qx))
     bb = fe_mul(fe_add(py, px), fe_add(qy, qx))
     c = fe_mul(fe_mul(pt, env.d2), qt)
-    d = fe_mul_small(fe_mul(pz, qz), 2)
+    d = fe_mul_small(fe_mul(pz, qz), 2)     # A2
     e = fe_sub(env, bb, a)
     f = fe_sub(env, d, c)
-    g = fe_add(d, c)
+    g = fe_carry1(fe_add(d, c))             # A3 → one pass
+    h = fe_add(bb, a)                       # A2
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def to_planes(env, p, z_doubled: bool = True):
+    """(X,Y,Z,T) → (Y−X, Y+X, 2dT, 2Z) for repeated use as an addend."""
+    px, py, pz, pt = p
+    return (
+        fe_sub(env, py, px),
+        fe_add(py, px),
+        fe_mul(pt, env.d2),
+        fe_mul_small(pz, 2),
+    )
+
+
+def _add_q_planes(env, p, planes):
+    ymx, ypx, t2d, z2 = planes
+    px, py, pz, pt = p
+    a = fe_mul(fe_sub(env, py, px), ymx)
+    bb = fe_mul(fe_add(py, px), ypx)
+    c = fe_mul(pt, t2d)
+    d = fe_mul(pz, z2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_carry1(fe_add(d, c))
     h = fe_add(bb, a)
     return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
-def point_double(env, p):
+def _add_b_entry(env, p, entry):
+    """Mixed add of a constant affine B-table entry (7 muls)."""
+    ymx, ypx, t2d = entry
     px, py, pz, pt = p
-    a = fe_sq(px)
-    b = fe_sq(py)
-    c = fe_mul_small(fe_sq(pz), 2)
-    h = fe_add(a, b)
-    e = fe_sub(env, h, fe_sq(fe_add(px, py)))
-    g = fe_sub(env, a, b)
-    f = fe_add(c, g)
+    a = fe_mul(fe_sub(env, py, px), ymx)
+    bb = fe_mul(fe_add(py, px), ypx)
+    c = fe_mul(pt, t2d)
+    d = fe_mul_small(pz, 2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_carry1(fe_add(d, c))
+    h = fe_add(bb, a)
     return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
 
 
@@ -221,16 +379,32 @@ def point_neg(env, p):
     return (fe_neg(env, px), py, pz, fe_neg(env, pt))
 
 
-def point_select(mask_row, p, q):
-    m = mask_row[None, :]
-    return tuple(jnp.where(m, a, b) for a, b in zip(p, q))
+def _select16(idx_row, entries):
+    """Branch-free 16-way select: binary tree of wheres on idx bits.
+
+    entries: list of 16 tuples of (22, blk) planes; idx_row: (blk,) int32
+    in [0, 16). Select cost (~15 wheres per plane) is ~7% of one mul —
+    negligible next to the table add it feeds."""
+    level = entries
+    for bit in range(4):
+        b_mask = ((idx_row >> bit) & 1) == 1
+        level = [
+            tuple(
+                jnp.where(b_mask[None, :], hi_p, lo_p)
+                for lo_p, hi_p in zip(lo, hi)
+            )
+            for lo, hi in zip(level[0::2], level[1::2])
+        ]
+    return level[0]
 
 
 def decompress(env, y, sign_row):
+    """RFC 8032 §5.1.3: y limbs (< p, host-checked) + parity bit →
+    (Point, ok-mask); off-curve lanes flagged and carry harmless garbage."""
     one = _one_hot_first(y.shape[1])
     y2 = fe_sq(y)
     u = fe_sub(env, y2, one)
-    v = fe_add(fe_mul(env.d, y2), one)
+    v = fe_carry1(fe_add(fe_mul(env.d, y2), one))
     v3 = fe_mul(fe_sq(v), v)
     v7 = fe_mul(fe_sq(v3), v)
     x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), _SQRT_EXP))
@@ -245,107 +419,169 @@ def decompress(env, y, sign_row):
     return (x, y, one, fe_mul(x, y)), ok
 
 
-def compress(env, p):
+def compress_y_parity(env, p):
+    """Point → (canonical y limbs, x parity): the comparable form of the
+    32-byte encoding without materializing bytes."""
     px, py, pz, _ = p
     zinv = fe_pow_const(pz, _INV_EXP)
     x = fe_canonical(env, fe_mul(px, zinv))
     y = fe_canonical(env, fe_mul(py, zinv))
-    sign_byte = y[LIMBS - 1:, :] + (((x[0:1, :] & 1) << 7))
-    return jnp.concatenate([y[:LIMBS - 1, :], sign_byte], axis=0)
+    return y, x[0, :] & 1
 
 
 # ------------------------------------------------------------- kernel
 
-def _verify_kernel(consts_ref, a_y_ref, a_sign_ref, r_ref,
-                   s_bits_ref, h_bits_ref, pre_ref, out_ref):
+def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
+                   sign_ref, pre_ref, out_ref):
     from jax.experimental import pallas as pl
 
     blk = a_y_ref.shape[1]
-    consts = consts_ref[:, :]          # (16, 128); row r cols 0:32 = limbs
+    consts = consts_ref[:, :]
 
     def cfull(i):
-        # full-lane broadcast: size-1 lane dims trip Mosaic's windowing
         return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
 
     env = Env(
-        eight_p=cfull(0), p_limbs=cfull(7),
-        d=cfull(1), d2=cfull(2), sqrt_m1=cfull(3),
-        base=(cfull(4), cfull(5), _one_hot_first(blk), cfull(6)),
+        k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+        sqrt_m1=cfull(4),
+        b_table=tuple(
+            (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+            for i in range(16)
+        ),
     )
 
-    a_pt, a_ok = decompress(env, a_y_ref[:, :], a_sign_ref[0, :])  # row 0 of the 8-row pad
-    minus_a = point_neg(env, a_pt)
-    t_both = point_add(env, env.base, minus_a)
-    ident = identity_point(blk)
+    a_y = a_y_ref[:, :][:LIMBS]
+    r12 = r_ref[:, :][:LIMBS]
+    sign_row = sign_ref[0, :]
 
-    def chunk_body(j, acc):
-        # dynamic sublane offsets must be 8-aligned: walk the 256 bit rows
-        # MSB-first in chunks of 8, unrolling the chunk statically
-        base_row = 8 * (31 - j)
-        s_chunk = s_bits_ref[pl.ds(base_row, 8), :]   # (8, blk)
-        h_chunk = h_bits_ref[pl.ds(base_row, 8), :]
+    a_pt, a_ok = decompress(env, a_y, sign_row)
+    minus_a = point_neg(env, a_pt)
+
+    # per-lane table: k·(−A) for k = 0..15, in (Y−X, Y+X, 2dT, 2Z) form
+    pts = [identity_point(blk), minus_a]
+    for k in range(2, 16):
+        if k % 2 == 0:
+            pts.append(point_double(env, pts[k // 2]))
+        else:
+            pts.append(point_add(env, pts[k - 1], minus_a))
+    a_table = [to_planes(env, pt) for pt in pts]
+
+    def chunk_body(cj, acc):
+        # dynamic sublane offsets must be 8-aligned: read 8 window rows at
+        # a time (MSB-first: chunk cj covers windows 63−8·cj … 56−8·cj)
+        base_row = 56 - 8 * cj
+        s_rows = s_win_ref[pl.ds(base_row, 8), :]   # (8, blk)
+        h_rows = h_win_ref[pl.ds(base_row, 8), :]
         for k in range(7, -1, -1):
-            acc = point_double(env, acc)
-            sb = s_chunk[k, :]
-            hb = h_chunk[k, :]
-            addend = point_select(
-                (sb == 1) & (hb == 1), t_both,
-                point_select(
-                    sb == 1, env.base,
-                    point_select(hb == 1, minus_a, ident)
-                ),
-            )
-            acc = point_add(env, acc, addend)
+            for i in range(4):
+                acc = point_double(env, acc, want_t=(i == 3))
+            acc = _add_b_entry(env, acc, _select16(s_rows[k, :], env.b_table))
+            acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
         return acc
 
-    result = jax.lax.fori_loop(0, 32, chunk_body, identity_point(blk))
-    encoded = compress(env, result)
-    match = jnp.all(encoded == r_ref[:, :], axis=0)
+    result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
+    enc_y, enc_parity = compress_y_parity(env, result)
+
+    r_y = jnp.concatenate([r12[: LIMBS - 1], r12[LIMBS - 1 :] & 7], axis=0)
+    r_sign = (r12[LIMBS - 1, :] >> 3) & 1
+    match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
     verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
-    # output block is 8 sublanes (1-row vector blocks crash Mosaic's
-    # windowing); every row carries the verdict, caller reads row 0
-    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, verdict.shape[0]))
+    # 8-sublane output block (1-row vector blocks crash Mosaic windowing)
+    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block"))
-def ed25519_verify_pallas(
-    a_y_t: jax.Array,      # (32, B) pubkey y limbs, limb-major
-    a_sign: jax.Array,     # (1, B)
-    r_t: jax.Array,        # (32, B) R bytes, limb-major
-    s_bits_t: jax.Array,   # (256, B)
-    h_bits_t: jax.Array,   # (256, B)
-    precheck: jax.Array,   # (1, B) int32
+# ------------------------------------------------------- device-side prep
+
+def bytes_to_limb12_t(x_bytes: jax.Array) -> jax.Array:
+    """(B, 32) uint8 → (24, B) int32 radix-4096 limbs (rows 22, 23 zero).
+
+    Pure jnp (runs on any backend, differentially tested on CPU); on TPU it
+    fuses into the same jit as the kernel launch so the host still ships
+    compact byte planes."""
+    xb = x_bytes.astype(jnp.int32)
+    rows = []
+    for k in range(LIMBS):
+        if k == LIMBS - 1:
+            rows.append(xb[:, 31] >> 4)
+        elif k % 2 == 0:
+            j = 3 * k // 2
+            rows.append(xb[:, j] | ((xb[:, j + 1] & 0xF) << 8))
+        else:
+            j = (3 * k - 1) // 2
+            rows.append((xb[:, j] >> 4) | (xb[:, j + 1] << 4))
+    limbs = jnp.stack(rows, axis=0)
+    return jnp.pad(limbs, ((0, 24 - LIMBS), (0, 0)))
+
+
+def bytes_to_windows_t(x_bytes: jax.Array) -> jax.Array:
+    """(B, 32) uint8 scalar bytes → (64, B) int32 4-bit windows, window k =
+    bits 4k..4k+3 (little-endian)."""
+    xb = x_bytes.astype(jnp.int32)
+    lo = xb & 0xF
+    hi = xb >> 4
+    inter = jnp.stack([lo, hi], axis=2).reshape(xb.shape[0], 64)
+    return inter.T
+
+
+def _pad8(v: jax.Array) -> jax.Array:
+    return jnp.broadcast_to(v.astype(jnp.int32)[None, :], (8, v.shape[0]))
+
+
+def verify_pallas_windows(
+    y_bytes: jax.Array,    # (B, 32) uint8 pubkey y bytes (top bit cleared)
+    r_bytes: jax.Array,    # (B, 32) uint8 signature R
+    s_bytes: jax.Array,    # (B, 32) uint8 scalar s (host-checked < L)
+    h_win_t: jax.Array,    # (64, B) int32 challenge windows (mod L)
+    sign: jax.Array,       # (B,) int32 pubkey x-parity bit
+    precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
     block: int = 128,
 ) -> jax.Array:
+    """Launch the kernel with the challenge already in window form (the
+    fused on-device SHA-512→mod-L path lands here)."""
     from jax.experimental import pallas as pl
 
-    b = a_y_t.shape[1]
+    b = y_bytes.shape[0]
     assert b % block == 0, (b, block)
-    assert a_sign.shape[0] == 8 and precheck.shape[0] == 8, (
-        "pass sign/precheck padded to 8 rows (row 0 = data)"
-    )
     grid = (b // block,)
+
+    a_y_t = bytes_to_limb12_t(y_bytes)
+    r_t = bytes_to_limb12_t(r_bytes)
+    s_win_t = bytes_to_windows_t(s_bytes)
 
     def col_spec(rows):
         return pl.BlockSpec((rows, block), lambda i: (0, i))
-
-    def const_spec(shape):
-        return pl.BlockSpec(shape, lambda i: (0, 0))
 
     mask = pl.pallas_call(
         _verify_kernel,
         out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
         grid=grid,
         in_specs=[
-            const_spec(_CONSTS_HOST.shape),
-            col_spec(LIMBS), col_spec(8), col_spec(LIMBS),
-            col_spec(256), col_spec(256), col_spec(8),
+            pl.BlockSpec(_CONSTS_HOST.shape, lambda i: (0, 0)),
+            col_spec(24), col_spec(24), col_spec(64), col_spec(64),
+            col_spec(8), col_spec(8),
         ],
         out_specs=col_spec(8),
         interpret=interpret,
     )(
         jnp.asarray(_CONSTS_HOST),
-        a_y_t, a_sign, r_t, s_bits_t, h_bits_t, precheck,
+        a_y_t, r_t, s_win_t, h_win_t, _pad8(sign), _pad8(precheck),
     )
     return mask[0] != 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+def ed25519_verify_pallas(
+    y_bytes: jax.Array,    # (B, 32) uint8 pubkey y bytes (top bit cleared)
+    r_bytes: jax.Array,    # (B, 32) uint8 signature R
+    s_bytes: jax.Array,    # (B, 32) uint8 scalar s (host-checked < L)
+    h_bytes: jax.Array,    # (B, 32) uint8 challenge h = SHA512(R‖A‖M) mod L
+    sign: jax.Array,       # (B,) int32 pubkey x-parity bit
+    precheck: jax.Array,   # (B,) bool host-side validity
+    interpret: bool = False,
+    block: int = 128,
+) -> jax.Array:
+    return verify_pallas_windows(
+        y_bytes, r_bytes, s_bytes, bytes_to_windows_t(h_bytes),
+        sign, precheck, interpret=interpret, block=block,
+    )

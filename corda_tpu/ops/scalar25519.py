@@ -1,0 +1,130 @@
+"""Batched mod-L scalar reduction for the ed25519 verify path, on device.
+
+The challenge scalar h = SHA-512(R ‖ A ‖ M) is a 512-bit value that every
+verify path reduces mod L = 2^252 + 27742…493 (the group order) before the
+ladder. The v1 pipeline did this reduction per-lane on the host with CPython
+bigints — at ~260k sigs/s device throughput that Python loop became the
+pipeline bottleneck — so it now runs as batched Barrett reduction in jnp,
+fused into the same jit as the SHA-512 compress and the pallas launch.
+
+Layouts match the verify kernel: radix-4096 (12-bit) limbs in int32 lanes,
+limb-major ``(n, B)``. All products are exact (12×12-bit into ≤22-term
+columns stays under 2^31); carry/borrow chains are ``lax.scan``s over the
+limb axis.
+
+Barrett: with m = ⌊2^516 / L⌋ (264 bits), q̂ = ⌊h·m / 2^516⌋ ∈ {q−2, …, q},
+so r = h − q̂·L < 3L needs at most two conditional subtracts of L.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+L = 2**252 + 27742317777372353535851937790883648493
+RADIX = 12
+MASK = (1 << RADIX) - 1
+
+_L_LIMBS = np.array(
+    [(L >> (RADIX * i)) & MASK for i in range(22)], dtype=np.int32
+)
+_M516 = (1 << 516) // L  # 264 bits → 22 limbs
+_M_LIMBS = np.array(
+    [(_M516 >> (RADIX * i)) & MASK for i in range(22)], dtype=np.int32
+)
+
+
+def _exact_limbs(cols: jax.Array, out_rows: int) -> jax.Array:
+    """(n, B) column sums → (out_rows, B) exact radix-4096 limbs."""
+    n = cols.shape[0]
+    if out_rows > n:
+        cols = jnp.pad(cols, ((0, out_rows - n), (0, 0)))
+
+    def step(carry, col):
+        v = col + carry
+        return v >> RADIX, v & MASK
+
+    carry, limbs = jax.lax.scan(
+        step, jnp.zeros_like(cols[0]), cols[:out_rows]
+    )
+    return limbs
+
+
+def _mp_mul_const(a: jax.Array, const_limbs: np.ndarray, out_rows: int):
+    """Exact product of (na, B) limbs with a constant limb vector."""
+    na = a.shape[0]
+    nc = len(const_limbs)
+    cols = jnp.zeros((na + nc, a.shape[1]), dtype=jnp.int32)
+    for i in range(nc):
+        c = int(const_limbs[i])
+        if c:
+            cols = cols + jnp.pad(c * a, ((i, nc - i), (0, 0)))
+    return _exact_limbs(cols, out_rows)
+
+
+def _mp_sub(a: jax.Array, b: jax.Array):
+    """(n, B) − (n, B) with borrow chain → (limbs, final_borrow_row)."""
+
+    def step(borrow, ab):
+        x, y = ab
+        d = x - y - borrow
+        return (d < 0).astype(jnp.int32), d & MASK
+
+    borrow, limbs = jax.lax.scan(
+        step, jnp.zeros_like(a[0]), (a, b)
+    )
+    return limbs, borrow
+
+
+def digest_words_to_limbs(digest: jax.Array) -> jax.Array:
+    """SHA-512 state words (B, 16) uint32 (big-endian hi/lo 64-bit pairs)
+    → (43, B) int32 limbs of the digest read as a little-endian 512-bit
+    integer (RFC 8032's convention for the challenge)."""
+    bytes_le = []
+    for i in range(8):  # 64-bit word i = digest bytes 8i..8i+7 big-endian
+        hi = digest[:, 2 * i]
+        lo = digest[:, 2 * i + 1]
+        for k in range(8):
+            src = hi if k < 4 else lo
+            shift = 24 - 8 * (k % 4)
+            bytes_le.append(((src >> shift) & 0xFF).astype(jnp.int32))
+    # bytes_le[j] = digest byte j; value = Σ byte[j]·2^(8j)
+    rows = []
+    for k in range(43):
+        if k == 42:
+            rows.append(bytes_le[63])  # top limb: 8 bits
+        elif k % 2 == 0:
+            j = 3 * k // 2
+            rows.append(bytes_le[j] | ((bytes_le[j + 1] & 0xF) << 8))
+        else:
+            j = (3 * k - 1) // 2
+            rows.append((bytes_le[j] >> 4) | (bytes_le[j + 1] << 4))
+    return jnp.stack(rows, axis=0)
+
+
+def mod_l(h_limbs: jax.Array) -> jax.Array:
+    """(43, B) limbs of a 512-bit value → (22, B) limbs of value mod L."""
+    b = h_limbs.shape[1]
+    q_hat = _mp_mul_const(h_limbs, _M_LIMBS, 66)[43:65]      # (22, B)
+    ql = _mp_mul_const(q_hat, _L_LIMBS, 45)                  # (45, B)
+    h45 = jnp.pad(h_limbs, ((0, 2), (0, 0)))
+    r, _ = _mp_sub(h45, ql)                                  # < 3L
+    r = r[:22]
+    l_col = jnp.broadcast_to(jnp.asarray(_L_LIMBS)[:, None], (22, b))
+    for _ in range(2):
+        diff, borrow = _mp_sub(r, l_col)
+        r = jnp.where(borrow == 0, diff, r)
+    return r
+
+
+def limbs_to_windows(r: jax.Array) -> jax.Array:
+    """(22, B) reduced limbs → (64, B) 4-bit windows, window k = bits
+    4k..4k+3 (the verify kernel's ladder operand form)."""
+    w = jnp.stack([r & 0xF, (r >> 4) & 0xF, r >> 8], axis=1)  # (22, 3, B)
+    return w.reshape(66, r.shape[1])[:64]
+
+
+def challenge_windows(digest: jax.Array) -> jax.Array:
+    """SHA-512 digest words → h mod L as ladder windows, all on device."""
+    return limbs_to_windows(mod_l(digest_words_to_limbs(digest)))

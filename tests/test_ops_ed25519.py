@@ -55,6 +55,12 @@ class TestValid:
     def test_empty_batch(self):
         assert ed25519_verify_batch([], [], []).shape == (0,)
 
+    def test_empty_batch_dispatch(self):
+        """Queue drain of an empty batch is a normal service event."""
+        from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+
+        assert np.asarray(ed25519_verify_dispatch([], [], [])).shape == (0,)
+
     def test_fixed_bucket(self):
         pks, sigs, msgs = _gen(4, seed=2, msglen=(10, 40))
         mask = ed25519_verify_batch(pks, sigs, msgs)
@@ -145,13 +151,14 @@ class TestDifferential:
 class TestPallasPath:
     """Coverage for the TPU pallas production path's components.
 
-    The full kernel needs a real TPU (interpret mode hits the XLA:CPU
-    pathological compile the einsum fe_mul form exists to avoid), so the
-    CPU tier differentially tests each piece the pallas path adds on top
-    of the already-tested XLA core: the byte→limb-major operand glue and
-    the limb-major transposition of the field/point arithmetic. The full
-    ladder runs under the TPU-gated test below, bench.py, and
-    __graft_entry__.py on the driver's real chip.
+    The full pallas_call needs a real TPU, so the CPU tier differentially
+    tests every piece the pallas path adds on top of the already-tested
+    XLA core: the byte→radix-4096 repack, the 4-bit window extraction,
+    the limb-major field/point arithmetic at the kernel's lazy bounds,
+    the constant B table, the 16-way select tree, and (driven step by
+    step from Python, eager mode) the full dual-window Straus ladder.
+    The compiled kernel itself runs under the TPU-gated test below,
+    bench.py, and __graft_entry__.py on the driver's real chip.
     """
 
     def _operand_fixture(self, b=8, seed=3):
@@ -172,26 +179,6 @@ class TestPallasPath:
             h[i] = np.frombuffer(hi.to_bytes(32, "little"), np.uint8)
         return y, sig_arr[:, :32], sig_arr[:, 32:], h, sign, np.ones(b, bool)
 
-    def test_limb_major_operand_glue(self):
-        """Bit order, transposes, and 8-row pads vs a numpy reference."""
-        from corda_tpu.ops.ed25519 import limb_major_operands
-
-        y, r, s, h, sign, pre = self._operand_fixture()
-        a_y_t, sign8, r_t, s_bits_t, h_bits_t, pre8 = (
-            np.asarray(x) for x in limb_major_operands(
-                *(np.asarray(v) for v in (y, r, s, h, sign, pre))
-            )
-        )
-        assert (a_y_t == y.astype(np.int32).T).all()
-        assert (r_t == r.astype(np.int32).T).all()
-        bit_idx = np.arange(8, dtype=np.uint8)
-        want_s = ((s[:, :, None] >> bit_idx) & 1).reshape(8, 256).T
-        want_h = ((h[:, :, None] >> bit_idx) & 1).reshape(8, 256).T
-        assert (s_bits_t == want_s).all()
-        assert (h_bits_t == want_h).all()
-        assert sign8.shape == (8, 8) and (sign8 == sign[None, :]).all()
-        assert pre8.shape == (8, 8) and (pre8 == 1).all()
-
     def _env(self, b):
         import jax.numpy as jnp
 
@@ -199,46 +186,93 @@ class TestPallasPath:
 
         def cfull(row):
             return jnp.broadcast_to(
-                jnp.asarray(edp._CONSTS_HOST[row, :32])[:, None], (32, b)
+                jnp.asarray(edp._CONSTS_HOST[row, : edp.LIMBS])[:, None],
+                (edp.LIMBS, b),
             )
 
         return edp.Env(
-            eight_p=cfull(0), p_limbs=cfull(7), d=cfull(1), d2=cfull(2),
-            sqrt_m1=cfull(3),
-            base=(cfull(4), cfull(5), edp._one_hot_first(b), cfull(6)),
+            k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+            sqrt_m1=cfull(4),
+            b_table=tuple(
+                (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+                for i in range(16)
+            ),
         )
 
-    def test_limb_major_field_ops_differential(self):
-        """Limb-major fe ops (the kernel's math) vs batch-major fe25519."""
-        import jax.numpy as jnp
-
+    def test_repack_and_windows(self):
+        """Byte→limb12 repack and 4-bit window extraction vs Python ints."""
         from corda_tpu.ops import ed25519_pallas as edp
-        from corda_tpu.ops import fe25519 as fe
+
+        y, r, s, h, sign, pre = self._operand_fixture()
+        limbs = np.asarray(edp.bytes_to_limb12_t(np.asarray(y)))
+        assert limbs.shape == (24, 8) and (limbs[22:] == 0).all()
+        for i in range(8):
+            want = int.from_bytes(y[i].tobytes(), "little")
+            assert edp.limbs12_to_int(limbs[:22, i]) == want
+        wins = np.asarray(edp.bytes_to_windows_t(np.asarray(s)))
+        assert wins.shape == (64, 8)
+        for i in range(8):
+            v = int.from_bytes(s[i].tobytes(), "little")
+            for k in range(64):
+                assert wins[k, i] == (v >> (4 * k)) & 0xF
+
+    def test_b_table_on_curve(self):
+        """Constant B-table entries are i·B in (y−x, y+x, 2dxy) form."""
+        from corda_tpu.ops import ed25519_pallas as edp
+        from corda_tpu.ops.ed25519 import _BX, _BY, _D, P
+
+        inv2 = pow(2, P - 2, P)
+        x, y = 0, 1
+        for i, (ymx, ypx, t2d) in enumerate(edp._b_table_host()):
+            assert ymx == (y - x) % P and ypx == (y + x) % P
+            assert t2d == 2 * _D * x * y % P
+            # on-curve: −x² + y² = 1 + d·x²·y²
+            assert (-x * x + y * y) % P == (1 + _D * x * x * y * y) % P
+            # advance to (i+1)·B
+            x, y = edp._affine_add((x, y), (_BX, _BY))
+
+    def test_limb12_field_ops_differential(self):
+        """Radix-4096 fe ops vs Python-int arithmetic, including at the
+        lazy (non-canonical) bounds the kernel actually feeds them."""
+        from corda_tpu.ops import ed25519_pallas as edp
+        from corda_tpu.ops.ed25519 import P
 
         rng = np.random.default_rng(7)
         b = 8
         a_int = [int.from_bytes(rng.bytes(31), "little") for _ in range(b)]
         b_int = [int.from_bytes(rng.bytes(31), "little") for _ in range(b)]
-        a_bm = jnp.stack([jnp.asarray(fe.int_to_limbs(x)) for x in a_int])
-        b_bm = jnp.stack([jnp.asarray(fe.int_to_limbs(x)) for x in b_int])
+        a_t = np.stack([edp.int_to_limbs12(x) for x in a_int]).T
+        b_t = np.stack([edp.int_to_limbs12(x) for x in b_int]).T
         env = self._env(b)
 
-        cases = {
-            "mul": (edp.fe_mul(a_bm.T, b_bm.T), [
-                (x * y) % fe.P for x, y in zip(a_int, b_int)]),
-            "sq": (edp.fe_sq(a_bm.T), [(x * x) % fe.P for x in a_int]),
-            "sub": (edp.fe_sub(env, a_bm.T, b_bm.T), [
-                (x - y) % fe.P for x, y in zip(a_int, b_int)]),
-            "add": (edp.fe_add(a_bm.T, b_bm.T), [
-                (x + y) % fe.P for x, y in zip(a_int, b_int)]),
-        }
-        for name, (got_t, want) in cases.items():
+        def vals(got_t):
             got = np.asarray(got_t).T
-            vals = [fe.limbs_to_int(got[i]) % fe.P for i in range(b)]
-            assert vals == want, name
+            return [edp.limbs12_to_int(got[i]) % P for i in range(b)]
 
-    def test_limb_major_point_ops_differential(self):
-        """Kernel point add/double/decompress vs the batch-major XLA core."""
+        assert vals(edp.fe_mul(a_t, b_t)) == [
+            (x * y) % P for x, y in zip(a_int, b_int)]
+        assert vals(edp.fe_sq(a_t)) == [(x * x) % P for x in a_int]
+        assert vals(edp.fe_sub(env, a_t, b_t)) == [
+            (x - y) % P for x, y in zip(a_int, b_int)]
+        assert vals(edp.fe_add(a_t, b_t)) == [
+            (x + y) % P for x, y in zip(a_int, b_int)]
+        assert vals(edp.fe_canonical(env, a_t)) == [x % P for x in a_int]
+
+        # lazy-bound stress: A2-bounded operands (limb0 ≤ 11262, rest ≤
+        # 8232) through mul, the worst-case the point formulas produce
+        lazy = np.full((22, b), 8232, dtype=np.int32)
+        lazy[0] = 11262
+        lazy_int = edp.limbs12_to_int(lazy[:, 0])
+        assert vals(edp.fe_mul(lazy, lazy)) == [lazy_int * lazy_int % P] * b
+        assert vals(edp.fe_mul(lazy, b_t)) == [
+            lazy_int * y % P for y in b_int]
+        assert vals(edp.fe_canonical(env, lazy)) == [lazy_int % P] * b
+        g = edp.fe_carry1(edp.fe_add(lazy, np.asarray(a_t)))
+        assert np.asarray(g).max() <= 8703
+        assert vals(g) == [(lazy_int + x) % P for x in a_int]
+
+    def test_limb12_point_ops_differential(self):
+        """Kernel point ops vs the batch-major XLA core."""
         import jax.numpy as jnp
 
         from corda_tpu.ops import ed25519 as ed
@@ -248,39 +282,220 @@ class TestPallasPath:
         y, r, s, h, sign, pre = self._operand_fixture(b)
         env = self._env(b)
 
-        # decompress the same pubkeys both ways
         y_bm = jnp.asarray(y.astype(np.int32))
         pt_bm, ok_bm = ed.decompress(y_bm, jnp.asarray(sign))
-        pt_lm, ok_lm = edp.decompress(env, y_bm.T, jnp.asarray(sign))
+        y12 = edp.bytes_to_limb12_t(np.asarray(y))[: edp.LIMBS]
+        pt_lm, ok_lm = edp.decompress(env, y12, jnp.asarray(sign))
         assert (np.asarray(ok_lm) == np.asarray(ok_bm)).all()
 
         def canon_bm(p):
-            return np.asarray(ed.compress(p))
+            """XLA-core point → list of (y_int, parity)."""
+            enc = np.asarray(ed.compress(p))
+            out = []
+            for i in range(b):
+                by = bytes(int(v) for v in enc[i])
+                v = int.from_bytes(by, "little")
+                out.append((v & ((1 << 255) - 1), v >> 255))
+            return out
 
         def canon_lm(p):
-            return np.asarray(edp.compress(env, p)).T
+            ey, par = edp.compress_y_parity(env, p)
+            ey = np.asarray(ey)
+            par = np.asarray(par)
+            return [
+                (edp.limbs12_to_int(ey[:, i]), int(par[i])) for i in range(b)
+            ]
 
-        assert (canon_lm(pt_lm) == canon_bm(pt_bm)).all()
+        assert canon_lm(pt_lm) == canon_bm(pt_bm)
 
-        # add and double agree after canonicalization
         dbl_bm = ed.point_double(pt_bm)
         dbl_lm = edp.point_double(env, pt_lm)
-        assert (canon_lm(dbl_lm) == canon_bm(dbl_bm)).all()
+        assert canon_lm(dbl_lm) == canon_bm(dbl_bm)
 
-        base_bm = ed.base_point(b)
-        sum_bm = ed.point_add(dbl_bm, base_bm)
-        sum_lm = edp.point_add(env, dbl_lm, env.base)
-        assert (canon_lm(sum_lm) == canon_bm(sum_bm)).all()
+        sum_bm = ed.point_add(dbl_bm, pt_bm)
+        sum_lm = edp.point_add(env, dbl_lm, pt_lm)
+        assert canon_lm(sum_lm) == canon_bm(sum_bm)
 
-    @pytest.mark.skipif(
-        __import__("jax").default_backend() != "tpu",
-        reason="full pallas ladder needs a real TPU (interpret mode hits "
-        "the pathological XLA:CPU compile)",
-    )
+        # planes-form add and the mixed B-entry add against the core
+        planes = edp.to_planes(env, pt_lm)
+        sum2_lm = edp._add_q_planes(env, dbl_lm, planes)
+        assert canon_lm(sum2_lm) == canon_bm(sum_bm)
+
+        basesum_bm = ed.point_add(dbl_bm, ed.base_point(b))
+        basesum_lm = edp._add_b_entry(env, dbl_lm, env.b_table[1])
+        assert canon_lm(basesum_lm) == canon_bm(basesum_bm)
+
+    def test_select16(self):
+        """Branch-free 16-way select picks the right table entry."""
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as edp
+
+        b = 16
+        entries = [
+            (jnp.full((edp.LIMBS, b), i, jnp.int32),
+             jnp.full((edp.LIMBS, b), 100 + i, jnp.int32))
+            for i in range(16)
+        ]
+        idx = jnp.arange(16, dtype=jnp.int32)
+        p0, p1 = edp._select16(idx, entries)
+        assert (np.asarray(p0)[0] == np.arange(16)).all()
+        assert (np.asarray(p1)[0] == 100 + np.arange(16)).all()
+
+    def test_full_window_ladder_eager(self):
+        """The kernel's exact ladder flow (table build, window order,
+        select, adds) driven step by step from Python in eager mode on a
+        tiny batch — differential against the host oracle's accept."""
+        import jax
+
+        from corda_tpu.ops import ed25519_pallas as edp
+
+        b = 2
+        y, r, s, h, sign, pre = self._operand_fixture(b, seed=13)
+        # lane 1: corrupt the challenge scalar → must reject
+        h = h.copy()
+        h[1, 0] ^= 1
+        env = self._env(b)
+
+        y12 = edp.bytes_to_limb12_t(np.asarray(y))[: edp.LIMBS]
+        r12 = np.asarray(edp.bytes_to_limb12_t(np.asarray(r)))[: edp.LIMBS]
+        s_win = np.asarray(edp.bytes_to_windows_t(np.asarray(s)))
+        h_win = np.asarray(edp.bytes_to_windows_t(np.asarray(h)))
+
+        a_pt, a_ok = edp.decompress(env, y12, np.asarray(sign))
+        assert np.asarray(a_ok).all()
+        minus_a = edp.point_neg(env, a_pt)
+        pts = [edp.identity_point(b), minus_a]
+        for k in range(2, 16):
+            if k % 2 == 0:
+                pts.append(edp.point_double(env, pts[k // 2]))
+            else:
+                pts.append(edp.point_add(env, pts[k - 1], minus_a))
+        a_table = [edp.to_planes(env, pt) for pt in pts]
+
+        acc = edp.identity_point(b)
+        for w in range(63, -1, -1):
+            for i in range(4):
+                acc = edp.point_double(env, acc, want_t=(i == 3))
+            acc = edp._add_b_entry(
+                env, acc, edp._select16(jax.numpy.asarray(s_win[w]), env.b_table))
+            acc = edp._add_q_planes(
+                env, acc, edp._select16(jax.numpy.asarray(h_win[w]), a_table))
+
+        enc_y, parity = edp.compress_y_parity(env, acc)
+        enc_y, parity = np.asarray(enc_y), np.asarray(parity)
+        r_y = r12.copy()
+        r_y[21] &= 7
+        r_sign = (r12[21] >> 3) & 1
+        match = (enc_y == r_y).all(axis=0) & (parity == r_sign)
+        assert match.tolist() == [True, False]
+
+    def test_packed_fixedlen_prep_differential(self):
+        """The fixed-length fast path's host packing + device-side
+        extraction and challenge pipeline (everything except the pallas
+        launch), on CPU, vs hashlib."""
+        import hashlib
+
+        import jax.numpy as jnp
+
+        from corda_tpu.ops.ed25519 import L, _gather_fixed
+        from corda_tpu.ops.scalar25519 import challenge_windows
+        from corda_tpu.ops.sha512 import sha512_blocks
+
+        b = 8
+        pks, sigs, msgs = _gen(b, seed=21, msglen=(44, 44))
+        pk_arr, sig_arr, len_ok = _gather_fixed(pks, sigs, b)
+        s_arr = sig_arr[:, 32:]
+        precheck = np.ones(b, bool)
+        mlen = 44
+        # the same packing code path _verify_prep_enqueue runs
+        packed = np.zeros((b, 161), np.uint8)
+        packed[:, :32] = sig_arr[:, :32]
+        packed[:, 32:64] = pk_arr
+        packed[:, 64 : 64 + mlen] = np.frombuffer(
+            b"".join(msgs), np.uint8
+        ).reshape(b, mlen)
+        total = 64 + mlen
+        packed[:, total] = 0x80
+        packed[:, 126] = (total * 8) >> 8
+        packed[:, 127] = (total * 8) & 0xFF
+        packed[:, 128:160] = s_arr
+        packed[:, 160] = precheck
+
+        # device-side extraction (the _tpu_verify_fixedlen prologue)
+        pj = jnp.asarray(packed)
+        blk = pj[:, :128].astype(jnp.uint32)
+        words = (
+            (blk[:, 0::4] << 24) | (blk[:, 1::4] << 16)
+            | (blk[:, 2::4] << 8) | blk[:, 3::4]
+        )
+        digest = sha512_blocks(words[:, None, :])
+        wins = np.asarray(challenge_windows(digest))
+        for i in range(b):
+            h = int.from_bytes(
+                hashlib.sha512(sigs[i][:32] + pks[i] + msgs[i]).digest(),
+                "little",
+            ) % L
+            for k in range(64):
+                assert wins[k, i] == (h >> (4 * k)) & 0xF, (i, k)
+        pk_x = np.asarray(pj[:, 32:64].astype(jnp.int32))
+        assert (pk_x == pk_arr).all()
+        assert (np.asarray(pj[:, :32]) == sig_arr[:, :32]).all()
+        assert (np.asarray(pj[:, 128:160]) == s_arr).all()
+
+    @pytest.mark.device
     def test_pallas_full_differential_tpu(self):
-        pks, sigs, msgs = _gen(64, seed=11)
-        sigs[5] = bytes([sigs[5][0] ^ 1]) + sigs[5][1:]
-        msgs[9] = b"tampered"
-        got = ed25519_verify_batch(pks, sigs, msgs)
-        want = np.array([i not in (5, 9) for i in range(64)])
-        assert (got == want).all()
+        """Adversarial differential of the COMPILED pallas kernel on the
+        real chip, via a subprocess that escapes conftest's forced-CPU env
+        (in-process the pallas path can never run under pytest). Covers
+        BOTH production routes: the fused fixed-length path (uniform
+        44-byte messages) and the generic variable-length path. Skips
+        cleanly where no TPU is attached."""
+        import os
+        import subprocess
+        import sys
+
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+        }
+        script = r"""
+import sys
+import numpy as np
+import jax
+if jax.default_backend() != "tpu":
+    print("NO-TPU"); sys.exit(0)
+import random
+from cryptography.hazmat.primitives.asymmetric import ed25519 as hostlib
+from corda_tpu.ops.ed25519 import L, ed25519_verify_batch
+
+rng = random.Random(11)
+for variant, mk in (("fixed", lambda: rng.randbytes(44)),
+                    ("var", lambda: rng.randbytes(rng.randint(1, 200)))):
+    pks, sigs, msgs = [], [], []
+    for _ in range(64):
+        sk = hostlib.Ed25519PrivateKey.generate()
+        m = mk()
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m)); msgs.append(m)
+    sigs[5] = bytes([sigs[5][0] ^ 1]) + sigs[5][1:]
+    msgs[9] = msgs[9][:-1] + bytes([msgs[9][-1] ^ 0x80])
+    s = int.from_bytes(sigs[17][32:], "little")
+    sigs[17] = sigs[17][:32] + (s + L).to_bytes(32, "little")
+    pks[23] = pks[23][:31]
+    got = ed25519_verify_batch(pks, sigs, msgs)
+    want = np.array([i not in (5, 9, 17, 23) for i in range(64)])
+    assert (got == want).all(), (variant, np.nonzero(got != want))
+print("OK")
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", script],
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env, capture_output=True, text=True, timeout=900,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        out = proc.stdout.strip().splitlines()[-1]
+        if out == "NO-TPU":
+            pytest.skip("no TPU attached")
+        assert out == "OK", proc.stdout

@@ -1,0 +1,77 @@
+"""Differential tests for the on-device challenge pipeline: SHA-512 digest
+words → little-endian 512-bit limbs → Barrett mod-L → ladder windows,
+against Python bigints and hashlib (the host oracle the v1 pipeline used
+per-lane — reference path Crypto.kt:621-624's JCA EdDSA engine does the
+same reduction inside `Signature.verify`)."""
+
+import hashlib
+import random
+
+import numpy as np
+
+from corda_tpu.ops import scalar25519 as sc
+
+
+def _limbs43(x: int) -> np.ndarray:
+    return np.array(
+        [(x >> (12 * i)) & 0xFFF for i in range(43)], dtype=np.int32
+    )
+
+
+class TestModL:
+    def test_barrett_matches_bigint(self):
+        rng = random.Random(1)
+        vals = [
+            0, 1, sc.L - 1, sc.L, sc.L + 1, 2 * sc.L, (1 << 512) - 1,
+            (sc.L << 260) - 1,
+        ] + [rng.getrandbits(512) for _ in range(24)]
+        h = np.stack([_limbs43(v) for v in vals]).T  # (43, B)
+        r = np.asarray(sc.mod_l(h))
+        for i, v in enumerate(vals):
+            got = sum(int(r[k, i]) << (12 * k) for k in range(22))
+            assert got == v % sc.L, (i, v)
+
+    def test_windows_match_bit_slices(self):
+        rng = random.Random(2)
+        vals = [rng.getrandbits(512) % sc.L for _ in range(8)]
+        r = np.stack(
+            [_limbs43(v)[:22] for v in vals]
+        ).T.astype(np.int32)
+        w = np.asarray(sc.limbs_to_windows(r))
+        assert w.shape == (64, 8)
+        for i, v in enumerate(vals):
+            for k in range(64):
+                assert w[k, i] == (v >> (4 * k)) & 0xF
+
+    def test_digest_words_roundtrip(self):
+        """hashlib digest → hi/lo word pairs → limbs equals the bigint."""
+        msgs = [b"abc", b"", b"x" * 100, b"corda-tpu"]
+        words = np.zeros((len(msgs), 16), dtype=np.uint32)
+        for i, m in enumerate(msgs):
+            d = hashlib.sha512(m).digest()
+            for w in range(8):
+                v = int.from_bytes(d[8 * w : 8 * w + 8], "big")
+                words[i, 2 * w] = v >> 32
+                words[i, 2 * w + 1] = v & 0xFFFFFFFF
+        limbs = np.asarray(sc.digest_words_to_limbs(words))
+        for i, m in enumerate(msgs):
+            want = int.from_bytes(hashlib.sha512(m).digest(), "little")
+            got = sum(int(limbs[k, i]) << (12 * k) for k in range(43))
+            assert got == want
+
+    def test_challenge_windows_end_to_end(self):
+        """Full device challenge path vs hashlib + bigint mod L."""
+        rng = random.Random(3)
+        msgs = [rng.randbytes(108) for _ in range(4)]
+        words = np.zeros((4, 16), dtype=np.uint32)
+        for i, m in enumerate(msgs):
+            d = hashlib.sha512(m).digest()
+            for w in range(8):
+                v = int.from_bytes(d[8 * w : 8 * w + 8], "big")
+                words[i, 2 * w] = v >> 32
+                words[i, 2 * w + 1] = v & 0xFFFFFFFF
+        wins = np.asarray(sc.challenge_windows(words))
+        for i, m in enumerate(msgs):
+            h = int.from_bytes(hashlib.sha512(m).digest(), "little") % sc.L
+            for k in range(64):
+                assert wins[k, i] == (h >> (4 * k)) & 0xF
