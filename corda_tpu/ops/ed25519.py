@@ -240,6 +240,51 @@ def ed25519_verify_core(
     return a_ok & precheck & jnp.all(encoded == r_bytes, axis=1)
 
 
+@jax.jit
+def _cpu_prep(a_y: jax.Array, a_sign: jax.Array):
+    a_pt, a_ok = decompress(a_y, a_sign)
+    minus_a = point_neg(a_pt)
+    t_both = point_add(base_point(a_y.shape[0]), minus_a)
+    return a_ok, minus_a, t_both
+
+
+@jax.jit
+def _cpu_step(acc, base, minus_a, t_both, ident, sb, hb):
+    acc = point_double(acc)
+    addend = point_select(
+        (sb == 1) & (hb == 1), t_both,
+        point_select(sb == 1, base, point_select(hb == 1, minus_a, ident)),
+    )
+    return point_add(acc, addend)
+
+
+@jax.jit
+def _cpu_finish(acc, r_bytes, a_ok, precheck):
+    return a_ok & precheck & jnp.all(compress(acc) == r_bytes, axis=1)
+
+
+def _ed25519_verify_core_cpu(a_y, a_sign, r_bytes, s_bits, h_bits, precheck):
+    """CPU-tier verify: identical math to ``ed25519_verify_core`` but the
+    ladder is DRIVEN FROM PYTHON, one jitted step per bit. XLA:CPU's LLVM
+    backend takes ~an hour on the whole-ladder graph (a known pathology
+    even in the einsum form); the per-step graph compiles in seconds and
+    256 eager dispatches cost milliseconds at test batch sizes. The TPU
+    production path (the pallas kernel) is unaffected."""
+    b = a_y.shape[0]
+    a_ok, minus_a, t_both = _cpu_prep(jnp.asarray(a_y), jnp.asarray(a_sign))
+    base = base_point(b)
+    ident = identity_point(b)
+    acc = ident
+    s_cols = np.asarray(s_bits)
+    h_cols = np.asarray(h_bits)
+    for i in range(255, -1, -1):
+        acc = _cpu_step(
+            acc, base, minus_a, t_both, ident,
+            jnp.asarray(s_cols[:, i]), jnp.asarray(h_cols[:, i]),
+        )
+    return _cpu_finish(acc, jnp.asarray(r_bytes), a_ok, jnp.asarray(precheck))
+
+
 _L_BE = np.frombuffer(L.to_bytes(32, "big"), dtype=np.uint8).astype(np.int16)
 
 
@@ -440,9 +485,9 @@ def _verify_prep_enqueue(
             jnp.asarray(sign), jnp.asarray(precheck),
         )
     else:
-        mask = ed25519_verify_core(
+        mask = _ed25519_verify_core_cpu(
             y_bytes.astype(np.int32), sign,
             sig_arr[:, :32].astype(np.int32),
-            _bits_le(s_arr), _bits_le(h_bytes), jnp.asarray(precheck),
+            _bits_le(s_arr), _bits_le(h_bytes), precheck,
         )
     return mask

@@ -22,7 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-L = 2**252 + 27742317777372353535851937790883648493
+from .ed25519 import L  # the ed25519 group order (single definition)
+
 RADIX = 12
 MASK = (1 << RADIX) - 1
 

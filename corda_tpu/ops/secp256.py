@@ -1,0 +1,560 @@
+"""Batched ECDSA verification for secp256k1 / secp256r1 on device.
+
+The device engines behind scheme ids 2 and 3 (reference:
+``Crypto.ECDSA_SECP256K1_SHA256`` / ``ECDSA_SECP256R1_SHA256``,
+core/.../crypto/Crypto.kt:85-113, verified one-at-a-time through the JCA
+seam at Crypto.kt:621-624). Together with the ed25519 kernel this completes
+the mixed-scheme bucketed dispatch (BASELINE config #3): the verifier
+flattens signature rows, buckets by scheme, and each ECDSA bucket becomes
+ONE batched ladder over the mesh instead of a per-signature BouncyCastle
+call.
+
+Design:
+
+- **Generic 256-bit prime field, radix-256.** Field elements are 32
+  little-endian 8-bit limbs in int32 lanes, batch-major ``(B, 32)``. All
+  reduction machinery is DERIVED from the prime at import: ``2^256 mod p``
+  is decomposed into small signed base-2^32 digits, which yields (a) the
+  word-level fold matrix for schoolbook products (the generalization of
+  the FIPS-186 s-term reduction), (b) the byte-decomposed wrap injections
+  for carry passes, and (c) positivity offsets (multiples of p with
+  every-limb slack) that keep the lazy representation non-negative. One
+  code path serves both curves — and any future short-Weierstrass prime.
+
+- **Complete point formulas** (Renes–Costello–Batina 2016, homogeneous
+  projective, Algorithms 1 and 3). Unlike Jacobian ladders, these have NO
+  exceptional cases — identity, doubling, and inverse inputs all flow
+  through the same branch-free arithmetic, which is what a verifier facing
+  adversarial inputs must use (a wrong-accept via a crafted u1·G = ±u2·Q
+  collision is a consensus bug). Verified against an affine reference over
+  all edge cases before this module was built; differentially tested vs
+  OpenSSL in tests/test_ops_secp256.py.
+
+- **Joint 1-bit Straus ladder**: R = u1·G + u2·Q with one doubling per bit
+  and a 4-way table select {∞, G, Q, G+Q}; accept iff R ≠ ∞ and
+  X ≡ r·Z or (r+n < p and X ≡ (r+n)·Z) — the projective form of
+  "x(R) mod n == r" without any inversion.
+
+Host-side prep (cold-path, per-lane bigints): SEC1 point parsing with an
+LRU cache (nodes reuse keys heavily), r/s range + low-S checks (matching
+``crypto.schemes.is_valid``'s canonical-form rule), e = SHA-256(msg), and
+w = s⁻¹ mod n → u1, u2.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ._blockpack import pow2_at_least
+
+LIMBS = 32
+
+
+def _int_to_limbs(x: int, n: int = LIMBS) -> np.ndarray:
+    return np.array([(x >> (8 * i)) & 0xFF for i in range(n)], dtype=np.int32)
+
+
+def _limbs_to_int(limbs) -> int:
+    return sum(int(v) << (8 * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+def _signed_word_digits(v: int, nwords: int = 8) -> list[int]:
+    """v (< 2^256) as signed base-2^32 digits with |digit| ≤ 2^31."""
+    out = []
+    for _ in range(nwords):
+        d = v & 0xFFFFFFFF
+        if d > 0x7FFFFFFF:
+            d -= 1 << 32
+        v = (v - d) >> 32
+        out.append(d)
+    assert v == 0
+    return out
+
+
+def _reduction_rows(p: int) -> list[dict[int, int]]:
+    """For word k = 0..15: 2^(32k) mod p as a small signed combo of words
+    0..7 (the generalized FIPS-186 s-term table, derived not transcribed)."""
+    top = {j: d for j, d in enumerate(_signed_word_digits(2**256 % p)) if d}
+    rows: list[dict[int, int]] = [{k: 1} for k in range(8)]
+    for k in range(8, 16):
+        vec = {k: 1}
+        while any(j >= 8 for j in vec):
+            j = max(vec)
+            c = vec.pop(j)
+            for tj, td in top.items():
+                vec[j - 8 + tj] = vec.get(j - 8 + tj, 0) + c * td
+            vec = {a: b for a, b in vec.items() if b}
+        rows.append(vec)
+    return rows
+
+
+def _pos_multiple(p: int, base: int) -> np.ndarray:
+    """A multiple of p whose every limb is in [base, base + 255]: the
+    all-``base`` vector plus the limb decomposition of p − (value mod p)."""
+    v = base * ((1 << 256) - 1) // 255
+    fix = (-v) % p
+    limbs = np.full(LIMBS, base, dtype=np.int64) + _int_to_limbs(fix).astype(
+        np.int64
+    )
+    assert _limbs_to_int(limbs) % p == 0
+    assert limbs.max() <= base + 255
+    return limbs.astype(np.int32)
+
+
+class FieldCtx:
+    """Derived constants + lazy-carry ops for GF(p), p a 256-bit prime.
+
+    Lazy invariant: public op outputs have limbs in [−16, 1100] (small
+    negatives only for primes with negative fold digits, e.g. secp256r1);
+    inputs up to ~2500 are accepted by mul (columns stay ≤ 32·2500² < 2^31).
+    Exactness is restored only at ``canonical`` boundaries.
+    """
+
+    def __init__(self, p: int):
+        self.p = p
+        self.p_limbs = _int_to_limbs(p)
+        digits = _signed_word_digits(2**256 % p)
+        # wrap injections: carry q out of limb 31 ≡ q·(2^256 mod p); each
+        # signed word digit is byte-decomposed so injections stay small
+        inj: list[tuple[int, int]] = []  # (limb index, signed byte coeff)
+        for j, d in enumerate(digits):
+            s = 1 if d >= 0 else -1
+            for i, byte in enumerate(_int_to_limbs(abs(d), 5)):
+                if byte:
+                    inj.append((4 * j + i, s * int(byte)))
+        assert all(idx < LIMBS for idx, _ in inj)
+        self.wrap_inj = inj
+        # word-level fold matrix for schoolbook columns 32..63
+        self.red_rows = _reduction_rows(p)
+        self.k_sub = _pos_multiple(p, 2600)       # covers subtrahends ≤ 2600
+        self.k_fold = _pos_multiple(p, 1 << 29)   # covers fold negatives
+        self.k_canon = _pos_multiple(p, 1 << 13)  # covers lazy negatives
+
+    # ---------------------------------------------------------- carries
+
+    def wrap_pass(self, c: jax.Array) -> jax.Array:
+        """One carry pass with the generic 2^256 wrap injection."""
+        q = c >> 8
+        r = c - (q << 8)
+        top = q[:, LIMBS - 1 :]
+        out = r + jnp.concatenate(
+            [jnp.zeros_like(top), q[:, : LIMBS - 1]], axis=1
+        )
+        pads = []
+        for idx, coeff in self.wrap_inj:
+            pads.append(
+                jnp.pad(coeff * top, ((0, 0), (idx, LIMBS - 1 - idx)))
+            )
+        return out + sum(pads)
+
+    def carry(self, c: jax.Array, passes: int) -> jax.Array:
+        for _ in range(passes):
+            c = self.wrap_pass(c)
+        return c
+
+    def fold_cols(self, cols: jax.Array) -> jax.Array:
+        """(B, 63) schoolbook columns → (B, 32) lazy limbs."""
+        b = cols.shape[0]
+        c = jnp.pad(cols, ((0, 0), (0, 1)))  # 64 cols = 16 words
+        # raw pass (no wrap): bounds each limb at 255 + carry
+        q = c >> 8
+        r = c - (q << 8)
+        c = r + jnp.concatenate([jnp.zeros((b, 1), jnp.int32), q[:, :-1]], 1)
+        # word-level fold: out word j gets Σ_k M[j,k]·word_k
+        out = jnp.zeros((b, LIMBS), dtype=jnp.int32)
+        for k in range(16):
+            word = c[:, 4 * k : 4 * k + 4]
+            for j, coeff in self.red_rows[k].items():
+                out = out + jnp.pad(
+                    coeff * word, ((0, 0), (4 * j, LIMBS - 4 - 4 * j))
+                )
+        # restore positivity (fold coefficients can be negative), then wrap
+        return self.carry(out + jnp.asarray(self.k_fold), 4)
+
+    # ---------------------------------------------------------- field ops
+
+    def mul(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        if jax.default_backend() == "cpu":
+            bmat = jnp.where(jnp.asarray(_CONV_MASK), b[:, _CONV_IDX], 0)
+            cols = jnp.einsum(
+                "bi,bik->bk", a, bmat, preferred_element_type=jnp.int32
+            )
+        else:
+            cols = jnp.zeros((a.shape[0], 2 * LIMBS - 1), dtype=jnp.int32)
+            for i in range(LIMBS):
+                cols = cols.at[:, i : i + LIMBS].add(a[:, i : i + 1] * b)
+        return self.fold_cols(cols)
+
+    def sq(self, a: jax.Array) -> jax.Array:
+        return self.mul(a, a)
+
+    def add(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return self.carry(a + b, 1)
+
+    def sub(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return self.carry(a - b + jnp.asarray(self.k_sub), 2)
+
+    def neg(self, a: jax.Array) -> jax.Array:
+        return self.sub(jnp.zeros_like(a), a)
+
+    def mul_small(self, a: jax.Array, k: int) -> jax.Array:
+        return self.carry(a * np.int32(k), 2)
+
+    def pow_const(self, a: jax.Array, exponent: int) -> jax.Array:
+        nbits = exponent.bit_length()
+        bits = np.array(
+            [(exponent >> (nbits - 1 - i)) & 1 for i in range(nbits)],
+            dtype=np.int32,
+        )
+        bits_d = jnp.asarray(bits)
+        one = jnp.zeros_like(a).at[:, 0].set(1)
+
+        def body(i, r):
+            r = self.sq(r)
+            return jnp.where(bits_d[i] == 1, self.mul(r, a), r)
+
+        return jax.lax.fori_loop(0, nbits, body, one)
+
+    def canonical(self, a: jax.Array) -> jax.Array:
+        """Exact reduction: limbs in [0, 255], value in [0, p)."""
+        c = a + jnp.asarray(self.k_canon)  # positivity
+
+        def exact(c):
+            def step(carry, limb):
+                v = limb + carry
+                return v >> 8, v & 255
+
+            top, limbs = jax.lax.scan(step, jnp.zeros_like(c[:, 0]), c.T)
+            out = limbs.T
+            pads = []
+            for idx, coeff in self.wrap_inj:
+                pads.append(
+                    jnp.pad(
+                        (coeff * top)[:, None],
+                        ((0, 0), (idx, LIMBS - 1 - idx)),
+                    )
+                )
+            return out + sum(pads)
+
+        c = exact(exact(exact(c)))
+
+        p_limbs = jnp.asarray(self.p_limbs)
+
+        def sub_p(v):
+            def borrow_step(borrow, pair):
+                limb, pl = pair
+                d = limb - pl - borrow
+                return (d < 0).astype(jnp.int32), d & 255
+
+            borrow, diff = jax.lax.scan(
+                borrow_step,
+                jnp.zeros_like(v[:, 0]),
+                (v.T, jnp.broadcast_to(p_limbs[:, None], (LIMBS, v.shape[0]))),
+            )
+            return jnp.where((borrow == 0)[:, None], diff.T, v)
+
+        return sub_p(sub_p(c))
+
+    def eq(self, a: jax.Array, b: jax.Array) -> jax.Array:
+        return jnp.all(self.canonical(a) == self.canonical(b), axis=1)
+
+    def is_zero(self, a: jax.Array) -> jax.Array:
+        return jnp.all(self.canonical(a) == 0, axis=1)
+
+
+# CPU einsum helper tables (same trick as fe25519: XLA:CPU compiles the
+# shifted-accumulate form pathologically slowly; the einsum compiles fast
+# and CPU-tier test batches are tiny)
+_CONV_IDX = np.clip(
+    np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None], 0, LIMBS - 1
+).astype(np.int32)
+_CONV_MASK = (
+    (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] >= 0)
+    & (np.arange(2 * LIMBS - 1)[None, :] - np.arange(LIMBS)[:, None] < LIMBS)
+)
+
+
+# ------------------------------------------------------------ curve contexts
+
+class CurveCtx:
+    def __init__(self, name, p, a, b, n, gx, gy):
+        self.name = name
+        self.field = FieldCtx(p)
+        self.p, self.a, self.b, self.n = p, a, b, n
+        self.gx, self.gy = gx, gy
+        self.a_limbs = _int_to_limbs(a % p)
+        self.b_limbs = _int_to_limbs(b % p)
+        self.b3_limbs = _int_to_limbs(3 * b % p)
+        self.gx_limbs = _int_to_limbs(gx)
+        self.gy_limbs = _int_to_limbs(gy)
+        self.a_is_zero = a % p == 0
+
+
+SECP256K1 = CurveCtx(
+    "secp256k1",
+    p=2**256 - 2**32 - 977,
+    a=0,
+    b=7,
+    n=0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141,
+    gx=0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798,
+    gy=0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8,
+)
+
+SECP256R1 = CurveCtx(
+    "secp256r1",
+    p=0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF,
+    a=-3,
+    b=0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B,
+    n=0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551,
+    gx=0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296,
+    gy=0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5,
+)
+
+_CURVES = {"secp256k1": SECP256K1, "secp256r1": SECP256R1}
+
+
+def _const(limbs: np.ndarray, b: int) -> jax.Array:
+    return jnp.broadcast_to(jnp.asarray(limbs), (b, LIMBS))
+
+
+# --------------------------------------------- complete point ops (RCB16)
+
+def point_add(cv: CurveCtx, P, Q):
+    """Complete addition (RCB16 Alg 1): correct for ALL inputs — identity,
+    P == Q, P == −Q. mul-by-a folds away at trace time for a = 0."""
+    f = cv.field
+    X1, Y1, Z1 = P
+    X2, Y2, Z2 = Q
+    b = X1.shape[0]
+    a_c = _const(cv.a_limbs, b)
+    b3_c = _const(cv.b3_limbs, b)
+
+    def mul_a(v):
+        return jnp.zeros_like(v) if cv.a_is_zero else f.mul(a_c, v)
+
+    t0 = f.mul(X1, X2)
+    t1 = f.mul(Y1, Y2)
+    t2 = f.mul(Z1, Z2)
+    t3 = f.sub(f.mul(f.add(X1, Y1), f.add(X2, Y2)), f.add(t0, t1))
+    t4 = f.sub(f.mul(f.add(X1, Z1), f.add(X2, Z2)), f.add(t0, t2))
+    t5 = f.sub(f.mul(f.add(Y1, Z1), f.add(Y2, Z2)), f.add(t1, t2))
+    Z3 = f.add(f.mul(b3_c, t2), mul_a(t4))
+    X3 = f.sub(t1, Z3)
+    Z3 = f.add(t1, Z3)
+    Y3 = f.mul(X3, Z3)
+    t1 = f.add(f.add(t0, t0), t0)
+    t2a = mul_a(t2)
+    t4b = f.mul(b3_c, t4)
+    t1 = f.add(t1, t2a)
+    t2 = mul_a(f.sub(t0, t2a))
+    t4 = f.add(t4b, t2)
+    Y3 = f.add(Y3, f.mul(t1, t4))
+    X3n = f.sub(f.mul(X3, t3), f.mul(t5, t4))
+    Z3n = f.add(f.mul(t5, Z3), f.mul(t3, t1))
+    return (X3n, Y3, Z3n)
+
+
+def point_double(cv: CurveCtx, P):
+    """Complete doubling (RCB16 Alg 3); also correct on the identity."""
+    f = cv.field
+    X, Y, Z = P
+    b = X.shape[0]
+    a_c = _const(cv.a_limbs, b)
+    b3_c = _const(cv.b3_limbs, b)
+
+    def mul_a(v):
+        return jnp.zeros_like(v) if cv.a_is_zero else f.mul(a_c, v)
+
+    t0 = f.sq(X)
+    t1 = f.sq(Y)
+    t2 = f.sq(Z)
+    t3 = f.mul_small(f.mul(X, Y), 2)
+    Z3 = f.mul_small(f.mul(X, Z), 2)
+    Y3 = f.add(f.mul(b3_c, t2), mul_a(Z3))
+    X3 = f.sub(t1, Y3)
+    Y3 = f.add(t1, Y3)
+    Y3 = f.mul(X3, Y3)
+    X3 = f.mul(t3, X3)
+    Z3 = f.mul(b3_c, Z3)
+    t2a = mul_a(t2)
+    t3n = f.add(mul_a(f.sub(t0, t2a)), Z3)
+    Z3 = f.add(f.add(t0, t0), t0)
+    t0 = f.add(Z3, t2a)
+    t0 = f.mul(t0, t3n)
+    Y3 = f.add(Y3, t0)
+    t2 = f.mul_small(f.mul(Y, Z), 2)
+    X3 = f.sub(X3, f.mul(t2, t3n))
+    Z3n = f.mul_small(f.mul(t2, t1), 4)
+    return (X3, Y3, Z3n)
+
+
+def identity_point(b: int):
+    zero = jnp.zeros((b, LIMBS), dtype=jnp.int32)
+    one = zero.at[:, 0].set(1)
+    return (zero, one, zero)
+
+
+def point_select(mask, P, Q):
+    m = mask[:, None]
+    return tuple(jnp.where(m, x, y) for x, y in zip(P, Q))
+
+
+def on_curve(cv: CurveCtx, x, y):
+    """y² == x³ + a·x + b (projective inputs with Z=1)."""
+    f = cv.field
+    b = x.shape[0]
+    rhs = f.add(f.mul(f.sq(x), x), _const(cv.b_limbs, b))
+    if not cv.a_is_zero:
+        rhs = f.add(rhs, f.mul(_const(cv.a_limbs, b), x))
+    return f.eq(f.sq(y), rhs)
+
+
+# ------------------------------------------------------------ verify core
+
+@functools.partial(jax.jit, static_argnames=("curve_name",))
+def ecdsa_verify_core(
+    curve_name: str,
+    qx: jax.Array,        # (B, 32) pubkey x limbs
+    qy: jax.Array,        # (B, 32) pubkey y limbs
+    u1_bits: jax.Array,   # (B, 256) little-endian bits of u1 = e/s mod n
+    u2_bits: jax.Array,   # (B, 256) little-endian bits of u2 = r/s mod n
+    r_a: jax.Array,       # (B, 32) candidate x limbs: r
+    r_b: jax.Array,       # (B, 32) candidate x limbs: r + n (when < p)
+    r_b_ok: jax.Array,    # (B,) second candidate validity
+    precheck: jax.Array,  # (B,) host-side validity
+) -> jax.Array:
+    """R = u1·G + u2·Q; accept iff R ≠ ∞ and x(R) ≡ r (mod n), projectively:
+    X ≡ r·Z or X ≡ (r+n)·Z. All-complete formulas: adversarial scalar
+    collisions (u1·G = ±u2·Q) produce correct results, not garbage."""
+    cv = _CURVES[curve_name]
+    f = cv.field
+    b = qx.shape[0]
+    nbits = u1_bits.shape[1]
+
+    Q = (qx, qy, jnp.zeros((b, LIMBS), jnp.int32).at[:, 0].set(1))
+    q_ok = on_curve(cv, qx, qy)
+    G = (
+        _const(cv.gx_limbs, b),
+        _const(cv.gy_limbs, b),
+        jnp.zeros((b, LIMBS), jnp.int32).at[:, 0].set(1),
+    )
+    GQ = point_add(cv, G, Q)
+    ident = identity_point(b)
+
+    def body(i, acc):
+        acc = point_double(cv, acc)
+        b1 = jax.lax.dynamic_slice_in_dim(u1_bits, nbits - 1 - i, 1, 1)[:, 0]
+        b2 = jax.lax.dynamic_slice_in_dim(u2_bits, nbits - 1 - i, 1, 1)[:, 0]
+        addend = point_select(
+            (b1 == 1) & (b2 == 1), GQ,
+            point_select(b1 == 1, G, point_select(b2 == 1, Q, ident)),
+        )
+        return point_add(cv, acc, addend)
+
+    X, Y, Z = jax.lax.fori_loop(0, nbits, body, ident)
+
+    nonzero = ~f.is_zero(Z)
+    match = f.eq(X, f.mul(r_a, Z)) | (r_b_ok & f.eq(X, f.mul(r_b, Z)))
+    return precheck & q_ok & nonzero & match
+
+
+# ------------------------------------------------------------ host wrapper
+
+@functools.lru_cache(maxsize=8192)
+def _decompress_point(curve_name: str, encoded: bytes) -> tuple | None:
+    """SEC1 point parse (compressed 33B / uncompressed 65B) → (x, y) ints,
+    on-curve-checked. Cached: vaults verify thousands of signatures from a
+    handful of well-known party keys."""
+    cv = _CURVES[curve_name]
+    p = cv.p
+    try:
+        if len(encoded) == 33 and encoded[0] in (2, 3):
+            x = int.from_bytes(encoded[1:], "big")
+            if x >= p:
+                return None
+            rhs = (pow(x, 3, p) + cv.a * x + cv.b) % p
+            y = pow(rhs, (p + 1) // 4, p)  # both primes ≡ 3 (mod 4)
+            if y * y % p != rhs:
+                return None
+            if y & 1 != encoded[0] & 1:
+                y = p - y
+            return (x, y)
+        if len(encoded) == 65 and encoded[0] == 4:
+            x = int.from_bytes(encoded[1:33], "big")
+            y = int.from_bytes(encoded[33:], "big")
+            if x >= p or y >= p:
+                return None
+            if (y * y - pow(x, 3, p) - cv.a * x - cv.b) % p != 0:
+                return None
+            return (x, y)
+    except Exception:
+        return None
+    return None
+
+
+from .ed25519 import _bits_le  # noqa: E402  (shared bit-plane converter)
+
+
+def ecdsa_verify_batch(
+    curve_name: str,
+    pubkeys: list[bytes],
+    signatures: list[bytes],
+    messages: list[bytes],
+) -> np.ndarray:
+    """Batch-verify 64-byte r‖s ECDSA signatures (low-S canonical form, the
+    framework's wire encoding — crypto/schemes.py sign()) → (B,) bool."""
+    cv = _CURVES[curve_name]
+    n_real = len(pubkeys)
+    if not (len(signatures) == len(messages) == n_real):
+        raise ValueError("batch length mismatch")
+    if n_real == 0:
+        return np.zeros(0, dtype=bool)
+    b = pow2_at_least(n_real, 8)
+
+    qx = np.zeros((b, LIMBS), np.int32)
+    qy = np.zeros((b, LIMBS), np.int32)
+    u1b = np.zeros((b, 32), np.uint8)
+    u2b = np.zeros((b, 32), np.uint8)
+    ra = np.zeros((b, LIMBS), np.int32)
+    rb = np.zeros((b, LIMBS), np.int32)
+    rb_ok = np.zeros(b, bool)
+    pre = np.zeros(b, bool)
+
+    n = cv.n
+    for i in range(n_real):
+        sig = signatures[i]
+        if len(sig) != 64:
+            continue
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        # canonical form: r, s in range and low-S (matches the host oracle
+        # and sign(); the malleated high-S twin must NOT verify)
+        if not (1 <= r < n and 1 <= s <= n // 2):
+            continue
+        pt = _decompress_point(curve_name, bytes(pubkeys[i]))
+        if pt is None:
+            continue
+        e = int.from_bytes(hashlib.sha256(messages[i]).digest(), "big")
+        w = pow(s, n - 2, n)
+        u1 = e * w % n
+        u2 = r * w % n
+        qx[i] = _int_to_limbs(pt[0])
+        qy[i] = _int_to_limbs(pt[1])
+        u1b[i] = np.frombuffer(u1.to_bytes(32, "little"), np.uint8)
+        u2b[i] = np.frombuffer(u2.to_bytes(32, "little"), np.uint8)
+        ra[i] = _int_to_limbs(r)
+        if r + n < cv.p:
+            rb[i] = _int_to_limbs(r + n)
+            rb_ok[i] = True
+        pre[i] = True
+
+    mask = ecdsa_verify_core(
+        curve_name, qx, qy, _bits_le(u1b), _bits_le(u2b),
+        ra, rb, jnp.asarray(rb_ok), jnp.asarray(pre),
+    )
+    return np.asarray(mask)[:n_real]

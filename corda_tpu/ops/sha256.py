@@ -47,8 +47,7 @@ def _rotr(x: jax.Array, n: int) -> jax.Array:
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
-def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
-    """One SHA-256 compression. state: (..., 8), block: (..., 16) uint32."""
+def _compress_unrolled(state: jax.Array, block: jax.Array) -> jax.Array:
     w = [block[..., i] for i in range(16)]
     for i in range(16, 64):
         s0 = _rotr(w[i - 15], 7) ^ _rotr(w[i - 15], 18) ^ (w[i - 15] >> np.uint32(3))
@@ -66,6 +65,50 @@ def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
         a, b, c, d, e, f, g, h = t1 + t2, a, b, c, d + t1, e, f, g
     new = jnp.stack([a, b, c, d, e, f, g, h], axis=-1)
     return state + new
+
+
+def _compress_scan(state: jax.Array, block: jax.Array) -> jax.Array:
+    """Scan-form compression: one schedule step / one round per scan body.
+    Identical math to the unrolled form; exists because XLA:CPU's LLVM
+    backend takes minutes-to-hours on large straight-line uint32 graphs
+    (the test tier runs on CPU), while per-step scan bodies compile in
+    seconds."""
+    w16 = jnp.moveaxis(block, -1, 0)  # (16, ...)
+
+    def sched(buf, _):
+        x, y = buf[1], buf[14]
+        s0 = _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
+        s1 = _rotr(y, 17) ^ _rotr(y, 19) ^ (y >> np.uint32(10))
+        new = buf[0] + s0 + buf[9] + s1
+        return jnp.concatenate([buf[1:], new[None]], axis=0), new
+
+    _, extra = jax.lax.scan(sched, w16, None, length=48)
+    w_all = jnp.concatenate([w16, extra], axis=0)  # (64, ...)
+
+    def rnd(vs, xs):
+        w_i, k_i = xs
+        a, b, c, d, e, f, g, h = (vs[..., i] for i in range(8))
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + k_i + w_i
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        out = [t1 + s0 + maj, a, b, c, d + t1, e, f, g]
+        return jnp.stack(out, axis=-1), None
+
+    final, _ = jax.lax.scan(rnd, state, (w_all, jnp.asarray(_K)))
+    return state + final
+
+
+def _compress(state: jax.Array, block: jax.Array) -> jax.Array:
+    """One SHA-256 compression. state: (..., 8), block: (..., 16) uint32.
+
+    Backend-split at trace time: the TPU path keeps the fully-unrolled
+    straight-line graph (one fusible block, what the Merkle hot path
+    wants); the CPU test tier uses the scan form (see _compress_scan)."""
+    if jax.default_backend() == "cpu":
+        return _compress_scan(state, block)
+    return _compress_unrolled(state, block)
 
 
 @jax.jit

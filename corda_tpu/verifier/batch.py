@@ -9,7 +9,7 @@ Crypto.findSignatureScheme (Crypto.kt:236-267) — and each bucket goes to its
 best engine in one shot:
 
   scheme 4 (ed25519)  → one batched device kernel (ops/ed25519.py)
-  schemes 2/3 (ECDSA) → device kernel when available, host OpenSSL otherwise
+  schemes 2/3 (ECDSA) → batched complete-formula ladder (ops/secp256.py)
   schemes 1/5 (RSA, SPHINCS — cold paths) → host loop
 
 Bucketing + padding policy is what decides real MXU utilization (SURVEY.md
@@ -24,6 +24,8 @@ import dataclasses
 import numpy as np
 
 from corda_tpu.crypto import (
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
     EDDSA_ED25519_SHA512,
     SecureHash,
     TransactionSignature,
@@ -33,9 +35,12 @@ from corda_tpu.crypto import (
 from corda_tpu.ledger import SignedTransaction
 from corda_tpu.ledger.signed import SignaturesMissingException
 
-# Schemes with a batched device kernel. secp256r1/k1 join once their
-# Jacobian-ladder kernels land (ops/secp256.py).
-_DEVICE_SCHEMES = {EDDSA_ED25519_SHA512}
+# Schemes with a batched device kernel (ops/ed25519.py, ops/secp256.py).
+_DEVICE_SCHEMES = {
+    EDDSA_ED25519_SHA512,
+    ECDSA_SECP256K1_SHA256,
+    ECDSA_SECP256R1_SHA256,
+}
 
 
 def verify_signature_rows(
@@ -57,13 +62,22 @@ def verify_signature_rows(
 
     for scheme_id, idxs in buckets.items():
         if use_device and scheme_id in _DEVICE_SCHEMES:
-            from corda_tpu.ops.ed25519 import ed25519_verify_batch
+            keys = [rows[i][0].encoded for i in idxs]
+            sigs = [rows[i][1] for i in idxs]
+            msgs = [rows[i][2] for i in idxs]
+            if scheme_id == EDDSA_ED25519_SHA512:
+                from corda_tpu.ops.ed25519 import ed25519_verify_batch
 
-            mask = ed25519_verify_batch(
-                [rows[i][0].encoded for i in idxs],
-                [rows[i][1] for i in idxs],
-                [rows[i][2] for i in idxs],
-            )
+                mask = ed25519_verify_batch(keys, sigs, msgs)
+            else:
+                from corda_tpu.ops.secp256 import ecdsa_verify_batch
+
+                curve = (
+                    "secp256k1"
+                    if scheme_id == ECDSA_SECP256K1_SHA256
+                    else "secp256r1"
+                )
+                mask = ecdsa_verify_batch(curve, keys, sigs, msgs)
             out[idxs] = mask
         else:
             for i in idxs:

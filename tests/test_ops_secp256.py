@@ -1,0 +1,272 @@
+"""Differential tests for the batched ECDSA device engines (secp256k1 /
+secp256r1) vs Python-int field/curve references and the OpenSSL oracle —
+the JCA-vector tier of the reference's crypto tests (CryptoUtilsTest.kt)
+for scheme ids 2 and 3. Adversarial cases are the point: high-S twins,
+corrupted r/s/msg, wrong keys, off-curve/garbage pubkeys, r=0."""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature,
+)
+
+from corda_tpu.ops import secp256 as sp
+
+CURVES = [sp.SECP256K1, sp.SECP256R1]
+
+
+def _limbs(x, b=1):
+    return np.broadcast_to(sp._int_to_limbs(x), (b, sp.LIMBS)).astype(np.int32)
+
+
+def _val(limbs_row):
+    return sp._limbs_to_int(limbs_row)
+
+
+# --------------------------------------------------------- field tier
+
+class TestField:
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_ops_match_bigints(self, cv):
+        f = cv.field
+        rng = random.Random(1)
+        vals_a = [0, 1, cv.p - 1, rng.getrandbits(255) % cv.p,
+                  rng.getrandbits(255) % cv.p]
+        vals_b = [cv.p - 1, 2, 977, rng.getrandbits(255) % cv.p, 1]
+        a = np.stack([sp._int_to_limbs(v) for v in vals_a])
+        b = np.stack([sp._int_to_limbs(v) for v in vals_b])
+        got_mul = np.asarray(f.canonical(f.mul(a, b)))
+        got_add = np.asarray(f.canonical(f.add(a, b)))
+        got_sub = np.asarray(f.canonical(f.sub(a, b)))
+        for i, (x, y) in enumerate(zip(vals_a, vals_b)):
+            assert _val(got_mul[i]) == x * y % cv.p, ("mul", i)
+            assert _val(got_add[i]) == (x + y) % cv.p, ("add", i)
+            assert _val(got_sub[i]) == (x - y) % cv.p, ("sub", i)
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_lazy_bound_extremes(self, cv):
+        """Worst-case lazy limbs (the add-of-add bound the point formulas
+        produce) through mul and canonical stay exact."""
+        f = cv.field
+        lazy = np.full((4, sp.LIMBS), 2304, dtype=np.int32)
+        lazy_val = _val(lazy[0])
+        other = np.stack([sp._int_to_limbs(cv.p - 1 - 7 * k) for k in range(4)])
+        got = np.asarray(f.canonical(f.mul(lazy, other)))
+        for i in range(4):
+            assert _val(got[i]) == lazy_val * _val(other[i]) % cv.p
+        got_c = np.asarray(f.canonical(lazy))
+        assert all(_val(got_c[i]) == lazy_val % cv.p for i in range(4))
+        # chained lazy ops: sub of an add-of-add, then mul
+        chain = f.mul(f.sub(f.add(f.add(lazy, lazy), lazy), other), lazy)
+        got2 = np.asarray(f.canonical(chain))
+        want = (3 * lazy_val - _val(other[0])) * lazy_val % cv.p
+        assert _val(got2[0]) == want
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_pow_and_eq(self, cv):
+        f = cv.field
+        x = 0xDEADBEEF
+        a = _limbs(x, 2).copy()
+        inv = np.asarray(f.canonical(f.pow_const(a, cv.p - 2)))
+        assert _val(inv[0]) == pow(x, cv.p - 2, cv.p)
+        # equality across non-canonical (value + p) lazy representations
+        lazy_xp = a + np.broadcast_to(cv.field.p_limbs, a.shape)
+        assert np.asarray(f.eq(a, lazy_xp)).all()
+        assert not np.asarray(f.eq(a, _limbs(x + 1, 2))).any()
+        assert np.asarray(f.is_zero(_limbs(0, 2))).all()
+        assert not np.asarray(f.is_zero(a)).any()
+
+
+# --------------------------------------------------------- point tier
+
+def _aff_add(cv, P, Q):
+    p, a = cv.p, cv.a
+    if P is None:
+        return Q
+    if Q is None:
+        return P
+    x1, y1 = P
+    x2, y2 = Q
+    if x1 == x2 and (y1 + y2) % p == 0:
+        return None
+    if P == Q:
+        lam = (3 * x1 * x1 + a) * pow(2 * y1, p - 2, p) % p
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, p - 2, p) % p
+    x3 = (lam * lam - x1 - x2) % p
+    return (x3, (lam * (x1 - x3) - y1) % p)
+
+
+def _aff_mul(cv, k, P):
+    R, A = None, P
+    while k:
+        if k & 1:
+            R = _aff_add(cv, R, A)
+        A = _aff_add(cv, A, A)
+        k >>= 1
+    return R
+
+
+def _to_aff(cv, P_dev, i):
+    f = cv.field
+    X = _val(np.asarray(f.canonical(P_dev[0]))[i])
+    Y = _val(np.asarray(f.canonical(P_dev[1]))[i])
+    Z = _val(np.asarray(f.canonical(P_dev[2]))[i])
+    if Z == 0:
+        return None
+    zi = pow(Z, cv.p - 2, cv.p)
+    return (X * zi % cv.p, Y * zi % cv.p)
+
+
+class TestPoints:
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_complete_add_and_double(self, cv):
+        rng = random.Random(2)
+        ks = [1, 2, 3, rng.getrandbits(200)]
+        pts = [_aff_mul(cv, k, (cv.gx, cv.gy)) for k in ks]
+        b = len(pts)
+        P = (
+            np.stack([sp._int_to_limbs(x) for x, _ in pts]),
+            np.stack([sp._int_to_limbs(y) for _, y in pts]),
+            _limbs(1, b).copy(),
+        )
+        # P + P via the COMPLETE add must equal the doubling formula
+        dbl = sp.point_double(cv, P)
+        added = sp.point_add(cv, P, P)
+        for i in range(b):
+            want = _aff_add(cv, pts[i], pts[i])
+            assert _to_aff(cv, dbl, i) == want, i
+            assert _to_aff(cv, added, i) == want, i
+        # P + (−P) = ∞ and P + ∞ = P through the same formula
+        negP = (P[0], np.stack([sp._int_to_limbs(cv.p - y) for _, y in pts]),
+                P[2])
+        inf = sp.point_add(cv, P, negP)
+        for i in range(b):
+            assert _to_aff(cv, inf, i) is None, i
+        ident = sp.identity_point(b)
+        same = sp.point_add(cv, P, ident)
+        for i in range(b):
+            assert _to_aff(cv, same, i) == pts[i], i
+        # mixed adds of distinct points
+        Q = (
+            np.roll(P[0], 1, axis=0), np.roll(P[1], 1, axis=0), P[2],
+        )
+        mixed = sp.point_add(cv, P, Q)
+        for i in range(b):
+            want = _aff_add(cv, pts[i], pts[(i - 1) % b])
+            assert _to_aff(cv, mixed, i) == want, i
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_on_curve_check(self, cv):
+        good = (_limbs(cv.gx, 2), _limbs(cv.gy, 2))
+        assert np.asarray(sp.on_curve(cv, *good)).all()
+        bad = (_limbs(cv.gx, 2), _limbs((cv.gy + 1) % cv.p, 2))
+        assert not np.asarray(sp.on_curve(cv, *bad)).any()
+
+
+# --------------------------------------------------------- verify tier
+
+def _gen(cv, n, seed, compressed=True):
+    curve = ec.SECP256K1() if cv.name == "secp256k1" else ec.SECP256R1()
+    fmt = (
+        serialization.PublicFormat.CompressedPoint
+        if compressed
+        else serialization.PublicFormat.UncompressedPoint
+    )
+    rng = random.Random(seed)
+    pks, sigs, msgs = [], [], []
+    for _ in range(n):
+        priv = ec.generate_private_key(curve)
+        m = rng.randbytes(rng.randint(1, 120))
+        der = priv.sign(m, ec.ECDSA(hashes.SHA256()))
+        r, s = decode_dss_signature(der)
+        if s > cv.n // 2:
+            s = cv.n - s
+        pks.append(
+            priv.public_key().public_bytes(serialization.Encoding.X962, fmt)
+        )
+        sigs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        msgs.append(m)
+    return pks, sigs, msgs
+
+
+class TestVerify:
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_valid_batch(self, cv):
+        pks, sigs, msgs = _gen(cv, 6, seed=3)
+        assert sp.ecdsa_verify_batch(cv.name, pks, sigs, msgs).all()
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_uncompressed_keys(self, cv):
+        pks, sigs, msgs = _gen(cv, 3, seed=4, compressed=False)
+        assert sp.ecdsa_verify_batch(cv.name, pks, sigs, msgs).all()
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_corruption_modes(self, cv):
+        pks, sigs, msgs = _gen(cv, 8, seed=5)
+        sigs[0] = bytes([sigs[0][0] ^ 1]) + sigs[0][1:]          # r bit
+        sigs[1] = sigs[1][:40] + bytes([sigs[1][40] ^ 8]) + sigs[1][41:]  # s
+        msgs[2] = msgs[2] + b"x"                                  # message
+        other = _gen(cv, 1, seed=99)[0][0]
+        pks[3] = other                                            # wrong key
+        # high-S twin of a valid signature must be rejected (canonical form)
+        s4 = int.from_bytes(sigs[4][32:], "big")
+        sigs[4] = sigs[4][:32] + (cv.n - s4).to_bytes(32, "big")
+        sigs[5] = b"\x00" * 64                                    # r = s = 0
+        pks[6] = b"\x02" + b"\xff" * 32                           # bad x
+        mask = sp.ecdsa_verify_batch(cv.name, pks, sigs, msgs)
+        assert mask.tolist() == [False] * 7 + [True]
+
+    @pytest.mark.parametrize("cv", CURVES, ids=lambda c: c.name)
+    def test_agrees_with_host_oracle(self, cv):
+        """Random valid/corrupted mix must match OpenSSL verdicts (modulo
+        the deliberate low-S-only policy, which _gen respects)."""
+        rng = random.Random(7)
+        pks, sigs, msgs = _gen(cv, 8, seed=7)
+        expected = []
+        for i in range(8):
+            if rng.random() < 0.5:
+                j = rng.randrange(64)
+                sigs[i] = (
+                    sigs[i][:j]
+                    + bytes([sigs[i][j] ^ (1 << rng.randrange(8))])
+                    + sigs[i][j + 1 :]
+                )
+            from corda_tpu.crypto import schemes as cs
+
+            sid = (
+                cs.ECDSA_SECP256K1_SHA256
+                if cv.name == "secp256k1"
+                else cs.ECDSA_SECP256R1_SHA256
+            )
+            expected.append(
+                cs.is_valid(cs.PublicKey(sid, pks[i]), sigs[i], msgs[i])
+            )
+        got = sp.ecdsa_verify_batch(cv.name, pks, sigs, msgs)
+        assert got.tolist() == expected
+
+    def test_empty_batch(self):
+        assert sp.ecdsa_verify_batch("secp256k1", [], [], []).shape == (0,)
+
+    def test_zero_u1_edge(self):
+        """A crafted message whose SHA-256 ≡ 0 mod n is infeasible, but
+        u1·G = ∞ routes through the complete add — exercised by verifying
+        with u1 forced small via the core API directly."""
+        cv = sp.SECP256K1
+        # R = 0·G + 1·Q must equal Q; pick Q = G so x(R) = gx
+        b = 8
+        qx, qy = _limbs(cv.gx, b).copy(), _limbs(cv.gy, b).copy()
+        u1 = np.zeros((b, 32), np.uint8)
+        u2 = np.zeros((b, 32), np.uint8)
+        u2[:, 0] = 1
+        ra = _limbs(cv.gx % cv.n, b).copy()
+        mask = sp.ecdsa_verify_core(
+            cv.name, qx, qy, sp._bits_le(u1), sp._bits_le(u2),
+            ra, np.zeros_like(ra), np.zeros(b, bool), np.ones(b, bool),
+        )
+        assert np.asarray(mask).all()

@@ -130,6 +130,31 @@ class TestBatchCheck:
         mask = verify_signature_rows(good + bad, use_device=False)
         assert mask.tolist() == [True] * len(good) + [False] * len(bad)
 
+    def test_rows_mixed_schemes_device_dispatch(self):
+        """BASELINE config #3 shape: one flattened row set spanning
+        ed25519 + secp256k1 + secp256r1 (device buckets) + SPHINCS (host
+        bucket), with invalid lanes in each bucket. Exercises the real
+        scheme-bucketed device dispatch on CPU-backed kernels."""
+        from corda_tpu.crypto import schemes as cs
+
+        rows, want = [], []
+        for sid in (
+            cs.EDDSA_ED25519_SHA512,
+            cs.ECDSA_SECP256K1_SHA256,
+            cs.ECDSA_SECP256R1_SHA256,
+            cs.SPHINCS256_SHA256,
+        ):
+            for j in range(3):
+                kp = cs.generate_keypair(sid)
+                msg = b"row-%d-%d" % (sid, j)
+                sig = cs.sign(kp.private, msg)
+                if j == 1:  # tamper the middle lane of every bucket
+                    msg = msg + b"!"
+                rows.append((kp.public, sig, msg))
+                want.append(j != 1)
+        mask = verify_signature_rows(rows, use_device=True)
+        assert mask.tolist() == want
+
     def test_check_transactions_ok(self, notary, alice):
         stxs = [issue_tx(notary, alice, v) for v in (1, 2, 3)]
         report = check_transactions(stxs, use_device=False)
