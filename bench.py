@@ -1,33 +1,64 @@
 """Benchmark entry — run by the driver on real TPU hardware.
 
-Measures BASELINE.json config #2: batched ed25519 signature verification
-(the reference's hot loop — one JCA ``Signature.verify`` call per signature,
-``Crypto.kt:621-624`` inside ``TransactionWithSignatures.checkSignaturesAreValid``)
-re-platformed as one batched device kernel (`corda_tpu.ops.ed25519`).
+Measures the two halves of the north star (BASELINE.json):
 
-Baseline = the host-CPU sequential verify loop over the same signatures via
-the `cryptography` (OpenSSL) package — the same "one native verify per
-signature on one core" shape as the reference's BouncyCastle/i2p path, and
-measured here rather than copied because the reference publishes no numbers
-(BASELINE.md).
+1. **notarised_tx_per_sec** (headline; BASELINE config #5): a validating
+   batched notary — device signature verification (`ops/ed25519`), host
+   contract validation, one-round-trip uniqueness commit, device batch
+   signing (`ops/ed25519_sign`) — pipelined over the request stream
+   (`BatchedNotaryService.process_stream`). Baseline = the reference's
+   shape: one transaction at a time through a sequential validating notary
+   (`ValidatingNotaryService.process`, host OpenSSL crypto; reference
+   ValidatingNotaryFlow.kt:17-51 + Crypto.kt:621-624), plus a
+   loadtest-driven run through the async request window
+   (`tools/loadtest.notary_service_storm_test`, reference NotaryTest.kt).
+
+2. **ed25519 batch verify** (BASELINE config #2): batched device kernel vs
+   the host-CPU sequential verify loop (OpenSSL via `cryptography` — see
+   BASELINE.md for the BouncyCastle conversion).
+
+Methodology per ADVICE r1: device rates are the MEDIAN of 3 timed rounds
+(best-of also reported); each round enqueues all reps before a single
+deferred readback, measuring pipelined steady state — the service queue
+shape — not per-batch round-trip latency.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import statistics
 import sys
 import time
 
 import numpy as np
 
 
-BATCH = 8192          # device batch (power-of-two bucket, ~10k config shape)
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: the pallas kernels cost tens of
+    seconds to compile; repeat bench runs should pay that once."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+
+
+_enable_compile_cache()
+
+
+SIG_BATCH = 8192      # device batch (power-of-two bucket, ~10k config shape)
 HOST_SAMPLE = 2048    # host baseline sample (throughput extrapolates)
 DEVICE_REPS = 12
+
+NOTARY_TXS = 8192     # notarisation stream size
+NOTARY_CHUNK = 1024   # batching window
+NOTARY_HOST_SAMPLE = 384
 
 
 def make_batch(n: int):
@@ -49,7 +80,7 @@ def make_batch(n: int):
     return pubkeys, sigs, msgs
 
 
-def bench_host(pubkeys, sigs, msgs) -> float:
+def bench_host_sigs(pubkeys, sigs, msgs) -> float:
     """Sequential host verify loop → sigs/sec."""
     from cryptography.exceptions import InvalidSignature
     from cryptography.hazmat.primitives.asymmetric import ed25519
@@ -68,24 +99,19 @@ def bench_host(pubkeys, sigs, msgs) -> float:
     return len(sigs) / dt
 
 
-def bench_device(pubkeys, sigs, msgs) -> float:
-    """Batched device verify → sigs/sec (pipelined steady state).
+def bench_device_sigs(pubkeys, sigs, msgs) -> tuple[float, float]:
+    """Batched device verify → (median, best) sigs/sec over 3 rounds.
 
-    Measures the verifier service's production loop shape: every rep does
-    full host prep (parse, precheck, block build) and async upload, all
-    reps' kernels queue on device, and the verdict masks are stacked
-    on-device and fetched with ONE readback. Deferred sync matters: the
+    Every rep does full host prep (parse, precheck, block build) and async
+    upload; all reps' kernels queue on device and the verdict masks are
+    stacked on-device and fetched with ONE readback (deferred sync: the
     tunneled interconnect has ~100 ms round-trip latency, so a per-batch
-    blocking fetch would measure the tunnel, not the engine — the durable
-    queue service acks in batches for exactly this reason."""
+    blocking fetch would measure the tunnel, not the engine)."""
     import jax.numpy as jnp
-    import numpy as np
 
     from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
 
     n = len(sigs)
-    # warmup: compile, then one full pipelined round so the tunnel's
-    # transfer queue and the device queue are in steady state before timing
     mask = np.asarray(ed25519_verify_dispatch(pubkeys, sigs, msgs))[:n]
     assert mask.all(), "device kernel rejected valid sigs"
     # no-wrong-accept probe on the real chip: a tampered lane must fail
@@ -99,9 +125,7 @@ def bench_device(pubkeys, sigs, msgs) -> float:
     ]
     np.asarray(jnp.stack(warm))
 
-    # best of 3 rounds: the tunneled link to the chip is shared and bursty,
-    # so a single round can under-measure the engine by 2-3x
-    best = 0.0
+    rates = []
     for _ in range(3):
         t0 = time.perf_counter()
         pending = [
@@ -111,27 +135,174 @@ def bench_device(pubkeys, sigs, msgs) -> float:
         ok = np.asarray(jnp.stack(pending))
         dt = time.perf_counter() - t0
         assert ok[:, :n].all(), "device kernel rejected valid sigs"
-        best = max(best, n * DEVICE_REPS / dt)
-    return best
+        rates.append(n * DEVICE_REPS / dt)
+    return statistics.median(rates), max(rates)
+
+
+# ------------------------------------------------------------ notarisation
+
+def make_notary_stream(n: int):
+    """One issue fanning out n Cash states + n independent move txs, with
+    the resolver and fresh parties (the notary-demo / loadtest shape)."""
+    from corda_tpu.crypto import derive_keypair_from_entropy
+    from corda_tpu.finance import CashState
+    from corda_tpu.finance.contracts import CASH_PROGRAM_ID, Issue, Move
+    from corda_tpu.ledger import (
+        Amount, CordaX500Name, Issued, Party, PartyAndReference,
+        TransactionBuilder,
+    )
+
+    def party(tag):
+        kp = derive_keypair_from_entropy(4, hashlib.sha256(tag).digest())
+        return Party(CordaX500Name(tag.decode(), "London", "GB"), kp.public), kp
+
+    (alice, akp) = party(b"Alice Corp")
+    (bob, _bkp) = party(b"Bob Inc")
+    (notary, nkp) = party(b"Notary Service")
+    token = Issued(PartyAndReference(alice, b"\x01"), "GBP")
+
+    b = TransactionBuilder(notary=notary)
+    for i in range(n):
+        b.add_output_state(
+            CashState(Amount(100 + i, token), alice), CASH_PROGRAM_ID
+        )
+    b.add_command(Issue(), alice.owning_key)
+    issue_stx = b.sign_initial_transaction(akp)
+
+    moves = []
+    for i in range(n):
+        mb = TransactionBuilder(notary=notary)
+        mb.add_input_state(issue_stx.tx.out_ref(i))
+        mb.add_output_state(
+            CashState(Amount(100 + i, token), bob), CASH_PROGRAM_ID
+        )
+        mb.add_command(Move(), alice.owning_key)
+        moves.append(mb.sign_initial_transaction(akp))
+
+    txmap = {issue_stx.id: issue_stx}
+
+    def resolve(ref):
+        return txmap[ref.txhash].tx.outputs[ref.index]
+
+    return moves, resolve, (notary, nkp)
+
+
+def bench_notary_host(moves, resolve, notary_id) -> float:
+    """Sequential validating notary, host crypto — the reference shape."""
+    from corda_tpu.notary import InMemoryUniquenessProvider, ValidatingNotaryService
+
+    svc = ValidatingNotaryService(
+        notary_id[0], notary_id[1], InMemoryUniquenessProvider()
+    )
+    t0 = time.perf_counter()
+    for stx in moves:
+        svc.process(stx, resolve, "bench")
+    dt = time.perf_counter() - t0
+    return len(moves) / dt
+
+
+def _fresh_batched_service(notary_id, use_device=True):
+    from corda_tpu.notary import BatchedNotaryService, PersistentUniquenessProvider
+
+    return BatchedNotaryService(
+        notary_id[0], notary_id[1], PersistentUniquenessProvider(),
+        use_device=use_device, validating=True,
+        max_batch=NOTARY_CHUNK, window_s=0.005,
+    )
+
+
+def bench_notary_device(moves, resolve, notary_id) -> tuple[float, float]:
+    """Pipelined batched notary over the move stream → (median, best)
+    notarised tx/sec over 3 rounds (fresh uniqueness store per round)."""
+    from corda_tpu.crypto import TransactionSignature
+
+    chunks = [
+        [(stx, resolve, "bench") for stx in moves[i : i + NOTARY_CHUNK]]
+        for i in range(0, len(moves), NOTARY_CHUNK)
+    ]
+    # warm round compiles both kernels (verify + sign comb)
+    svc = _fresh_batched_service(notary_id)
+    out = svc.process_stream(chunks[:2], depth=3)
+    for batch in out:
+        for r in batch:
+            assert isinstance(r, TransactionSignature), r
+
+    rates = []
+    for _ in range(3):
+        svc = _fresh_batched_service(notary_id)
+        t0 = time.perf_counter()
+        results = svc.process_stream(chunks, depth=3)
+        dt = time.perf_counter() - t0
+        n_ok = sum(
+            1 for batch in results for r in batch
+            if isinstance(r, TransactionSignature)
+        )
+        assert n_ok == len(moves), f"only {n_ok}/{len(moves)} notarised"
+        # spot-check a response signature against its tx id
+        results[0][0].verify(moves[0].id)
+        rates.append(len(moves) / dt)
+    return statistics.median(rates), max(rates)
+
+
+def bench_notary_loadtest(moves, resolve, notary_id) -> float:
+    """Loadtest-harness-driven run through the async request window
+    (reference: NotaryTest.kt storm via LoadTest.kt:37-69)."""
+    from corda_tpu.tools.loadtest import (
+        LoadTestRunner, RunParameters, notary_service_storm_test,
+    )
+
+    svc = _fresh_batched_service(notary_id)
+    test = notary_service_storm_test(svc, moves, resolve, chunk=128)
+    params = RunParameters(
+        parallelism=8,
+        generate_count=len(moves) // (8 * 128),
+        execution_frequency_hz=None,
+        gather_frequency=10**9,  # gather (drain) once, at the end
+    )
+    t0 = time.perf_counter()
+    metrics = LoadTestRunner(test, params).run()
+    dt = time.perf_counter() - t0
+    svc.shutdown()
+    assert metrics["failed"] == 0, metrics
+    assert metrics["final_state"] == metrics["executed"] * 128
+    return metrics["final_state"] / dt
 
 
 def main() -> None:
     import jax
 
-    pubkeys, sigs, msgs = make_batch(BATCH)
-    host_rate = bench_host(pubkeys[:HOST_SAMPLE], sigs[:HOST_SAMPLE],
-                           msgs[:HOST_SAMPLE])
-    dev_rate = bench_device(pubkeys, sigs, msgs)
+    device = str(jax.devices()[0])
+
+    pubkeys, sigs, msgs = make_batch(SIG_BATCH)
+    host_sig_rate = bench_host_sigs(
+        pubkeys[:HOST_SAMPLE], sigs[:HOST_SAMPLE], msgs[:HOST_SAMPLE]
+    )
+    sig_median, sig_best = bench_device_sigs(pubkeys, sigs, msgs)
+
+    moves, resolve, notary_id = make_notary_stream(NOTARY_TXS)
+    host_notary_rate = bench_notary_host(
+        moves[:NOTARY_HOST_SAMPLE], resolve, notary_id
+    )
+    notary_median, notary_best = bench_notary_device(moves, resolve, notary_id)
+    loadtest_rate = bench_notary_loadtest(moves, resolve, notary_id)
+
     print(
         json.dumps(
             {
-                "metric": "ed25519_batch_verify",
-                "value": round(dev_rate, 1),
-                "unit": "sigs/sec",
-                "vs_baseline": round(dev_rate / host_rate, 3),
-                "baseline_host_sigs_per_sec": round(host_rate, 1),
-                "batch": BATCH,
-                "device": str(jax.devices()[0]),
+                "metric": "notarised_tx_per_sec",
+                "value": round(notary_median, 1),
+                "unit": "tx/sec",
+                "vs_baseline": round(notary_median / host_notary_rate, 3),
+                "notary_best_tx_per_sec": round(notary_best, 1),
+                "notary_loadtest_tx_per_sec": round(loadtest_rate, 1),
+                "baseline_host_notary_tx_per_sec": round(host_notary_rate, 1),
+                "ed25519_sigs_per_sec": round(sig_median, 1),
+                "ed25519_best_sigs_per_sec": round(sig_best, 1),
+                "ed25519_vs_host": round(sig_median / host_sig_rate, 3),
+                "baseline_host_sigs_per_sec": round(host_sig_rate, 1),
+                "sig_batch": SIG_BATCH,
+                "notary_txs": NOTARY_TXS,
+                "device": device,
             }
         )
     )

@@ -17,6 +17,7 @@ path is the batched device kernel set in ``corda_tpu.ops`` dispatched by
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
 import secrets
 
@@ -90,12 +91,38 @@ def _order(scheme_id: int) -> int:
     return SECP256K1_N if scheme_id == ECDSA_SECP256K1_SHA256 else SECP256R1_N
 
 
+# Native key handles are cached: parsing/deriving an OpenSSL key object
+# costs more than the sign/verify it precedes (a notary signs with ONE key
+# at tens of kHz), and key bytes are immutable so the cache is sound.
+
+@functools.lru_cache(maxsize=4096)
 def _ec_pub_from_encoded(scheme_id: int, encoded: bytes) -> ec.EllipticCurvePublicKey:
     return ec.EllipticCurvePublicKey.from_encoded_point(_curve(scheme_id), encoded)
 
 
+@functools.lru_cache(maxsize=1024)
 def _ec_priv_from_encoded(scheme_id: int, encoded: bytes) -> ec.EllipticCurvePrivateKey:
     return ec.derive_private_key(int.from_bytes(encoded, "big"), _curve(scheme_id))
+
+
+@functools.lru_cache(maxsize=1024)
+def _ed_priv_from_encoded(encoded: bytes) -> ed25519.Ed25519PrivateKey:
+    return ed25519.Ed25519PrivateKey.from_private_bytes(encoded)
+
+
+@functools.lru_cache(maxsize=4096)
+def _ed_pub_from_encoded(encoded: bytes) -> ed25519.Ed25519PublicKey:
+    return ed25519.Ed25519PublicKey.from_public_bytes(encoded)
+
+
+@functools.lru_cache(maxsize=256)
+def _rsa_priv_from_der(encoded: bytes):
+    return serialization.load_der_private_key(encoded, password=None)
+
+
+@functools.lru_cache(maxsize=1024)
+def _rsa_pub_from_der(encoded: bytes):
+    return serialization.load_der_public_key(encoded)
 
 
 # ------------------------------------------------------------ generation
@@ -165,7 +192,7 @@ def sign(private: PrivateKey, data: bytes) -> bytes:
     RSA = PKCS#1 v1.5 over SHA-256; SPHINCS = packed WOTS/Merkle opening."""
     sid = private.scheme_id
     if sid == EDDSA_ED25519_SHA512:
-        return ed25519.Ed25519PrivateKey.from_private_bytes(private.encoded).sign(data)
+        return _ed_priv_from_encoded(private.encoded).sign(data)
     if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
         der = _ec_priv_from_encoded(sid, private.encoded).sign(
             data, ec.ECDSA(hashes.SHA256())
@@ -176,7 +203,7 @@ def sign(private: PrivateKey, data: bytes) -> bytes:
             s = n - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
     if sid == RSA_SHA256:
-        priv = serialization.load_der_private_key(private.encoded, password=None)
+        priv = _rsa_priv_from_der(private.encoded)
         return priv.sign(data, padding.PKCS1v15(), hashes.SHA256())
     if sid == SPHINCS256_SHA256:
         return sphincs.sign(private.encoded, data)
@@ -200,9 +227,7 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
     sid = public.scheme_id
     try:
         if sid == EDDSA_ED25519_SHA512:
-            ed25519.Ed25519PublicKey.from_public_bytes(public.encoded).verify(
-                signature, data
-            )
+            _ed_pub_from_encoded(public.encoded).verify(signature, data)
             return True
         if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
             if len(signature) != 64:
@@ -221,7 +246,7 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
             )
             return True
         if sid == RSA_SHA256:
-            pub = serialization.load_der_public_key(public.encoded)
+            pub = _rsa_pub_from_der(public.encoded)
             pub.verify(signature, data, padding.PKCS1v15(), hashes.SHA256())
             return True
         if sid == SPHINCS256_SHA256:

@@ -172,20 +172,71 @@ class BatchedNotaryService(NotaryService):
 
     # ---------------------------------------------------------- sync core
 
+    def dispatch_batch(self, requests):
+        """Enqueue the device half (signature ladders) of a batch; the
+        returned pending check settles in ``settle_batch``. Splitting the
+        two is what hides the interconnect round trip: while batch k's
+        ladders run on device, the host validates/commits/signs batch k-1
+        (see ``process_stream``)."""
+        from corda_tpu.verifier import dispatch_transactions
+
+        return dispatch_transactions(
+            [r[0] for r in requests],
+            [{self.identity.owning_key}] * len(requests),
+            use_device=self._use_device,
+            # one compiled kernel shape across ragged window flushes
+            min_bucket=self._max_batch if self._use_device else None,
+        )
+
     def process_batch(
         self, requests: list[tuple[SignedTransaction, object, str]]
     ) -> list[TransactionSignature | Exception]:
         """Verify + commit + sign a batch; one result slot per request."""
-        from corda_tpu.verifier import check_transactions
+        return self.settle_batch(requests, self.dispatch_batch(requests))
 
+    def process_stream(
+        self, batches, *, depth: int = 3
+    ) -> list[list[TransactionSignature | Exception]]:
+        """Pipelined notarisation over an iterable of request batches.
+
+        Keeps up to ``depth`` batches' signature checks in flight on the
+        device while the host settles (validates + commits + signs) earlier
+        batches — the steady-state shape of the ≥10k-tx/sec target, where
+        per-batch device latency (dominated by the tunneled link's ~100 ms
+        round trip) must overlap host work rather than serialize with it.
+        """
+        from collections import deque
+
+        verifying: deque = deque()   # (batch, pending sig-check)
+        signing: deque = deque()     # (results, live idxs, ids, pending sigs)
+        out: list = []
+        for batch in batches:
+            verifying.append((batch, self.dispatch_batch(batch)))
+            if len(verifying) >= depth:
+                b, pending = verifying.popleft()
+                signing.append(self.settle_commit(b, pending))
+            if len(signing) >= depth:
+                out.append(self.finalize_batch(*signing.popleft()))
+        while verifying:
+            b, pending = verifying.popleft()
+            signing.append(self.settle_commit(b, pending))
+        while signing:
+            out.append(self.finalize_batch(*signing.popleft()))
+        return out
+
+    def settle_batch(
+        self, requests, pending
+    ) -> list[TransactionSignature | Exception]:
+        """Blocking half: collect the signature masks, then validate,
+        commit and sign."""
+        return self.finalize_batch(*self.settle_commit(requests, pending))
+
+    def settle_commit(self, requests, pending):
+        """Collect the signature masks, validate, commit, and ENQUEUE the
+        response signing; ``finalize_batch`` fills in the signatures."""
         n = len(requests)
         results: list = [None] * n
-        stxs = [r[0] for r in requests]
-        report = check_transactions(
-            stxs,
-            [{self.identity.owning_key}] * n,
-            use_device=self._use_device,
-        )
+        report = pending.collect()
         live: list[int] = []
         for i, err in enumerate(report.results):
             if err is not None:
@@ -221,6 +272,7 @@ class BatchedNotaryService(NotaryService):
             for i in live
         ]
         conflicts = self.uniqueness.commit_batch(commit_reqs)
+        accepted: list[int] = []
         for i, conflict in zip(live, conflicts):
             if conflict is not None:
                 results[i] = NotaryError(
@@ -228,13 +280,68 @@ class BatchedNotaryService(NotaryService):
                     conflict,
                 )
             else:
-                results[i] = self.sign(requests[i][0].id)
+                accepted.append(i)
+        pending_sigs = self._dispatch_sign([requests[i][0].id for i in accepted])
+        return results, accepted, pending_sigs
+
+    def finalize_batch(
+        self, results, accepted, pending_sigs
+    ) -> list[TransactionSignature | Exception]:
+        """Fill in the (possibly device-batched) response signatures."""
+        for i, sig in zip(accepted, pending_sigs.collect()):
+            results[i] = sig
         if self._metrics is not None:
-            self._metrics.meter("notary.requests").mark(n)
+            self._metrics.meter("notary.requests").mark(len(results))
             self._metrics.meter("notary.committed").mark(
                 sum(1 for r in results if isinstance(r, TransactionSignature))
             )
         return results
+
+    def _dispatch_sign(self, tx_ids: list[SecureHash]):
+        """Enqueue response signing: one device comb-kernel batch when the
+        notary key is ed25519 (the default scheme), host loop otherwise.
+        Signatures are RFC 8032 deterministic either way, so device and
+        host paths emit identical bytes."""
+        from corda_tpu.crypto.schemes import EDDSA_ED25519_SHA512
+
+        if (
+            self._use_device
+            and tx_ids
+            and self._keypair.private.scheme_id == EDDSA_ED25519_SHA512
+        ):
+            from corda_tpu.crypto.signatures import (
+                CURRENT_PLATFORM_VERSION,
+                SignableData,
+                SignatureMetadata,
+            )
+            from corda_tpu.ops.ed25519_sign import ed25519_sign_dispatch
+
+            meta = SignatureMetadata(
+                CURRENT_PLATFORM_VERSION, EDDSA_ED25519_SHA512
+            )
+            payloads = [SignableData(t, meta).to_bytes() for t in tx_ids]
+            seed = self._keypair.private.encoded
+            inner = ed25519_sign_dispatch(
+                [seed] * len(tx_ids), payloads, min_bucket=self._max_batch
+            )
+            public = self._keypair.public
+
+            class _DeviceSigs:
+                def collect(_self):
+                    return [
+                        TransactionSignature(raw, public, meta)
+                        for raw in inner.collect()
+                    ]
+
+            return _DeviceSigs()
+
+        sigs = [self.sign(t) for t in tx_ids]
+
+        class _HostSigs:
+            def collect(_self):
+                return sigs
+
+        return _HostSigs()
 
     # ---------------------------------------------------------- async path
 
@@ -254,19 +361,46 @@ class BatchedNotaryService(NotaryService):
         return req.future
 
     def _flush_loop(self) -> None:
-        while True:
-            self._wake.wait(timeout=self._window_s)
-            self._wake.clear()
-            with self._lock:
-                batch, self._pending = self._pending, []
-                stopped = self._stopped
-            if batch:
+        """Stage 1 of the async pipeline: window/size-batch the pending
+        requests and enqueue their device signature checks. Stages 2
+        (validate+commit+enqueue signing) and 3 (collect signatures,
+        resolve futures) run on their own threads so consecutive windows
+        overlap the device round trips instead of serializing on them —
+        the same pipeline shape as ``process_stream``, driven by arrival."""
+        import queue as _queue
+
+        commit_q: _queue.Queue = _queue.Queue(maxsize=4)
+        final_q: _queue.Queue = _queue.Queue(maxsize=4)
+
+        def commit_loop():
+            while True:
+                item = commit_q.get()
+                if item is None:
+                    final_q.put(None)
+                    return
+                batch, pending = item
                 try:
-                    results = self.process_batch(
-                        [(r.stx, r.resolve_state, r.caller) for r in batch]
+                    staged = self.settle_commit(
+                        [(r.stx, r.resolve_state, r.caller) for r in batch],
+                        pending,
                     )
-                except Exception as e:  # batch-level failure fails every req
-                    results = [e] * len(batch)
+                    final_q.put((batch, staged, None))
+                except Exception as e:
+                    final_q.put((batch, None, e))
+
+        def finalize_loop():
+            while True:
+                item = final_q.get()
+                if item is None:
+                    return
+                batch, staged, err = item
+                if err is not None:
+                    results: list = [err] * len(batch)
+                else:
+                    try:
+                        results = self.finalize_batch(*staged)
+                    except Exception as e:
+                        results = [e] * len(batch)
                 for req, res in zip(batch, results):
                     try:
                         if isinstance(res, Exception):
@@ -275,12 +409,50 @@ class BatchedNotaryService(NotaryService):
                             req.future.set_result(res)
                     except Exception:
                         pass  # caller cancelled
-            if stopped:
-                return
+
+        committer = threading.Thread(
+            target=commit_loop, daemon=True, name="notary-committer"
+        )
+        finalizer = threading.Thread(
+            target=finalize_loop, daemon=True, name="notary-finalizer"
+        )
+        committer.start()
+        finalizer.start()
+        try:
+            while True:
+                self._wake.wait(timeout=self._window_s)
+                self._wake.clear()
+                while True:
+                    # cap every flush at max_batch: an uncapped drain under
+                    # burst load would exceed the pinned kernel bucket and
+                    # stall this thread behind a fresh compile
+                    with self._lock:
+                        batch = self._pending[: self._max_batch]
+                        self._pending = self._pending[self._max_batch :]
+                        stopped = self._stopped
+                    if not batch:
+                        break
+                    try:
+                        pending = self.dispatch_batch(
+                            [(r.stx, r.resolve_state, r.caller) for r in batch]
+                        )
+                        commit_q.put((batch, pending))
+                    except Exception as e:
+                        for req in batch:
+                            try:
+                                req.future.set_exception(e)
+                            except Exception:
+                                pass
+                if stopped:
+                    return
+        finally:
+            commit_q.put(None)
+            committer.join(timeout=5)
+            finalizer.join(timeout=5)
 
     def shutdown(self) -> None:
         with self._lock:
             self._stopped = True
         self._wake.set()
         if self._flusher is not None:
-            self._flusher.join(timeout=5)
+            self._flusher.join(timeout=15)

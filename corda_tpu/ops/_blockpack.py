@@ -25,6 +25,26 @@ def pow2_at_least(n: int, floor: int = 1) -> int:
     return b
 
 
+def bucket_floor(min_bucket: int | None, on_tpu: bool) -> int:
+    """Pad-bucket floor for the crypto kernels: caller-pinned ``min_bucket``
+    rounded UP to a power of two (services pass their max batch, which need
+    not be one), never below the pallas block width (128) on TPU."""
+    if on_tpu:
+        return pow2_at_least(min_bucket or 0, 128)
+    return pow2_at_least(min_bucket or 0, 8)
+
+
+def start_host_copy(arr) -> None:
+    """Kick off the device→host copy of a (possibly still computing) array
+    so it overlaps later host work — a blocking fetch at collect() time
+    would pay the tunneled interconnect's full round trip per batch. No-op
+    for plain numpy results (host fallbacks)."""
+    try:
+        arr.copy_to_host_async()
+    except AttributeError:
+        pass
+
+
 def bucket_batch(
     messages: list[bytes], block_bytes: int, min_batch: int = 8
 ) -> tuple[list[bytes], int]:

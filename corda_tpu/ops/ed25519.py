@@ -32,7 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ._blockpack import pow2_at_least
+from ._blockpack import bucket_floor, pow2_at_least
 from .fe25519 import (
     P,
     fe_add,
@@ -376,6 +376,7 @@ def _tpu_verify_from_bytes(
 
 def ed25519_verify_dispatch(
     pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+    min_bucket: int | None = None,
 ) -> jax.Array:
     """Prep + enqueue a verify batch WITHOUT materializing the result.
 
@@ -383,8 +384,16 @@ def ed25519_verify_dispatch(
     ``np.asarray``). JAX dispatch is async, so a caller that preps batch
     k+1 while holding batch k's mask overlaps host parsing/hashing with
     device ladder time — the steady-state shape of the verifier service's
-    queue loop."""
-    return _verify_prep_enqueue(pubkeys, signatures, messages)
+    queue loop.
+
+    ``min_bucket`` pins the pad bucket's floor: a service whose batch sizes
+    vary (window-flushed notary) passes its max batch so EVERY dispatch
+    reuses one compiled kernel shape — a ragged batch hitting a fresh
+    power-of-two bucket would otherwise stall its pipeline thread behind a
+    multi-second compile."""
+    return _verify_prep_enqueue(
+        pubkeys, signatures, messages, min_bucket=min_bucket
+    )
 
 
 def ed25519_verify_batch(
@@ -409,6 +418,7 @@ def ed25519_verify_batch(
 
 def _verify_prep_enqueue(
     pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+    min_bucket: int | None = None,
 ) -> jax.Array:
     import hashlib
 
@@ -422,7 +432,7 @@ def _verify_prep_enqueue(
     # bucket instead of once per caller batch size; pad lanes fail the
     # length precheck. On TPU the bucket floor is the pallas block width.
     on_tpu = jax.default_backend() == "tpu"
-    b = pow2_at_least(n_real, 128 if on_tpu else 8)
+    b = pow2_at_least(n_real, bucket_floor(min_bucket, on_tpu))
 
     pk_arr, sig_arr, len_ok = _gather_fixed(pubkeys, signatures, b)
     y_bytes = pk_arr.copy()

@@ -183,6 +183,53 @@ def self_issue_test(nodes: dict, notary, amounts=(100, 1000)) -> LoadTest:
     )
 
 
+def notary_service_storm_test(
+    service, stxs: list, resolve, chunk: int = 64
+) -> LoadTest:
+    """Drive a ``BatchedNotaryService``'s async request path at full rate —
+    the service-level notary storm (reference: NotaryTest.kt:22-50 floods
+    the notary with issue+move pairs; here the pre-built move transactions
+    submit through ``service.request`` and the model checks every one
+    committed exactly once).
+
+    ``generate`` hands out chunks of pre-built transactions, ``execute``
+    fire-and-forgets them into the batching window (throughput comes from
+    the service's pipeline, not from injector threads blocking on
+    futures), and ``gather`` drains all futures and reads the committed-tx
+    count off the uniqueness provider.
+    """
+    futures: list = []
+
+    def generate(state, parallelism):
+        cmds = []
+        start = state
+        for _ in range(parallelism):
+            part = stxs[start : start + chunk]
+            if not part:
+                break
+            cmds.append(part)
+            start += len(part)
+        return cmds
+
+    def interpret(state, cmd):
+        return state + len(cmd)
+
+    def execute(cmd):
+        for stx in cmd:
+            futures.append(service.request(stx, resolve, "loadtest"))
+
+    def gather():
+        for f in list(futures):
+            f.result(timeout=120)
+        return service.uniqueness.committed_txs()
+
+    return LoadTest(
+        name="NotaryServiceStorm",
+        generate=generate, interpret=interpret, execute=execute,
+        gather=gather, initial_state=0,
+    )
+
+
 def notarisation_storm_test(nodes: dict, notary_party) -> LoadTest:
     """Issue+move pairs through FinalityFlow — the notary-storm shape
     (reference: NotaryTest.kt:22-50). The model counts notarised moves;

@@ -10,7 +10,10 @@ verify as topological wavefronts (`corda_tpu.parallel.wavefront`).
 
 from .batch import (
     BatchVerifyReport,
+    PendingTxCheck,
     check_transactions,
+    dispatch_signature_rows,
+    dispatch_transactions,
     verify_signature_rows,
 )
 from .service import (
@@ -27,7 +30,10 @@ from .worker import (
 
 __all__ = [
     "BatchVerifyReport",
+    "PendingTxCheck",
     "check_transactions",
+    "dispatch_signature_rows",
+    "dispatch_transactions",
     "verify_signature_rows",
     "BatchedVerifierService",
     "InMemoryVerifierService",

@@ -43,18 +43,46 @@ _DEVICE_SCHEMES = {
 }
 
 
-def verify_signature_rows(
-    rows: list[tuple], *, use_device: bool = True
-) -> np.ndarray:
-    """Verify (PublicKey, signature, message) rows → (N,) bool mask.
+class PendingRows:
+    """An in-flight row verification: device buckets are ENQUEUED (async JAX
+    dispatch, no readback yet), host buckets already resolved. ``collect()``
+    materializes the (N,) mask with one blocking readback per device bucket.
 
-    One device dispatch per device-capable scheme bucket; host loop for the
-    rest. Row order is preserved.
+    The two-phase split is what lets callers (the pipelined notary, the
+    verifier service queue loop) overlap the device ladder time — dominated
+    by the tunneled interconnect's ~100 ms round trip — with host work on a
+    previous batch.
+    """
+
+    __slots__ = ("_n", "_deferred", "_out")
+
+    def __init__(self, n: int):
+        self._n = n
+        self._deferred: list[tuple[list[int], object]] = []
+        self._out = np.zeros(n, dtype=bool)
+
+    def collect(self) -> np.ndarray:
+        for idxs, mask in self._deferred:
+            self._out[idxs] = np.asarray(mask)[: len(idxs)]
+        self._deferred = []
+        return self._out
+
+
+def dispatch_signature_rows(
+    rows: list[tuple], *, use_device: bool = True,
+    min_bucket: int | None = None,
+) -> PendingRows:
+    """Enqueue verification of (PublicKey, signature, message) rows.
+
+    One async device dispatch per device-capable scheme bucket; host loop
+    (resolved immediately) for the rest. Row order is preserved in the
+    collected mask. ``min_bucket`` pins the device pad-bucket floor (one
+    compiled kernel shape for services with ragged batch sizes).
     """
     n = len(rows)
-    out = np.zeros(n, dtype=bool)
+    pending = PendingRows(n)
     if n == 0:
-        return out
+        return pending
 
     buckets: dict[int, list[int]] = {}
     for i, (key, _sig, _msg) in enumerate(rows):
@@ -66,9 +94,15 @@ def verify_signature_rows(
             sigs = [rows[i][1] for i in idxs]
             msgs = [rows[i][2] for i in idxs]
             if scheme_id == EDDSA_ED25519_SHA512:
-                from corda_tpu.ops.ed25519 import ed25519_verify_batch
+                from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
 
-                mask = ed25519_verify_batch(keys, sigs, msgs)
+                from corda_tpu.ops._blockpack import start_host_copy
+
+                mask = ed25519_verify_dispatch(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
+                start_host_copy(mask)
+                pending._deferred.append((idxs, mask))
             else:
                 from corda_tpu.ops.secp256 import ecdsa_verify_batch
 
@@ -78,12 +112,22 @@ def verify_signature_rows(
                     else "secp256r1"
                 )
                 mask = ecdsa_verify_batch(curve, keys, sigs, msgs)
-            out[idxs] = mask
+                pending._out[idxs] = mask
         else:
             for i in idxs:
                 key, sig, msg = rows[i]
-                out[i] = is_valid(key, sig, msg)
-    return out
+                pending._out[i] = is_valid(key, sig, msg)
+    return pending
+
+
+def verify_signature_rows(
+    rows: list[tuple], *, use_device: bool = True
+) -> np.ndarray:
+    """Verify (PublicKey, signature, message) rows → (N,) bool mask.
+
+    Synchronous wrapper over ``dispatch_signature_rows``.
+    """
+    return dispatch_signature_rows(rows, use_device=use_device).collect()
 
 
 @dataclasses.dataclass
@@ -111,17 +155,58 @@ class InvalidSignatureError(Exception):
         super().__init__(f"invalid signature by {sig.by!r} on tx {tx_id}")
 
 
-def check_transactions(
+class PendingTxCheck:
+    """An in-flight ``check_transactions``: signature rows are enqueued on
+    device, the per-tx signer-set algebra runs at ``collect()`` time."""
+
+    __slots__ = ("_stxs", "_allowed", "_pending", "_row_tx", "_row_sig",
+                 "_n_device")
+
+    def __init__(self, stxs, allowed, pending, row_tx, row_sig, n_device):
+        self._stxs = stxs
+        self._allowed = allowed
+        self._pending = pending
+        self._row_tx = row_tx
+        self._row_sig = row_sig
+        self._n_device = n_device
+
+    def collect(self) -> BatchVerifyReport:
+        stxs = self._stxs
+        mask = self._pending.collect()
+        results: list = [None] * len(stxs)
+        # first invalid signature per tx wins (matches the sequential
+        # reference loop's first-throw behavior)
+        for i, valid in enumerate(mask):
+            t = self._row_tx[i]
+            if not valid and results[t] is None:
+                results[t] = InvalidSignatureError(
+                    stxs[t].id, stxs[t].sigs[self._row_sig[i]]
+                )
+        for t, stx in enumerate(stxs):
+            if results[t] is not None:
+                continue
+            signed_by = {s.by for s in stx.sigs}
+            missing = {
+                k
+                for k in stx.required_signing_keys
+                if not is_fulfilled_by(k, signed_by)
+            } - set(self._allowed[t])
+            if missing:
+                results[t] = SignaturesMissingException(missing, stx.id)
+        return BatchVerifyReport(
+            results, n_sigs=len(self._row_tx), n_device=self._n_device
+        )
+
+
+def dispatch_transactions(
     stxs: list[SignedTransaction],
     allowed_missing: list[set] | None = None,
     *,
     use_device: bool = True,
-) -> BatchVerifyReport:
-    """Batched equivalent of ``stx.verify_signatures_except(allowed)`` over
-    many transactions: all signature rows flatten into one scheme-bucketed
-    dispatch, then per-tx signer-set algebra (composite-key fulfilment, the
-    host-cheap half of TransactionWithSignatures.kt:29-63) runs on the mask.
-    """
+    min_bucket: int | None = None,
+) -> PendingTxCheck:
+    """Enqueue the signature half of a batched tx check; see
+    ``check_transactions`` for semantics."""
     if allowed_missing is None:
         allowed_missing = [set()] * len(stxs)
     if len(allowed_missing) != len(stxs):
@@ -136,31 +221,30 @@ def check_transactions(
             row_tx.append(t)
             row_sig.append(j)
 
-    mask = verify_signature_rows(rows, use_device=use_device)
+    pending = dispatch_signature_rows(
+        rows, use_device=use_device, min_bucket=min_bucket
+    )
     n_device = (
         sum(1 for key, _s, _m in rows if key.scheme_id in _DEVICE_SCHEMES)
         if use_device
         else 0
     )
+    return PendingTxCheck(
+        stxs, allowed_missing, pending, row_tx, row_sig, n_device
+    )
 
-    results: list = [None] * len(stxs)
-    # first invalid signature per tx wins (matches the sequential reference
-    # loop's first-throw behavior)
-    for i, valid in enumerate(mask):
-        t = row_tx[i]
-        if not valid and results[t] is None:
-            results[t] = InvalidSignatureError(
-                stxs[t].id, stxs[t].sigs[row_sig[i]]
-            )
-    for t, stx in enumerate(stxs):
-        if results[t] is not None:
-            continue
-        signed_by = {s.by for s in stx.sigs}
-        missing = {
-            k
-            for k in stx.required_signing_keys
-            if not is_fulfilled_by(k, signed_by)
-        } - set(allowed_missing[t])
-        if missing:
-            results[t] = SignaturesMissingException(missing, stx.id)
-    return BatchVerifyReport(results, n_sigs=len(rows), n_device=n_device)
+
+def check_transactions(
+    stxs: list[SignedTransaction],
+    allowed_missing: list[set] | None = None,
+    *,
+    use_device: bool = True,
+) -> BatchVerifyReport:
+    """Batched equivalent of ``stx.verify_signatures_except(allowed)`` over
+    many transactions: all signature rows flatten into one scheme-bucketed
+    dispatch, then per-tx signer-set algebra (composite-key fulfilment, the
+    host-cheap half of TransactionWithSignatures.kt:29-63) runs on the mask.
+    """
+    return dispatch_transactions(
+        stxs, allowed_missing, use_device=use_device
+    ).collect()

@@ -261,6 +261,69 @@ class TestBatchedNotary:
         sig.verify(spend.id)
         svc.shutdown()
 
+    def test_process_stream_pipelined(self, alice, notary_id):
+        """The pipelined stream path must give the same per-request results
+        as the one-shot batch path, including double-spends ACROSS batch
+        boundaries (batch k commits before batch k+1 settles)."""
+        from corda_tpu.crypto import TransactionSignature
+
+        svc = BatchedNotaryService(
+            notary_id[0], notary_id[1], PersistentUniquenessProvider(),
+            use_device=False,
+        )
+        issues = [make_issue(alice, notary_id, value=50 + i) for i in range(6)]
+        spends = [make_spend(alice, notary_id, s, value=60 + i)
+                  for i, s in enumerate(issues)]
+        resolve = resolver_for(*issues)
+        # batch 2 re-spends issue[0] (conflict with batch 1) and issue[5]'s
+        # double appears within batch 3
+        double_b2 = make_spend(alice, notary_id, issues[0], value=99)
+        double_b3 = make_spend(alice, notary_id, issues[5], value=98)
+        batches = [
+            [(spends[0], resolve, "a"), (spends[1], resolve, "a")],
+            [(double_b2, resolve, "a"), (spends[2], resolve, "a")],
+            [(spends[3], resolve, "a"), (spends[4], resolve, "a"),
+             (spends[5], resolve, "a"), (double_b3, resolve, "a")],
+        ]
+        out = svc.process_stream(batches, depth=2)
+        assert isinstance(out[0][0], TransactionSignature)
+        assert isinstance(out[0][1], TransactionSignature)
+        assert isinstance(out[1][0], NotaryError)       # cross-batch double
+        assert out[1][0].conflict is not None
+        assert isinstance(out[1][1], TransactionSignature)
+        assert isinstance(out[2][3], NotaryError)       # in-batch double
+        for batch_out, batch in zip(out, batches):
+            for res, (stx, _, _) in zip(batch_out, batch):
+                if isinstance(res, TransactionSignature):
+                    res.verify(stx.id)
+
+    def test_storm_loadtest_drives_async_path(self, alice, notary_id):
+        """The loadtest harness shape (generate/interpret/execute/gather)
+        over the async request window commits every submitted tx."""
+        from corda_tpu.tools.loadtest import (
+            LoadTestRunner, RunParameters, notary_service_storm_test,
+        )
+
+        svc = BatchedNotaryService(
+            notary_id[0], notary_id[1], PersistentUniquenessProvider(),
+            use_device=False, window_s=0.005, max_batch=16,
+        )
+        issues = [make_issue(alice, notary_id, value=100 + i)
+                  for i in range(24)]
+        spends = [make_spend(alice, notary_id, s, value=200 + i)
+                  for i, s in enumerate(issues)]
+        resolve = resolver_for(*issues)
+        test = notary_service_storm_test(svc, spends, resolve, chunk=4)
+        params = RunParameters(
+            parallelism=3, generate_count=2,
+            execution_frequency_hz=None, gather_frequency=10**9,
+        )
+        metrics = LoadTestRunner(test, params).run()
+        svc.shutdown()
+        assert metrics["failed"] == 0
+        assert metrics["final_state"] == 24
+        assert svc.uniqueness.committed_txs() == 24
+
 
 # ----------------------------------------------------------- raft
 
