@@ -173,55 +173,79 @@ def _signers_of(tx, command_cls) -> set:
 def verify_fungible_asset(tx, state_cls) -> None:
     """Shared issue/move/exit verifier for Cash-like assets (reference:
     Cash.verify, asset/Cash.kt:199-236: groupStates by token, then clause
-    dispatch per group)."""
-    issue_signers = _signers_of(tx, Issue)
-    move_signers = _signers_of(tx, Move)
-    exit_cmds = tx.commands_of_type(Exit)
-    exit_signers = _signers_of(tx, Exit)
+    dispatch per group). Single source of truth is the batch form —
+    per-tx verification is the one-element batch."""
+    err = verify_fungible_asset_batch([tx], state_cls)[0]
+    if err is not None:
+        raise err
 
-    groups = tx.group_states(state_cls, lambda s: s.amount.token)
-    _require(bool(groups), f"no {state_cls.__name__} groups in transaction")
-    for group in groups:
-        token = group.grouping_key
-        in_total = sum(s.amount.quantity for s in group.inputs)
-        out_total = sum(s.amount.quantity for s in group.outputs)
 
-        if not group.inputs:
-            # issuance of this token (reference: verifyIssueCommand)
-            _require(bool(group.outputs), "issue group has no outputs")
-            _require(out_total > 0, "cannot issue zero value")
-            issuer_key = token.issuer.party.owning_key
-            _require(
-                issuer_key in issue_signers,
-                "issuer must sign an issuance",
-            )
-            continue
-
-        exit_amount = sum(
-            c.value.amount.quantity for c in exit_cmds
-            if c.value.amount.token == token
-        )
-        _require(
-            in_total == out_total + exit_amount,
-            f"value not conserved for {token}: {in_total} -> "
-            f"{out_total} (+{exit_amount} exited)",
-        )
-        owner_keys = {s.owner.owning_key for s in group.inputs}
-        if exit_amount:
-            # exits need owner AND issuer consent (reference: exit clause —
-            # exitKeys covers both)
-            required = owner_keys | {token.issuer.party.owning_key}
-            _require(
-                required <= exit_signers,
-                "exit requires the owners' and issuer's signatures",
-            )
-        if out_total:
-            _require(
-                owner_keys <= move_signers or (exit_amount and owner_keys <= exit_signers),
-                "input owners must sign a move",
-            )
-        elif not exit_amount:
-            _require(False, "inputs fully consumed with no outputs and no exit")
+def verify_fungible_asset_batch(ltxs, state_cls) -> list:
+    """Batched fungible verifier: same acceptance set as
+    ``verify_fungible_asset`` over each tx, one fused pass per transaction
+    (single state walk, memoised signer sets) instead of the generic
+    ``group_states`` machinery — the contract-semantics half of the
+    ≥10k-notarised-tx/sec path (SURVEY.md §7 hard part (f)). Returns one
+    ``None | Exception`` slot per tx.
+    """
+    out = []
+    for tx in ltxs:
+        try:
+            issue_signers = _signers_of(tx, Issue)
+            move_signers = _signers_of(tx, Move)
+            exit_cmds = tx.commands_of_type(Exit)
+            exit_signers = _signers_of(tx, Exit) if exit_cmds else set()
+            # one walk over inputs+outputs: token -> [in, out, owners, n_in]
+            acc: dict = {}
+            for s in tx.input_states():
+                if isinstance(s, state_cls):
+                    row = acc.setdefault(s.amount.token, [0, 0, set(), 0])
+                    row[0] += s.amount.quantity
+                    row[2].add(s.owner.owning_key)
+                    row[3] += 1
+            for s in tx.output_states():
+                if isinstance(s, state_cls):
+                    row = acc.setdefault(s.amount.token, [0, 0, set(), 0])
+                    row[1] += s.amount.quantity
+            _require(bool(acc), f"no {state_cls.__name__} groups in transaction")
+            for token, (in_total, out_total, owner_keys, n_in) in acc.items():
+                if n_in == 0:
+                    _require(out_total > 0, "cannot issue zero value")
+                    _require(
+                        token.issuer.party.owning_key in issue_signers,
+                        "issuer must sign an issuance",
+                    )
+                    continue
+                exit_amount = sum(
+                    c.value.amount.quantity for c in exit_cmds
+                    if c.value.amount.token == token
+                )
+                _require(
+                    in_total == out_total + exit_amount,
+                    f"value not conserved for {token}: {in_total} -> "
+                    f"{out_total} (+{exit_amount} exited)",
+                )
+                if exit_amount:
+                    required = owner_keys | {token.issuer.party.owning_key}
+                    _require(
+                        required <= exit_signers,
+                        "exit requires the owners' and issuer's signatures",
+                    )
+                if out_total:
+                    _require(
+                        owner_keys <= move_signers
+                        or (exit_amount and owner_keys <= exit_signers),
+                        "input owners must sign a move",
+                    )
+                elif not exit_amount:
+                    _require(
+                        False,
+                        "inputs fully consumed with no outputs and no exit",
+                    )
+            out.append(None)
+        except Exception as e:
+            out.append(e)
+    return out
 
 
 def fungible_move_rows(ltxs, state_cls=None):
@@ -259,6 +283,10 @@ class Cash:
     def verify(self, tx):
         verify_fungible_asset(tx, CashState)
 
+    def verify_batch(self, ltxs):
+        """Batched fast path (ledger_tx.verify_ledger_batch hook)."""
+        return verify_fungible_asset_batch(ltxs, CashState)
+
 
 @register_contract(COMMODITY_PROGRAM_ID)
 class Commodity:
@@ -266,6 +294,10 @@ class Commodity:
 
     def verify(self, tx):
         verify_fungible_asset(tx, CommodityState)
+
+    def verify_batch(self, ltxs):
+        """Batched fast path (ledger_tx.verify_ledger_batch hook)."""
+        return verify_fungible_asset_batch(ltxs, CommodityState)
 
 
 @register_contract(CP_PROGRAM_ID)

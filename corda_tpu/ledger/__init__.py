@@ -46,7 +46,7 @@ from .signed import (
     SignaturesMissingException,
     SignedTransaction,
 )
-from .ledger_tx import InOutGroup, LedgerTransaction
+from .ledger_tx import InOutGroup, LedgerTransaction, verify_ledger_batch
 from .filtered import (
     FilteredComponent,
     FilteredGroup,
@@ -69,7 +69,7 @@ __all__ = [
     "contract_code_hash", "register_contract", "resolve_contract",
     "ComponentGroupType", "PrivacySalt", "WireTransaction",
     "SignaturesMissingException", "SignedTransaction",
-    "InOutGroup", "LedgerTransaction",
+    "InOutGroup", "LedgerTransaction", "verify_ledger_batch",
     "FilteredComponent", "FilteredGroup", "FilteredTransaction",
     "FilteredTransactionVerificationException",
     "TransactionBuilder",

@@ -266,6 +266,98 @@ class LedgerTransaction:
                 )
 
 
+def verify_ledger_batch(ltxs: list[LedgerTransaction]) -> list:
+    """Batched ``ltx.verify()`` over many transactions → one result slot
+    per tx (None = valid, else the TransactionVerificationException).
+
+    Structural checks (special forms, notary pinning, encumbrances,
+    constraints) run per-tx — they are cheap dict/set work. Contract
+    SEMANTICS dispatch once per contract class across the whole cohort:
+    a contract exposing ``verify_batch(ltxs) -> list[Exception | None]``
+    checks all its transactions in one fused pass (the vectorizable
+    fungible fast path, SURVEY §7 hard part (f)); others fall back to
+    per-tx ``verify``. This is the validating batched notary's host half —
+    per-tx Python overhead is what bounds notarised-tx/sec once signatures
+    are on device.
+    """
+    n = len(ltxs)
+    results: list = [None] * n
+    live: list[int] = []
+    for i, ltx in enumerate(ltxs):
+        try:
+            if ltx.commands_of_type(NotaryChangeCommand):
+                ltx._verify_notary_change()
+                continue
+            if ltx.commands_of_type(UpgradeCommand):
+                ltx._verify_contract_upgrade()
+                continue
+            ltx.check_no_notary_change()
+            ltx.check_encumbrances()
+            ltx.verify_constraints()
+            live.append(i)
+        except TransactionVerificationException as e:
+            results[i] = e
+        except Exception as e:
+            results[i] = TransactionVerificationException(
+                ltx.tx_id, f"structural check failed: {e}"
+            )
+
+    cohorts: dict[str, list[int]] = {}
+    for i in live:
+        for name in ltxs[i].referenced_contracts():
+            cohorts.setdefault(name, []).append(i)
+
+    for name, idxs in cohorts.items():
+        idxs = [i for i in idxs if results[i] is None]
+        if not idxs:
+            continue
+        try:
+            contract = resolve_contract(name)()
+        except TransactionVerificationException as e:
+            for i in idxs:
+                results[i] = e
+            continue
+        except Exception as e:
+            for i in idxs:
+                results[i] = TransactionVerificationException(
+                    ltxs[i].tx_id, f"contract {name} failed to instantiate: {e}"
+                )
+            continue
+        batch_fn = getattr(contract, "verify_batch", None)
+        errs = None
+        if batch_fn is not None:
+            # trust boundary: a hook that raises or returns the wrong
+            # number of slots must not fail (or worse, fail-OPEN for) the
+            # other transactions — fall back to the per-tx verifier
+            try:
+                errs = batch_fn([ltxs[i] for i in idxs])
+                if len(errs) != len(idxs):
+                    errs = None
+            except Exception:
+                errs = None
+        if errs is not None:
+            for i, err in zip(idxs, errs):
+                if err is not None and results[i] is None:
+                    results[i] = (
+                        err
+                        if isinstance(err, TransactionVerificationException)
+                        else TransactionVerificationException(
+                            ltxs[i].tx_id, f"contract {name} rejected: {err}"
+                        )
+                    )
+        else:
+            for i in idxs:
+                try:
+                    contract.verify(ltxs[i])
+                except TransactionVerificationException as e:
+                    results[i] = e
+                except Exception as e:
+                    results[i] = TransactionVerificationException(
+                        ltxs[i].tx_id, f"contract {name} rejected: {e}"
+                    )
+    return results
+
+
 @dataclasses.dataclass(frozen=True)
 class InOutGroup:
     inputs: tuple

@@ -16,6 +16,7 @@ attachments pin the registered code hash instead of a JAR hash).
 from __future__ import annotations
 
 import dataclasses
+import functools as _functools
 import uuid as _uuid
 from typing import Any, ClassVar, Protocol, runtime_checkable
 
@@ -73,8 +74,11 @@ def resolve_contract(name: str) -> type:
         ) from None
 
 
+@_functools.lru_cache(maxsize=1024)
 def contract_code_hash(name: str) -> SecureHash:
-    """Deterministic stand-in for the reference's attachment JAR hash."""
+    """Deterministic stand-in for the reference's attachment JAR hash.
+    Cached: the constraint check recomputes it per state on the notary's
+    hot path."""
     return sha256(b"CTCONTRACT" + name.encode())
 
 

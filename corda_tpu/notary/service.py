@@ -244,17 +244,28 @@ class BatchedNotaryService(NotaryService):
             else:
                 live.append(i)
         if self._validating:
-            still_live = []
+            from corda_tpu.ledger.ledger_tx import verify_ledger_batch
+
+            resolved: list[int] = []
+            ltxs = []
             for i in live:
                 stx, resolve_state, _caller = requests[i]
                 try:
                     self._check_notary(stx.tx.notary, stx.id)
-                    ltx = stx.tx.to_ledger_transaction(resolve_state)
-                    ltx.verify()
                     self.check_time_window(stx.tx.time_window)
-                    still_live.append(i)
+                    ltxs.append(stx.tx.to_ledger_transaction(resolve_state))
+                    resolved.append(i)
                 except Exception as e:
                     results[i] = NotaryError(f"validation failed: {e}")
+            # contract semantics dispatch once per contract class across
+            # the batch (verify_batch fast paths) instead of per tx
+            errs = verify_ledger_batch(ltxs)
+            still_live = []
+            for i, err in zip(resolved, errs):
+                if err is None:
+                    still_live.append(i)
+                else:
+                    results[i] = NotaryError(f"validation failed: {err}")
             live = still_live
         else:
             still_live = []

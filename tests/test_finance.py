@@ -398,3 +398,165 @@ class TestGeneratedLedger:
             (len(stx.inputs), len(stx.tx.outputs)) for stx in txs.values()
         )
         assert shape(a) == shape(b)
+
+
+# ---------------------------------------------------- batched verification
+
+from corda_tpu.crypto import sha256
+from corda_tpu.ledger import (
+    Command,
+    LedgerTransaction,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+    verify_ledger_batch,
+)
+from corda_tpu.finance.contracts import verify_fungible_asset_batch
+from corda_tpu.ledger.states import contract_code_hash
+
+
+def _ltx(tag, ins, outs, commands, contract=CASH_PROGRAM_ID):
+    """Hand-built LedgerTransaction over fungible states."""
+    src = sha256(b"src-" + tag)
+    return LedgerTransaction(
+        tx_id=sha256(tag),
+        inputs=tuple(
+            StateAndRef(
+                TransactionState(s, contract, DUMMY_NOTARY), StateRef(src, i)
+            )
+            for i, s in enumerate(ins)
+        ),
+        outputs=tuple(
+            TransactionState(s, contract, DUMMY_NOTARY) for s in outs
+        ),
+        commands=tuple(commands),
+        attachments=(contract_code_hash(contract),),
+        notary=DUMMY_NOTARY,
+        time_window=None,
+    )
+
+
+class TestBatchedFungibleVerification:
+    """The batch fast path must accept/reject EXACTLY the set the per-tx
+    verifier does (same cohort dispatched through verify_ledger_batch and
+    through ltx.verify())."""
+
+    def _cohort(self):
+        usd = Issued(PartyAndReference(CHARLIE, b"\x02"), "USD")
+        return [
+            # valid issue
+            _ltx(b"t0", [], [cash(100, ALICE)], [Command(Issue(), (ISSUER_KEY,))]),
+            # valid move with change
+            _ltx(b"t1", [cash(100, ALICE)], [cash(60, BOB), cash(40, ALICE)],
+                 [Command(Move(), (ALICE.owning_key,))]),
+            # inflation
+            _ltx(b"t2", [cash(100, ALICE)], [cash(150, BOB)],
+                 [Command(Move(), (ALICE.owning_key,))]),
+            # wrong signer on move
+            _ltx(b"t3", [cash(100, ALICE)], [cash(100, BOB)],
+                 [Command(Move(), (BOB.owning_key,))]),
+            # issue without issuer signature
+            _ltx(b"t4", [], [cash(5, ALICE)], [Command(Issue(), (ALICE.owning_key,))]),
+            # valid exit of the full amount
+            _ltx(b"t5", [cash(30, ALICE)], [],
+                 [Command(Exit(Amount(30, GBP)), (ALICE.owning_key, ISSUER_KEY))]),
+            # exit without issuer consent
+            _ltx(b"t6", [cash(30, ALICE)], [],
+                 [Command(Exit(Amount(30, GBP)), (ALICE.owning_key,))]),
+            # consumed with no outputs and no exit command
+            _ltx(b"t7", [cash(30, ALICE)], [],
+                 [Command(Move(), (ALICE.owning_key,))]),
+            # two-token tx: GBP conserved, USD inflated -> must fail
+            _ltx(b"t8", [cash(10, ALICE), cash(10, ALICE, usd)],
+                 [cash(10, BOB), cash(99, BOB, usd)],
+                 [Command(Move(), (ALICE.owning_key,))]),
+            # zero-value issue
+            _ltx(b"t9", [], [cash(0, ALICE)], [Command(Issue(), (ISSUER_KEY,))]),
+        ]
+
+    def test_batch_matches_per_tx_fungible(self):
+        cohort = self._cohort()
+        batch = verify_fungible_asset_batch(cohort, CashState)
+        for ltx, err in zip(cohort, batch):
+            try:
+                from corda_tpu.finance.contracts import verify_fungible_asset
+
+                verify_fungible_asset(ltx, CashState)
+                per_tx = None
+            except Exception as e:
+                per_tx = e
+            assert (err is None) == (per_tx is None), (
+                ltx.tx_id, err, per_tx
+            )
+
+    def test_verify_ledger_batch_matches_verify(self):
+        cohort = self._cohort()
+        batch = verify_ledger_batch(cohort)
+        for ltx, err in zip(cohort, batch):
+            try:
+                ltx.verify()
+                per_tx = None
+            except Exception as e:
+                per_tx = e
+            assert (err is None) == (per_tx is None), (ltx.tx_id, err, per_tx)
+
+    def test_verify_ledger_batch_structural_failure(self):
+        # constraint failure (missing attachment) caught per-tx, others fine
+        good = self._cohort()[0]
+        bad = LedgerTransaction(
+            tx_id=sha256(b"bad"), inputs=good.inputs, outputs=good.outputs,
+            commands=good.commands, attachments=(),  # no attachment
+            notary=DUMMY_NOTARY, time_window=None,
+        )
+        out = verify_ledger_batch([good, bad])
+        assert out[0] is None
+        assert out[1] is not None and "attachment" in str(out[1])
+
+    def test_misbehaving_batch_hook_falls_back(self):
+        """A verify_batch hook that raises or returns the wrong number of
+        slots must not fail (or fail-open) the cohort: the framework falls
+        back to per-tx verify."""
+        from corda_tpu.ledger import register_contract
+
+        calls = {"batch": 0, "per_tx": 0}
+
+        @register_contract("test.MisbehavingBatch")
+        class Misbehaving:
+            def verify(self, tx):
+                calls["per_tx"] += 1
+
+            def verify_batch(self, ltxs):
+                calls["batch"] += 1
+                raise AttributeError("boom")
+
+        good = self._cohort()[0]
+        tx = LedgerTransaction(
+            tx_id=sha256(b"mb"), inputs=(), outputs=(
+                TransactionState(cash(5, ALICE), "test.MisbehavingBatch",
+                                 DUMMY_NOTARY),),
+            commands=(Command(Issue(), (ISSUER_KEY,)),),
+            attachments=(contract_code_hash("test.MisbehavingBatch"),),
+            notary=DUMMY_NOTARY, time_window=None,
+        )
+        out = verify_ledger_batch([good, tx])
+        assert out == [None, None]
+        assert calls["batch"] == 1 and calls["per_tx"] == 1
+
+        @register_contract("test.ShortBatch")
+        class ShortBatch:
+            def verify(self, tx):
+                calls["per_tx"] += 1
+
+            def verify_batch(self, ltxs):
+                return []  # wrong length: must not be trusted
+
+        tx2 = LedgerTransaction(
+            tx_id=sha256(b"sb"), inputs=(), outputs=(
+                TransactionState(cash(5, ALICE), "test.ShortBatch",
+                                 DUMMY_NOTARY),),
+            commands=(Command(Issue(), (ISSUER_KEY,)),),
+            attachments=(contract_code_hash("test.ShortBatch"),),
+            notary=DUMMY_NOTARY, time_window=None,
+        )
+        assert verify_ledger_batch([tx2]) == [None]
+        assert calls["per_tx"] == 2
