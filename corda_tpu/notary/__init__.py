@@ -21,6 +21,7 @@ from .service import (
     ValidatingNotaryService,
 )
 from .raft import RaftNode, RaftUniquenessProvider
+from .raft_storage import RaftStorage
 from .bft import BFTClusterClient, BFTReplica, BFTUniquenessProvider
 
 __all__ = [
@@ -28,6 +29,6 @@ __all__ = [
     "UniquenessConflict", "UniquenessProvider",
     "BatchedNotaryService", "NotaryService", "SimpleNotaryService",
     "ValidatingNotaryService",
-    "RaftNode", "RaftUniquenessProvider",
+    "RaftNode", "RaftStorage", "RaftUniquenessProvider",
     "BFTClusterClient", "BFTReplica", "BFTUniquenessProvider",
 ]

@@ -7,15 +7,27 @@ total-order submission and gathers signed replica replies;
 commits, replying with a per-replica signature over the outcome; the client
 accepts on a cluster signature quorum). The consensus engine the reference
 outsources to the BFT-SMaRt jar is implemented here as PBFT-style
-three-phase total-order broadcast (pre-prepare / prepare / commit with 2f
-and 2f+1 quorums over n = 3f+1 replicas) on this framework's messaging
-layer.
+three-phase total-order broadcast (pre-prepare / prepare / commit with
+2f+1 quorums over n = 3f+1 replicas) on this framework's messaging layer.
 
-Scope note: view changes are not implemented — safety holds under f
-Byzantine replicas (quorum intersection + signed replies), while liveness
-assumes the view's primary stays up, the same operational posture the
-reference's demo configs run (static view, BFTSMaRtConfig.kt). A client
-that times out surfaces the failure rather than electing a new primary.
+View changes (liveness under primary failure — BFT-SMaRt's leader-change
+regency protocol): primary of view v is ``names[v % n]``. A replica whose
+pending requests stall past the suspicion timeout broadcasts a SIGNED
+VIEW-CHANGE carrying its prepared certificates; replicas join a view
+change once f+1 peers demand one (so a single faulty replica cannot force
+view churn); the new primary installs the view with a NEW-VIEW containing
+2f+1 signed view-change messages and re-proposes every prepared entry —
+by quorum intersection any entry committed at an honest replica appears in
+at least one certificate of any 2f+1 set, so committed state is never
+lost. Unordered pending requests are re-proposed by the new primary (the
+client broadcasts every request to all replicas), restoring liveness.
+
+Trust model note: phase messages ride authenticated channels (the
+transport identifies senders); VIEW-CHANGE/NEW-VIEW are additionally
+signed with the replica keys, so a new-view certificate is
+non-repudiable. Prepare certificates inside a view-change are the
+collector's claim (MAC-PBFT posture) — sufficient for crash faults and
+for Byzantine replicas that cannot forge channel identities.
 """
 
 from __future__ import annotations
@@ -45,6 +57,10 @@ T_PREPREPARE = "bft.preprepare"
 T_PREPARE = "bft.prepare"
 T_COMMIT = "bft.commit"
 T_REPLY = "bft.reply"
+T_VIEWCHANGE = "bft.viewchange"
+T_NEWVIEW = "bft.newview"
+
+_NULL_DIGEST = b""  # gap-filling no-op slot installed by a new view
 
 
 def _digest(command: bytes) -> bytes:
@@ -56,30 +72,35 @@ def _digest(command: bytes) -> bytes:
 class BFTReplica:
     """One PBFT replica executing a deterministic uniqueness state machine.
 
-    ``names`` fixes the cluster membership and view: primary = names[0].
-    f = (n - 1) // 3 replicas may be Byzantine.
+    ``names`` fixes the cluster membership; the view rotates the primary
+    over it. f = (n - 1) // 3 replicas may be faulty.
     """
 
     def __init__(self, name: str, names: list[str], messaging, keypair: KeyPair,
-                 base: UniquenessProvider | None = None):
+                 base: UniquenessProvider | None = None,
+                 replica_keys: dict[str, PublicKey] | None = None,
+                 view_timeout_s: float = 1.0):
         self.name = name
         self.names = list(names)
         self.n = len(names)
         self.f = (self.n - 1) // 3
         self._messaging = messaging
         self._keypair = keypair
+        self._replica_keys = dict(replica_keys or {})
         self.base = base or InMemoryUniquenessProvider()
         self._lock = threading.RLock()
+        self.view = 0
         self._seq = 0                     # primary: next sequence number
         self._commands: dict[bytes, bytes] = {}   # digest -> command
         self._client_of: dict[bytes, str] = {}    # digest -> requesting client
-        self._preprepared: dict[int, bytes] = {}  # seq -> digest
-        # quorum tallies are keyed by (seq, digest): votes for different
-        # commands at the same sequence must never be conflated, or an
-        # equivocating primary could split honest replicas onto divergent
-        # uniqueness maps with both sides reaching "quorum"
-        self._prepares: dict[tuple[int, bytes], set[str]] = defaultdict(set)
-        self._commits: dict[tuple[int, bytes], set[str]] = defaultdict(set)
+        # (view, seq) -> digest accepted from that view's primary
+        self._preprepared: dict[tuple[int, int], bytes] = {}
+        # quorum tallies keyed by (view, seq, digest): votes for different
+        # commands at one sequence — or from different views — must never
+        # be conflated, or an equivocating primary could split honest
+        # replicas onto divergent uniqueness maps
+        self._prepares: dict[tuple[int, int, bytes], set[str]] = defaultdict(set)
+        self._commits: dict[tuple[int, int, bytes], set[str]] = defaultdict(set)
         self._next_exec = 0               # execute strictly in sequence order
         self._exec_queue: dict[int, bytes] = {}
         # recently-executed digests (bounded): a late/duplicate T_REQUEST
@@ -87,15 +108,41 @@ class BFTReplica:
         # entries that nothing will ever prune
         self._executed_digests: deque = deque(maxlen=4096)
         self._executed_set: set[bytes] = set()
+        # ----- view-change state
+        self._view_timeout_s = view_timeout_s
+        self._pending_since: dict[bytes, float] = {}  # digest -> arrival time
+        self._vc_msgs: dict[int, dict[str, tuple[bytes, bytes]]] = defaultdict(dict)
+        self._vc_sent_for = 0             # highest view we demanded
+        self._vc_last_sent = 0.0
+        self._stop = threading.Event()
+        self._timer: threading.Thread | None = None
         for topic, h in (
             (T_REQUEST, self._on_request), (T_PREPREPARE, self._on_preprepare),
             (T_PREPARE, self._on_prepare), (T_COMMIT, self._on_commit),
+            (T_VIEWCHANGE, self._on_viewchange), (T_NEWVIEW, self._on_newview),
         ):
             messaging.add_handler(topic, auto_ack(h))
+        self._start_timer()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _start_timer(self) -> None:
+        self._timer = threading.Thread(
+            target=self._timer_loop, daemon=True, name=f"bft-timer-{self.name}"
+        )
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._timer is not None:
+            self._timer.join(timeout=2)
+
+    def primary_of(self, view: int) -> str:
+        return self.names[view % self.n]
 
     @property
     def is_primary(self) -> bool:
-        return self.name == self.names[0]
+        return self.name == self.primary_of(self.view)
 
     MAX_PENDING_COMMANDS = 10_000
 
@@ -109,6 +156,7 @@ class BFTReplica:
             oldest = next(iter(self._commands))
             self._commands.pop(oldest, None)
             self._client_of.pop(oldest, None)
+            self._pending_since.pop(oldest, None)
 
     def _multicast(self, topic: str, obj) -> None:
         payload = serialize(obj)
@@ -127,77 +175,82 @@ class BFTReplica:
                 return  # late duplicate of an executed command
             self._commands[d] = command
             self._client_of[d] = req["client"]
+            self._pending_since.setdefault(d, time.monotonic())
             self._bound_pending()
             if not self.is_primary:
                 return
+            view = self.view
             seq = self._seq
             self._seq += 1
-            self._preprepared[seq] = d
-            self._prepares[(seq, d)].add(self.name)
-        self._multicast(T_PREPREPARE, {"seq": seq, "digest": d,
+            self._preprepared[(view, seq)] = d
+            self._prepares[(view, seq, d)].add(self.name)
+        self._multicast(T_PREPREPARE, {"view": view, "seq": seq, "digest": d,
                                        "command": command,
                                        "client": req["client"]})
-        self._check_prepared(seq)
+        self._check_prepared(view, seq)
 
     def _on_preprepare(self, msg) -> None:
         pp = deserialize(msg.payload)
-        if msg.sender != self.names[0]:
-            return  # only the view primary may pre-prepare
-        seq, d = pp["seq"], pp["digest"]
+        view, seq, d = pp["view"], pp["seq"], pp["digest"]
+        if msg.sender != self.primary_of(view):
+            return  # only that view's primary may pre-prepare
         if _digest(pp["command"]) != d:
             return  # Byzantine primary: digest mismatch
         with self._lock:
-            if seq < self._next_exec:
-                return  # already executed and pruned
-            existing = self._preprepared.get(seq)
+            if view < self.view or seq < self._next_exec:
+                return  # stale view, or already executed and pruned
+            existing = self._preprepared.get((view, seq))
             if existing is not None and existing != d:
                 return  # primary equivocation: keep the first
-            self._preprepared[seq] = d
+            self._preprepared[(view, seq)] = d
             self._commands[d] = pp["command"]
             self._client_of[d] = pp["client"]
-            self._prepares[(seq, d)].add(self.name)
-            self._prepares[(seq, d)].add(msg.sender)
-        self._multicast(T_PREPARE, {"seq": seq, "digest": d})
-        self._check_prepared(seq)
+            self._pending_since.setdefault(d, time.monotonic())
+            self._prepares[(view, seq, d)].add(self.name)
+            self._prepares[(view, seq, d)].add(msg.sender)
+        self._multicast(T_PREPARE, {"view": view, "seq": seq, "digest": d})
+        self._check_prepared(view, seq)
 
     def _on_prepare(self, msg) -> None:
         p = deserialize(msg.payload)
-        seq, d = p["seq"], p["digest"]
+        view, seq, d = p["view"], p["seq"], p["digest"]
         with self._lock:
-            if seq < self._next_exec:
+            if view < self.view or seq < self._next_exec:
                 return
-            self._prepares[(seq, d)].add(msg.sender)
-        self._check_prepared(seq)
+            # future-view votes are tallied too: after a NEW-VIEW installs
+            # that view, the early votes count instead of being lost
+            self._prepares[(view, seq, d)].add(msg.sender)
+        self._check_prepared(view, seq)
 
-    def _check_prepared(self, seq: int) -> None:
+    def _check_prepared(self, view: int, seq: int) -> None:
         with self._lock:
             # prepared: our pre-prepare's digest gathered 2f+1 prepares
             # (incl. own); then cast our commit vote once
-            d = self._preprepared.get(seq)
+            d = self._preprepared.get((view, seq))
             if (d is not None
-                    and len(self._prepares[(seq, d)]) >= 2 * self.f + 1
-                    and self.name not in self._commits[(seq, d)]):
-                self._commits[(seq, d)].add(self.name)
+                    and len(self._prepares[(view, seq, d)]) >= 2 * self.f + 1
+                    and self.name not in self._commits[(view, seq, d)]):
+                self._commits[(view, seq, d)].add(self.name)
             else:
                 return
-        self._multicast(T_COMMIT, {"seq": seq, "digest": d})
-        self._check_committed(seq)
+        self._multicast(T_COMMIT, {"view": view, "seq": seq, "digest": d})
+        self._check_committed(view, seq)
 
     def _on_commit(self, msg) -> None:
         c = deserialize(msg.payload)
-        seq, d = c["seq"], c["digest"]
+        view, seq, d = c["view"], c["seq"], c["digest"]
         with self._lock:
-            if seq < self._next_exec:
+            if view < self.view or seq < self._next_exec:
                 return
-            self._commits[(seq, d)].add(msg.sender)
-        self._check_prepared(seq)
-        self._check_committed(seq)
+            self._commits[(view, seq, d)].add(msg.sender)
+        self._check_prepared(view, seq)
+        self._check_committed(view, seq)
 
-    def _check_committed(self, seq: int) -> None:
+    def _check_committed(self, view: int, seq: int) -> None:
         with self._lock:
-            d = self._preprepared.get(seq)
+            d = self._preprepared.get((view, seq))
             if (d is not None
-                    and len(self._commits[(seq, d)]) >= 2 * self.f + 1
+                    and len(self._commits[(view, seq, d)]) >= 2 * self.f + 1
                     and seq >= self._next_exec
                     and seq not in self._exec_queue):
                 self._exec_queue[seq] = d
@@ -208,21 +261,25 @@ class BFTReplica:
                 # a client retry can order the same digest under two
                 # sequence numbers; the first execution pruned the command,
                 # so the duplicate slot is a no-op (commit is idempotent
-                # per tx anyway)
-                command_i = self._commands.get(d_i)
+                # per tx anyway). Null slots (view-change gap fill) skip.
+                command_i = (
+                    self._commands.get(d_i) if d_i != _NULL_DIGEST else None
+                )
                 if command_i is not None:
                     to_run.append((seq_i, d_i, command_i,
                                    self._client_of.get(d_i)))
                 self._next_exec += 1
                 # prune per-sequence protocol state (bounded memory at
                 # sustained notarisation rates)
-                self._preprepared.pop(seq_i, None)
+                for key in [k for k in self._preprepared if k[1] == seq_i]:
+                    del self._preprepared[key]
                 for store in (self._prepares, self._commits):
-                    for key in [k for k in store if k[0] == seq_i]:
+                    for key in [k for k in store if k[1] == seq_i]:
                         del store[key]
                 self._commands.pop(d_i, None)
                 self._client_of.pop(d_i, None)
-                if d_i not in self._executed_set:
+                self._pending_since.pop(d_i, None)
+                if d_i != _NULL_DIGEST and d_i not in self._executed_set:
                     if (len(self._executed_digests)
                             == self._executed_digests.maxlen):
                         self._executed_set.discard(self._executed_digests[0])
@@ -251,19 +308,224 @@ class BFTReplica:
                        "sig": sig, "key": self._keypair.public}),
         )
 
+    # ------------------------------------------------------- view change
+
+    def _timer_loop(self) -> None:
+        """Suspicion timer: pending requests that stall past the timeout
+        indict the current primary."""
+        while not self._stop.wait(0.05):
+            with self._lock:
+                if not self._pending_since:
+                    continue
+                oldest = min(self._pending_since.values())
+                now = time.monotonic()
+                stalled = now - oldest > self._view_timeout_s
+                resend_ok = now - self._vc_last_sent > self._view_timeout_s
+                if stalled and resend_ok:
+                    target = max(self.view + 1, self._vc_sent_for + 1)
+                else:
+                    continue
+            self._send_viewchange(target)
+
+    def _prepared_certs(self) -> list:
+        """(view, seq, digest, command) for every slot this replica has
+        PREPARED (2f+1 prepare votes) but not yet executed — what must
+        survive into the new view (caller holds the lock). The view rides
+        along so conflicting same-seq certs from different views resolve
+        deterministically (highest view wins, PBFT's selection rule)."""
+        certs = []
+        for (view, seq), d in self._preprepared.items():
+            if seq < self._next_exec or d == _NULL_DIGEST:
+                continue
+            if len(self._prepares[(view, seq, d)]) >= 2 * self.f + 1:
+                cmd = self._commands.get(d)
+                if cmd is not None:
+                    certs.append((view, seq, d, cmd))
+        return certs
+
+    def _newview_preps(self, vcs) -> list:
+        """Recompute the new view's re-proposals from the 2f+1 SIGNED
+        view-change messages — every replica derives this list itself and
+        NEVER trusts a primary-supplied one, so a Byzantine new primary
+        cannot drop or overwrite a committed entry (any entry committed at
+        an honest replica is prepared at ≥ f+1 honest replicas, hence
+        certified inside every 2f+1 view-change set by quorum
+        intersection). Per slot the highest-view certificate wins; gaps
+        below the top fill with null no-ops. Caller holds the lock."""
+        union: dict[int, tuple[int, bytes, bytes]] = {}
+        for body, _sig in vcs.values():
+            parsed = deserialize(body)
+            for view, seq, d, cmd in parsed["certs"]:
+                cur = union.get(seq)
+                if cur is None or view > cur[0]:
+                    union[seq] = (view, d, cmd)
+        top = max(union) if union else self._next_exec - 1
+        preps = []
+        for seq in range(self._next_exec, top + 1):
+            hit = union.get(seq)
+            if hit is None:
+                preps.append((seq, _NULL_DIGEST, b""))
+            else:
+                preps.append((seq, hit[1], hit[2]))
+        return preps
+
+    def _send_viewchange(self, target_view: int) -> None:
+        with self._lock:
+            if target_view <= self.view or self._vc_sent_for >= target_view:
+                return
+            self._vc_sent_for = target_view
+            self._vc_last_sent = time.monotonic()
+            body = serialize({
+                "view": target_view, "sender": self.name,
+                "last_exec": self._next_exec - 1,
+                "certs": self._prepared_certs(),
+            })
+            sig = host_sign(self._keypair.private, body)
+            self._vc_msgs[target_view][self.name] = (body, sig)
+        self._multicast(T_VIEWCHANGE, {"body": body, "sig": sig})
+        self._maybe_install_view(target_view)
+
+    def _vc_valid(self, sender: str, body: bytes, sig: bytes) -> bool:
+        key = self._replica_keys.get(sender)
+        if key is None:
+            # no key directory configured: fall back to channel identity
+            return True
+        try:
+            return host_verify(key, sig, body)
+        except Exception:
+            return False
+
+    def _on_viewchange(self, msg) -> None:
+        vc = deserialize(msg.payload)
+        body, sig = vc["body"], vc["sig"]
+        parsed = deserialize(body)
+        target = parsed["view"]
+        sender = parsed["sender"]
+        if sender != msg.sender or not self._vc_valid(sender, body, sig):
+            return
+        with self._lock:
+            if target <= self.view:
+                return
+            self._vc_msgs[target][sender] = (body, sig)
+            # join rule: once f+1 peers demand a higher view, a correct
+            # replica joins the SMALLEST such view — one faulty replica
+            # alone can never force churn
+            joinable = sorted(
+                v for v, msgs in self._vc_msgs.items()
+                if v > self.view and len(msgs) >= self.f + 1
+            )
+        if joinable and self._vc_sent_for < joinable[0]:
+            self._send_viewchange(joinable[0])
+        self._maybe_install_view(target)
+
+    def _maybe_install_view(self, target: int) -> None:
+        """The would-be primary of ``target`` installs it once 2f+1 signed
+        view-change messages (incl. its own) are in hand."""
+        with self._lock:
+            if (self.primary_of(target) != self.name
+                    or target <= self.view
+                    or len(self._vc_msgs[target]) < 2 * self.f + 1):
+                return
+            vcs = dict(self._vc_msgs[target])
+            preps = self._newview_preps(vcs)
+            newview = {"view": target, "vcs": vcs}
+        self._multicast(T_NEWVIEW, newview)
+        self._install_view(target, preps, as_primary=True)
+
+    def _on_newview(self, msg) -> None:
+        nv = deserialize(msg.payload)
+        target = nv["view"]
+        if msg.sender != self.primary_of(target):
+            return
+        with self._lock:
+            if target <= self.view:
+                return
+        # validate the certificate: 2f+1 distinct signed view-changes
+        valid_vcs = {}
+        for sender, (body, sig) in nv["vcs"].items():
+            parsed = deserialize(body)
+            if parsed["sender"] == sender and parsed["view"] == target \
+                    and self._vc_valid(sender, body, sig):
+                valid_vcs[sender] = (body, sig)
+        if len(valid_vcs) < 2 * self.f + 1:
+            return
+        # derive the re-proposals from the signed VCs OURSELVES — the
+        # primary's own list is never trusted
+        with self._lock:
+            preps = self._newview_preps(valid_vcs)
+        self._install_view(target, preps, as_primary=False)
+
+    def _install_view(self, target: int, preps, as_primary: bool) -> None:
+        with self._lock:
+            if target <= self.view:
+                return
+            self.view = target
+            self._vc_sent_for = max(self._vc_sent_for, target)
+            for v in [v for v in self._vc_msgs if v <= target]:
+                del self._vc_msgs[v]
+            # give the new primary a full timeout before suspecting it
+            now = time.monotonic()
+            for d in self._pending_since:
+                self._pending_since[d] = now
+            max_seq = self._next_exec - 1
+            installs = []
+            for seq, d, cmd in preps:
+                if seq < self._next_exec:
+                    continue
+                max_seq = max(max_seq, seq)
+                self._preprepared[(target, seq)] = d
+                if d != _NULL_DIGEST:
+                    self._commands[d] = cmd
+                    self._client_of.setdefault(d, "")
+                    self._pending_since.setdefault(d, now)
+                self._prepares[(target, seq, d)].add(self.name)
+                self._prepares[(target, seq, d)].add(self.primary_of(target))
+                installs.append((seq, d))
+            if as_primary:
+                self._seq = max_seq + 1
+                # liveness: re-propose pending requests that never got a
+                # sequence in the old view (clients broadcast to all
+                # replicas, so the new primary holds them already)
+                reproposals = []
+                ordered = set(self._preprepared.values())
+                for d, cmd in list(self._commands.items()):
+                    if d in ordered or d in self._executed_set:
+                        continue
+                    seq = self._seq
+                    self._seq += 1
+                    self._preprepared[(target, seq)] = d
+                    self._prepares[(target, seq, d)].add(self.name)
+                    reproposals.append(
+                        (seq, d, cmd, self._client_of.get(d, ""))
+                    )
+        for seq, d in installs:
+            self._multicast(T_PREPARE, {"view": target, "seq": seq, "digest": d})
+            self._check_prepared(target, seq)
+        if as_primary:
+            for seq, d, cmd, client in reproposals:
+                self._multicast(T_PREPREPARE, {
+                    "view": target, "seq": seq, "digest": d,
+                    "command": cmd, "client": client,
+                })
+                self._check_prepared(target, seq)
+
 
 class BFTClusterClient:
     """The client side (reference: BFTSMaRt.Client): broadcast the request,
-    accept when f+1 replicas sign the *same* outcome."""
+    accept when f+1 replicas sign the *same* outcome. Retries the broadcast
+    once per view-timeout so requests arriving during a view change are
+    re-seeded into the new view."""
 
     def __init__(self, name: str, messaging, replica_names: list[str],
-                 replica_keys: dict[str, PublicKey], timeout_s: float = 5.0):
+                 replica_keys: dict[str, PublicKey], timeout_s: float = 5.0,
+                 retry_every_s: float = 1.5):
         self.name = name
         self._messaging = messaging
         self._replicas = list(replica_names)
         self._keys = dict(replica_keys)
         self.f = (len(replica_names) - 1) // 3
         self._timeout_s = timeout_s
+        self._retry_every_s = retry_every_s
         self._lock = threading.Lock()
         # digest -> {outcome_bytes: {replica: sig}}
         self._replies: dict[bytes, dict[bytes, dict[str, bytes]]] = {}
@@ -301,10 +563,20 @@ class BFTClusterClient:
         with self._lock:
             self._futures[d] = fut
         payload = serialize({"command": command, "client": self.name})
-        for r in self._replicas:
-            self._messaging.send(r, T_REQUEST, payload)
+        deadline = time.monotonic() + self._timeout_s
         try:
-            outcome_bytes, sigs = fut.result(timeout=self._timeout_s)
+            while True:
+                for r in self._replicas:
+                    self._messaging.send(r, T_REQUEST, payload)
+                try:
+                    outcome_bytes, sigs = fut.result(
+                        timeout=min(self._retry_every_s,
+                                    max(0.01, deadline - time.monotonic()))
+                    )
+                    break
+                except TimeoutError:
+                    if time.monotonic() >= deadline:
+                        raise
         finally:
             with self._lock:
                 self._futures.pop(d, None)
@@ -327,17 +599,19 @@ class BFTUniquenessProvider(UniquenessProvider):
             )
 
     @staticmethod
-    def make_cluster(n: int, network, prefix: str = "bft-replica"):
+    def make_cluster(n: int, network, prefix: str = "bft-replica",
+                     view_timeout_s: float = 1.0):
         """n = 3f+1 co-located replicas + a client factory."""
         from corda_tpu.crypto import generate_keypair
 
         names = [f"{prefix}-{i}" for i in range(n)]
         keypairs = {name: generate_keypair() for name in names}
+        keys = {name: kp.public for name, kp in keypairs.items()}
         replicas = [
-            BFTReplica(name, names, network.create_node(name), keypairs[name])
+            BFTReplica(name, names, network.create_node(name), keypairs[name],
+                       replica_keys=keys, view_timeout_s=view_timeout_s)
             for name in names
         ]
-        keys = {name: kp.public for name, kp in keypairs.items()}
 
         def make_client(client_name: str) -> BFTUniquenessProvider:
             client = BFTClusterClient(

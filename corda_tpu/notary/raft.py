@@ -12,6 +12,14 @@ engine the reference outsources — here it's a first-class component.
 The replicated state machine is the uniqueness map: a committed log entry
 is a (states, tx_id, caller) commit request; apply() settles it against
 the local map, deterministically identical on every replica.
+
+Durability (parity with Copycat's on-disk log + snapshots): with a
+``RaftStorage`` attached, term/vote persist before any reply that promises
+them, log entries persist before acknowledgement, apply is atomic with the
+applied-index marker, and the log COMPACTS against the durable state
+machine (which is its own snapshot) — a lagging follower past the
+compaction horizon receives the map itself (InstallSnapshot). Without
+storage the node is a volatile test replica (full log, no compaction).
 """
 
 from __future__ import annotations
@@ -25,6 +33,7 @@ from concurrent.futures import Future
 from corda_tpu.messaging import auto_ack
 from corda_tpu.serialization import deserialize, serialize
 
+from .raft_storage import RaftStorage
 from .uniqueness import (
     InMemoryUniquenessProvider,
     NotaryError,
@@ -35,6 +44,7 @@ T_VOTE = "raft.vote"
 T_VOTE_REPLY = "raft.vote-reply"
 T_APPEND = "raft.append"
 T_APPEND_REPLY = "raft.append-reply"
+T_SNAPSHOT = "raft.snapshot"
 T_SUBMIT = "raft.submit"
 T_SUBMIT_REPLY = "raft.submit-reply"
 
@@ -45,6 +55,60 @@ class LogEntry:
     command: bytes  # serialized (states, tx_id, caller)
 
 
+class RaftLog:
+    """The replicated log with a compacted prefix.
+
+    ``entries[0]`` sits at absolute index ``base``; everything below is
+    folded into the state machine (the snapshot). ``snap_term`` is the term
+    of entry ``base - 1`` — needed for the AppendEntries consistency check
+    at the compaction boundary."""
+
+    __slots__ = ("base", "snap_term", "entries")
+
+    def __init__(self, base: int = 0, snap_term: int = 0, entries=None):
+        self.base = base
+        self.snap_term = snap_term
+        self.entries: list[LogEntry] = list(entries or [])
+
+    def last_index(self) -> int:
+        return self.base + len(self.entries) - 1
+
+    def last_term(self) -> int:
+        return self.entries[-1].term if self.entries else self.snap_term
+
+    def term_at(self, abs_idx: int) -> int | None:
+        """Term of the entry at abs_idx; snap_term at the boundary, None
+        for compacted (< base-1) or out-of-range indices."""
+        if abs_idx == -1:
+            return 0
+        if abs_idx == self.base - 1:
+            return self.snap_term
+        pos = abs_idx - self.base
+        if 0 <= pos < len(self.entries):
+            return self.entries[pos].term
+        return None
+
+    def get(self, abs_idx: int) -> LogEntry:
+        return self.entries[abs_idx - self.base]
+
+    def slice_from(self, abs_idx: int) -> list[LogEntry]:
+        return self.entries[max(0, abs_idx - self.base):]
+
+    def append(self, e: LogEntry) -> int:
+        self.entries.append(e)
+        return self.last_index()
+
+    def truncate_from(self, abs_idx: int) -> None:
+        del self.entries[abs_idx - self.base:]
+
+    def compact_to(self, abs_idx: int) -> None:
+        """Drop entries ≤ abs_idx (must be ≤ applied)."""
+        term = self.term_at(abs_idx)
+        del self.entries[: abs_idx - self.base + 1]
+        self.base = abs_idx + 1
+        self.snap_term = term
+
+
 class NotLeaderError(Exception):
     def __init__(self, leader: str | None):
         self.leader = leader
@@ -52,8 +116,8 @@ class NotLeaderError(Exception):
 
 
 class RaftNode:
-    """One Raft replica. ``apply_fn(command_bytes) -> result_bytes`` is the
-    deterministic state machine."""
+    """One Raft replica. ``apply_fn(command_bytes, abs_index) ->
+    result_bytes`` is the deterministic state machine."""
 
     FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -62,6 +126,9 @@ class RaftNode:
         election_timeout_s: tuple[float, float] = (0.15, 0.3),
         heartbeat_s: float = 0.05,
         rng: random.Random | None = None,
+        storage: RaftStorage | None = None,
+        compact_every: int = 512,
+        install_map_fn=None,
     ):
         self.name = name
         self.peers = [p for p in peers if p != name]
@@ -70,15 +137,30 @@ class RaftNode:
         self._timeout_range = election_timeout_s
         self._heartbeat_s = heartbeat_s
         self._rng = rng or random.Random(name)
+        self._storage = storage
+        self._compact_every = compact_every
+        self._install_map_fn = install_map_fn
 
         self._lock = threading.RLock()
         self.role = RaftNode.FOLLOWER
         self.current_term = 0
         self.voted_for: str | None = None
-        self.log: list[LogEntry] = []
+        self.log = RaftLog()
         self.commit_index = -1
         self.last_applied = -1
         self.leader: str | None = None
+        if storage is not None:
+            # restart: resume with the persisted term/vote/log; everything
+            # at or below the applied marker is already in the state machine
+            st = storage.load()
+            self.current_term = st["term"]
+            self.voted_for = st["voted_for"]
+            self.log = RaftLog(
+                st["base"], st["snap_term"],
+                [LogEntry(t, c) for (t, c) in st["entries"]],
+            )
+            self.last_applied = st["applied"]
+            self.commit_index = st["applied"]
         # leader volatile state
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
@@ -99,6 +181,7 @@ class RaftNode:
         for topic, handler in (
             (T_VOTE, self._on_vote), (T_VOTE_REPLY, self._on_vote_reply),
             (T_APPEND, self._on_append), (T_APPEND_REPLY, self._on_append_reply),
+            (T_SNAPSHOT, self._on_snapshot),
             (T_SUBMIT, self._on_submit),
             (T_SUBMIT_REPLY, self._on_submit_reply),
         ):
@@ -132,19 +215,25 @@ class RaftNode:
                 elif now >= self._deadline:
                     self._start_election()
 
+    def _persist_term_vote(self) -> None:
+        """Raft's persistence contract: term/vote are on disk BEFORE any
+        message promising them leaves this replica."""
+        if self._storage is not None:
+            self._storage.save_term_vote(self.current_term, self.voted_for)
+
     # ------------------------------------------------------------ election
 
     def _start_election(self) -> None:
         self.role = RaftNode.CANDIDATE
         self.current_term += 1
         self.voted_for = self.name
+        self._persist_term_vote()
         self._votes = {self.name}
         self.leader = None
         self._reset_timer()
-        last_idx = len(self.log) - 1
-        last_term = self.log[last_idx].term if last_idx >= 0 else 0
         req = {"term": self.current_term, "candidate": self.name,
-               "last_log_index": last_idx, "last_log_term": last_term}
+               "last_log_index": self.log.last_index(),
+               "last_log_term": self.log.last_term()}
         for p in self.peers:
             self._messaging.send(p, T_VOTE, serialize(req))
         self._maybe_win()  # single-node cluster wins immediately
@@ -155,14 +244,13 @@ class RaftNode:
             self._observe_term(req["term"])
             grant = False
             if req["term"] >= self.current_term and self.voted_for in (None, req["candidate"]):
-                last_idx = len(self.log) - 1
-                last_term = self.log[last_idx].term if last_idx >= 0 else 0
                 up_to_date = (req["last_log_term"], req["last_log_index"]) >= (
-                    last_term, last_idx,
+                    self.log.last_term(), self.log.last_index(),
                 )
                 if up_to_date:
                     grant = True
                     self.voted_for = req["candidate"]
+                    self._persist_term_vote()
                     self._reset_timer()
             self._messaging.send(
                 msg.sender, T_VOTE_REPLY,
@@ -184,7 +272,7 @@ class RaftNode:
         if self.role == RaftNode.CANDIDATE and len(self._votes) * 2 > len(self.peers) + 1:
             self.role = RaftNode.LEADER
             self.leader = self.name
-            n = len(self.log)
+            n = self.log.last_index() + 1
             self._next_index = {p: n for p in self.peers}
             self._match_index = {p: -1 for p in self.peers}
             self._deadline = 0.0  # heartbeat immediately
@@ -196,6 +284,7 @@ class RaftNode:
             self.role = RaftNode.FOLLOWER
             self.voted_for = None
             self._votes = set()
+            self._persist_term_vote()
 
     # ------------------------------------------------------------ replication
 
@@ -204,16 +293,67 @@ class RaftNode:
             self._send_append(p)
 
     def _send_append(self, peer: str) -> None:
-        nxt = self._next_index.get(peer, len(self.log))
+        nxt = self._next_index.get(peer, self.log.last_index() + 1)
+        if nxt < self.log.base:
+            # the entries this follower needs are compacted: ship the state
+            # machine itself (InstallSnapshot)
+            self._send_snapshot(peer)
+            return
         prev_idx = nxt - 1
-        prev_term = self.log[prev_idx].term if prev_idx >= 0 else 0
-        entries = [(e.term, e.command) for e in self.log[nxt:]]
+        prev_term = self.log.term_at(prev_idx) or 0
+        entries = [(e.term, e.command) for e in self.log.slice_from(nxt)]
         req = {
             "term": self.current_term, "leader": self.name,
             "prev_log_index": prev_idx, "prev_log_term": prev_term,
             "entries": entries, "leader_commit": self.commit_index,
         }
         self._messaging.send(peer, T_APPEND, serialize(req))
+
+    def _send_snapshot(self, peer: str) -> None:
+        assert self._storage is not None, "compaction requires storage"
+        req = {
+            "term": self.current_term, "leader": self.name,
+            "last_idx": self.log.base - 1, "last_term": self.log.snap_term,
+            "rows": self._storage.dump_map(),
+        }
+        self._messaging.send(peer, T_SNAPSHOT, serialize(req))
+
+    def _on_snapshot(self, msg) -> None:
+        req = deserialize(msg.payload)
+        with self._lock:
+            self._observe_term(req["term"])
+            if req["term"] != self.current_term:
+                return
+            installer = (
+                self._storage.install_snapshot
+                if self._storage is not None
+                else self._install_map_fn
+            )
+            if installer is None:
+                # no way to apply a snapshot on this replica: say so (a
+                # silent drop would have the leader re-shipping the map
+                # every heartbeat forever)
+                self._messaging.send(
+                    msg.sender, T_APPEND_REPLY,
+                    serialize({"term": self.current_term, "ok": False,
+                               "follower": self.name, "match_index": -1}),
+                )
+                return
+            self.role = RaftNode.FOLLOWER
+            self.leader = req["leader"]
+            self._reset_timer()
+            last_idx = req["last_idx"]
+            if last_idx > self.last_applied:
+                installer(req["rows"], last_idx, req["last_term"])
+                self.log = RaftLog(last_idx + 1, req["last_term"])
+                self.last_applied = last_idx
+                self.commit_index = max(self.commit_index, last_idx)
+            self._messaging.send(
+                msg.sender, T_APPEND_REPLY,
+                serialize({"term": self.current_term, "ok": True,
+                           "follower": self.name,
+                           "match_index": max(last_idx, self.last_applied)}),
+            )
 
     def _on_append(self, msg) -> None:
         req = deserialize(msg.payload)
@@ -226,24 +366,44 @@ class RaftNode:
                 self.leader = req["leader"]
                 self._reset_timer()
                 prev_idx = req["prev_log_index"]
-                prev_ok = prev_idx < 0 or (
-                    prev_idx < len(self.log)
-                    and self.log[prev_idx].term == req["prev_log_term"]
-                )
+                entries = req["entries"]
+                if prev_idx < self.log.base - 1:
+                    # our snapshot already covers a prefix of these
+                    # entries; everything ≤ base-1 is committed+applied, so
+                    # it matches any legitimate leader's log by Raft safety
+                    skip = (self.log.base - 1) - prev_idx
+                    entries = entries[skip:]
+                    prev_idx = self.log.base - 1
+                    prev_ok = True
+                else:
+                    prev_term = self.log.term_at(prev_idx)
+                    prev_ok = prev_term is not None and prev_term == req["prev_log_term"]
                 if prev_ok:
                     ok = True
                     idx = prev_idx + 1
-                    for term, cmd in req["entries"]:
-                        if idx < len(self.log) and self.log[idx].term != term:
-                            del self.log[idx:]
+                    first_change: int | None = None
+                    for term, cmd in entries:
+                        have = self.log.term_at(idx)
+                        if have is not None and have != term:
+                            self.log.truncate_from(idx)
                             self._fail_waiters_from(idx)
-                        if idx >= len(self.log):
+                            have = None
+                        if have is None and idx > self.log.last_index():
                             self.log.append(LogEntry(term, cmd))
+                            if first_change is None:
+                                first_change = idx
                         idx += 1
-                    match_index = prev_idx + len(req["entries"])
+                    if first_change is not None and self._storage is not None:
+                        # persist the changed suffix BEFORE acknowledging
+                        self._storage.replace_suffix(
+                            first_change,
+                            [(e.term, e.command)
+                             for e in self.log.slice_from(first_change)],
+                        )
+                    match_index = prev_idx + len(entries)
                     if req["leader_commit"] > self.commit_index:
                         self.commit_index = min(
-                            req["leader_commit"], len(self.log) - 1
+                            req["leader_commit"], self.log.last_index()
                         )
                         self._apply_committed()
             self._messaging.send(
@@ -270,8 +430,8 @@ class RaftNode:
 
     def _advance_commit(self) -> None:
         n = len(self.peers) + 1
-        for idx in range(len(self.log) - 1, self.commit_index, -1):
-            if self.log[idx].term != self.current_term:
+        for idx in range(self.log.last_index(), self.commit_index, -1):
+            if self.log.term_at(idx) != self.current_term:
                 continue
             votes = 1 + sum(1 for p in self.peers if self._match_index.get(p, -1) >= idx)
             if votes * 2 > n:
@@ -289,8 +449,8 @@ class RaftNode:
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
-            entry = self.log[self.last_applied]
-            result = self._apply_fn(entry.command)
+            entry = self.log.get(self.last_applied)
+            result = self._apply_fn(entry.command, self.last_applied)
             waiter = self._waiters.pop(self.last_applied, None)
             if waiter is not None:
                 proposed, fut = waiter
@@ -300,6 +460,13 @@ class RaftNode:
                     fut.set_result(result)
                 else:  # a different command landed at our index
                     fut.set_exception(NotLeaderError(self.leader))
+        if (
+            self._storage is not None
+            and self.last_applied - self.log.base + 1 >= self._compact_every
+        ):
+            term = self.log.term_at(self.last_applied)
+            self._storage.compact(self.last_applied, term)
+            self.log.compact_to(self.last_applied)
 
     # ------------------------------------------------------------ client API
 
@@ -310,8 +477,11 @@ class RaftNode:
             if self.role != RaftNode.LEADER:
                 raise NotLeaderError(self.leader)
             entry = LogEntry(self.current_term, command)
-            self.log.append(entry)
-            idx = len(self.log) - 1
+            idx = self.log.append(entry)
+            if self._storage is not None:
+                # the leader's own log write must be durable before it can
+                # count toward the majority
+                self._storage.append(idx, entry.term, entry.command)
             fut: Future = Future()
             self._waiters[idx] = (entry, fut)
             if not self.peers:  # single-node cluster commits immediately
@@ -389,7 +559,8 @@ class RaftUniquenessProvider(UniquenessProvider):
     """UniquenessProvider face over a RaftNode whose state machine is a
     local uniqueness map (reference: RaftUniquenessProvider +
     DistributedImmutableMap). Use ``RaftUniquenessProvider.make_cluster``
-    to build co-located replicas for tests/demos."""
+    to build co-located replicas for tests/demos; pass ``storage_dir`` for
+    durable replicas that survive full-cluster restarts."""
 
     def __init__(self, node: RaftNode):
         self.node = node
@@ -398,9 +569,10 @@ class RaftUniquenessProvider(UniquenessProvider):
 
     @staticmethod
     def state_machine(base: UniquenessProvider | None = None):
+        """Volatile state machine over an in-memory uniqueness map."""
         base = base or InMemoryUniquenessProvider()
 
-        def apply(command: bytes) -> bytes:
+        def apply(command: bytes, _abs_idx: int) -> bytes:
             states, tx_id, caller = deserialize(command)
             try:
                 base.commit(states, tx_id, caller)
@@ -409,6 +581,19 @@ class RaftUniquenessProvider(UniquenessProvider):
                 return serialize(e.conflict)
 
         return apply, base
+
+    @staticmethod
+    def storage_state_machine(storage: RaftStorage):
+        """Durable state machine: apply lands in the same transaction as
+        the applied-index marker (exactly-once across restarts)."""
+
+        def apply(command: bytes, abs_idx: int) -> bytes:
+            states, tx_id, caller = deserialize(command)
+            return serialize(
+                storage.apply_commit(abs_idx, list(states), tx_id, caller)
+            )
+
+        return apply
 
     def commit(self, states, tx_id, caller_name) -> None:
         command = serialize((list(states), tx_id, caller_name))
@@ -428,14 +613,54 @@ class RaftUniquenessProvider(UniquenessProvider):
             )
 
     @staticmethod
-    def make_cluster(names: list[str], network) -> "list[RaftUniquenessProvider]":
+    def make_node(
+        name: str, names: list[str], network, storage_dir: str | None = None,
+        compact_every: int = 512,
+    ) -> "RaftUniquenessProvider":
+        """Build (or REBUILD after a crash — state restores from storage)
+        one replica."""
+        install_fn = None
+        if storage_dir is not None:
+            storage = RaftStorage(f"{storage_dir}/{name}.db")
+            apply_fn = RaftUniquenessProvider.storage_state_machine(storage)
+        else:
+            storage = None
+            apply_fn, base = RaftUniquenessProvider.state_machine()
+
+            def install_fn(rows, _last_idx, _last_term, base=base):
+                # replace the in-memory consumed map with a leader snapshot
+                # (a durable peer compacted past this replica's log)
+                from corda_tpu.crypto import SecureHash
+
+                from .uniqueness import ConsumedStateDetails
+
+                with base._lock:
+                    base._map = {
+                        bytes(k): ConsumedStateDetails(
+                            SecureHash(bytes(t)), i, c
+                        )
+                        for (k, t, i, c) in rows
+                    }
+        node = RaftNode(
+            name, list(names), network.create_node(name), apply_fn,
+            storage=storage, compact_every=compact_every,
+            install_map_fn=install_fn,
+        )
+        return RaftUniquenessProvider(node)
+
+    @staticmethod
+    def make_cluster(
+        names: list[str], network, storage_dir: str | None = None,
+        compact_every: int = 512,
+    ) -> "list[RaftUniquenessProvider]":
         """Co-located cluster over an InMemoryMessagingNetwork (the
         reference's cluster-of-3-in-one-JVM driver test shape)."""
-        providers = []
-        for name in names:
-            apply_fn, _base = RaftUniquenessProvider.state_machine()
-            node = RaftNode(name, list(names), network.create_node(name), apply_fn)
-            providers.append(RaftUniquenessProvider(node))
+        providers = [
+            RaftUniquenessProvider.make_node(
+                name, names, network, storage_dir, compact_every
+            )
+            for name in names
+        ]
         for p in providers:
             p.node.start()
         return providers

@@ -431,11 +431,224 @@ class TestBFT:
         command_b = ser((_refs("a"), sha256(b"txB"), "bob"))
         da, db = _digest(command_a), _digest(command_b)
         with r0._lock:
-            r0._preprepared[0] = da
+            r0._preprepared[(0, 0)] = da
             r0._commands[da] = command_a
-            r0._prepares[(0, da)].add(r0.name)
+            r0._prepares[(0, 0, da)].add(r0.name)
         # forged commits for digest B land at seq 0
         for sender in ("bft-replica-1", "bft-replica-2", "bft-replica-3"):
-            r0._commits[(0, db)].add(sender)
-        r0._check_committed(0)
+            r0._commits[(0, 0, db)].add(sender)
+        r0._check_committed(0, 0)
         assert r0._next_exec == 0  # B-votes did not commit digest A
+        for r in replicas:
+            r.stop()
+
+
+class TestRaftDurability:
+    """Copycat-storage parity (reference: RaftUniquenessProvider.kt:4-17):
+    term/vote/log survive restarts, apply is exactly-once, the log compacts
+    against the durable map, and stale followers catch up via snapshot."""
+
+    def _wait_leader(self, providers, timeout=5):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leader = next(
+                (p for p in providers if p.node.role == "leader"), None
+            )
+            if leader is not None:
+                return leader
+            time.sleep(0.02)
+        raise AssertionError("no leader elected")
+
+    def test_full_cluster_restart_keeps_consumed_set(self, tmp_path):
+        from corda_tpu.notary import RaftUniquenessProvider
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        names = ["d0", "d1", "d2"]
+        try:
+            providers = RaftUniquenessProvider.make_cluster(
+                names, net, storage_dir=str(tmp_path)
+            )
+            leader = self._wait_leader(providers)
+            leader.commit(_refs("p", "q"), sha256(b"tx1"), "alice")
+            leader.commit(_refs("r"), sha256(b"tx2"), "bob")
+            # kill EVERY replica (whole-cluster power loss)
+            for p in providers:
+                p.node.stop()
+                net.stop_node(p.node.name)
+            # rebuild replicas from their on-disk state on fresh transports
+            revived = []
+            for name in names:
+                net._nodes.pop(name, None)
+                revived.append(
+                    RaftUniquenessProvider.make_node(
+                        name, names, net, storage_dir=str(tmp_path)
+                    )
+                )
+            for p in revived:
+                p.node.start()
+            leader2 = self._wait_leader(revived)
+            # consumed set is intact: the same states conflict
+            with pytest.raises(NotaryError) as ei:
+                leader2.commit(_refs("q"), sha256(b"tx9"), "mallory")
+            assert ei.value.conflict is not None
+            # and new commits still work
+            leader2.commit(_refs("s"), sha256(b"tx3"), "carol")
+            for p in revived:
+                p.node.stop()
+        finally:
+            net.stop_pumping()
+
+    def test_restart_does_not_double_vote(self, tmp_path):
+        """A replica that voted, crashed, and restarted must refuse to vote
+        for a DIFFERENT candidate in the same term (the safety hole of a
+        volatile votedFor)."""
+        from corda_tpu.messaging import InMemoryMessagingNetwork as Net
+        from corda_tpu.notary import RaftUniquenessProvider
+        from corda_tpu.notary.raft import T_VOTE, T_VOTE_REPLY
+        from corda_tpu.serialization import deserialize as de, serialize as se
+
+        net = Net()
+        p = RaftUniquenessProvider.make_node(
+            "v0", ["v0", "vA", "vB"], net, storage_dir=str(tmp_path)
+        )
+        # candidate A requests and gets the vote in term 5
+        observer = net.create_node("vA")
+        replies = []
+        observer.add_handler(
+            T_VOTE_REPLY, lambda m, ack=None: replies.append(de(m.payload))
+        )
+        observer.send("v0", T_VOTE, se({
+            "term": 5, "candidate": "vA",
+            "last_log_index": -1, "last_log_term": 0,
+        }))
+        net.run_until_quiescent()
+        assert replies and replies[0]["granted"]
+        # crash + restart from storage
+        p.node.stop()
+        net._nodes.pop("v0", None)
+        p2 = RaftUniquenessProvider.make_node(
+            "v0", ["v0", "vA", "vB"], net, storage_dir=str(tmp_path)
+        )
+        assert p2.node.current_term == 5
+        assert p2.node.voted_for == "vA"
+        # candidate B asks in the SAME term: must be refused
+        observer2 = net.create_node("vB")
+        replies2 = []
+        observer2.add_handler(
+            T_VOTE_REPLY, lambda m, ack=None: replies2.append(de(m.payload))
+        )
+        observer2.send("v0", T_VOTE, se({
+            "term": 5, "candidate": "vB",
+            "last_log_index": 10, "last_log_term": 5,
+        }))
+        net.run_until_quiescent()
+        assert replies2 and not replies2[0]["granted"]
+
+    def test_compaction_and_snapshot_catchup(self, tmp_path):
+        """With compact_every small, the log truncates against the durable
+        map; a follower that slept through the compacted prefix catches up
+        via InstallSnapshot and still detects double spends."""
+        from corda_tpu.notary import RaftUniquenessProvider
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        names = ["c0", "c1", "c2"]
+        try:
+            providers = RaftUniquenessProvider.make_cluster(
+                names, net, storage_dir=str(tmp_path), compact_every=4
+            )
+            leader = self._wait_leader(providers)
+            sleeper = next(p for p in providers if p is not leader)
+            net.stop_node(sleeper.node.name)
+            sleeper.node.stop()
+            for i in range(12):  # well past compact_every
+                leader.commit(_refs(f"k{i}"), sha256(b"tx%d" % i), "alice")
+            assert leader.node.log.base > 0  # leader log compacted
+            # revive the sleeper with its (stale) storage
+            net._nodes.pop(sleeper.node.name, None)
+            revived = RaftUniquenessProvider.make_node(
+                sleeper.node.name, names, net, storage_dir=str(tmp_path),
+                compact_every=4,
+            )
+            revived.node.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if revived.node.last_applied >= 11:
+                    break
+                time.sleep(0.02)
+            assert revived.node.last_applied >= 11
+            # snapshot carried the consumed set: double spend detected via
+            # the revived replica's own state machine
+            assert revived.node._storage.committed_txs() == 12
+            for p in providers:
+                if p is not sleeper:
+                    p.node.stop()
+            revived.node.stop()
+        finally:
+            net.stop_pumping()
+
+
+class TestBFTViewChange:
+    """Liveness under primary failure (reference: BFT-SMaRt's leader-change
+    regency; BFTSMaRt.kt:55+): killing the view-0 primary must not halt the
+    cluster — replicas time out, agree on view 1, and the new primary
+    orders both in-flight and new requests."""
+
+    def test_primary_kill_then_progress(self):
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix="vc-replica", view_timeout_s=0.3
+            )
+            provider = make_client("vc-client")
+            provider.commit(_refs("va"), sha256(b"tx1"), "alice")
+            # kill the view-0 primary
+            net.stop_node(replicas[0].name)
+            replicas[0].stop()
+            # a new request must still commit (view change + re-order)
+            provider.commit(_refs("vb"), sha256(b"tx2"), "bob")
+            survivors = replicas[1:]
+            assert all(r.view >= 1 for r in survivors)
+            assert any(r.is_primary for r in survivors)
+            # committed state from view 0 survives into view 1
+            with pytest.raises(NotaryError):
+                provider.commit(_refs("va"), sha256(b"tx9"), "mallory")
+            # and double spends are still caught for view-1 commits
+            with pytest.raises(NotaryError):
+                provider.commit(_refs("vb"), sha256(b"tx8"), "mallory")
+            for r in survivors:
+                r.stop()
+        finally:
+            net.stop_pumping()
+
+    def test_single_faulty_replica_cannot_force_view_change(self):
+        """The f+1 join rule: one replica demanding a view change (a faulty
+        accuser) must not move correct replicas off a live primary."""
+        from corda_tpu.notary.bft import T_VIEWCHANGE
+        from corda_tpu.serialization import serialize as ser
+        from corda_tpu.crypto import sign as host_sign
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix="fj-replica", view_timeout_s=30.0
+            )
+            provider = make_client("fj-client")
+            provider.commit(_refs("fa"), sha256(b"tx1"), "alice")
+            # replica 3 (faulty) demands view 1, properly signed
+            accuser = replicas[3]
+            body = ser({"view": 1, "sender": accuser.name,
+                        "last_exec": 0, "certs": []})
+            sig = host_sign(accuser._keypair.private, body)
+            accuser._multicast(T_VIEWCHANGE, {"body": body, "sig": sig})
+            time.sleep(0.3)
+            assert all(r.view == 0 for r in replicas[:3])
+            # cluster still live under the original primary
+            provider.commit(_refs("fb"), sha256(b"tx2"), "bob")
+            for r in replicas:
+                r.stop()
+        finally:
+            net.stop_pumping()
