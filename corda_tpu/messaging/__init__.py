@@ -27,6 +27,13 @@ from .network import (
     PeerHandle,
 )
 from .broker_client import BrokerMessagingClient, p2p_queue
+from .secure_transport import (
+    ChannelClosedError,
+    HandshakeError,
+    SecureBrokerConnection,
+    SecureBrokerServer,
+    SecureChannel,
+)
 from .native_queue import (
     NativeEngineUnavailable,
     NativeQueueBroker,
@@ -44,6 +51,8 @@ __all__ = [
     "PeerHandle",
     "BrokerMessagingClient",
     "p2p_queue",
+    "ChannelClosedError", "HandshakeError",
+    "SecureBrokerConnection", "SecureBrokerServer", "SecureChannel",
     "NativeEngineUnavailable", "NativeQueueBroker", "make_broker",
     "native_engine_available",
 ]
