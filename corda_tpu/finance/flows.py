@@ -97,7 +97,7 @@ class CashIssueFlow(FlowLogic):
             CashState(Amount(self.quantity, token), me), CASH_PROGRAM_ID
         )
         builder.add_command(Issue(), me.owning_key)
-        stx = self.services.sign_initial_transaction(builder)
+        stx = self.sign_builder(builder)
         return self.sub_flow(FinalityFlow(stx))
 
 
@@ -115,10 +115,18 @@ class CashPaymentFlow(FlowLogic):
         # record the selected refs (replay-safe: the selection is the
         # nondeterministic step), then re-derive the StateAndRefs. The lock
         # is held from selection to finality — everything after selection
-        # sits under the release-finally so a failure cannot leak locks.
-        refs = self.record(lambda: [
-            sr.ref for sr in select_cash(self, self.currency, self.quantity)
-        ])
+        # sits under the release-finally so a failure cannot leak locks; a
+        # PARK also runs that finally, so the replay hook re-reserves the
+        # recorded refs when the flow resumes.
+        refs = self.record(
+            lambda: [
+                sr.ref
+                for sr in select_cash(self, self.currency, self.quantity)
+            ],
+            replay=lambda recs: self.services.vault_service.soft_lock_reacquire(
+                self.flow_id, list(recs)
+            ),
+        )
         try:
             selected = [self.services.to_state_and_ref(r) for r in refs]
             notary = selected[0].state.notary
@@ -148,7 +156,7 @@ class CashPaymentFlow(FlowLogic):
             builder.add_command(Move(), *sorted(
                 signers, key=lambda k: (k.scheme_id, k.encoded)
             ))
-            stx = self.services.sign_initial_transaction(builder)
+            stx = self.sign_builder(builder)
             return self.sub_flow(FinalityFlow(stx))
         finally:
             self.services.vault_service.soft_lock_release(self.flow_id)
@@ -167,11 +175,16 @@ class CashExitFlow(FlowLogic):
         me = self.our_identity
         token = Issued(PartyAndReference(me, self.issuer_ref), self.currency)
         vault = self.services.vault_service
-        refs = self.record(lambda: [
-            sr.ref for sr in vault.select_fungible(
-                token, self.quantity, self.flow_id, CashState
-            )
-        ])
+        refs = self.record(
+            lambda: [
+                sr.ref for sr in vault.select_fungible(
+                    token, self.quantity, self.flow_id, CashState
+                )
+            ],
+            replay=lambda recs: vault.soft_lock_reacquire(
+                self.flow_id, list(recs)
+            ),
+        )
         try:
             selected = [self.services.to_state_and_ref(r) for r in refs]
             notary = selected[0].state.notary
@@ -191,7 +204,7 @@ class CashExitFlow(FlowLogic):
                 Exit(Amount(self.quantity, token)),
                 *sorted(signers, key=lambda k: (k.scheme_id, k.encoded)),
             )
-            stx = self.services.sign_initial_transaction(builder)
+            stx = self.sign_builder(builder)
             return self.sub_flow(FinalityFlow(stx))
         finally:
             vault.soft_lock_release(self.flow_id)

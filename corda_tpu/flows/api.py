@@ -175,10 +175,27 @@ class FlowLogic:
         """Recorded randomness — replay-safe."""
         return self._executor.op_entropy(n)
 
-    def record(self, fn: Callable[[], Any]):
+    def record(self, fn: Callable[[], Any], replay: Callable[[Any], Any] | None = None):
         """Run an arbitrary nondeterministic/effectful host function once,
-        recording its (CBE-serializable) result for replay."""
-        return self._executor.op_record(fn)
+        recording its (CBE-serializable) result for replay.
+
+        ``replay(recorded)`` — when given — runs on every REPLAY of this op
+        (crash restore or park/resume) to re-establish host-side state the
+        original call created and the unwind may have dropped: vault soft
+        locks are the canonical case (a park runs the flow's ``finally``,
+        releasing them; the replay hook re-reserves the recorded refs)."""
+        return self._executor.op_record(fn, replay)
+
+    def sign_builder(self, builder) -> "Any":
+        """Sign a TransactionBuilder replay-safely: the SIGNED transaction
+        is a recorded op, so a replay (crash restore or park/resume) yields
+        the bit-identical transaction — a re-built one would draw a fresh
+        privacy salt and change the id, orphaning signatures already sent.
+        Every flow that builds a transaction must sign it through this (or
+        wrap the build in ``record``)."""
+        return self.record(
+            lambda: self.services.sign_initial_transaction(builder)
+        )
 
     def wait_for_ledger_commit(self, tx_id):
         """Suspend until the transaction is recorded locally (reference:

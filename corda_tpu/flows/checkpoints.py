@@ -74,6 +74,15 @@ class CheckpointStorage:
                 )
             )
 
+    def get_flow(self, flow_id: str) -> bytes | None:
+        """The flow blob for one checkpointed flow (the park/resume path
+        rebuilds a single flow without scanning the table)."""
+        with self._lock:
+            row = self._db.execute(
+                "SELECT flow_blob FROM flows WHERE flow_id=?", (flow_id,)
+            ).fetchone()
+            return row[0] if row else None
+
     # ------------------------------------------------------------- op log
     def record_op(self, flow_id: str, op_index: int, result) -> None:
         with self._lock:

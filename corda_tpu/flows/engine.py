@@ -5,8 +5,10 @@ Role parity with StateMachineManager + FlowStateMachineImpl
 FlowStateMachineImpl.kt:40-510), mechanism re-designed for deterministic
 replay (package docstring):
 
-- every flow runs on its own host thread, executing ``FlowLogic.call()``
-  from the top;
+- flows execute ``FlowLogic.call()`` from the top on a BOUNDED worker
+  pool (reference: the single scheduler thread multiplexing thousands of
+  Quasar fibers, StateMachineManager.kt:76-83) — never one OS thread per
+  flow;
 - each effectful op is numbered; its result is recorded via
   ``CheckpointStorage.record_op`` the moment it completes;
 - on restore, recorded ops replay instantly (re-registering sessions,
@@ -16,6 +18,16 @@ replay (package docstring):
   an at-least-once transport (messaging.queue) yields exactly-once effects —
   the guarantee the reference gets from checkpoint-commit riding the ack
   transaction (StateMachineManager.kt:548).
+
+**Parking = the fiber mechanism.** Where Quasar captures a fiber's stack,
+this engine PARKS a blocked flow: if a wait (receive, session confirm,
+sleep, ledger commit) isn't satisfied within a short grace window, the
+flow abandons its worker thread and registers a wake key; when the key
+fires (message arrival, commit, deadline) the flow is re-queued and
+REPLAYED from its op log to the wait point — an in-process crash+restore,
+which the op-log design makes exact and cheap (each recorded op replays in
+microseconds). A parked flow costs a dict entry, not a thread, so tens of
+thousands of concurrent flows run on a fixed-size pool.
 
 Session ids are derived ``sha256(flow_id ‖ op_index)`` so a crash-replayed
 open reuses the same id.
@@ -57,6 +69,18 @@ class FlowKilledException(Exception):
     pass
 
 
+class _FlowParked(BaseException):
+    """Internal: the flow released its worker thread; it resumes by replay
+    when its registered wake key fires.
+
+    A BaseException so a flow's ``except Exception`` can never swallow the
+    park signal. NOTE the unwind contract: parking raises THROUGH the flow
+    body, so ``finally`` blocks run at park time and the try body re-runs
+    on replay — "crash at the suspension point" semantics. Cleanup that
+    must span a suspension (e.g. vault soft locks) therefore needs a
+    replay hook re-establishing it (``FlowLogic.record(fn, replay=...)``)."""
+
+
 class FlowHandle:
     def __init__(self, flow_id: str, result: Future):
         self.flow_id = flow_id
@@ -88,7 +112,8 @@ class _FlowExecutor:
     def __init__(self, smm: "StateMachineManager", flow_id: str,
                  oplog: list, flow: FlowLogic | None,
                  responder_cls: type | None = None,
-                 init_info: dict | None = None):
+                 init_info: dict | None = None,
+                 result: Future | None = None):
         self.smm = smm
         self.flow_id = flow_id
         self.oplog = oplog
@@ -96,9 +121,10 @@ class _FlowExecutor:
         self.responder_cls = responder_cls
         self.init_info = init_info            # live responder spawn only
         self.op_counter = 0
-        self.result: Future = Future()
+        # the result future OUTLIVES this executor: a parked flow resumes
+        # on a fresh executor that resolves the same future
+        self.result: Future = result if result is not None else Future()
         self.sessions: list[int] = []         # local sids owned
-        self.thread: threading.Thread | None = None
         self.killed = False                   # set by SMM.kill_flow
 
     # ------------------------------------------------------------ op core
@@ -118,15 +144,24 @@ class _FlowExecutor:
     def op_entropy(self, n: int) -> bytes:
         return self._do_op(lambda idx: secrets.token_bytes(n))
 
-    def op_record(self, fn):
-        return self._do_op(lambda idx: fn())
+    def op_record(self, fn, replay_fn=None):
+        """Record fn()'s result; on replay, optionally run
+        ``replay_fn(recorded)`` to re-establish host-side state the
+        original call created (locks, registrations) — state that a park's
+        unwind or a crash may have dropped."""
+        replay = (
+            (lambda idx, rec: replay_fn(rec)) if replay_fn is not None else None
+        )
+        return self._do_op(lambda idx: fn(), replay)
 
     def op_sleep(self, seconds: float) -> None:
         rec = self._do_op(lambda idx: {"deadline": time.time() + seconds})
         remaining = rec["deadline"] - time.time()
         if remaining > 0:
-            self.smm.wait_or_killed(lambda: False, timeout=remaining,
-                                    executor=self)
+            self.smm.wait_or_killed(
+                lambda: False, timeout=remaining, executor=self,
+                sleep_deadline=rec["deadline"],
+            )
 
     def op_send(self, local_sid: int, obj) -> None:
         payload = serialize(obj)
@@ -155,7 +190,7 @@ class _FlowExecutor:
             sess = self.smm.session(local_sid)
             item = self.smm.wait_or_killed(
                 lambda: sess.inbound[0] if sess.inbound else None,
-                executor=self,
+                executor=self, park_key=("sid", local_sid),
             )
             sess.inbound.popleft()
             kind, body, msg_id, ack = item
@@ -193,7 +228,7 @@ class _FlowExecutor:
             )
             self.smm.wait_or_killed(
                 lambda: sess.peer_sid is not None or sess.rejected is not None,
-                executor=self,
+                executor=self, park_key=("sid", sid),
             )
             if sess.rejected is not None:
                 raise FlowException(f"session rejected: {sess.rejected}")
@@ -251,7 +286,7 @@ class _FlowExecutor:
         def effect(idx):
             stx = self.smm.wait_or_killed(
                 lambda: self.smm.lookup_committed(tx_id),
-                executor=self,
+                executor=self, park_key=("tx", tx_id),
             )
             return {"stx": stx}
 
@@ -259,13 +294,9 @@ class _FlowExecutor:
         return rec["stx"]
 
     # ------------------------------------------------------------ lifecycle
-    def start(self):
-        self.thread = threading.Thread(
-            target=self._run, name=f"flow-{self.flow_id[:8]}", daemon=True
-        )
-        self.thread.start()
-
-    def _run(self):
+    def run_once(self) -> str:
+        """Execute on the calling worker thread until the flow finishes,
+        parks, or dies → "finished" | "parked"."""
         try:
             if self.responder_cls is not None:
                 session = self.op_accept_session()
@@ -275,6 +306,8 @@ class _FlowExecutor:
             self.flow.our_identity = self.smm.our_identity
             result = self.flow.call()
             self._finish(result, None)
+        except _FlowParked:
+            return "parked"
         except FlowKilledException:
             if self.killed:
                 # explicit kill: tell counterparties (SessionEnd), surface
@@ -283,9 +316,13 @@ class _FlowExecutor:
                 # cancels quietly and preserves checkpoints for restore.
                 self._finish(None, FlowException("flow was killed"))
             else:
-                self.result.cancel()
+                try:
+                    self.result.cancel()
+                except Exception:
+                    pass
         except Exception as e:  # flow failure → future + peers
             self._finish(None, e)
+        return "finished"
 
     def _finish(self, result, error):
         error_msg = "" if error is None else f"{type(error).__name__}: {error}"
@@ -304,10 +341,13 @@ class _FlowExecutor:
             except Exception:
                 pass
         self.smm.flow_finished(self)
-        if error is None:
-            self.result.set_result(result)
-        else:
-            self.result.set_exception(error)
+        try:
+            if error is None:
+                self.result.set_result(result)
+            else:
+                self.result.set_exception(error)
+        except Exception:
+            pass  # future already cancelled (shutdown race)
 
 
 class StateMachineManager:
@@ -322,6 +362,8 @@ class StateMachineManager:
         our_identity: Party,
         party_resolver=None,
         services=None,
+        max_workers: int = 16,
+        parking_grace_s: float = 0.05,
     ):
         self.messaging = messaging
         self.checkpoints = checkpoints
@@ -334,6 +376,20 @@ class StateMachineManager:
         self._consumed_msg_ids: set[str] = set()
         self._committed = {}  # tx_id -> SignedTransaction (ledger hook)
         self._closed = False
+        # ----- scheduler state (bounded pool + parked flows)
+        self._max_workers = max_workers
+        self._parking_grace_s = parking_grace_s
+        self._runq: deque[str] = deque()
+        self._queued: set[str] = set()
+        self._running: set[str] = set()
+        self._parked: dict = {}               # wake key -> set[flow_id]
+        self._park_key_of: dict[str, object] = {}
+        self._rewake: set[str] = set()        # woken while still running
+        self._sleepers: dict[str, float] = {} # flow_id -> deadline
+        self._results: dict[str, Future] = {} # persistent per-flow futures
+        self._killed_ids: set[str] = set()
+        self._workers: list[threading.Thread] = []
+        self._timer: threading.Thread | None = None
         messaging.add_handler(SESSION_TOPIC, self._on_message)
 
     # ------------------------------------------------------------ public
@@ -346,11 +402,13 @@ class StateMachineManager:
         })
         self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
                                   time.time())
-        ex = _FlowExecutor(self, flow_id, [], flow)
+        fut: Future = Future()
+        ex = _FlowExecutor(self, flow_id, [], flow, result=fut)
         with self._lock:
             self._flows[flow_id] = ex
-        ex.start()
-        return FlowHandle(flow_id, ex.result)
+            self._results[flow_id] = fut
+        self._enqueue(flow_id)
+        return FlowHandle(flow_id, fut)
 
     def restore(self) -> list[FlowHandle]:
         """Re-spawn every checkpointed flow; replay brings each to its live
@@ -360,44 +418,219 @@ class StateMachineManager:
             with self._lock:
                 if flow_id in self._flows:
                     continue
-            meta = deserialize(blob)
-            oplog = self.checkpoints.load_oplog(flow_id)
-            # reconstruct consumed-message dedupe set from receive records
-            for rec in oplog:
-                if isinstance(rec, dict) and "msg_id" in rec:
-                    self._consumed_msg_ids.add(rec["msg_id"])
-            cls = load_class(meta["cls"])
-            if meta["responder"]:
-                ex = _FlowExecutor(self, flow_id, oplog, None,
-                                   responder_cls=cls)
-            else:
-                flow = cls.from_flow_fields(meta["fields"])
-                ex = _FlowExecutor(self, flow_id, oplog, flow)
-            with self._lock:
-                self._flows[flow_id] = ex
-            ex.start()
+            ex = self._rebuild(flow_id, blob)
+            if ex is None:
+                continue
+            self._enqueue(flow_id)
             handles.append(FlowHandle(flow_id, ex.result))
         return handles
 
+    def _rebuild(self, flow_id: str, blob: bytes) -> "_FlowExecutor | None":
+        """Reconstruct an executor from its checkpoint (both the restart
+        restore path and the park/resume path)."""
+        meta = deserialize(blob)
+        oplog = self.checkpoints.load_oplog(flow_id)
+        # reconstruct consumed-message dedupe set from receive records
+        for rec in oplog:
+            if isinstance(rec, dict) and "msg_id" in rec:
+                self._consumed_msg_ids.add(rec["msg_id"])
+        cls = load_class(meta["cls"])
+        with self._lock:
+            fut = self._results.setdefault(flow_id, Future())
+        if meta["responder"]:
+            ex = _FlowExecutor(self, flow_id, oplog, None,
+                               responder_cls=cls, result=fut)
+        else:
+            flow = cls.from_flow_fields(meta["fields"])
+            ex = _FlowExecutor(self, flow_id, oplog, flow, result=fut)
+        with self._lock:
+            ex.killed = flow_id in self._killed_ids
+            self._flows[flow_id] = ex
+        return ex
+
+    # ------------------------------------------------------- scheduler
+    def _enqueue(self, flow_id: str) -> None:
+        with self._lock:
+            if self._closed or flow_id in self._queued:
+                return
+            self._queued.add(flow_id)
+            self._runq.append(flow_id)
+            self._spawn_workers_locked()
+            self._lock.notify_all()
+
+    def _spawn_workers_locked(self) -> None:
+        live = [t for t in self._workers if t.is_alive()]
+        self._workers = live
+        want = min(self._max_workers, len(self._runq) + len(self._running))
+        for i in range(len(live), want):
+            t = threading.Thread(
+                target=self._worker_loop, daemon=True,
+                name=f"flow-worker-{i}",
+            )
+            self._workers.append(t)
+            t.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._runq and not self._closed:
+                    timeout = self._wake_due_sleepers_locked()
+                    self._lock.wait(timeout=timeout)
+                if self._closed and not self._runq:
+                    return
+                flow_id = self._runq.popleft()
+                self._queued.discard(flow_id)
+                if flow_id in self._running:
+                    # executing elsewhere: flag so the running worker
+                    # re-queues on exit (the wake that queued this pop must
+                    # not be lost if that run parks after our check)
+                    self._rewake.add(flow_id)
+                    continue
+                self._running.add(flow_id)
+                ex = self._flows.get(flow_id)
+            try:
+                if ex is None:
+                    blob = self.checkpoints.get_flow(flow_id)
+                    if blob is None:
+                        continue  # finished while queued
+                    try:
+                        ex = self._rebuild(flow_id, blob)
+                    except Exception as e:
+                        # an unreconstructible flow must FAIL loudly, not
+                        # vanish: resolve its future and drop the state
+                        self._fail_unrunnable(flow_id, e)
+                        continue
+                    if ex is None:
+                        continue
+                ex.run_once()
+            except Exception:
+                pass  # executor-level failures resolve the flow future
+            finally:
+                with self._lock:
+                    self._running.discard(flow_id)
+                    # parked-with-pending-wake race: a wake fired while we
+                    # were marked running; it couldn't re-queue then, so
+                    # honour it now (only if the flow actually parked —
+                    # a finished flow has no park state left)
+                    if flow_id in self._rewake:
+                        self._rewake.discard(flow_id)
+                        if flow_id in self._park_key_of:
+                            self._unpark_locked(flow_id)
+
+    def _fail_unrunnable(self, flow_id: str, error: Exception) -> None:
+        with self._lock:
+            fut = self._results.pop(flow_id, None)
+            self._flows.pop(flow_id, None)
+            self._park_key_of.pop(flow_id, None)
+            self._sleepers.pop(flow_id, None)
+            self._killed_ids.discard(flow_id)
+        if fut is not None and not fut.done():
+            try:
+                fut.set_exception(
+                    FlowException(f"flow cannot be rebuilt for resume: {error}")
+                )
+            except Exception:
+                pass
+
+    def _wake_due_sleepers_locked(self) -> float:
+        """Move sleepers past their deadline onto the run queue; return the
+        wait timeout until the next deadline (capped)."""
+        now = time.time()
+        due = [f for f, dl in self._sleepers.items() if dl <= now]
+        for f in due:
+            self._sleepers.pop(f, None)
+            self._unpark_locked(f)
+        nxt = min(self._sleepers.values()) - now if self._sleepers else 0.5
+        return max(0.01, min(nxt, 0.5))
+
+    def _start_timer_locked(self) -> None:
+        """Dedicated sleeper timer: due deadlines must fire even when every
+        worker is busy (the idle-loop check alone starves under sustained
+        load)."""
+        if self._timer is not None and self._timer.is_alive():
+            return
+
+        def loop():
+            while True:
+                with self._lock:
+                    if self._closed:
+                        return
+                    if not self._sleepers:
+                        self._timer = None
+                        return
+                    timeout = self._wake_due_sleepers_locked()
+                time.sleep(min(timeout, 0.05))
+
+        self._timer = threading.Thread(
+            target=loop, daemon=True, name="flow-sleep-timer"
+        )
+        self._timer.start()
+
+    def _park_locked(self, flow_id: str, key, deadline: float | None) -> None:
+        """Caller holds the lock and has just re-checked the condition."""
+        self._park_key_of[flow_id] = key
+        if key is not None:
+            self._parked.setdefault(key, set()).add(flow_id)
+        if deadline is not None:
+            self._sleepers[flow_id] = deadline
+            self._start_timer_locked()
+        # drop the executor: the flow's state IS its checkpoint now; the
+        # resume path rebuilds and replays (sessions stay registered and
+        # keep buffering inbound messages while parked)
+        self._flows.pop(flow_id, None)
+
+    def _unpark_locked(self, flow_id: str) -> None:
+        if flow_id in self._running:
+            # raced with the parking worker: flag for re-queue on its exit
+            self._rewake.add(flow_id)
+            return
+        key = self._park_key_of.pop(flow_id, "absent")
+        if key == "absent":
+            return
+        if key is not None:
+            group = self._parked.get(key)
+            if group is not None:
+                group.discard(flow_id)
+                if not group:
+                    self._parked.pop(key, None)
+        self._sleepers.pop(flow_id, None)
+        if not self._closed and flow_id not in self._queued:
+            self._queued.add(flow_id)
+            self._runq.append(flow_id)
+        self._lock.notify_all()
+
+    def _wake_key_locked(self, key) -> None:
+        for flow_id in list(self._parked.get(key, ())):
+            self._unpark_locked(flow_id)
+
     def flows_in_progress(self) -> list[str]:
         with self._lock:
-            return list(self._flows)
+            live = set(self._flows) | set(self._park_key_of) | self._queued
+            return list(live)
 
     def handle_of(self, flow_id: str) -> FlowHandle | None:
         """Handle for a running flow (None once finished and pruned)."""
         with self._lock:
-            ex = self._flows.get(flow_id)
-        return FlowHandle(flow_id, ex.result) if ex is not None else None
+            fut = self._results.get(flow_id)
+        return FlowHandle(flow_id, fut) if fut is not None else None
 
     def kill_flow(self, flow_id: str) -> bool:
         """Terminate one running flow (reference: CordaRPCOps.killFlow).
         The flow's next suspension point raises; its checkpoint is
-        removed."""
+        removed. A parked flow is woken so it can observe the kill."""
         with self._lock:
-            ex = self._flows.get(flow_id)
-            if ex is None:
+            known = (
+                flow_id in self._flows
+                or flow_id in self._park_key_of
+                or flow_id in self._queued
+            )
+            if not known:
                 return False
-            ex.killed = True
+            self._killed_ids.add(flow_id)
+            ex = self._flows.get(flow_id)
+            if ex is not None:
+                ex.killed = True
+            self._unpark_locked(flow_id)
             self._lock.notify_all()
         return True
 
@@ -408,6 +641,7 @@ class StateMachineManager:
     def notify_ledger_commit(self, stx) -> None:
         with self._lock:
             self._committed[stx.id] = stx
+            self._wake_key_locked(("tx", stx.id))
             self._lock.notify_all()
 
     def lookup_committed(self, tx_id):
@@ -422,6 +656,8 @@ class StateMachineManager:
     def stop(self) -> None:
         with self._lock:
             self._closed = True
+            self._runq.clear()
+            self._queued.clear()
             self._lock.notify_all()
         self.messaging.stop()
 
@@ -436,9 +672,15 @@ class StateMachineManager:
     def register_session(self, sid: int, peer: Party, executor) -> _SessionState:
         with self._lock:
             sess = self._sessions.get(sid)
-            if sess is None or sess.executor is not executor:
+            if sess is None:
                 sess = _SessionState(sid, peer, executor)
                 self._sessions[sid] = sess
+            else:
+                # a resumed (parked or restored) flow re-registers its own
+                # sid on a FRESH executor: rebind but keep the buffered
+                # inbound and the confirmed peer_sid — messages that
+                # arrived while parked must not be lost
+                sess.executor = executor
             return sess
 
     def send_to(self, party: Party, obj, *, msg_id: str) -> None:
@@ -446,11 +688,24 @@ class StateMachineManager:
                             msg_id=msg_id)
 
     def wait_or_killed(self, predicate, timeout: float | None = None,
-                       executor=None):
+                       executor=None, park_key=None, sleep_deadline=None):
         """Block until predicate() returns non-None/True; FlowKilled on
         shutdown or when this flow was explicitly killed. Runs under the
-        SMM lock."""
+        SMM lock.
+
+        With a ``park_key`` (or ``sleep_deadline``), a wait that outlives
+        the parking grace PARKS the flow instead of holding its worker
+        thread: the flow's state collapses to its checkpoint, the key is
+        registered, and ``_FlowParked`` unwinds the worker. The wake
+        (message arrival / commit / deadline) re-queues the flow, which
+        replays to this exact wait and re-checks."""
+        parkable = executor is not None and (
+            park_key is not None or sleep_deadline is not None
+        )
         deadline = None if timeout is None else time.monotonic() + timeout
+        grace = (
+            time.monotonic() + self._parking_grace_s if parkable else None
+        )
         with self._lock:
             while True:
                 if self._closed or (executor is not None and executor.killed):
@@ -458,18 +713,29 @@ class StateMachineManager:
                 val = predicate()
                 if val not in (None, False):
                     return val
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return None
+                if grace is not None and now >= grace:
+                    self._park_locked(
+                        executor.flow_id, park_key, sleep_deadline
+                    )
+                    raise _FlowParked()
+                waits = [0.5]
                 if deadline is not None:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        return None
-                    self._lock.wait(timeout=remaining)
-                else:
-                    self._lock.wait(timeout=0.5)
+                    waits.append(deadline - now)
+                if grace is not None:
+                    waits.append(grace - now)
+                self._lock.wait(timeout=max(0.001, min(waits)))
 
     def flow_finished(self, ex: _FlowExecutor) -> None:
         self.checkpoints.remove_flow(ex.flow_id)
         with self._lock:
             self._flows.pop(ex.flow_id, None)
+            self._results.pop(ex.flow_id, None)
+            self._killed_ids.discard(ex.flow_id)
+            self._park_key_of.pop(ex.flow_id, None)
+            self._sleepers.pop(ex.flow_id, None)
             for sid in ex.sessions:
                 self._sessions.pop(sid, None)
 
@@ -488,6 +754,7 @@ class StateMachineManager:
                 sess = self._sessions.get(obj.initiator_session_id)
                 if sess is not None:
                     sess.peer_sid = obj.responder_session_id
+                    self._wake_key_locked(("sid", obj.initiator_session_id))
                     self._lock.notify_all()
             if ack:
                 ack()
@@ -496,6 +763,7 @@ class StateMachineManager:
                 sess = self._sessions.get(obj.initiator_session_id)
                 if sess is not None:
                     sess.rejected = obj.error
+                    self._wake_key_locked(("sid", obj.initiator_session_id))
                     self._lock.notify_all()
             if ack:
                 ack()
@@ -514,6 +782,7 @@ class StateMachineManager:
                 # by leaving unacked (broker redelivers) or drop on mock
                 return
             sess.inbound.append((kind, body, msg_id, ack))
+            self._wake_key_locked(("sid", sid))
             self._lock.notify_all()
 
     def _handle_init(self, msg, init: SessionInit, ack) -> None:
@@ -559,13 +828,16 @@ class StateMachineManager:
         })
         self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
                                   time.time())
+        fut: Future = Future()
         ex = _FlowExecutor(
             self, flow_id, [], None, responder_cls=responder,
             init_info={"peer": peer, "peer_sid": init.initiator_session_id,
                        "first": init.first_payload},
+            result=fut,
         )
         with self._lock:
             self._flows[flow_id] = ex
+            self._results[flow_id] = fut
         if ack:
             ack()  # responder is durable; Init is consumed
-        ex.start()
+        self._enqueue(flow_id)

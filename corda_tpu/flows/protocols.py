@@ -586,7 +586,7 @@ class AbstractStateReplacementFlow:
 
         def call(self) -> StateAndRef:
             builder = self.assemble_builder()
-            stx = self.services.sign_initial_transaction(builder)
+            stx = self.sign_builder(builder)
             my_key = self.our_identity.owning_key
             parties = []
             seen = set()

@@ -338,6 +338,25 @@ class NodeVaultService:
                 )
             self._db.commit()
 
+    def soft_lock_reacquire(self, lock_id: str, refs: list[StateRef]) -> int:
+        """Best-effort re-reservation for flow REPLAY (crash restore or
+        park/resume): re-lock every ref still unconsumed and free (or
+        already ours), silently skipping the rest — a state the flow's own
+        transaction has consumed since selection no longer needs the lock.
+        Returns the number re-locked."""
+        n = 0
+        with self._lock:
+            for ref in refs:
+                cur = self._db.execute(
+                    "UPDATE vault_states SET lock_id=?"
+                    " WHERE tx_id=? AND output_index=? AND consumed=0"
+                    " AND (lock_id IS NULL OR lock_id=?)",
+                    (lock_id, ref.txhash.bytes, ref.index, lock_id),
+                )
+                n += cur.rowcount
+            self._db.commit()
+        return n
+
     def soft_lock_release(self, lock_id: str, refs: list[StateRef] | None = None) -> None:
         with self._lock:
             if refs is None:

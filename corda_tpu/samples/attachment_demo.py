@@ -80,7 +80,7 @@ class PublishDocumentFlow(FlowLogic):
         )
         b.add_command(DocumentCommand(), self.our_identity.owning_key)
         b.add_attachment(att_id)
-        stx = self.services.sign_initial_transaction(b)
+        stx = self.sign_builder(b)
         self.sub_flow(FinalityFlow(stx))
         return att_id
 

@@ -118,9 +118,15 @@ class BuyerFlow(FlowLogic):
         me = self.our_identity
         seller = self.session.counterparty
 
-        refs = self.record(lambda: [
-            sr.ref for sr in select_cash(self, offer.currency, offer.price)
-        ])
+        refs = self.record(
+            lambda: [
+                sr.ref
+                for sr in select_cash(self, offer.currency, offer.price)
+            ],
+            replay=lambda recs: self.services.vault_service.soft_lock_reacquire(
+                self.flow_id, list(recs)
+            ),
+        )
         try:
             selected = [self.services.to_state_and_ref(r) for r in refs]
             builder = TransactionBuilder(notary=offer.paper.state.notary)
@@ -150,7 +156,7 @@ class BuyerFlow(FlowLogic):
             builder.add_command(Move(), *sorted(
                 signers, key=lambda k: (k.scheme_id, k.encoded)
             ))
-            stx = self.services.sign_initial_transaction(builder)
+            stx = self.sign_builder(builder)
             stx = self.sub_flow(CollectSignaturesFlow(stx, [self.session]))
             return self.sub_flow(FinalityFlow(stx))
         finally:
@@ -195,7 +201,7 @@ def issue_paper(node, notary: Party, face: int = 1000,
             b.set_time_window(TimeWindow(
                 None, int((time.time() + 3600) * 1_000_000)
             ))
-            stx = self.services.sign_initial_transaction(b)
+            stx = self.sign_builder(b)
             return self.sub_flow(FinalityFlow(stx))
 
     maturity = time.time() + maturity_days * 86400

@@ -45,3 +45,29 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: spawns real node subprocesses (seconds per boot)"
     )
+
+
+import functools  # noqa: E402
+import subprocess  # noqa: E402
+import sys  # noqa: E402
+
+
+@functools.lru_cache(maxsize=1)
+def tpu_backend_reachable() -> bool:
+    """Cheap probe used by device-marked tests before they spawn a real-TPU
+    subprocess: when the tunneled backend is down, backend INIT hangs
+    indefinitely, which would stall the whole suite for the subprocess
+    timeout — probe once with a short deadline and let the tests skip."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            env=env, capture_output=True, text=True, timeout=90,
+        )
+        return proc.returncode == 0 and "tpu" in proc.stdout
+    except Exception:
+        return False

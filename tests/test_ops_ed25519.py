@@ -455,6 +455,11 @@ class TestPallasPath:
         import subprocess
         import sys
 
+        from conftest import tpu_backend_reachable
+
+        if not tpu_backend_reachable():
+            pytest.skip("TPU backend unreachable")
+
         env = {
             k: v
             for k, v in os.environ.items()

@@ -124,6 +124,11 @@ class TestSignBatch:
         import subprocess
         import sys
 
+        from conftest import tpu_backend_reachable
+
+        if not tpu_backend_reachable():
+            pytest.skip("TPU backend unreachable")
+
         env = {
             k: v
             for k, v in os.environ.items()
