@@ -80,6 +80,19 @@ def make_batch(n: int):
     return pubkeys, sigs, msgs
 
 
+def bench_portable_c_sigs(pubkeys, sigs, msgs) -> float:
+    """The reference-CPU-path anchor: one-at-a-time verifies through the
+    portable scalar C engine (see BASELINE.md — a measured stand-in for
+    the JVM's pure-software EdDSA, at least as fast as the Java engine)."""
+    from corda_tpu.ops.host_ref import verify_loop
+
+    t0 = time.perf_counter()
+    mask = verify_loop(pubkeys, sigs, msgs)
+    dt = time.perf_counter() - t0
+    assert mask.all(), "portable baseline rejected valid sigs"
+    return len(sigs) / dt
+
+
 def bench_host_sigs(pubkeys, sigs, msgs) -> float:
     """Sequential host verify loop → sigs/sec."""
     from cryptography.exceptions import InvalidSignature
@@ -244,6 +257,85 @@ def bench_notary_device(moves, resolve, notary_id) -> tuple[float, float]:
     return statistics.median(rates), max(rates)
 
 
+def make_back_chain(hops: int):
+    """A 1k-hop Cash back-chain (BASELINE config #4: ResolveTransactionsFlow
+    deep-chain shape — issue, then `hops` sequential self-moves)."""
+    from corda_tpu.crypto import derive_keypair_from_entropy
+    from corda_tpu.finance import CashState
+    from corda_tpu.finance.contracts import CASH_PROGRAM_ID, Issue, Move
+    from corda_tpu.ledger import (
+        Amount, CordaX500Name, Issued, Party, PartyAndReference,
+        TransactionBuilder,
+    )
+
+    def party(tag):
+        kp = derive_keypair_from_entropy(4, hashlib.sha256(tag).digest())
+        return Party(CordaX500Name(tag.decode(), "London", "GB"), kp.public), kp
+
+    (alice, akp) = party(b"Chain Owner")
+    (notary, _nkp) = party(b"Chain Notary")
+    token = Issued(PartyAndReference(alice, b"\x03"), "GBP")
+
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(CashState(Amount(1000, token), alice), CASH_PROGRAM_ID)
+    b.add_command(Issue(), alice.owning_key)
+    head = b.sign_initial_transaction(akp)
+    chain = [head]
+    for _ in range(hops):
+        mb = TransactionBuilder(notary=notary)
+        mb.add_input_state(chain[-1].tx.out_ref(0))
+        mb.add_output_state(
+            CashState(Amount(1000, token), alice), CASH_PROGRAM_ID
+        )
+        mb.add_command(Move(), alice.owning_key)
+        chain.append(mb.sign_initial_transaction(akp))
+    return chain, notary
+
+
+def _clear_id_caches(chain) -> None:
+    for stx in chain:
+        object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+
+
+def bench_dag_host(chain, notary) -> float:
+    """The reference's sequential resolve shape: per tx, recompute the
+    Merkle id, verify signatures (host crypto), run contracts. (Wire
+    decode is excluded on BOTH sides — this measures the verify engine.)"""
+    from corda_tpu.ledger import StateRef
+
+    _clear_id_caches(chain)
+    t0 = time.perf_counter()
+    outputs = {}
+    for stx in chain:
+        stx.verify_signatures_except({notary.owning_key})
+        ltx = stx.tx.to_ledger_transaction(lambda r: outputs[r])
+        ltx.verify()
+        for i in range(len(stx.tx.outputs)):
+            outputs[StateRef(stx.id, i)] = stx.tx.outputs[i]
+    dt = time.perf_counter() - t0
+    return len(chain) / dt
+
+
+def bench_dag_device(chain, notary) -> tuple[float, float]:
+    """Wavefront DAG verify: whole-chain device dispatch for signatures and
+    Merkle ids, host walk for structure + contracts → (median, best)."""
+    from corda_tpu.parallel.wavefront import verify_transaction_dag
+
+    dag = {stx.id: stx for stx in chain}
+    allowed = lambda s: {notary.owning_key}  # noqa: E731
+    _clear_id_caches(chain)
+    verify_transaction_dag(dag, allowed_missing_fn=allowed)  # warm/compile
+    rates = []
+    for _ in range(3):
+        _clear_id_caches(chain)
+        t0 = time.perf_counter()
+        res = verify_transaction_dag(dag, allowed_missing_fn=allowed)
+        dt = time.perf_counter() - t0
+        assert len(res.order) == len(chain)
+        rates.append(len(chain) / dt)
+    return statistics.median(rates), max(rates)
+
+
 def bench_notary_loadtest(moves, resolve, notary_id) -> float:
     """Loadtest-harness-driven run through the async request window
     (reference: NotaryTest.kt storm via LoadTest.kt:37-69)."""
@@ -277,6 +369,12 @@ def main() -> None:
     host_sig_rate = bench_host_sigs(
         pubkeys[:HOST_SAMPLE], sigs[:HOST_SAMPLE], msgs[:HOST_SAMPLE]
     )
+    try:
+        ref_cpu_rate = bench_portable_c_sigs(
+            pubkeys[:256], sigs[:256], msgs[:256]
+        )
+    except Exception:
+        ref_cpu_rate = None
     sig_median, sig_best = bench_device_sigs(pubkeys, sigs, msgs)
 
     moves, resolve, notary_id = make_notary_stream(NOTARY_TXS)
@@ -285,6 +383,10 @@ def main() -> None:
     )
     notary_median, notary_best = bench_notary_device(moves, resolve, notary_id)
     loadtest_rate = bench_notary_loadtest(moves, resolve, notary_id)
+
+    chain, chain_notary = make_back_chain(1000)
+    dag_host_rate = bench_dag_host(chain[:256], chain_notary)
+    dag_median, dag_best = bench_dag_device(chain, chain_notary)
 
     print(
         json.dumps(
@@ -296,10 +398,23 @@ def main() -> None:
                 "notary_best_tx_per_sec": round(notary_best, 1),
                 "notary_loadtest_tx_per_sec": round(loadtest_rate, 1),
                 "baseline_host_notary_tx_per_sec": round(host_notary_rate, 1),
+                # BASELINE config #4: 1k-hop back-chain DAG verify
+                "dag_1k_chain_tx_per_sec": round(dag_median, 1),
+                "dag_1k_chain_best_tx_per_sec": round(dag_best, 1),
+                "baseline_host_dag_tx_per_sec": round(dag_host_rate, 1),
+                "dag_vs_host": round(dag_median / dag_host_rate, 3),
                 "ed25519_sigs_per_sec": round(sig_median, 1),
                 "ed25519_best_sigs_per_sec": round(sig_best, 1),
                 "ed25519_vs_host": round(sig_median / host_sig_rate, 3),
                 "baseline_host_sigs_per_sec": round(host_sig_rate, 1),
+                # north-star anchor: the reference-CPU-path proxy
+                # (portable scalar C engine — see BASELINE.md)
+                "baseline_reference_cpu_sigs_per_sec": (
+                    round(ref_cpu_rate, 1) if ref_cpu_rate else None
+                ),
+                "ed25519_vs_reference_cpu": (
+                    round(sig_median / ref_cpu_rate, 2) if ref_cpu_rate else None
+                ),
                 "sig_batch": SIG_BATCH,
                 "notary_txs": NOTARY_TXS,
                 "device": device,

@@ -16,14 +16,12 @@ rebuilt when the .cpp is newer); environments without a toolchain raise
 from __future__ import annotations
 
 import ctypes
-import subprocess
 import threading
 from pathlib import Path
 
 from .queue import Message, QueueClosedError
 
 _SRC = Path(__file__).resolve().parents[2] / "native" / "queue_engine.cpp"
-_LIB = _SRC.with_suffix(".so")
 
 _build_lock = threading.Lock()
 _lib = None
@@ -38,27 +36,12 @@ def _load():
     with _build_lock:
         if _lib is not None:
             return _lib
-        if not _SRC.exists():
-            raise NativeEngineUnavailable(f"missing source {_SRC}")
-        if not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
-            # build to a temp name + atomic rename: concurrent processes
-            # must never dlopen a half-written .so
-            import os
+        from corda_tpu.native_build import NativeBuildError, build_and_load
 
-            tmp = _LIB.with_suffix(f".{os.getpid()}.tmp.so")
-            try:
-                subprocess.run(
-                    ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-                     "-o", str(tmp), str(_SRC)],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _LIB)
-            except (OSError, subprocess.SubprocessError) as e:
-                tmp.unlink(missing_ok=True)
-                raise NativeEngineUnavailable(
-                    f"cannot build native queue engine: {e}"
-                ) from e
-        lib = ctypes.CDLL(str(_LIB))
+        try:
+            lib = build_and_load(_SRC)
+        except NativeBuildError as e:
+            raise NativeEngineUnavailable(str(e)) from e
         lib.ctq_open.argtypes = [ctypes.c_char_p, ctypes.c_double,
                                  ctypes.c_int]
         lib.ctq_open.restype = ctypes.c_int64

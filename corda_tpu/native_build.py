@@ -1,0 +1,55 @@
+"""Shared build-on-first-use loader for the native (C++) engines.
+
+One implementation of the compile-cache-load dance — mtime staleness
+check, temp-file + atomic rename (concurrent processes must never dlopen a
+half-written .so), error wrapping — used by every ctypes-bound engine
+(messaging/native_queue.py, ops/host_ref.py). The runtime around the
+device compute path is native where the reference's is (SURVEY.md §2.10);
+this is its build seam.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+_lock = threading.Lock()
+
+
+def build_and_load(
+    src: str | Path,
+    *,
+    flags: tuple[str, ...] = ("-O2", "-std=c++17"),
+    timeout: int = 120,
+) -> ctypes.CDLL:
+    """Compile ``src`` beside itself (if stale) and dlopen the result."""
+    src = Path(src)
+    lib_path = src.with_suffix(".so")
+    with _lock:
+        if not src.exists():
+            raise NativeBuildError(f"missing source {src}")
+        if not lib_path.exists() or (
+            lib_path.stat().st_mtime < src.stat().st_mtime
+        ):
+            tmp = lib_path.with_suffix(f".{os.getpid()}.tmp.so")
+            try:
+                subprocess.run(
+                    ["g++", *flags, "-shared", "-fPIC",
+                     "-o", str(tmp), str(src)],
+                    check=True, capture_output=True, timeout=timeout,
+                )
+                os.replace(tmp, lib_path)
+            except (OSError, subprocess.SubprocessError) as e:
+                tmp.unlink(missing_ok=True)
+                raise NativeBuildError(
+                    f"cannot build native engine {src.name}: {e}"
+                ) from e
+        return ctypes.CDLL(str(lib_path))

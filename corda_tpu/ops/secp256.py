@@ -111,7 +111,12 @@ class FieldCtx:
 
     Lazy invariant: public op outputs have limbs in [−16, 1100] (small
     negatives only for primes with negative fold digits, e.g. secp256r1);
-    inputs up to ~2500 are accepted by mul (columns stay ≤ 32·2500² < 2^31).
+    mul accepts input limbs up to ±2300 — the bound exercised by
+    test_lazy_bound_extremes. (Schoolbook columns at 2300 stay ≤
+    32·2300² ≈ 1.69e8; the worst fold column then adds the wrap terms and
+    k_fold ≈ 2^29, totalling well under 2^31. The theoretical cliff is
+    near ~2500 for secp256k1's ×977 double-fold, but 2300 is the
+    documented contract so chained-op bounds keep real headroom.)
     Exactness is restored only at ``canonical`` boundaries.
     """
 

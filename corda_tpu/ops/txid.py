@@ -1,0 +1,177 @@
+"""Batched device recomputation of WireTransaction Merkle ids.
+
+The production id path (ledger/wire.py hash schedule; reference:
+WireTransaction.kt:139-195 + MerkleTree.kt:27-57) is per-transaction host
+hashlib. Resolving a deep back-chain (ResolveTransactionsFlow.kt:91-99 —
+BASELINE config #4's 1k-hop DAG) recomputes ids for EVERY transaction in
+the chain; here that becomes a handful of batched SHA-256 dispatches over
+the whole cohort:
+
+  1. all component nonces      → one fixed-length sha256 batch
+  2. all component leaf hashes → bucketed sha256 batches (variable length)
+  3. all group Merkle trees    → one ``sha256_pair`` dispatch per level,
+                                 every tree in the cohort reducing together
+  4. all top trees (8 wide)    → three more ``sha256_pair`` levels
+
+Differentially tested against the host path (tests/test_ops_txid.py); the
+wavefront DAG verifier uses it to check + prime ids for a whole DAG in one
+sweep (a transaction whose claimed id does not match its recomputed id is
+a forged chain link and fails the DAG).
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from corda_tpu.crypto import SecureHash, ZERO_HASH
+
+from .sha256 import (
+    bytes_to_digest_words,
+    digest_words_to_bytes,
+    sha256_batch,
+    sha256_pair,
+)
+
+_ZERO_WORDS = np.frombuffer(ZERO_HASH.bytes, dtype=">u4").astype(np.uint32)
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _merkle_levels(
+    trees: list[list[int]], pool: np.ndarray
+) -> tuple[list[int], np.ndarray]:
+    """Reduce many Merkle trees together, one device dispatch per LEVEL.
+
+    ``trees``: per tree, the indices (into ``pool``, an (N, 8) uint32 word
+    array) of its pow2-padded leaf row. Returns ``(root_indices,
+    grown_pool)`` — interior-node digests append to the pool, so callers
+    MUST index roots into the returned pool, not the argument."""
+    import jax.numpy as jnp
+
+    trees = [list(t) for t in trees]
+    pool_list = [pool]
+
+    def flat_pool():
+        return np.concatenate(pool_list, axis=0)
+
+    while any(len(t) > 1 for t in trees):
+        left_idx, right_idx = [], []
+        base = sum(p.shape[0] for p in pool_list)
+        for t in trees:
+            if len(t) == 1:
+                continue
+            new_t = []
+            for i in range(0, len(t), 2):
+                left_idx.append(t[i])
+                right_idx.append(t[i + 1])
+                new_t.append(base + len(left_idx) - 1)
+            t[:] = new_t
+        cat = flat_pool()
+        out = np.asarray(
+            sha256_pair(
+                jnp.asarray(cat[np.asarray(left_idx)]),
+                jnp.asarray(cat[np.asarray(right_idx)]),
+            )
+        )
+        pool_list.append(out)
+    final = flat_pool()
+    return [t[0] for t in trees], final
+
+
+def compute_tx_ids(wtxs: list) -> list[SecureHash]:
+    """Recompute every transaction's Merkle id with batched device hashing.
+    Returns ids in input order; bit-identical to ``WireTransaction.id``."""
+    from corda_tpu.ledger.wire import ComponentGroupType
+
+    if not wtxs:
+        return []
+
+    # ---- flatten: every (tx, group, index) component across the cohort
+    nonce_msgs: list[bytes] = []
+    comp_bytes: list[bytes] = []
+    # per (tx, group): slice into the flattened component rows
+    spans: list[list[tuple[int, int]]] = []
+    cursor = 0
+    for wtx in wtxs:
+        tx_spans = []
+        for g in ComponentGroupType:
+            raws = wtx.component_bytes(g)
+            for i, raw in enumerate(raws):
+                nonce_msgs.append(
+                    wtx.privacy_salt.salt
+                    + b"CTNONCE"
+                    + struct.pack("<II", int(g), i)
+                )
+                comp_bytes.append(raw)
+            tx_spans.append((cursor, cursor + len(raws)))
+            cursor += len(raws)
+        spans.append(tx_spans)
+
+    # ---- stage 1+2: nonces, then leaves = sha256(nonce ‖ component)
+    nonces = sha256_batch(nonce_msgs)
+    leaves = sha256_batch(
+        [n + c for n, c in zip(nonces, comp_bytes)]
+    )
+
+    # ---- stage 3: all group trees reduce level-by-level together
+    leaf_words = (
+        bytes_to_digest_words(leaves)
+        if leaves
+        else np.zeros((0, 8), np.uint32)
+    )
+    pool = np.concatenate([leaf_words, _ZERO_WORDS[None, :]], axis=0)
+    zero_idx = pool.shape[0] - 1
+    trees: list[list[int]] = []
+    tree_of: list[list[int | None]] = []  # per tx: group -> tree index|None
+    for tx_spans in spans:
+        per_tx = []
+        for lo, hi in tx_spans:
+            n = hi - lo
+            if n == 0:
+                per_tx.append(None)  # empty group -> ZERO_HASH
+                continue
+            row = list(range(lo, hi)) + [zero_idx] * (_pow2(n) - n)
+            trees.append(row)
+            per_tx.append(len(trees) - 1)
+        tree_of.append(per_tx)
+
+    roots, pool = _merkle_levels(trees, pool)
+
+    # ---- stage 4: top tree over the 7 group roots (padded to 8)
+    top_trees = []
+    for per_tx in tree_of:
+        row = [
+            roots[t] if t is not None else zero_idx for t in per_tx
+        ]
+        row += [zero_idx] * (_pow2(len(row)) - len(row))
+        top_trees.append(row)
+    top_roots, pool = _merkle_levels(top_trees, pool)
+
+    id_bytes = digest_words_to_bytes(pool[np.asarray(top_roots)])
+    return [SecureHash(b) for b in id_bytes]
+
+
+def check_and_prime_ids(stxs: dict) -> None:
+    """Device-recompute the id of every SignedTransaction in
+    ``{claimed_id: stx}``; raise on any mismatch (forged chain link),
+    otherwise PRIME each WireTransaction's id cache so downstream host
+    code never re-hashes (the per-tx hot-path cost this kernel removes)."""
+    items = list(stxs.items())
+    ids = compute_tx_ids([stx.tx for _tid, stx in items])
+    for (claimed, stx), computed in zip(items, ids):
+        if computed != claimed:
+            from corda_tpu.ledger.states import TransactionVerificationException
+
+            raise TransactionVerificationException(
+                claimed,
+                f"transaction id mismatch: claimed {claimed}, "
+                f"recomputed {computed}",
+            )
+        object.__getattribute__(stx.tx, "__dict__")["_id"] = computed

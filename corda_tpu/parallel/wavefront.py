@@ -6,11 +6,16 @@ verification at a time (ResolveTransactionsFlow.kt:38-105). The TPU-native
 design (SURVEY.md §2.9 P7, BASELINE config #4): all transactions at the same
 topological depth are independent, so each level becomes
 
-  1. ONE scheme-bucketed device batch for every signature in the level
-     (corda_tpu.verifier.check_transactions), and
-  2. host-parallel contract-semantics verification per transaction,
-
-with a running consumed-state set rejecting double-spends inside the DAG —
+  1. ONE scheme-bucketed device batch for every signature in the WHOLE
+     DAG — signature validity and Merkle-id integrity are order-free, so
+     they never wait on the chain walk at all (a 1k-hop pure chain has
+     1k levels of width one: per-level dispatch would serialize on device
+     round trips; whole-DAG dispatch is one),
+  2. one batched device sweep recomputing and checking every Merkle id
+     (ops/txid.py), and
+  3. the order-DEPENDENT remainder per level: structural input
+     resolution, the running consumed-state set rejecting double-spends
+     inside the DAG, and host-parallel contract semantics —
 the host-side mirror of the mesh's all-gathered spent-state hashes
 (parallel/mesh.py).
 """
@@ -79,6 +84,7 @@ def verify_transaction_dag(
     use_device: bool = True,
     max_workers: int = 8,
     check_contracts: bool = True,
+    recompute_ids: bool = True,
 ) -> DagVerifyResult:
     """Verify a set of interdependent SignedTransactions wavefront-parallel.
 
@@ -88,10 +94,34 @@ def verify_transaction_dag(
     ``allowed_missing_fn(stx) -> set`` names keys allowed to be missing
     (e.g. the notary key during assembly); defaults to none.
 
+    With ``recompute_ids`` (device path), every transaction's Merkle id is
+    RECOMPUTED for the whole DAG in one batched device sweep
+    (ops/txid.py) — a forged chain link (claimed id ≠ recomputed id) fails
+    here, and the verified ids prime the per-tx caches so no host hashing
+    remains on the hot path. (Host id computation is the reference's
+    per-tx cost in ResolveTransactionsFlow.kt:91-99.)
+
     Raises the first verification failure; on success returns the ordering
     + consumed-set report.
     """
     from corda_tpu.verifier import check_transactions
+
+    if recompute_ids and use_device and stxs:
+        from corda_tpu.ops.txid import check_and_prime_ids
+
+        check_and_prime_ids(stxs)
+
+    # order-free work first: EVERY signature in the DAG in one bucketed
+    # dispatch (the chain walk below never waits on device round trips)
+    all_ids = list(stxs)
+    all_stxs = [stxs[tid] for tid in all_ids]
+    allowed_all = [
+        allowed_missing_fn(s) if allowed_missing_fn else set()
+        for s in all_stxs
+    ]
+    report = check_transactions(all_stxs, allowed_all, use_device=use_device)
+    report.raise_first()
+    n_sigs = report.n_sigs
 
     deps: dict = {}
     for tid, stx in stxs.items():
@@ -101,7 +131,6 @@ def verify_transaction_dag(
     outputs: dict = {}  # StateRef -> TransactionState, from verified txs
     consumed: set = set()
     order: list = []
-    n_sigs = 0
 
     def resolve(ref: StateRef, tid: SecureHash):
         if ref in outputs:
@@ -115,17 +144,6 @@ def verify_transaction_dag(
     pool = ThreadPoolExecutor(max_workers=max_workers) if check_contracts else None
     try:
         for level in levels:
-            level_stxs = [stxs[tid] for tid in level]
-            allowed = [
-                allowed_missing_fn(s) if allowed_missing_fn else set()
-                for s in level_stxs
-            ]
-            report = check_transactions(
-                level_stxs, allowed, use_device=use_device
-            )
-            report.raise_first()
-            n_sigs += report.n_sigs
-
             # consumed-set update is sequential (cheap set algebra); it is
             # the correctness gate for double-spends within the DAG
             for tid in level:

@@ -1,0 +1,64 @@
+"""Portable-C ed25519 baseline engine tests (ops/host_ref +
+native/ed25519_portable.cpp): the measured stand-in for the reference's
+JVM CPU path must agree exactly with the OpenSSL oracle — its only job is
+to be a fair, correct baseline."""
+
+import hashlib
+
+import pytest
+
+from corda_tpu.ops import host_ref
+
+
+@pytest.fixture(scope="module")
+def batch():
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as oed
+
+    pks, sigs, msgs = [], [], []
+    for i in range(32):
+        sk = oed.Ed25519PrivateKey.from_private_bytes(
+            hashlib.sha256(b"key%d" % i).digest()
+        )
+        m = hashlib.sha512(b"msg%d" % i).digest()[: 5 + 3 * i]
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    return pks, sigs, msgs
+
+
+class TestPortableBaseline:
+    def test_accepts_valid(self, batch):
+        pks, sigs, msgs = batch
+        assert host_ref.verify_loop(pks, sigs, msgs).all()
+
+    def test_rejects_every_corruption(self, batch):
+        pks, sigs, msgs = batch
+        pk, sig, msg = pks[0], sigs[0], msgs[0]
+        assert host_ref.verify_one(pk, sig, msg)
+        # flipped R bit, flipped s bit, flipped msg bit, wrong key
+        assert not host_ref.verify_one(
+            pk, bytes([sig[0] ^ 1]) + sig[1:], msg
+        )
+        assert not host_ref.verify_one(
+            pk, sig[:32] + bytes([sig[32] ^ 1]) + sig[33:], msg
+        )
+        assert not host_ref.verify_one(pk, sig, msg + b"x")
+        assert not host_ref.verify_one(pks[1], sig, msg)
+
+    def test_rejects_malformed(self, batch):
+        pks, sigs, msgs = batch
+        assert not host_ref.verify_one(pks[0][:31], sigs[0], msgs[0])
+        assert not host_ref.verify_one(pks[0], sigs[0][:63], msgs[0])
+        # s >= L rejected (malleability)
+        s = int.from_bytes(sigs[0][32:], "little") + host_ref.L
+        forged = sigs[0][:32] + s.to_bytes(32, "little")
+        assert not host_ref.verify_one(pks[0], forged, msgs[0])
+
+    def test_loop_mask_positions(self, batch):
+        pks, sigs, msgs = batch
+        bad = list(sigs)
+        bad[5] = bytes([sigs[5][0] ^ 1]) + sigs[5][1:]
+        bad[11] = sigs[11][:63] + b""  # short
+        mask = host_ref.verify_loop(pks, bad, msgs)
+        assert not mask[5] and not mask[11]
+        assert mask.sum() == len(pks) - 2

@@ -1,0 +1,118 @@
+"""Device tx-id recomputation (ops/txid.py): the batched Merkle pipeline
+must be bit-identical to the host path (ledger/wire.py hash schedule), and
+the DAG verifier must reject forged chain links."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sha256
+from corda_tpu.ledger import CordaX500Name, Party, TransactionBuilder
+from corda_tpu.ledger import register_contract
+from corda_tpu.ops.txid import check_and_prime_ids, compute_tx_ids
+from corda_tpu.serialization import register_custom
+
+
+@dataclasses.dataclass(frozen=True)
+class TState:
+    v: int
+    owner: Party
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class TCmd:
+    tag: str = "x"
+
+
+register_custom(
+    TState, "txid.TState",
+    to_fields=lambda s: {"v": s.v, "owner": s.owner},
+    from_fields=lambda d: TState(d["v"], d["owner"]),
+)
+register_custom(
+    TCmd, "txid.TCmd",
+    to_fields=lambda c: {"tag": c.tag},
+    from_fields=lambda d: TCmd(d["tag"]),
+)
+
+
+@register_contract("txid.TContract")
+class TContract:
+    def verify(self, tx):
+        pass
+
+
+def _party(name):
+    kp = generate_keypair()
+    return Party(CordaX500Name(name, "City", "GB"), kp.public), kp
+
+
+NOTARY, _NKP = _party("Notary")
+
+
+@pytest.fixture(scope="module")
+def cohort():
+    """A varied cohort: different group shapes, widths, attachments."""
+    alice, akp = _party("Alice")
+    notary = NOTARY
+    stxs = []
+    prev = None
+    for i in range(9):
+        b = TransactionBuilder(notary=notary)
+        if prev is not None:
+            b.add_input_state(prev.tx.out_ref(0))
+        for j in range(1 + i % 4):        # ragged output groups
+            b.add_output_state(TState(10 * i + j, alice), "txid.TContract")
+        b.add_command(TCmd(f"c{i}"), alice.owning_key)
+        if i % 3 == 0:
+            b.add_attachment(sha256(b"att%d" % i))
+        stx = b.sign_initial_transaction(akp)
+        stxs.append(stx)
+        prev = stx
+    return stxs
+
+
+class TestDeviceTxIds:
+    def test_bit_identical_to_host(self, cohort):
+        wtxs = [stx.tx for stx in cohort]
+        device_ids = compute_tx_ids(wtxs)
+        for wtx, did in zip(wtxs, device_ids):
+            # host path: clear the cache and recompute from scratch
+            object.__getattribute__(wtx, "__dict__").pop("_id", None)
+            assert wtx.id == did
+
+    def test_check_and_prime(self, cohort):
+        stxs = {stx.id: stx for stx in cohort}
+        for stx in cohort:
+            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+        check_and_prime_ids(stxs)
+        for stx in cohort:
+            assert "_id" in object.__getattribute__(stx.tx, "__dict__")
+
+    def test_forged_chain_link_detected(self, cohort):
+        from corda_tpu.ledger.states import TransactionVerificationException
+
+        stxs = {stx.id: stx for stx in cohort[:3]}
+        # mislabel one entry under a different id (a forged resolution map)
+        forged_key = sha256(b"not-the-real-id")
+        stxs[forged_key] = cohort[4]
+        with pytest.raises(TransactionVerificationException, match="mismatch"):
+            check_and_prime_ids(stxs)
+
+    def test_wavefront_uses_device_ids(self, cohort):
+        from corda_tpu.parallel.wavefront import verify_transaction_dag
+
+        stxs = {stx.id: stx for stx in cohort}
+        res = verify_transaction_dag(
+            stxs, use_device=True, check_contracts=True,
+            allowed_missing_fn=lambda s: {NOTARY.owning_key},
+        )
+        assert len(res.order) == len(cohort)
+
+    def test_empty_and_single(self, cohort):
+        assert compute_tx_ids([]) == []
+        assert compute_tx_ids([cohort[0].tx])[0] == cohort[0].id
