@@ -89,12 +89,37 @@ class LedgerTransaction:
                 out.append(ts.contract)
         return out
 
+    def contract_code_for(self, name: str):
+        """Resolve a contract to (class, code_hash).
+
+        Locally REGISTERED contracts win (the node's audited code, hashed
+        as the registry pseudo-attachment); otherwise the transaction's
+        OWN attachments are searched for restricted-executable contract
+        source defining the name (ledger/attachment_code.py — the
+        reference's attachments-classloader capability,
+        AttachmentsClassLoader.kt:24). The returned code hash is what the
+        state's constraint is checked against, so HashAttachmentConstraint
+        pins the exact code that runs."""
+        try:
+            return resolve_contract(name), contract_code_hash(name)
+        except TransactionVerificationException:
+            pass
+        from .attachment_code import resolve_from_attachments
+
+        hit = resolve_from_attachments(name, self.attachments)
+        if hit is None:
+            raise TransactionVerificationException(
+                self.tx_id,
+                f"unknown contract {name!r}: not registered and not carried "
+                "by any transaction attachment",
+            )
+        return hit
+
     def verify_constraints(self) -> None:
         """Every state's constraint must accept the contract code in scope
-        (reference: LedgerTransaction.verifyConstraints, :92-106; attachment
-        = registered contract-code hash here)."""
+        (reference: LedgerTransaction.verifyConstraints, :92-106)."""
         for ts in [sr.state for sr in self.inputs] + list(self.outputs):
-            code_hash = contract_code_hash(ts.contract)
+            _cls, code_hash = self.contract_code_for(ts.contract)
             if code_hash not in self.attachments:
                 raise TransactionVerificationException(
                     self.tx_id,
@@ -110,7 +135,7 @@ class LedgerTransaction:
         """Instantiate and run each referenced contract (reference:
         LedgerTransaction.verifyContracts, :110-128)."""
         for name in self.referenced_contracts():
-            contract = resolve_contract(name)()
+            contract = self.contract_code_for(name)[0]()
             try:
                 contract.verify(self)
             except TransactionVerificationException:
@@ -313,9 +338,19 @@ def verify_ledger_batch(ltxs: list[LedgerTransaction]) -> list:
             continue
         try:
             contract = resolve_contract(name)()
-        except TransactionVerificationException as e:
+        except TransactionVerificationException:
+            # not registered: each tx resolves from its OWN attachments
+            # (two txs may legitimately carry different code for one name)
             for i in idxs:
-                results[i] = e
+                try:
+                    contract_i = ltxs[i].contract_code_for(name)[0]()
+                    contract_i.verify(ltxs[i])
+                except TransactionVerificationException as e:
+                    results[i] = e
+                except Exception as e:
+                    results[i] = TransactionVerificationException(
+                        ltxs[i].tx_id, f"contract {name} rejected: {e}"
+                    )
             continue
         except Exception as e:
             for i in idxs:

@@ -101,6 +101,19 @@ class Node:
             verifier_service=self._make_verifier_service(),
             notary_service=notary_service,
         )
+        # attachment-carried contract code: the verify path resolves
+        # unknown contract names from transaction attachments through this
+        # store (ledger/attachment_code.py; reference:
+        # AttachmentsClassLoader.kt:24)
+        from corda_tpu.ledger.attachment_code import set_attachment_fetcher
+
+        attachments_store = self.services.attachments
+
+        def _fetch(att_id):
+            att = attachments_store.open_attachment(att_id)
+            return att.data if att is not None else None
+
+        set_attachment_fetcher(_fetch)
         if party_resolver is None:
             def party_resolver(sender_name: str):
                 info = network_map.get_node_by_legal_name(

@@ -1,0 +1,269 @@
+"""Attachment-carried contract code (ledger/attachment_code.py).
+
+The reference capability under test (AttachmentsClassLoader.kt:24 +
+LedgerTransaction.kt:92-106): verify a transaction whose contract code
+arrives AS AN ATTACHMENT — no local registration — with the state's
+HashAttachmentConstraint pinning the exact code; and the restriction gate
+must reject every escape-hatch construct."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sha256
+from corda_tpu.ledger import (
+    Command,
+    CordaX500Name,
+    LedgerTransaction,
+    Party,
+    StateAndRef,
+    StateRef,
+    TransactionState,
+    verify_ledger_batch,
+)
+from corda_tpu.ledger.attachment_code import (
+    ForbiddenContractCode,
+    load_attachment_contracts,
+    resolve_from_attachments,
+    set_attachment_fetcher,
+    validate_contract_source,
+)
+from corda_tpu.ledger.states import (
+    HashAttachmentConstraint,
+    TransactionVerificationException,
+)
+from corda_tpu.serialization import register_custom
+
+
+@dataclasses.dataclass(frozen=True)
+class IouState:
+    amount: int
+    holder: Party
+
+    @property
+    def participants(self):
+        return [self.holder]
+
+
+@dataclasses.dataclass(frozen=True)
+class IouCmd:
+    op: str = "issue"
+
+
+register_custom(
+    IouState, "attcode.IouState",
+    to_fields=lambda s: {"amount": s.amount, "holder": s.holder},
+    from_fields=lambda d: IouState(d["amount"], d["holder"]),
+)
+register_custom(
+    IouCmd, "attcode.IouCmd",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: IouCmd(d["op"]),
+)
+
+# the counterparty's CorDapp, carried as attachment SOURCE — never
+# registered locally
+IOU_SOURCE = b'''
+class IouContract:
+    def verify(self, tx):
+        outs = tx.output_states()
+        if not outs:
+            raise ValueError("an IOU transaction must create IOUs")
+        for s in outs:
+            if s.amount <= 0:
+                raise ValueError("IOU amount must be positive")
+        total_in = sum(s.amount for s in tx.input_states())
+        total_out = sum(s.amount for s in outs)
+        if tx.input_states() and total_out > total_in:
+            raise ValueError("IOU value cannot inflate on a move")
+
+CONTRACTS = {"attcode.Iou": IouContract}
+'''
+
+
+def _party(name):
+    kp = generate_keypair()
+    return Party(CordaX500Name(name, "City", "GB"), kp.public), kp
+
+
+@pytest.fixture()
+def store():
+    """An attachment store (content-addressed dict) wired into the
+    resolver, torn down after each test."""
+    blobs = {}
+
+    def put(data: bytes):
+        h = sha256(data)
+        blobs[h] = data
+        return h
+
+    set_attachment_fetcher(blobs.get)
+    yield put
+    set_attachment_fetcher(None)
+
+
+def _ltx(att_hashes, outputs, commands, inputs=(), tx_tag=b"t1"):
+    notary, _ = _party("Notary")
+    return LedgerTransaction(
+        tx_id=sha256(tx_tag),
+        inputs=tuple(inputs),
+        outputs=tuple(outputs),
+        commands=tuple(commands),
+        attachments=tuple(att_hashes),
+        notary=notary,
+        time_window=None,
+    )
+
+
+class TestRestrictedExecution:
+    def test_benign_contract_loads_and_verifies(self, store):
+        alice, _ = _party("Alice")
+        att = store(IOU_SOURCE)
+        ts = TransactionState(
+            IouState(100, alice), "attcode.Iou", _party("N")[0],
+            constraint=HashAttachmentConstraint(att),
+        )
+        ltx = _ltx([att], [ts], [Command(IouCmd(), (alice.owning_key,))])
+        ltx.verify()  # end to end: unregistered contract, code from attachment
+
+    def test_contract_semantics_enforced(self, store):
+        alice, _ = _party("Alice")
+        att = store(IOU_SOURCE)
+        bad = TransactionState(
+            IouState(-5, alice), "attcode.Iou", _party("N")[0],
+            constraint=HashAttachmentConstraint(att),
+        )
+        ltx = _ltx([att], [bad], [Command(IouCmd(), (alice.owning_key,))])
+        with pytest.raises(TransactionVerificationException, match="positive"):
+            ltx.verify()
+
+    def test_hash_constraint_pins_exact_code(self, store):
+        """A state pinned to code hash H must reject a transaction carrying
+        DIFFERENT code for the same contract name."""
+        alice, _ = _party("Alice")
+        rogue_source = IOU_SOURCE.replace(b"s.amount <= 0", b"False")
+        rogue_att = store(rogue_source)
+        pinned = sha256(IOU_SOURCE)  # the honest code's hash
+        ts = TransactionState(
+            IouState(100, alice), "attcode.Iou", _party("N")[0],
+            constraint=HashAttachmentConstraint(pinned),
+        )
+        ltx = _ltx([rogue_att], [ts], [Command(IouCmd(), (alice.owning_key,))])
+        with pytest.raises(TransactionVerificationException):
+            ltx.verify()
+
+    def test_unknown_contract_without_attachment_fails(self, store):
+        alice, _ = _party("Alice")
+        ts = TransactionState(
+            IouState(1, alice), "attcode.NotCarried", _party("N")[0],
+        )
+        ltx = _ltx([], [ts], [Command(IouCmd(), (alice.owning_key,))])
+        with pytest.raises(TransactionVerificationException, match="unknown"):
+            ltx.verify()
+
+    def test_batch_path_resolves_attachment_contracts(self, store):
+        alice, _ = _party("Alice")
+        att = store(IOU_SOURCE)
+        mk = lambda amount, tag: _ltx(  # noqa: E731
+            [att],
+            [TransactionState(
+                IouState(amount, alice), "attcode.Iou", _party("N")[0],
+                constraint=HashAttachmentConstraint(att),
+            )],
+            [Command(IouCmd(), (alice.owning_key,))],
+            tx_tag=tag,
+        )
+        out = verify_ledger_batch([mk(10, b"a"), mk(-1, b"b"), mk(7, b"c")])
+        assert out[0] is None and out[2] is None
+        assert out[1] is not None
+
+    def test_registered_contract_shadows_attachment(self, store):
+        """Locally registered (audited) code always wins over attachment
+        code for the same name."""
+        from corda_tpu.ledger import register_contract
+
+        @register_contract("attcode.Shadowed")
+        class Local:
+            def verify(self, tx):
+                raise ValueError("local wins")
+
+        alice, _ = _party("Alice")
+        evil = store(
+            b"class C:\n"
+            b"    def verify(self, tx):\n"
+            b"        pass\n"
+            b'CONTRACTS = {"attcode.Shadowed": C}\n'
+        )
+        from corda_tpu.ledger.states import contract_code_hash
+
+        ts = TransactionState(
+            IouState(1, alice), "attcode.Shadowed", _party("N")[0],
+        )
+        ltx = _ltx(
+            [evil, contract_code_hash("attcode.Shadowed")], [ts],
+            [Command(IouCmd(), (alice.owning_key,))],
+        )
+        with pytest.raises(TransactionVerificationException, match="local wins"):
+            ltx.verify()
+
+
+HOSTILE_SOURCES = [
+    b"import os\nCONTRACTS = {}\n",
+    b"from subprocess import run\nCONTRACTS = {}\n",
+    b"x = open('/etc/passwd').read()\nCONTRACTS = {}\n",
+    b"x = eval('1+1')\nCONTRACTS = {}\n",
+    b"x = exec('pass')\nCONTRACTS = {}\n",
+    b"x = getattr(int, 'bit_length')\nCONTRACTS = {}\n",
+    b"x = ().__class__\nCONTRACTS = {}\n",
+    b"x = (1).__class__.__mro__\nCONTRACTS = {}\n",
+    b"def f():\n    global CONTRACTS\nCONTRACTS = {}\n",
+    b"x = [c for c in ().__class__.__base__.__subclasses__()]\n",
+    b"async def f():\n    pass\nCONTRACTS = {}\n",
+    b"x = __import__('os')\nCONTRACTS = {}\n",
+    b"class C:\n    def __init_subclass__(cls):\n        pass\n",
+    b"x" * (300 * 1024),
+]
+
+
+class TestRestrictionGate:
+    @pytest.mark.parametrize("src", HOSTILE_SOURCES, ids=range(len(HOSTILE_SOURCES)))
+    def test_hostile_source_rejected(self, src):
+        with pytest.raises(ForbiddenContractCode):
+            validate_contract_source(src)
+
+    def test_hostile_sources_never_reach_execution(self, store):
+        for src in HOSTILE_SOURCES:
+            with pytest.raises(ForbiddenContractCode):
+                load_attachment_contracts(bytes(src))
+
+    def test_no_verify_class_rejected(self):
+        with pytest.raises(ForbiddenContractCode, match="CONTRACTS"):
+            load_attachment_contracts(b"x = 1\n")
+        with pytest.raises(ForbiddenContractCode, match="verify"):
+            load_attachment_contracts(
+                b"class C:\n    pass\nCONTRACTS = {'a': C}\n"
+            )
+
+    def test_builtins_are_frozen(self):
+        """The execution namespace must not expose import machinery or IO
+        even indirectly."""
+        src = (
+            b"caught = []\n"
+            b"class C:\n"
+            b"    def verify(self, tx):\n"
+            b"        pass\n"
+            b"CONTRACTS = {'x': C}\n"
+        )
+        contracts = load_attachment_contracts(src)
+        assert "x" in contracts
+
+    def test_corrupt_attachment_never_executes(self, store):
+        """A fetcher returning bytes that do not hash to the requested id
+        (storage corruption / forged mapping) must be ignored."""
+        evil = IOU_SOURCE
+        wrong_id = sha256(b"something else")
+        set_attachment_fetcher(lambda h: evil)  # lies about every id
+        try:
+            assert resolve_from_attachments("attcode.Iou", (wrong_id,)) is None
+        finally:
+            set_attachment_fetcher(None)

@@ -344,3 +344,55 @@ class TestReviewRegressions:
         composite_pub = ck.to_public_key()
         # adversarial: the composite key itself listed as a signer
         assert verify_composite(composite_pub, [(composite_pub, b"junk")], b"m") is False
+
+
+class TestSphincsPlus:
+    """The SPHINCS+-shaped hypertree scheme (crypto/sphincs.py): stateless
+    many-time signing, addressed hashing, commitment-checked public key."""
+
+    def test_many_time_stateless(self):
+        kp = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"mt")
+        for i in range(3):
+            m = b"msg-%d" % i
+            sig = crypto.sign(kp.private, m)
+            assert crypto.is_valid(kp.public, sig, m)
+
+    def test_every_tamper_mode_rejected(self):
+        from corda_tpu.crypto import sphincs
+
+        kp = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"tm")
+        m = b"the message"
+        sig = crypto.sign(kp.private, m)
+        n = sphincs.N
+        # randomizer, idx, a FORS leaf sk, a WOTS chain byte, auth path,
+        # the trailing pub_seed/root commitment
+        for off in (0, n, n + 9, n + 8 + n + 2, len(sig) - 1, len(sig) - n - 1):
+            bad = sig[:off] + bytes([sig[off] ^ 1]) + sig[off + 1:]
+            assert not crypto.is_valid(kp.public, bad, m), off
+        assert not crypto.is_valid(kp.public, sig[:-1], m)  # truncated
+
+    def test_hypertree_instance_selection_is_bound(self):
+        """The signature's claimed hypertree index must match the
+        randomized message hash — an attacker cannot steer verification
+        to a different (reused) FORS instance."""
+        import struct as _struct
+
+        from corda_tpu.crypto import sphincs
+
+        kp = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"ix")
+        m = b"bind me"
+        sig = crypto.sign(kp.private, m)
+        (idx,) = _struct.unpack(">Q", sig[sphincs.N:sphincs.N + 8])
+        forged = (
+            sig[:sphincs.N]
+            + _struct.pack(">Q", (idx + 1) % (1 << sphincs.H))
+            + sig[sphincs.N + 8:]
+        )
+        assert not crypto.is_valid(kp.public, forged, m)
+
+    def test_wrong_key_commitment_rejected(self):
+        kp1 = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"a1")
+        kp2 = crypto.derive_keypair_from_entropy(crypto.SPHINCS256_SHA256, b"a2")
+        m = b"x"
+        sig = crypto.sign(kp1.private, m)
+        assert not crypto.is_valid(kp2.public, sig, m)
