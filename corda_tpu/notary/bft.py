@@ -292,8 +292,27 @@ class BFTReplica:
                  client: str | None) -> None:
         """Apply to the uniqueness map and reply to the client with a
         signature over the outcome (reference: Replica.verifyAndCommitTx +
-        sign over the tx id, BFTNonValidatingNotaryService.kt:136-158)."""
-        states, tx_id, caller = deserialize(command)
+        sign over the tx id, BFTNonValidatingNotaryService.kt:136-158).
+
+        A ``("batch", [request, ...])`` command settles a whole notary
+        window in this one totally-ordered slot; the outcome (the per-
+        request conflict list) is deterministic across replicas because
+        requests apply in batch order."""
+        cmd = deserialize(command)
+        if cmd[0] == "batch":
+            requests = [(s, t, c) for s, t, c in cmd[1]]
+            conflicts = self.base.commit_batch(requests)
+            outcome = serialize({"batch": True, "conflicts": conflicts})
+            sig = host_sign(self._keypair.private, outcome)
+            client = client or (requests[0][2] if requests else None)
+            self._messaging.send(
+                client, T_REPLY,
+                serialize({"digest": d, "replica": self.name,
+                           "outcome": outcome, "sig": sig,
+                           "key": self._keypair.public}),
+            )
+            return
+        states, tx_id, caller = cmd
         try:
             self.base.commit(states, tx_id, caller)
             conflict = None
@@ -557,7 +576,22 @@ class BFTClusterClient:
 
     def submit(self, states, tx_id, caller: str):
         """Returns (conflict_or_None, {replica: sig}) after quorum."""
-        command = serialize((list(states), tx_id, caller))
+        outcome, sigs = self._submit_command(
+            serialize((list(states), tx_id, caller))
+        )
+        return outcome["conflict"], sigs
+
+    def submit_batch(self, requests):
+        """N requests in ONE total-order slot: returns (conflicts, sigs)
+        where conflicts is the per-request list, after f+1 matching
+        replies (matching = identical serialized conflict list, so the
+        quorum certifies the whole batch outcome)."""
+        outcome, sigs = self._submit_command(serialize(
+            ("batch", [(list(s), t, c) for (s, t, c) in requests])
+        ))
+        return list(outcome["conflicts"]), sigs
+
+    def _submit_command(self, command: bytes):
         d = _digest(command)
         fut: Future = Future()
         with self._lock:
@@ -581,8 +615,7 @@ class BFTClusterClient:
             with self._lock:
                 self._futures.pop(d, None)
                 self._replies.pop(d, None)
-        outcome = deserialize(outcome_bytes)
-        return outcome["conflict"], sigs
+        return deserialize(outcome_bytes), sigs
 
 
 class BFTUniquenessProvider(UniquenessProvider):
@@ -597,6 +630,14 @@ class BFTUniquenessProvider(UniquenessProvider):
             raise NotaryError(
                 f"input states of {tx_id} already consumed", conflict
             )
+
+    def commit_batch(self, requests):
+        """One total-order broadcast for the whole window (r2 VERDICT weak
+        #4); the f+1 quorum certifies the per-request conflict list."""
+        if not requests:
+            return []
+        conflicts, _sigs = self.client.submit_batch(requests)
+        return conflicts
 
     @staticmethod
     def make_cluster(n: int, network, prefix: str = "bft-replica",

@@ -178,6 +178,57 @@ class RaftStorage:
             self._db.commit()
             return UniquenessConflict(conflict) if conflict else None
 
+    def apply_commit_batch(
+        self, abs_idx: int, requests: list
+    ) -> list[UniquenessConflict | None]:
+        """Apply ONE committed log entry carrying N commit requests — one
+        transaction, one applied-marker write, one fsync (the clustered
+        notary's answer to per-tx consensus: the reference's Raft map is
+        batched per tx via putAll, DistributedImmutableMap.kt; this goes
+        wider — a whole notary window per entry). Requests settle in
+        order, so intra-batch double-spends conflict deterministically on
+        every replica. Idempotent on replay like ``apply_commit``."""
+        with self._lock:
+            if abs_idx <= self._get_meta("applied", -1):
+                return [None] * len(requests)
+            out: list[UniquenessConflict | None] = []
+            prior: dict[bytes, tuple] = {}
+            to_insert = []
+            for states, tx_id, caller in requests:
+                conflict: dict = {}
+                for ref in states:
+                    key = _ref_key(ref)
+                    hit = prior.get(key)
+                    if hit is None:
+                        row = self._db.execute(
+                            "SELECT consuming_tx, input_index, caller FROM"
+                            " notary_commits WHERE state_key=?", (key,)
+                        ).fetchone()
+                        if row is not None:
+                            hit = (bytes(row[0]), row[1], row[2])
+                            prior[key] = hit
+                    if hit is not None and hit[0] != tx_id.bytes:
+                        conflict[ref] = ConsumedStateDetails(
+                            SecureHash(hit[0]), hit[1], hit[2]
+                        )
+                if conflict:
+                    out.append(UniquenessConflict(conflict))
+                    continue
+                for i, ref in enumerate(states):
+                    key = _ref_key(ref)
+                    if key not in prior:
+                        to_insert.append((key, tx_id.bytes, i, caller))
+                        prior[key] = (tx_id.bytes, i, caller)
+                out.append(None)
+            if to_insert:
+                self._db.executemany(
+                    "INSERT OR IGNORE INTO notary_commits VALUES (?,?,?,?)",
+                    to_insert,
+                )
+            self._set_meta_tx("applied", abs_idx)
+            self._db.commit()
+            return out
+
     def compact(self, upto_abs_idx: int, upto_term: int) -> None:
         """Drop log entries ≤ ``upto_abs_idx`` — the state machine already
         reflects them (it IS the snapshot)."""
