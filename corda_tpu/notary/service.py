@@ -180,6 +180,15 @@ class BatchedNotaryService(NotaryService):
         (see ``process_stream``)."""
         from corda_tpu.verifier import dispatch_transactions
 
+        if self._use_device:
+            # receive-path integrity: recompute every tx's Merkle id from
+            # its component bytes in one batched device sweep (reference
+            # gets this implicitly from WireTransaction.kt:139-195 — the
+            # id IS the content hash); the signature batch below then
+            # checks each signer actually signed that recomputed root
+            from corda_tpu.ops.txid import prime_ids
+
+            prime_ids([r[0] for r in requests])
         return dispatch_transactions(
             [r[0] for r in requests],
             [{self.identity.owning_key}] * len(requests),

@@ -158,6 +158,28 @@ def compute_tx_ids(wtxs: list) -> list[SecureHash]:
     return [SecureHash(b) for b in id_bytes]
 
 
+def prime_ids(stxs: list) -> None:
+    """Device-recompute and prime the Merkle id of every SignedTransaction
+    whose wire tx has a cold id cache — one batched hashing sweep instead of
+    per-tx host hashlib.
+
+    This is the notary's receive-path integrity work (reference:
+    WireTransaction.kt:139-195 — the id IS the Merkle root over the
+    components, so a peer cannot claim an id its content doesn't hash to):
+    the id each signature is checked against is recomputed from the
+    component bytes here, and the signature batch then fails any lane whose
+    signer signed a different root."""
+    cold = [
+        stx for stx in stxs
+        if "_id" not in object.__getattribute__(stx.tx, "__dict__")
+    ]
+    if not cold:
+        return
+    ids = compute_tx_ids([stx.tx for stx in cold])
+    for stx, computed in zip(cold, ids):
+        object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+
+
 def check_and_prime_ids(stxs: dict) -> None:
     """Device-recompute the id of every SignedTransaction in
     ``{claimed_id: stx}``; raise on any mismatch (forged chain link),
