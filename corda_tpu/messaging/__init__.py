@@ -34,6 +34,7 @@ from .secure_transport import (
     SecureBrokerServer,
     SecureChannel,
 )
+from .fabric import SecureFabricClient
 from .native_queue import (
     NativeEngineUnavailable,
     NativeQueueBroker,
@@ -53,6 +54,7 @@ __all__ = [
     "p2p_queue",
     "ChannelClosedError", "HandshakeError",
     "SecureBrokerConnection", "SecureBrokerServer", "SecureChannel",
+    "SecureFabricClient",
     "NativeEngineUnavailable", "NativeQueueBroker", "make_broker",
     "native_engine_available",
 ]

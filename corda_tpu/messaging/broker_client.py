@@ -76,27 +76,48 @@ class BrokerMessagingClient(MessagingClient):
         while self._running:
             try:
                 msg = self._broker.consume(p2p_queue(self._name), timeout=0.5)
-            except QueueClosedError:
+            except (QueueClosedError, ConnectionError):
+                # broker closed or the secure-fabric channel tore down —
+                # either way the transport is gone; exit cleanly
                 return
             if msg is None:
                 continue
             hlen = int.from_bytes(msg.payload[:4], "big")
             header = json.loads(msg.payload[4 : 4 + hlen])
             body = msg.payload[4 + hlen :]
+            # message attribution: the broker stamps Message.sender with
+            # the transport-authenticated identity (the secure fabric's
+            # channel peer; in-process, the publishing client's own name).
+            # An envelope claiming a DIFFERENT sender is a spoof attempt —
+            # a certified-but-malicious peer must not speak as the notary
+            # — and is dropped, so the mutual-auth boundary extends from
+            # the socket to per-message attribution.
+            if msg.sender and msg.sender != header["sender"]:
+                try:
+                    self._broker.ack(msg.msg_id)
+                except (QueueClosedError, ConnectionError):
+                    return
+                continue
             tmsg = TopicMessage(
                 header["topic"], body, header["sender"], msg.msg_id
             )
             with self._lock:
                 handlers = list(self._handlers.get(tmsg.topic, ()))
             if not handlers:
-                self._broker.nack(msg.msg_id)  # no handler yet: requeue
+                try:
+                    self._broker.nack(msg.msg_id)  # no handler yet: requeue
+                except (QueueClosedError, ConnectionError):
+                    return
                 continue
             acked = threading.Event()
 
             def ack(msg_id=msg.msg_id):
                 if not acked.is_set():
                     acked.set()
-                    self._broker.ack(msg_id)
+                    try:
+                        self._broker.ack(msg_id)
+                    except (QueueClosedError, ConnectionError):
+                        pass  # fabric torn down: redelivery will settle it
 
             for h in handlers:
                 h(tmsg, ack)

@@ -1,0 +1,148 @@
+"""The node's handle onto a remote secure broker: the fabric client.
+
+This is what makes the authenticated transport (secure_transport.py) the
+node fabric rather than a component demo: `SecureFabricClient` presents
+the exact ``DurableQueueBroker`` surface (publish/consume/ack/nack/depth)
+that ``BrokerMessagingClient``, the out-of-process verifier service and
+the RPC tier already consume — so a node ensemble moves from the shared
+in-process broker to mutually-authenticated TCP by swapping the object,
+with every protocol layer unchanged (the reference gets the same
+layering from Artemis: one TLS transport under P2P, RPC and verifier
+traffic, ArtemisTcpTransport.kt:1-60).
+
+Connection discipline: consuming is long-polling (the server holds the
+request up to the timeout), so a consumer thread would head-of-line-block
+every other operation if it shared a channel. Each consuming THREAD gets
+its own authenticated channel (lazily, keyed by thread id); fast control
+operations (publish/ack/nack/depth) share one locked channel.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from corda_tpu.crypto import PublicKey
+from corda_tpu.crypto.keys import PrivateKey
+from corda_tpu.ledger.identity import PartyAndCertificate
+
+from .queue import Message, QueueClosedError
+from .secure_transport import SecureBrokerConnection
+
+logger = logging.getLogger(__name__)
+
+
+class SecureFabricClient:
+    """A certified peer's broker handle over the authenticated transport.
+
+    Raises ``HandshakeError`` during construction when this identity does
+    not chain to the fabric's trust root — an uncertified process cannot
+    even open the fabric, let alone read or publish.
+    """
+
+    def __init__(
+        self, address: tuple | str,
+        identity: PartyAndCertificate, identity_private: PrivateKey,
+        trust_root: PublicKey, timeout_s: float = 10.0,
+    ):
+        if isinstance(address, str):
+            host, _, port = address.rpartition(":")
+            address = (host or "127.0.0.1", int(port))
+        self._address = address
+        self._identity = identity
+        self._private = identity_private
+        self._trust_root = trust_root
+        self._timeout_s = timeout_s
+        self._closed = False
+        self._lock = threading.Lock()
+        self._control = self._connect()
+        # per consuming thread: (thread object, its channel) — the thread
+        # object lets dead threads' channels be pruned (and guards against
+        # a reused thread id silently sharing a predecessor's channel)
+        self._consumers: dict[int, tuple] = {}
+
+    def _connect(self) -> SecureBrokerConnection:
+        return SecureBrokerConnection(
+            self._address, self._identity, self._private, self._trust_root,
+            timeout_s=self._timeout_s,
+        )
+
+    @property
+    def peer(self) -> PartyAndCertificate:
+        """The broker's certified identity (both directions authenticate)."""
+        return self._control.peer
+
+    def _consumer_conn(self) -> SecureBrokerConnection:
+        me = threading.current_thread()
+        with self._lock:
+            if self._closed:
+                raise QueueClosedError("fabric client closed")
+            dead = [
+                tid for tid, (t, _c) in self._consumers.items()
+                if not t.is_alive()
+            ]
+            stale = [self._consumers.pop(tid) for tid in dead]
+            entry = self._consumers.get(me.ident)
+        for _t, c in stale:
+            try:
+                c.close()
+            except Exception:
+                pass
+        if entry is None:
+            # connect + handshake OUTSIDE the lock (up to timeout_s): other
+            # threads' operations and close() must not stall behind it
+            conn = self._connect()
+            with self._lock:
+                if self._closed:
+                    conn.close()
+                    raise QueueClosedError("fabric client closed")
+                entry = self._consumers.setdefault(me.ident, (me, conn))
+            if entry[1] is not conn:  # lost a (same-thread-id) race
+                conn.close()
+        return entry[1]
+
+    @staticmethod
+    def _map_closed(fn):
+        try:
+            return fn()
+        except RuntimeError as e:
+            # the remote broker reports errors as strings; closed-queue is
+            # the one the consuming loops handle as a clean shutdown signal
+            if "QueueClosedError" in str(e):
+                raise QueueClosedError(str(e)) from None
+            raise
+
+    # ------------------------------------------------- broker surface
+    def publish(self, queue: str, payload: bytes, *, msg_id: str | None = None,
+                sender: str = "", reply_to: str = "") -> str:
+        # ``sender`` is accepted for surface parity but the BROKER stamps
+        # the channel identity — a peer cannot publish as someone else
+        return self._map_closed(lambda: self._control.publish(
+            queue, payload, msg_id=msg_id, reply_to=reply_to
+        ))
+
+    def consume(self, queue: str, timeout: float = 0.0) -> Message | None:
+        conn = self._consumer_conn()
+        return self._map_closed(lambda: conn.consume(queue, timeout=timeout))
+
+    def ack(self, msg_id: str) -> None:
+        self._map_closed(lambda: self._control.ack(msg_id))
+
+    def nack(self, msg_id: str) -> None:
+        self._map_closed(lambda: self._control.nack(msg_id))
+
+    def depth(self, queue: str) -> int:
+        return self._map_closed(lambda: self._control.depth(queue))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            conns = [self._control] + [c for _t, c in self._consumers.values()]
+            self._consumers.clear()
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass

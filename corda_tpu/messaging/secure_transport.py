@@ -59,8 +59,9 @@ class HandshakeError(Exception):
     pass
 
 
-class ChannelClosedError(Exception):
-    pass
+class ChannelClosedError(ConnectionError):
+    """Peer closed the channel — a ConnectionError so transport-blind
+    consumer loops can treat fabric teardown as a clean shutdown."""
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -247,6 +248,11 @@ class SecureBrokerServer:
         self._stop = threading.Event()
         self._conn_lock = threading.Lock()
         self._conns: set[socket.socket] = set()
+        # per-PEER delivered-but-unsettled msg ids: a fabric client consumes
+        # on per-thread channels and acks on its control channel, so the
+        # settlement authority spans all of one identity's connections
+        self._delivered_lock = threading.Lock()
+        self._delivered: dict[str, set] = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="secure-broker-accept"
         )
@@ -280,9 +286,11 @@ class SecureBrokerServer:
                 conn.close()
                 return
             peer_name = str(chan.peer.party.name)
+            with self._delivered_lock:
+                delivered = self._delivered.setdefault(peer_name, set())
             while not self._stop.is_set():
                 req = deserialize(chan.recv())
-                chan.send(serialize(self._dispatch(req, peer_name)))
+                chan.send(serialize(self._dispatch(req, peer_name, delivered)))
         except (ChannelClosedError, ConnectionError, OSError):
             pass
         except Exception:
@@ -292,7 +300,24 @@ class SecureBrokerServer:
                 self._conns.discard(conn)
             conn.close()
 
-    def _dispatch(self, req: dict, peer_name: str) -> dict:
+    @staticmethod
+    def _may_consume(queue: str, peer_name: str) -> bool:
+        """Queue-level authorization (the role of the reference broker's
+        per-queue security settings, ArtemisMessagingServer securityRoles):
+        addressed inbox queues — ``p2p.<name>`` and the verifier response
+        queue ``verifier.responses.<name>`` — are consumable ONLY by the
+        channel identity they address; unaddressed queues (e.g. the shared
+        ``verifier.requests`` work queue) are open to any certified peer.
+        Without this, any certified peer could drain and ack another
+        party's inbox — a stronger attack than sender spoofing."""
+        if queue.startswith("p2p."):
+            return queue == f"p2p.{peer_name}"
+        if queue.startswith("verifier.responses."):
+            return queue == f"verifier.responses.{peer_name}"
+        return True
+
+    def _dispatch(self, req: dict, peer_name: str,
+                  delivered: set[str]) -> dict:
         try:
             op = req["op"]
             if op == "publish":
@@ -306,24 +331,41 @@ class SecureBrokerServer:
                 )
                 return {"ok": True, "msg_id": msg_id}
             if op == "consume":
+                if not self._may_consume(req["queue"], peer_name):
+                    return {"ok": False, "error":
+                            f"NotAuthorized: {peer_name!r} may not consume "
+                            f"{req['queue']!r}"}
                 msg = self._broker.consume(
                     req["queue"], timeout=req.get("timeout", 0.0)
                 )
                 if msg is None:
                     return {"ok": True, "msg": None}
+                delivered.add(msg.msg_id)
                 return {"ok": True, "msg": {
                     "queue": msg.queue, "payload": msg.payload,
                     "msg_id": msg.msg_id, "sender": msg.sender,
                     "reply_to": msg.reply_to,
                     "redelivered": msg.redelivered,
                 }}
-            if op == "ack":
-                self._broker.ack(req["msg_id"])
-                return {"ok": True}
-            if op == "nack":
-                self._broker.nack(req["msg_id"])
+            if op in ("ack", "nack"):
+                # a peer settles only messages delivered on ITS connections
+                # (same `delivered` set is shared per serve_conn socket;
+                # redelivered messages re-enter via a later consume)
+                if req["msg_id"] not in delivered:
+                    return {"ok": False, "error":
+                            f"NotAuthorized: {req['msg_id']!r} was not "
+                            f"delivered to {peer_name!r} here"}
+                delivered.discard(req["msg_id"])
+                if op == "ack":
+                    self._broker.ack(req["msg_id"])
+                else:
+                    self._broker.nack(req["msg_id"])
                 return {"ok": True}
             if op == "depth":
+                if not self._may_consume(req["queue"], peer_name):
+                    return {"ok": False, "error":
+                            f"NotAuthorized: {peer_name!r} may not inspect "
+                            f"{req['queue']!r}"}
                 return {"ok": True, "depth": self._broker.depth(req["queue"])}
             return {"ok": False, "error": f"unknown op {op!r}"}
         except Exception as e:

@@ -227,6 +227,12 @@ class Node:
         self.rpc_server.stop()
         self.smm.stop()
         self.services.shutdown()
+        fabric_server = getattr(self, "fabric_server", None)
+        if fabric_server is not None:
+            fabric_server.close()
+        fabric_client = getattr(self, "fabric_client", None)
+        if fabric_client is not None:
+            fabric_client.close()
         if self._notary_uniqueness is not None and hasattr(
             self._notary_uniqueness, "close"
         ):

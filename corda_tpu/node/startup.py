@@ -32,8 +32,31 @@ BANNER = r"""
 """
 
 
-def build_node(config, broker_path: str, is_network_map: bool = False):
-    """Assemble a node over the shared-broker fabric."""
+def build_node(
+    config, broker_path: str, is_network_map: bool = False,
+    fabric_listen: str | None = None, fabric_address: str | None = None,
+):
+    """Assemble a node over the fabric.
+
+    Three transports (reference: every wire is the node's Artemis broker,
+    ArtemisMessagingServer.kt:132-376):
+
+    - ``fabric_listen``: this node EMBEDS the broker and serves it to
+      certified peers over the authenticated transport (the
+      ArtemisMessagingServer role — required client certs). The node
+      itself talks to its in-process broker directly, like the
+      reference's NODE_USER local session.
+    - ``fabric_address``: connect to a remote node's broker as a
+      certified peer (the bridge/client role). The handshake fails —
+      before any payload crosses — unless this node's certificate chains
+      to the network trust root.
+    - neither: open the shared sqlite broker file directly (single-host
+      dev ensembles; the pre-secure-fabric mode).
+
+    Certificates auto-provision from the well-known dev CA only when
+    ``config.dev_mode`` (reference: devMode certificate generation);
+    production mode requires operator-provisioned certificate files.
+    """
     from corda_tpu.messaging import BrokerMessagingClient, DurableQueueBroker
     from corda_tpu.node.network_map import (
         NetworkMapCache,
@@ -53,13 +76,48 @@ def build_node(config, broker_path: str, is_network_map: bool = False):
         # files — default the base dir to a per-identity subdirectory
         safe = _re.sub(r"[^A-Za-z0-9._-]+", "_", canonical)
         config = _dc.replace(config, base_directory=f"./{safe}")
-    broker = DurableQueueBroker(broker_path)
-    messaging = BrokerMessagingClient(broker, canonical)
+
+    if fabric_listen and fabric_address:
+        raise ValueError(
+            "--fabric-listen and --fabric are mutually exclusive: a node "
+            "either embeds the broker or connects to a remote one"
+        )
+    fabric_server = None
+    keypair = None
+    if fabric_listen or fabric_address:
+        from corda_tpu.node.certificates import node_certificates
+
+        ident = node_certificates(
+            config.base_directory, canonical, dev_mode=config.dev_mode
+        )
+        keypair = ident.keypair
+        if fabric_listen:
+            from corda_tpu.messaging import SecureBrokerServer
+
+            broker = DurableQueueBroker(broker_path)
+            host, _, port = fabric_listen.rpartition(":")
+            fabric_server = SecureBrokerServer(
+                broker, ident.certificate, ident.keypair.private,
+                ident.trust_root, host=host or "127.0.0.1", port=int(port),
+            )
+            fabric = broker  # embedded broker: local direct session
+        else:
+            from corda_tpu.messaging import SecureFabricClient
+
+            fabric = SecureFabricClient(
+                fabric_address, ident.certificate, ident.keypair.private,
+                ident.trust_root,
+            )
+    else:
+        fabric = DurableQueueBroker(broker_path)
+    messaging = BrokerMessagingClient(fabric, canonical)
     cache = NetworkMapCache()
     node = Node(
-        config, messaging, network_map=cache,
+        config, messaging, network_map=cache, keypair=keypair,
         persistent=broker_path != ":memory:",
     )
+    node.fabric_server = fabric_server
+    node.fabric_client = fabric if fabric_address else None
     if is_network_map:
         node.network_map_server = NetworkMapServer(messaging, cache)
     node.network_map_client = NetworkMapClient(messaging, cache)
@@ -83,6 +141,16 @@ def main(argv=None) -> int:
         "--network-map", action="store_true",
         help="also run the network-map service on this node",
     )
+    parser.add_argument(
+        "--fabric-listen", default=None, metavar="HOST:PORT",
+        help="embed the broker and serve it to certified peers over the "
+             "mutually-authenticated transport (ArtemisMessagingServer role)",
+    )
+    parser.add_argument(
+        "--fabric", default=None, metavar="HOST:PORT", dest="fabric_address",
+        help="connect to a remote node's broker as a certified peer "
+             "instead of opening the sqlite fabric file",
+    )
     parser.add_argument("--no-banner", action="store_true")
     args = parser.parse_args(argv)
 
@@ -96,7 +164,13 @@ def main(argv=None) -> int:
     from corda_tpu.node.config import load_config
 
     config = load_config(args.config)
-    node = build_node(config, args.broker, is_network_map=args.network_map)
+    node = build_node(
+        config, args.broker, is_network_map=args.network_map,
+        fabric_listen=args.fabric_listen, fabric_address=args.fabric_address,
+    )
+    if node.fabric_server is not None:
+        print(f"Secure fabric listening on "
+              f"{node.fabric_server.address[0]}:{node.fabric_server.address[1]}")
     print(f"Node {node.party.name} started. RPC users: "
           f"{[u.username for u in config.rpc_users]}")
     sys.stdout.flush()

@@ -57,13 +57,19 @@ class NodeHandle:
 class DriverDSL:
     DEFAULT_RPC_USER = ("driverUser", "driverPass", ("ALL",))
 
-    def __init__(self, base_dir: str):
+    def __init__(self, base_dir: str, secure: bool = False):
         self.base = Path(base_dir)
         self.base.mkdir(parents=True, exist_ok=True)
         self.broker_path = str(self.base / "fabric.db")
         self.nodes: list[NodeHandle] = []
         self._rpc_endpoints: list = []
         self._network_map_name: str | None = None
+        # secure mode: the ensemble rides the mutually-authenticated
+        # transport — the first node embeds + serves the broker on an
+        # ephemeral port (parsed from its startup banner, no bind race),
+        # later nodes and RPC clients connect as certified peers
+        self.secure = secure
+        self.fabric_address: str | None = None
 
     # ------------------------------------------------------------ nodes
     def start_node(self, legal_name: str, notary: bool = False,
@@ -111,7 +117,13 @@ class DriverDSL:
             "--config", str(conf), "--broker", self.broker_path,
             "--no-banner",
         ]
-        if self._network_map_name is None:
+        first_node = self._network_map_name is None
+        if self.secure:
+            if first_node:
+                args += ["--fabric-listen", "127.0.0.1:0"]
+            else:
+                args += ["--fabric", self.fabric_address]
+        if first_node:
             args.append("--network-map")
             self._network_map_name = canonical
         with open(log_path, "wb") as log:
@@ -122,6 +134,19 @@ class DriverDSL:
         handle = NodeHandle(canonical, process, log_path)
         self.nodes.append(handle)
         self._await_started(handle, timeout_s)
+        if self.secure and first_node:
+            import re
+
+            m = re.search(
+                r"Secure fabric listening on (\S+:\d+)",
+                handle.log_path.read_text(errors="replace"),
+            )
+            if m is None:
+                raise RuntimeError(
+                    f"first node did not report its fabric address:\n"
+                    + handle.log_path.read_text()[-2000:]
+                )
+            self.fabric_address = m.group(1)
         return handle
 
     @staticmethod
@@ -141,15 +166,33 @@ class DriverDSL:
     # -------------------------------------------------------------- rpc
     def rpc(self, node: NodeHandle, username: str | None = None,
             password: str | None = None, timeout_s: float = 30.0):
-        """An RPC connection to a spawned node, over the shared fabric."""
+        """An RPC connection to a spawned node, over the shared fabric —
+        in secure mode the client is itself a certified fabric peer (the
+        reference's RPC rides the same TLS Artemis transport)."""
         from corda_tpu.messaging import BrokerMessagingClient, DurableQueueBroker
         from corda_tpu.rpc import CordaRPCClient
 
         user, pw, _ = self.DEFAULT_RPC_USER
-        broker = DurableQueueBroker(self.broker_path)
-        endpoint = BrokerMessagingClient(
-            broker, f"driver-rpc-{secrets.token_hex(4)}"
-        )
+        client_name = f"driver-rpc-{secrets.token_hex(4)}"
+        if self.secure:
+            from corda_tpu.crypto import generate_keypair
+            from corda_tpu.messaging import SecureFabricClient
+            from corda_tpu.node.certificates import issue_identity
+
+            ident = issue_identity(
+                f"O={client_name},L=London,C=GB", generate_keypair()
+            )
+            broker = SecureFabricClient(
+                self.fabric_address, ident.certificate,
+                ident.keypair.private, ident.trust_root,
+            )
+            # the endpoint name must equal the CHANNEL identity — the
+            # fabric stamps every publish with it, and receivers drop
+            # messages whose envelope claims a different sender
+            client_name = str(ident.party.name)
+        else:
+            broker = DurableQueueBroker(self.broker_path)
+        endpoint = BrokerMessagingClient(broker, client_name)
         self._rpc_endpoints.append((endpoint, broker))
         client = CordaRPCClient(endpoint, node.name)
         return client.start(username or user, password or pw,
@@ -169,13 +212,13 @@ class DriverDSL:
 
 
 @contextmanager
-def driver(base_dir: str | None = None):
+def driver(base_dir: str | None = None, secure: bool = False):
     """reference: Driver.kt driver { } entry (:313)."""
     tmp = None
     if base_dir is None:
         tmp = tempfile.mkdtemp(prefix="corda-tpu-driver-")
         base_dir = tmp
-    dsl = DriverDSL(base_dir)
+    dsl = DriverDSL(base_dir, secure=secure)
     try:
         yield dsl
     finally:

@@ -130,7 +130,7 @@ class VerifierWorker:
         while not self._stop.is_set():
             try:
                 self.serve_one()
-            except QueueClosedError:
+            except (QueueClosedError, ConnectionError):
                 return
             except Exception:
                 logger.exception("verifier worker iteration failed")
@@ -189,7 +189,7 @@ class OutOfProcessVerifierService:
         while not self._stop.is_set():
             try:
                 msg = self._broker.consume(self.reply_queue, timeout=0.5)
-            except QueueClosedError:
+            except (QueueClosedError, ConnectionError):
                 return
             if msg is None:
                 continue
@@ -220,23 +220,62 @@ class VerificationFailedError(Exception):
     pass
 
 
-def run_worker(broker_path: str, use_device: bool = True) -> None:
-    """Process entry: ``python -m corda_tpu.verifier.worker <broker.db>``
-    (reference: Verifier.main)."""
-    from corda_tpu.messaging import DurableQueueBroker
+def run_worker(
+    broker_path: str = "broker.db", use_device: bool = True,
+    fabric_address: str | None = None, certs_dir: str | None = None,
+    worker_name: str = "verifier-worker",
+) -> None:
+    """Process entry (reference: Verifier.main, Verifier.kt:49-87 — load
+    config, open an authenticated connection TO THE NODE'S BROKER, consume
+    verifier.requests). With ``fabric_address`` the worker is a certified
+    fabric peer: its identity loads from ``certs_dir`` or (dev) is issued
+    from the dev CA on the fly."""
+    if fabric_address:
+        from corda_tpu.messaging import SecureFabricClient
+        from corda_tpu.node.certificates import issue_identity, load_identity
 
-    broker = DurableQueueBroker(broker_path)
-    worker = VerifierWorker(broker, use_device=use_device)
+        if certs_dir:
+            ident = load_identity(certs_dir)
+        else:
+            from corda_tpu.crypto import generate_keypair
+
+            ident = issue_identity(
+                f"O={worker_name},L=London,C=GB", generate_keypair()
+            )
+        broker = SecureFabricClient(
+            fabric_address, ident.certificate, ident.keypair.private,
+            ident.trust_root,
+        )
+    else:
+        from corda_tpu.messaging import DurableQueueBroker
+
+        broker = DurableQueueBroker(broker_path)
+    worker = VerifierWorker(broker, use_device=use_device,
+                            worker_name=worker_name)
     logger.info("verifier worker serving %s", VERIFICATION_REQUESTS_QUEUE)
     try:
         while True:
             worker.serve_one(timeout=1.0)
-    except KeyboardInterrupt:
+    except (KeyboardInterrupt, ConnectionError):
         pass
 
 
 if __name__ == "__main__":
-    import sys
+    import argparse
 
     logging.basicConfig(level=logging.INFO)
-    run_worker(sys.argv[1] if len(sys.argv) > 1 else "broker.db")
+    ap = argparse.ArgumentParser(prog="corda-tpu-verifier")
+    ap.add_argument("broker", nargs="?", default="broker.db",
+                    help="shared sqlite broker file (non-fabric mode)")
+    ap.add_argument("--fabric", default=None, metavar="HOST:PORT",
+                    help="connect to a node's secure broker as a "
+                         "certified peer")
+    ap.add_argument("--certs-dir", default=None,
+                    help="identity.cbe/truststore.cbe directory "
+                         "(defaults to a fresh dev-CA identity)")
+    ap.add_argument("--name", default="verifier-worker")
+    ap.add_argument("--no-device", action="store_true")
+    a = ap.parse_args()
+    run_worker(a.broker, use_device=not a.no_device,
+               fabric_address=a.fabric, certs_dir=a.certs_dir,
+               worker_name=a.name)
