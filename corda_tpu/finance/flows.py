@@ -26,11 +26,37 @@ from corda_tpu.node import QueryCriteria, Sort, SoftLockError
 from .contracts import CASH_PROGRAM_ID, CashState, Exit, Issue, Move
 
 
-def select_cash(flow: FlowLogic, currency: str, quantity: int) -> list:
+def select_cash(
+    flow: FlowLogic, currency: str, quantity: int, *, attempts: int = 10,
+) -> list:
     """Currency-level coin selection over the vault: unconsumed, UNLOCKED
     CashStates of any issuer in ``currency``, smallest-first, soft-locked
     under the flow id (reference:
-    CashSelectionH2Impl.unconsumedCashStatesForSpending)."""
+    CashSelectionH2Impl.unconsumedCashStatesForSpending).
+
+    Query→pick→reserve races with concurrent spends are RETRIED with a
+    fresh query, like the reference's selection loop (attemptSpend retries
+    on lock contention) — only exhausted retries surface as a failure."""
+    import random as _random
+    import time as _time
+
+    last_conflict = None
+    for attempt in range(attempts):
+        try:
+            return _select_cash_once(flow, currency, quantity)
+        except SoftLockError as e:
+            # lost a race between query and reserve: another flow locked
+            # one of our picks — back off briefly and re-query (the loser
+            # sees the winner's locks excluded next round)
+            last_conflict = e
+            _time.sleep(0.005 * (attempt + 1) * (1 + _random.random()))
+    raise FlowException(
+        f"cash selection conflict persisted after {attempts} attempts: "
+        f"{last_conflict}"
+    ) from last_conflict
+
+
+def _select_cash_once(flow: FlowLogic, currency: str, quantity: int) -> list:
     vault = flow.services.vault_service
     page = vault.query_by(
         QueryCriteria(
@@ -71,11 +97,7 @@ def select_cash(flow: FlowLogic, currency: str, quantity: int) -> list:
             f"insufficient spendable cash under a single notary: best "
             f"notary covers {best_total}, need {quantity} {currency}"
         )
-    try:
-        vault.soft_lock_reserve(flow.flow_id, [sr.ref for sr in picked])
-    except SoftLockError as e:
-        # lost a race with a concurrent spend between query and reserve
-        raise FlowException(f"cash selection conflict, retry: {e}") from e
+    vault.soft_lock_reserve(flow.flow_id, [sr.ref for sr in picked])
     return picked
 
 
@@ -114,10 +136,10 @@ class CashPaymentFlow(FlowLogic):
         me = self.our_identity
         # record the selected refs (replay-safe: the selection is the
         # nondeterministic step), then re-derive the StateAndRefs. The lock
-        # is held from selection to finality — everything after selection
-        # sits under the release-finally so a failure cannot leak locks; a
-        # PARK also runs that finally, so the replay hook re-reserves the
-        # recorded refs when the flow resumes.
+        # is held from selection until the ENGINE releases it at flow
+        # completion (engine._finish — the VaultSoftLockManager role); the
+        # replay hook re-reserves the recorded refs when a parked flow
+        # resumes.
         refs = self.record(
             lambda: [
                 sr.ref
@@ -127,39 +149,40 @@ class CashPaymentFlow(FlowLogic):
                 self.flow_id, list(recs)
             ),
         )
-        try:
-            selected = [self.services.to_state_and_ref(r) for r in refs]
-            notary = selected[0].state.notary
-            builder = TransactionBuilder(notary=notary)
-            remaining = self.quantity
-            signers = set()
-            # spend per (issuer) token bucket, paying the recipient up to
-            # the requested quantity and returning change per-token
-            for sr in selected:
-                state = sr.state.data
-                builder.add_input_state(sr)
-                signers.add(state.owner.owning_key)
-                pay = min(remaining, state.amount.quantity)
-                remaining -= pay
-                if pay > 0:
-                    builder.add_output_state(
-                        CashState(Amount(pay, state.amount.token),
-                                  self.recipient),
-                        CASH_PROGRAM_ID,
-                    )
-                change = state.amount.quantity - pay
-                if change > 0:
-                    builder.add_output_state(
-                        CashState(Amount(change, state.amount.token), me),
-                        CASH_PROGRAM_ID,
-                    )
-            builder.add_command(Move(), *sorted(
-                signers, key=lambda k: (k.scheme_id, k.encoded)
-            ))
-            stx = self.sign_builder(builder)
-            return self.sub_flow(FinalityFlow(stx))
-        finally:
-            self.services.vault_service.soft_lock_release(self.flow_id)
+        # soft-lock release is engine-managed at flow completion
+        # (engine._finish, the VaultSoftLockManager role) — never
+        # release in flow code: a park unwinds the stack, and a
+        # release here would free the selected states mid-suspension
+        selected = [self.services.to_state_and_ref(r) for r in refs]
+        notary = selected[0].state.notary
+        builder = TransactionBuilder(notary=notary)
+        remaining = self.quantity
+        signers = set()
+        # spend per (issuer) token bucket, paying the recipient up to
+        # the requested quantity and returning change per-token
+        for sr in selected:
+            state = sr.state.data
+            builder.add_input_state(sr)
+            signers.add(state.owner.owning_key)
+            pay = min(remaining, state.amount.quantity)
+            remaining -= pay
+            if pay > 0:
+                builder.add_output_state(
+                    CashState(Amount(pay, state.amount.token),
+                              self.recipient),
+                    CASH_PROGRAM_ID,
+                )
+            change = state.amount.quantity - pay
+            if change > 0:
+                builder.add_output_state(
+                    CashState(Amount(change, state.amount.token), me),
+                    CASH_PROGRAM_ID,
+                )
+        builder.add_command(Move(), *sorted(
+            signers, key=lambda k: (k.scheme_id, k.encoded)
+        ))
+        stx = self.sign_builder(builder)
+        return self.sub_flow(FinalityFlow(stx))
 
 
 @dataclasses.dataclass
@@ -185,26 +208,27 @@ class CashExitFlow(FlowLogic):
                 self.flow_id, list(recs)
             ),
         )
-        try:
-            selected = [self.services.to_state_and_ref(r) for r in refs]
-            notary = selected[0].state.notary
-            builder = TransactionBuilder(notary=notary)
-            total = 0
-            signers = {me.owning_key}
-            for sr in selected:
-                builder.add_input_state(sr)
-                total += sr.state.data.amount.quantity
-                signers.add(sr.state.data.owner.owning_key)
-            if total > self.quantity:
-                builder.add_output_state(
-                    CashState(Amount(total - self.quantity, token), me),
-                    CASH_PROGRAM_ID,
-                )
-            builder.add_command(
-                Exit(Amount(self.quantity, token)),
-                *sorted(signers, key=lambda k: (k.scheme_id, k.encoded)),
+        # soft-lock release is engine-managed at flow completion
+        # (engine._finish, the VaultSoftLockManager role) — never
+        # release in flow code: a park unwinds the stack, and a
+        # release here would free the selected states mid-suspension
+        selected = [self.services.to_state_and_ref(r) for r in refs]
+        notary = selected[0].state.notary
+        builder = TransactionBuilder(notary=notary)
+        total = 0
+        signers = {me.owning_key}
+        for sr in selected:
+            builder.add_input_state(sr)
+            total += sr.state.data.amount.quantity
+            signers.add(sr.state.data.owner.owning_key)
+        if total > self.quantity:
+            builder.add_output_state(
+                CashState(Amount(total - self.quantity, token), me),
+                CASH_PROGRAM_ID,
             )
-            stx = self.sign_builder(builder)
-            return self.sub_flow(FinalityFlow(stx))
-        finally:
-            vault.soft_lock_release(self.flow_id)
+        builder.add_command(
+            Exit(Amount(self.quantity, token)),
+            *sorted(signers, key=lambda k: (k.scheme_id, k.encoded)),
+        )
+        stx = self.sign_builder(builder)
+        return self.sub_flow(FinalityFlow(stx))

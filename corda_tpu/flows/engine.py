@@ -340,6 +340,18 @@ class _FlowExecutor:
                     )
             except Exception:
                 pass
+        # engine-managed soft-lock release (reference: VaultSoftLockManager
+        # hooks flow completion). Flows must NOT release in their own
+        # try/finally: a park unwinds the Python stack through finally
+        # blocks, so a flow-managed release would free its selected states
+        # mid-suspension — a rival spends them, and the replayed flow
+        # double-spends at the notary.
+        try:
+            vault = getattr(self.smm.services, "vault_service", None)
+            if vault is not None:
+                vault.soft_lock_release(self.flow_id)
+        except Exception:
+            pass
         self.smm.flow_finished(self)
         try:
             if error is None:
@@ -369,6 +381,12 @@ class StateMachineManager:
         self.checkpoints = checkpoints
         self.our_identity = our_identity
         self.services = services
+        if services is not None and hasattr(services, "add_commit_listener"):
+            # a PARKED wait_for_ledger_commit only resumes via its wake
+            # key; recording must push the wake (polling covers only the
+            # pre-park grace window — without this hook, any flow that
+            # parked waiting on a commit slept forever)
+            services.add_commit_listener(self.notify_ledger_commit)
         self._party_resolver = party_resolver or (lambda name: None)
         self._lock = threading.Condition()
         self._sessions: dict[int, _SessionState] = {}
@@ -640,7 +658,12 @@ class StateMachineManager:
 
     def notify_ledger_commit(self, stx) -> None:
         with self._lock:
-            self._committed[stx.id] = stx
+            if self.services is None:
+                # no storage backing lookup_committed: keep the in-memory
+                # feed. With services, storing here would duplicate the
+                # whole validated-transactions store for the node's
+                # lifetime — the wake alone suffices.
+                self._committed[stx.id] = stx
             self._wake_key_locked(("tx", stx.id))
             self._lock.notify_all()
 

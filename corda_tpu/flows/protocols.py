@@ -156,11 +156,15 @@ class ResolveTransactionsFlow(FlowLogic):
         storage = services.validated_transactions
         fetched: dict = {}
 
-        frontier = sorted(
+        # every "is it already in storage?" decision is RECORDED: it gates
+        # which fetch ops run, and storage mutates between a park and its
+        # replay (this very flow records what it fetches — an unrecorded
+        # gate would make the replay skip ops and misalign the op log)
+        frontier = self.record(lambda: sorted(
             {ref.txhash for ref in self.stx.inputs
              if ref.txhash not in storage},
             key=lambda h: h.bytes,
-        )
+        ))
         while frontier:
             if len(fetched) + len(frontier) > MAX_RESOLVE_TRANSACTIONS:
                 raise FlowException(
@@ -171,7 +175,6 @@ class ResolveTransactionsFlow(FlowLogic):
             ).unwrap(lambda xs: xs)
             if len(items) != len(frontier):
                 raise FlowException("wrong number of transactions returned")
-            next_frontier = set()
             for want, got in zip(frontier, items):
                 if not isinstance(got, SignedTransaction) or got.id != want:
                     # downloaded-data integrity: the check of
@@ -179,11 +182,17 @@ class ResolveTransactionsFlow(FlowLogic):
                     # received bytes, so a lying peer cannot substitute
                     raise FlowException(f"peer sent wrong transaction for {want}")
                 fetched[got.id] = got
-                for ref in got.inputs:
-                    h = ref.txhash
-                    if h not in fetched and h not in storage:
-                        next_frontier.add(h)
-            frontier = sorted(next_frontier, key=lambda h: h.bytes)
+
+            def next_frontier(items=items):
+                out = set()
+                for got in items:
+                    for ref in got.inputs:
+                        h = ref.txhash
+                        if h not in fetched and h not in storage:
+                            out.add(h)
+                return sorted(out, key=lambda h: h.bytes)
+
+            frontier = self.record(next_frontier)
 
         self._fetch_attachments(fetched)
         self.session.send(FetchRequest("end"))
@@ -216,20 +225,30 @@ class ResolveTransactionsFlow(FlowLogic):
 
     def _fetch_attachments(self, fetched: dict) -> None:
         services = self.services
-        needed = set()
-        for stx in list(fetched.values()) + [self.stx]:
-            for h in stx.tx.attachments:
-                if not services.attachments.has_attachment(h):
-                    needed.add(h)
-        # contract-code pseudo-attachments are registry hashes, not stored
-        # blobs — never fetch those (covers input-contract hashes that
-        # TransactionBuilder auto-attached, which outputs alone would miss)
-        from corda_tpu.ledger.states import registered_contract_code_hashes
 
-        needed -= registered_contract_code_hashes()
-        if not needed:
+        def compute_needed():
+            needed = set()
+            for stx in list(fetched.values()) + [self.stx]:
+                for h in stx.tx.attachments:
+                    if not services.attachments.has_attachment(h):
+                        needed.add(h)
+            # contract-code pseudo-attachments are registry hashes, not
+            # stored blobs — never fetch those (covers input-contract
+            # hashes that TransactionBuilder auto-attached, which outputs
+            # alone would miss)
+            from corda_tpu.ledger.states import (
+                registered_contract_code_hashes,
+            )
+
+            needed -= registered_contract_code_hashes()
+            return sorted(needed, key=lambda h: h.bytes)
+
+        # recorded for the same reason as the tx frontier: the attachment
+        # store mutates between a park and its replay, and this gate
+        # decides whether the fetch ops below run at all
+        hashes = self.record(compute_needed)
+        if not hashes:
             return
-        hashes = sorted(needed, key=lambda h: h.bytes)
         blobs = self.session.send_and_receive(
             list, FetchRequest("attachment", tuple(hashes))
         ).unwrap(lambda xs: xs)

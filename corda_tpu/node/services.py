@@ -69,6 +69,15 @@ class ServiceHub:
         # the NotaryService this node runs, if it is a notary (reference:
         # AbstractNode.makeCoreNotaryService, AbstractNode.kt:615-632)
         self.notary_service = notary_service
+        # commit listeners: the flow engine registers here so a PARKED
+        # wait_for_ledger_commit wakes when its transaction records (the
+        # reference's equivalent push is DBTransactionStorage.updates
+        # feeding waitForLedgerCommit)
+        self._commit_listeners: list = []
+
+    def add_commit_listener(self, fn) -> None:
+        """``fn(stx)`` fires after each NEWLY-recorded transaction."""
+        self._commit_listeners.append(fn)
 
     # -- identity conveniences ------------------------------------------------
 
@@ -97,6 +106,8 @@ class ServiceHub:
         for stx in stxs:
             if self.validated_transactions.add_transaction(stx):
                 self.vault_service.record_transaction(stx)
+                for fn in list(self._commit_listeners):
+                    fn(stx)
 
     # -- signing (reference: ServiceHub.signInitialTransaction :187-209) ------
 

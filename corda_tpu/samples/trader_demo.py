@@ -127,40 +127,41 @@ class BuyerFlow(FlowLogic):
                 self.flow_id, list(recs)
             ),
         )
-        try:
-            selected = [self.services.to_state_and_ref(r) for r in refs]
-            builder = TransactionBuilder(notary=offer.paper.state.notary)
-            builder.add_input_state(offer.paper)
-            builder.add_output_state(
-                paper.with_new_owner(me), CP_PROGRAM_ID
-            )
-            signers = {seller.owning_key}
-            remaining = offer.price
-            for sr in selected:
-                cash = sr.state.data
-                builder.add_input_state(sr)
-                signers.add(cash.owner.owning_key)
-                pay = min(remaining, cash.amount.quantity)
-                remaining -= pay
-                if pay:
-                    builder.add_output_state(
-                        CashState(Amount(pay, cash.amount.token), seller),
-                        CASH_PROGRAM_ID,
-                    )
-                change = cash.amount.quantity - pay
-                if change:
-                    builder.add_output_state(
-                        CashState(Amount(change, cash.amount.token), me),
-                        CASH_PROGRAM_ID,
-                    )
-            builder.add_command(Move(), *sorted(
-                signers, key=lambda k: (k.scheme_id, k.encoded)
-            ))
-            stx = self.sign_builder(builder)
-            stx = self.sub_flow(CollectSignaturesFlow(stx, [self.session]))
-            return self.sub_flow(FinalityFlow(stx))
-        finally:
-            self.services.vault_service.soft_lock_release(self.flow_id)
+        # soft-lock release is engine-managed at flow completion
+        # (engine._finish, the VaultSoftLockManager role) — never
+        # release in flow code: a park unwinds the stack, and a
+        # release here would free the selected states mid-suspension
+        selected = [self.services.to_state_and_ref(r) for r in refs]
+        builder = TransactionBuilder(notary=offer.paper.state.notary)
+        builder.add_input_state(offer.paper)
+        builder.add_output_state(
+            paper.with_new_owner(me), CP_PROGRAM_ID
+        )
+        signers = {seller.owning_key}
+        remaining = offer.price
+        for sr in selected:
+            cash = sr.state.data
+            builder.add_input_state(sr)
+            signers.add(cash.owner.owning_key)
+            pay = min(remaining, cash.amount.quantity)
+            remaining -= pay
+            if pay:
+                builder.add_output_state(
+                    CashState(Amount(pay, cash.amount.token), seller),
+                    CASH_PROGRAM_ID,
+                )
+            change = cash.amount.quantity - pay
+            if change:
+                builder.add_output_state(
+                    CashState(Amount(change, cash.amount.token), me),
+                    CASH_PROGRAM_ID,
+                )
+        builder.add_command(Move(), *sorted(
+            signers, key=lambda k: (k.scheme_id, k.encoded)
+        ))
+        stx = self.sign_builder(builder)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [self.session]))
+        return self.sub_flow(FinalityFlow(stx))
 
     def _validate(self, offer: SellOffer) -> SellOffer:
         if not isinstance(offer.paper.state.data, CommercialPaperState):
@@ -172,38 +173,40 @@ class BuyerFlow(FlowLogic):
 
 # ------------------------------------------------------------- the demo
 
+@dataclasses.dataclass
+class _IssuePaper(FlowLogic):
+    # module-level (not nested in issue_paper): a PARKED flow is rebuilt
+    # from its class path on resume, and a <locals> class has none
+    notary: Party
+    face: int
+    maturity: float
+
+    def call(self):
+        me = self.our_identity
+        issuance = PartyAndReference(me, b"\x42")
+        from corda_tpu.ledger import Issued
+
+        paper = CommercialPaperState(
+            issuance=issuance, owner=me,
+            face_value=Amount(self.face, Issued(issuance, "GBP")),
+            maturity_date=self.maturity,
+        )
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(paper, CP_PROGRAM_ID)
+        b.add_command(Issue(), me.owning_key)
+        # a real validity margin — an exactly-now expiry would rest
+        # entirely on the notary's 30s tolerance
+        b.set_time_window(TimeWindow(
+            None, int((time.time() + 3600) * 1_000_000)
+        ))
+        stx = self.sign_builder(b)
+        return self.sub_flow(FinalityFlow(stx))
+
+
 def issue_paper(node, notary: Party, face: int = 1000,
                 maturity_days: float = 30.0):
     """Self-issue commercial paper (the role the bank plays in the
     reference demo)."""
-
-    @dataclasses.dataclass
-    class _IssuePaper(FlowLogic):
-        notary: Party
-        face: int
-        maturity: float
-
-        def call(self):
-            me = self.our_identity
-            issuance = PartyAndReference(me, b"\x42")
-            from corda_tpu.ledger import Issued
-
-            paper = CommercialPaperState(
-                issuance=issuance, owner=me,
-                face_value=Amount(self.face, Issued(issuance, "GBP")),
-                maturity_date=self.maturity,
-            )
-            b = TransactionBuilder(notary=self.notary)
-            b.add_output_state(paper, CP_PROGRAM_ID)
-            b.add_command(Issue(), me.owning_key)
-            # a real validity margin — an exactly-now expiry would rest
-            # entirely on the notary's 30s tolerance
-            b.set_time_window(TimeWindow(
-                None, int((time.time() + 3600) * 1_000_000)
-            ))
-            stx = self.sign_builder(b)
-            return self.sub_flow(FinalityFlow(stx))
-
     maturity = time.time() + maturity_days * 86400
     return node.run_flow(_IssuePaper(notary, face, maturity))
 
