@@ -27,6 +27,7 @@ against R is exact (canonical limbs).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 
 import jax
 import jax.numpy as jnp
@@ -435,22 +436,9 @@ def _verify_prep_enqueue(
     b = pow2_at_least(n_real, bucket_floor(min_bucket, on_tpu))
 
     pk_arr, sig_arr, len_ok = _gather_fixed(pubkeys, signatures, b)
-    y_bytes = pk_arr.copy()
-    y_bytes[:, 31] &= 0x7F
-    sign = (pk_arr[:, 31] >> 7).astype(np.int32)
-    # y ≥ p = 2^255-19 iff the cleared-top-bit bytes are ff..ff7f with the
-    # low byte ≥ ed
-    y_ge_p = (
-        (y_bytes[:, 31] == 0x7F)
-        & (y_bytes[:, 1:31] == 0xFF).all(axis=1)
-        & (y_bytes[:, 0] >= 0xED)
+    y_bytes, sign, s_arr, precheck = _canonical_precheck(
+        pk_arr, sig_arr, len_ok
     )
-    s_arr = sig_arr[:, 32:]
-    # s < L: lexicographic compare on big-endian byte order
-    diff = s_arr[:, ::-1].astype(np.int16) - _L_BE
-    first_nz = (diff != 0).argmax(axis=1)
-    s_lt_l = np.take_along_axis(diff, first_nz[:, None], 1)[:, 0] < 0
-    precheck = len_ok & ~y_ge_p & s_lt_l
 
     # Fixed-length fast path (production tx signatures): R‖A‖M fits one
     # SHA-512 block, so challenge hashing + mod-L reduction fuse into the
@@ -479,14 +467,7 @@ def _verify_prep_enqueue(
 
     # challenge scalars: SHA-512(R‖A‖M) mod L on host — hashlib is C-speed
     # and this generic path only serves variable-length message batches
-    h_bytes = np.zeros((b, 32), dtype=np.uint8)
-    for i in np.nonzero(precheck[:n_real])[0]:
-        sig = signatures[i]
-        h = int.from_bytes(
-            hashlib.sha512(sig[:32] + pubkeys[i] + messages[i]).digest(),
-            "little",
-        ) % L
-        h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+    h_bytes = _challenge_bytes(pubkeys, signatures, messages, precheck, b)
 
     if on_tpu:
         mask = _tpu_verify_from_bytes(
@@ -501,3 +482,57 @@ def _verify_prep_enqueue(
             _bits_le(s_arr), _bits_le(h_bytes), precheck,
         )
     return mask
+
+
+def _challenge_bytes(pubkeys, signatures, messages, precheck, b) -> np.ndarray:
+    h_bytes = np.zeros((b, 32), dtype=np.uint8)
+    for i in np.nonzero(precheck[: len(pubkeys)])[0]:
+        sig = signatures[i]
+        h = int.from_bytes(
+            hashlib.sha512(sig[:32] + pubkeys[i] + messages[i]).digest(),
+            "little",
+        ) % L
+        h_bytes[i] = np.frombuffer(h.to_bytes(32, "little"), dtype=np.uint8)
+    return h_bytes
+
+
+def prep_core_planes(
+    pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+    b: int,
+):
+    """Host prep for the XLA verify core: (a_y, a_sign, r_bytes, s_bits,
+    h_bits, precheck) padded to batch ``b`` — the plane set
+    ``ed25519_verify_core`` and the mesh ``distributed_verify_step``
+    consume. Shared by the mesh service tier (parallel/mesh.py)."""
+    pk_arr, sig_arr, len_ok = _gather_fixed(pubkeys, signatures, b)
+    y_bytes, sign, s_arr, precheck = _canonical_precheck(
+        pk_arr, sig_arr, len_ok
+    )
+    h_bytes = _challenge_bytes(pubkeys, signatures, messages, precheck, b)
+    return (
+        y_bytes.astype(np.int32), sign, sig_arr[:, :32].astype(np.int32),
+        _bits_le(s_arr), _bits_le(h_bytes), precheck,
+    )
+
+
+def _canonical_precheck(pk_arr, sig_arr, len_ok):
+    """The ONE implementation of the host-side canonical-form checks
+    (y < p encoding, s < L anti-malleability, sign-bit split) — shared by
+    the single-chip enqueue path and the mesh prep so the two tiers can
+    never drift on what counts as a valid signature encoding."""
+    y_bytes = pk_arr.copy()
+    y_bytes[:, 31] &= 0x7F
+    sign = (pk_arr[:, 31] >> 7).astype(np.int32)
+    # y ≥ p = 2^255-19 iff the cleared-top-bit bytes are ff..ff7f with the
+    # low byte ≥ ed
+    y_ge_p = (
+        (y_bytes[:, 31] == 0x7F)
+        & (y_bytes[:, 1:31] == 0xFF).all(axis=1)
+        & (y_bytes[:, 0] >= 0xED)
+    )
+    s_arr = sig_arr[:, 32:]
+    # s < L: lexicographic compare on big-endian byte order
+    diff = s_arr[:, ::-1].astype(np.int16) - _L_BE
+    first_nz = (diff != 0).argmax(axis=1)
+    s_lt_l = np.take_along_axis(diff, first_nz[:, None], 1)[:, 0] < 0
+    return y_bytes, sign, s_arr, len_ok & ~y_ge_p & s_lt_l

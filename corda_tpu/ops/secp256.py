@@ -505,28 +505,24 @@ def _decompress_point(curve_name: str, encoded: bytes) -> tuple | None:
 from .ed25519 import _bits_le  # noqa: E402  (shared bit-plane converter)
 
 
-def ecdsa_verify_batch(
+def _prep_byte_planes(
     curve_name: str,
     pubkeys: list[bytes],
     signatures: list[bytes],
     messages: list[bytes],
-) -> np.ndarray:
-    """Batch-verify 64-byte r‖s ECDSA signatures (low-S canonical form, the
-    framework's wire encoding — crypto/schemes.py sign()) → (B,) bool."""
+    b: int,
+):
+    """Host prep shared by the XLA and Pallas tiers: per-lane canonical-form
+    checks, point parse, e/s⁻¹ scalar math — emitted as compact uint8
+    little-endian byte planes (for radix-256 these ARE the field limbs)."""
     cv = _CURVES[curve_name]
     n_real = len(pubkeys)
-    if not (len(signatures) == len(messages) == n_real):
-        raise ValueError("batch length mismatch")
-    if n_real == 0:
-        return np.zeros(0, dtype=bool)
-    b = pow2_at_least(n_real, 8)
-
-    qx = np.zeros((b, LIMBS), np.int32)
-    qy = np.zeros((b, LIMBS), np.int32)
+    qx = np.zeros((b, 32), np.uint8)
+    qy = np.zeros((b, 32), np.uint8)
     u1b = np.zeros((b, 32), np.uint8)
     u2b = np.zeros((b, 32), np.uint8)
-    ra = np.zeros((b, LIMBS), np.int32)
-    rb = np.zeros((b, LIMBS), np.int32)
+    ra = np.zeros((b, 32), np.uint8)
+    rb = np.zeros((b, 32), np.uint8)
     rb_ok = np.zeros(b, bool)
     pre = np.zeros(b, bool)
 
@@ -548,18 +544,69 @@ def ecdsa_verify_batch(
         w = pow(s, n - 2, n)
         u1 = e * w % n
         u2 = r * w % n
-        qx[i] = _int_to_limbs(pt[0])
-        qy[i] = _int_to_limbs(pt[1])
+        qx[i] = np.frombuffer(pt[0].to_bytes(32, "little"), np.uint8)
+        qy[i] = np.frombuffer(pt[1].to_bytes(32, "little"), np.uint8)
         u1b[i] = np.frombuffer(u1.to_bytes(32, "little"), np.uint8)
         u2b[i] = np.frombuffer(u2.to_bytes(32, "little"), np.uint8)
-        ra[i] = _int_to_limbs(r)
+        ra[i] = np.frombuffer(r.to_bytes(32, "little"), np.uint8)
         if r + n < cv.p:
-            rb[i] = _int_to_limbs(r + n)
+            rb[i] = np.frombuffer((r + n).to_bytes(32, "little"), np.uint8)
             rb_ok[i] = True
         pre[i] = True
+    return qx, qy, u1b, u2b, ra, rb, rb_ok, pre
 
-    mask = ecdsa_verify_core(
-        curve_name, qx, qy, _bits_le(u1b), _bits_le(u2b),
-        ra, rb, jnp.asarray(rb_ok), jnp.asarray(pre),
+
+def ecdsa_verify_dispatch(
+    curve_name: str,
+    pubkeys: list[bytes],
+    signatures: list[bytes],
+    messages: list[bytes],
+    min_bucket: int | None = None,
+) -> jax.Array:
+    """Prep + ENQUEUE a verify batch without materializing the result
+    (async, like ed25519_verify_dispatch): returns the bucket-padded
+    device mask; slice ``[:len(pubkeys)]`` after ``np.asarray``. On the
+    TPU backend the windowed Pallas kernel runs (block-width bucket
+    floor); elsewhere the XLA bit-serial ladder."""
+    n_real = len(pubkeys)
+    if not (len(signatures) == len(messages) == n_real):
+        raise ValueError("batch length mismatch")
+    if n_real == 0:
+        return jnp.zeros((0,), dtype=bool)
+    on_tpu = jax.default_backend() == "tpu"
+    floor = max(min_bucket or 0, 128 if on_tpu else 8)
+    b = pow2_at_least(n_real, floor)
+    qx, qy, u1b, u2b, ra, rb, rb_ok, pre = _prep_byte_planes(
+        curve_name, pubkeys, signatures, messages, b
     )
+    if on_tpu:
+        from .secp256_pallas import ecdsa_verify_pallas
+
+        return ecdsa_verify_pallas(
+            curve_name, qx, qy, u1b, u2b, ra, rb,
+            jnp.asarray(rb_ok), jnp.asarray(pre),
+        )
+    return ecdsa_verify_core(
+        curve_name,
+        qx.astype(np.int32), qy.astype(np.int32),
+        _bits_le(u1b), _bits_le(u2b),
+        ra.astype(np.int32), rb.astype(np.int32),
+        jnp.asarray(rb_ok), jnp.asarray(pre),
+    )
+
+
+def ecdsa_verify_batch(
+    curve_name: str,
+    pubkeys: list[bytes],
+    signatures: list[bytes],
+    messages: list[bytes],
+) -> np.ndarray:
+    """Batch-verify 64-byte r‖s ECDSA signatures (low-S canonical form, the
+    framework's wire encoding — crypto/schemes.py sign()) → (B,) bool."""
+    n_real = len(pubkeys)
+    if n_real == 0:
+        if len(signatures) or len(messages):
+            raise ValueError("batch length mismatch")
+        return np.zeros(0, dtype=bool)
+    mask = ecdsa_verify_dispatch(curve_name, pubkeys, signatures, messages)
     return np.asarray(mask)[:n_real]

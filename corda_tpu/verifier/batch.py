@@ -93,26 +93,42 @@ def dispatch_signature_rows(
             keys = [rows[i][0].encoded for i in idxs]
             sigs = [rows[i][1] for i in idxs]
             msgs = [rows[i][2] for i in idxs]
+            from corda_tpu.ops._blockpack import start_host_copy
+
             if scheme_id == EDDSA_ED25519_SHA512:
-                from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+                from corda_tpu.parallel.mesh import service_mesh_active
 
-                from corda_tpu.ops._blockpack import start_host_copy
+                if service_mesh_active():
+                    # production fan-out: shard the bucket over the device
+                    # mesh (SURVEY §2.9 P3); single chip degrades to the
+                    # plain batched dispatch below
+                    from corda_tpu.parallel.mesh import service_mesh_verifier
 
-                mask = ed25519_verify_dispatch(
-                    keys, sigs, msgs, min_bucket=min_bucket
-                )
-                start_host_copy(mask)
-                pending._deferred.append((idxs, mask))
+                    mask, _spent, _total = service_mesh_verifier(
+                    ).dispatch_rows(keys, sigs, msgs, min_bucket=min_bucket)
+                else:
+                    from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+
+                    mask = ed25519_verify_dispatch(
+                        keys, sigs, msgs, min_bucket=min_bucket
+                    )
             else:
-                from corda_tpu.ops.secp256 import ecdsa_verify_batch
+                # async like the ed25519 bucket: the ECDSA ladder queues on
+                # device and collects later, so mixed-scheme batches overlap
+                # both ladders instead of serializing on this one (r2
+                # VERDICT weak #2)
+                from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
 
                 curve = (
                     "secp256k1"
                     if scheme_id == ECDSA_SECP256K1_SHA256
                     else "secp256r1"
                 )
-                mask = ecdsa_verify_batch(curve, keys, sigs, msgs)
-                pending._out[idxs] = mask
+                mask = ecdsa_verify_dispatch(
+                    curve, keys, sigs, msgs, min_bucket=min_bucket
+                )
+            start_host_copy(mask)
+            pending._deferred.append((idxs, mask))
         else:
             for i in idxs:
                 key, sig, msg = rows[i]
