@@ -166,6 +166,191 @@ def bench_device_sigs(pubkeys, sigs, msgs) -> tuple[float, float]:
     return statistics.median(rates), max(rates)
 
 
+# ------------------------------------------------------------ trader demo
+
+TRADER_TRADES = 48
+
+
+def bench_trader_demo(device: bool, n: int = TRADER_TRADES) -> float:
+    """BASELINE config #1: the trader-demo DvP end-to-end — n concurrent
+    commercial-paper-for-cash swaps through a full in-process ensemble
+    (seller, buyer, notary; reference: TraderDemo.kt:16 +
+    TwoPartyTradeFlow). ``device=True`` runs the batched device notary
+    (signature ladders + response comb on device, windowed across the
+    concurrent trades); ``device=False`` is the reference shape — host
+    crypto, per-tx validating notary. Setup (cash + paper issuance) is
+    untimed; the timed region is offer→swap→notarise→broadcast."""
+    from corda_tpu.finance import CashIssueFlow
+    from corda_tpu.ledger import StateRef
+    from corda_tpu.samples.trader_demo import SellerFlow, issue_paper
+    from corda_tpu.testing import MockNetworkNodes
+
+    with MockNetworkNodes() as net:
+        bank = net.create_node("Bank A")
+        buyer = net.create_node("Bank B")
+        if device:
+            from corda_tpu.notary import (
+                BatchedNotaryService, PersistentUniquenessProvider,
+            )
+
+            notary = net.create_node(
+                "Notary",
+                notary_service_factory=lambda party, kp: BatchedNotaryService(
+                    party, kp, PersistentUniquenessProvider(),
+                    use_device=True, validating=True,
+                    max_batch=64, window_s=0.004,
+                ),
+                validating_notary=True,
+            )
+        else:
+            notary = net.create_notary_node("Notary", validating=True)
+
+        papers = []
+        for _ in range(n):
+            buyer.run_flow(
+                CashIssueFlow(1500, "GBP", b"\x01", notary.party)
+            )
+            issued = issue_paper(bank, notary.party, face=1000)
+            papers.append(
+                bank.services.to_state_and_ref(StateRef(issued.id, 0))
+            )
+
+        t0 = time.perf_counter()
+        handles = [
+            bank.smm.start_flow(SellerFlow(buyer.party, sar, 900, "GBP"))
+            for sar in papers
+        ]
+        for h in handles:
+            h.result.result(timeout=300)
+        dt = time.perf_counter() - t0
+        svc = notary.services.notary_service
+        if hasattr(svc, "shutdown"):
+            svc.shutdown()
+        return n / dt
+
+
+# ------------------------------------------------------------ flow engine
+
+def bench_empty_flows(n: int = 10_000) -> float:
+    """Empty-flow throughput through the bounded-pool state machine
+    (reference: NodePerformanceTests.kt:60-87 — N=10,000 empty flows,
+    parallelism 8, prints flows/sec; the printed rate was never recorded
+    upstream, so this line IS the recorded artifact)."""
+    from corda_tpu.crypto import derive_keypair_from_entropy
+    from corda_tpu.flows import CheckpointStorage, FlowLogic, StateMachineManager
+    from corda_tpu.ledger import CordaX500Name, Party
+    from corda_tpu.messaging import InMemoryMessagingNetwork
+
+    import dataclasses
+
+    @dataclasses.dataclass
+    class EmptyFlow(FlowLogic):
+        def call(self):
+            return 1
+
+    kp = derive_keypair_from_entropy(4, hashlib.sha256(b"flow-bench").digest())
+    party = Party(CordaX500Name("FlowBench", "London", "GB"), kp.public)
+    net = InMemoryMessagingNetwork()
+    net.start_pumping()
+    try:
+        smm = StateMachineManager(
+            net.create_node(str(party.name)), CheckpointStorage(), party,
+            lambda _name: None, max_workers=8,
+        )
+        t0 = time.perf_counter()
+        handles = [smm.start_flow(EmptyFlow()) for _ in range(n)]
+        for h in handles:
+            assert h.result.result(timeout=120) == 1
+        return n / (time.perf_counter() - t0)
+    finally:
+        net.stop_pumping()
+
+
+# --------------------------------------------------------- mixed schemes
+
+MIXED_COMPOSITION = (  # (scheme name, rows) — BASELINE config #3 shape
+    ("eddsa", 2048), ("secp256k1", 512), ("secp256r1", 512), ("rsa", 16),
+)
+MIXED_REPS = 4
+
+
+def make_mixed_rows():
+    """Signature rows across schemes (one key per scheme — keygen is not
+    the measured path), shuffled so bucketing does real work."""
+    import random
+
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.crypto.schemes import (
+        ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256,
+        EDDSA_ED25519_SHA512, RSA_SHA256,
+    )
+
+    ids = {
+        "eddsa": EDDSA_ED25519_SHA512,
+        "secp256k1": ECDSA_SECP256K1_SHA256,
+        "secp256r1": ECDSA_SECP256R1_SHA256,
+        "rsa": RSA_SHA256,
+    }
+    rows = []
+    for name, count in MIXED_COMPOSITION:
+        kp = generate_keypair(ids[name])
+        for i in range(count):
+            msg = b"CTMX" + hashlib.sha256(
+                name.encode() + i.to_bytes(8, "little")
+            ).digest()
+            rows.append((kp.public, sign(kp.private, msg), msg))
+    random.Random(7).shuffle(rows)
+    return rows
+
+
+def bench_mixed_host(rows) -> float:
+    """Sequential host verify over the mixed sample — the reference's
+    per-signature JCA dispatch loop (Crypto.kt:552-555)."""
+    from corda_tpu.crypto import is_valid
+
+    sample = rows[:512]
+    t0 = time.perf_counter()
+    ok = sum(1 for k, s, m in sample if is_valid(k, s, m))
+    dt = time.perf_counter() - t0
+    assert ok == len(sample), f"host rejected {len(sample) - ok} mixed sigs"
+    return len(sample) / dt
+
+
+def bench_mixed_device(rows) -> tuple[float, float]:
+    """Scheme-bucketed device dispatch (BASELINE config #3): ed25519 and
+    both ECDSA curves enqueue as async device buckets (cold paths on
+    host), several batches in flight → (median, best) sigs/sec."""
+    from corda_tpu.verifier.batch import dispatch_signature_rows
+
+    pending = dispatch_signature_rows(rows)
+    assert pending.collect().all(), "device rejected valid mixed sigs"
+    # no-wrong-accept probe ON CHIP, one lane per device scheme: the CPU
+    # tier tests the ECDSA pallas kernel component-wise; this is the
+    # composed kernel's adversarial check on real hardware
+    tampered = list(rows)
+    seen, flipped = set(), []
+    for i, (key, sig, msg) in enumerate(tampered):
+        if key.scheme_id in (2, 3, 4) and key.scheme_id not in seen:
+            seen.add(key.scheme_id)
+            tampered[i] = (key, bytes([sig[0] ^ 1]) + sig[1:], msg)
+            flipped.append(i)
+    bad_mask = dispatch_signature_rows(tampered).collect()
+    for i in flipped:
+        assert not bad_mask[i], f"tampered lane {i} accepted"
+    ok_idx = [i for i in range(len(rows)) if i not in flipped]
+    assert bad_mask[ok_idx].all(), "tamper probe poisoned valid lanes"
+
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        in_flight = [dispatch_signature_rows(rows) for _ in range(MIXED_REPS)]
+        for pend in in_flight:
+            assert pend.collect().all()
+        dt = time.perf_counter() - t0
+        rates.append(len(rows) * MIXED_REPS / dt)
+    return statistics.median(rates), max(rates)
+
+
 # ------------------------------------------------------------ notarisation
 
 def make_notary_stream(n: int):
@@ -605,6 +790,16 @@ def main() -> int:
     if dag_host_rate:
         p.data["baseline_host_dag_tx_per_sec"] = round(dag_host_rate, 1)
 
+    flow_rate = p.run("empty_flows", bench_empty_flows)
+    if flow_rate:
+        p.data["empty_flows_per_sec"] = round(flow_rate, 1)
+
+    trader_host = p.run(
+        "host_trader", lambda: bench_trader_demo(device=False)
+    )
+    if trader_host:
+        p.data["baseline_host_trader_trades_per_sec"] = round(trader_host, 2)
+
     # ---- device init, bounded
     ok, detail = _probe_backend(INIT_DEADLINE_S)
     if not ok:
@@ -634,6 +829,17 @@ def main() -> int:
         if ref_cpu_rate:
             p.data["ed25519_vs_reference_cpu"] = round(sig_median / ref_cpu_rate, 2)
 
+    mixed_rows = make_mixed_rows()
+    mixed_host_rate = p.run("host_mixed", lambda: bench_mixed_host(mixed_rows))
+    if mixed_host_rate:
+        p.data["baseline_host_mixed_sigs_per_sec"] = round(mixed_host_rate, 1)
+    mixed = p.run("device_mixed", lambda: bench_mixed_device(mixed_rows))
+    if mixed:
+        p.data["mixed_scheme_sigs_per_sec"] = round(mixed[0], 1)
+        p.data["mixed_scheme_best_sigs_per_sec"] = round(mixed[1], 1)
+        if mixed_host_rate:
+            p.data["mixed_vs_host"] = round(mixed[0] / mixed_host_rate, 3)
+
     notary = p.run(
         "device_notary", lambda: bench_notary_device(moves, resolve, notary_id)
     )
@@ -658,6 +864,14 @@ def main() -> int:
     if raft:
         p.data["notary_raft_cluster_tx_per_sec"] = round(raft[0], 1)
         p.data["notary_raft_cluster_best_tx_per_sec"] = round(raft[1], 1)
+
+    trader_dev = p.run(
+        "device_trader", lambda: bench_trader_demo(device=True)
+    )
+    if trader_dev:
+        p.data["trader_demo_trades_per_sec"] = round(trader_dev, 2)
+        if trader_host:
+            p.data["trader_vs_host"] = round(trader_dev / trader_host, 3)
 
     dag = p.run(
         "device_dag", lambda: bench_dag_device(chain, chain_notary)

@@ -9,8 +9,12 @@ sharded batches.
 """
 
 from .mesh import (
+    MeshVerifier,
     distributed_verify_step,
+    enable_service_mesh,
     make_mesh,
+    service_mesh_active,
+    service_mesh_verifier,
     shard_batch,
 )
 from .wavefront import (
@@ -23,7 +27,9 @@ from .wavefront import (
 )
 
 __all__ = [
-    "distributed_verify_step", "make_mesh", "shard_batch",
+    "MeshVerifier", "distributed_verify_step", "enable_service_mesh",
+    "make_mesh", "service_mesh_active", "service_mesh_verifier",
+    "shard_batch",
     "DagVerificationError", "DagVerifyResult", "DoubleSpendInDagError",
     "UnresolvedStateError", "topological_levels", "verify_transaction_dag",
 ]

@@ -43,11 +43,12 @@ def shard_batch(mesh: Mesh, arr, axis: str = "batch"):
     return jax.device_put(arr, NamedSharding(mesh, P(axis)))
 
 
-def distributed_verify_step(mesh: Mesh):
+def distributed_verify_step(mesh: Mesh, with_spent: bool = True):
     """Build the jitted multi-chip verify step for ``mesh``.
 
-    Returns fn(a_y, a_sign, r_bytes, s_bits, h_bits, precheck,
-    spent_hashes) → (valid_mask, spent_all, total_valid):
+    With ``with_spent`` (the notary-commit shape) returns
+    fn(a_y, a_sign, r_bytes, s_bits, h_bits, precheck, spent_hashes)
+    → (valid_mask, spent_all, total_valid):
 
     - every input is batch-sharded on axis 0 (batch size must divide the
       mesh size);
@@ -56,8 +57,22 @@ def distributed_verify_step(mesh: Mesh):
       batch consumes — are all-gathered so each shard returns the complete
       consumed-set delta (the notary-commit collective);
     - ``total_valid`` is a psum'd scalar (the batch-level accept count).
-    """
+
+    ``with_spent=False`` builds the mask-only variant (6 inputs → mask):
+    verification fan-out with NO collectives — callers that only need
+    verdicts must not pay an all-gather per batch."""
     spec = P("batch")
+
+    if not with_spent:
+        def step_mask(a_y, a_sign, r_bytes, s_bits, h_bits, precheck):
+            return ed25519_verify_core(
+                a_y, a_sign, r_bytes, s_bits, h_bits, precheck
+            )
+
+        return jax.jit(shard_map(
+            step_mask, mesh=mesh, in_specs=(spec,) * 6, out_specs=spec,
+            **_shard_map_compat_kwargs(),
+        ))
 
     def step(a_y, a_sign, r_bytes, s_bits, h_bits, precheck,
              spent_hashes):
@@ -70,11 +85,22 @@ def distributed_verify_step(mesh: Mesh):
         total = jax.lax.psum(jnp.sum(mask.astype(jnp.int32)), "batch")
         return mask, spent_all, total
 
-    kwargs = {}
+    sharded = shard_map(
+        step,
+        mesh=mesh,
+        in_specs=(spec,) * 7,
+        out_specs=(spec, P(), P()),
+        **_shard_map_compat_kwargs(),
+    )
+    return jax.jit(sharded)
+
+
+def _shard_map_compat_kwargs() -> dict:
+    """Relax replication/varying-axis checking: the kernel's loop carries
+    are initialized from constants (unvarying) and become batch-varying
+    through the loop body, which strict checking rejects."""
+    kwargs: dict = {}
     try:
-        # relax replication/varying-axis checking: the kernel's loop carries
-        # are initialized from constants (unvarying) and become batch-varying
-        # through the loop body, which strict checking rejects
         import inspect
 
         params = inspect.signature(shard_map).parameters
@@ -84,11 +110,98 @@ def distributed_verify_step(mesh: Mesh):
             kwargs["check_rep"] = False
     except (TypeError, ValueError):
         pass
-    sharded = shard_map(
-        step,
-        mesh=mesh,
-        in_specs=(spec,) * 7,
-        out_specs=(spec, P(), P()),
-        **kwargs,
-    )
-    return jax.jit(sharded)
+    return kwargs
+
+
+# ------------------------------------------------------------ service tier
+
+_service_mesh_enabled: bool | None = None
+
+
+def enable_service_mesh(on: bool = True) -> None:
+    """Force the service-tier mesh routing on/off (tests use this to
+    exercise the fan-out on the 8-virtual-device CPU mesh without slowing
+    every single-chip-shaped test through shard_map)."""
+    global _service_mesh_enabled, _mesh_verifier_singleton
+    _service_mesh_enabled = on
+    _mesh_verifier_singleton = None
+
+
+def service_mesh_active() -> bool:
+    """Policy: route service signature batches through the mesh when more
+    than one REAL accelerator device is visible (the production fan-out,
+    SURVEY §2.9 P3), or when explicitly enabled. Single chip degrades
+    transparently to the plain batched dispatch."""
+    import os
+
+    if _service_mesh_enabled is not None:
+        return _service_mesh_enabled
+    if os.environ.get("CORDA_TPU_SERVICE_MESH") == "1":
+        return True
+    return jax.default_backend() != "cpu" and len(jax.devices()) > 1
+
+
+_mesh_verifier_singleton = None
+
+
+def service_mesh_verifier():
+    global _mesh_verifier_singleton
+    if _mesh_verifier_singleton is None:
+        _mesh_verifier_singleton = MeshVerifier()
+    return _mesh_verifier_singleton
+
+
+class MeshVerifier:
+    """Service-facing data-parallel signature verification over the device
+    mesh — the production role of the reference's N-stateless-verifiers
+    fan-out (Verifier.kt:66-84, VerifierTests.kt:55-113): one batch is
+    sharded over every device, each verifies its shard, and the consumed
+    input-state hashes are all-gathered so every shard (and the host)
+    holds the full spent-set delta for a notary commit."""
+
+    def __init__(self, mesh: Mesh | None = None):
+        self.mesh = mesh or make_mesh()
+        self.n_devices = int(np.prod(self.mesh.devices.shape))
+        # two compiled variants: verdict-only (no collectives — the
+        # verifier-service fast path) and the notary-commit shape with
+        # the spent-set all-gather + psum
+        self._step_mask = distributed_verify_step(self.mesh, with_spent=False)
+        self._step_spent = distributed_verify_step(self.mesh, with_spent=True)
+
+    def _bucket(self, n: int, min_bucket: int | None) -> int:
+        from corda_tpu.ops._blockpack import pow2_at_least
+
+        return pow2_at_least(
+            max(n, 1), max(min_bucket or 0, 8 * self.n_devices)
+        )
+
+    def dispatch_rows(
+        self,
+        pubkeys: list[bytes],
+        signatures: list[bytes],
+        messages: list[bytes],
+        min_bucket: int | None = None,
+        spent_hashes=None,
+    ):
+        """Prep + enqueue WITHOUT materializing (async like the single-chip
+        dispatch): returns (mask, spent_all, total_valid) device values;
+        slice the mask ``[:len(pubkeys)]`` after ``np.asarray``.
+
+        ``spent_hashes``: optional (N, 8) int32 rows (the input-state
+        reference hashes each signature's tx consumes); they come back
+        all-gathered. When omitted the verdict-only step runs — no
+        collectives — and spent_all/total_valid are None."""
+        from corda_tpu.ops.ed25519 import prep_core_planes
+
+        n = len(pubkeys)
+        b = self._bucket(n, min_bucket)
+        planes = prep_core_planes(pubkeys, signatures, messages, b)
+        if spent_hashes is None:
+            args = tuple(shard_batch(self.mesh, a) for a in planes)
+            return self._step_mask(*args), None, None
+        spent = np.zeros((b, 8), np.int32)
+        spent[:n] = spent_hashes
+        args = tuple(
+            shard_batch(self.mesh, a) for a in (*planes, spent)
+        )
+        return self._step_spent(*args)
