@@ -250,9 +250,13 @@ class SecureBrokerServer:
         self._conns: set[socket.socket] = set()
         # per-PEER delivered-but-unsettled msg ids: a fabric client consumes
         # on per-thread channels and acks on its control channel, so the
-        # settlement authority spans all of one identity's connections
+        # settlement authority spans all of one identity's connections.
+        # Bounded: ids clear from EVERY peer's set on settle (a redelivered
+        # message may be settled by a different consumer), and a peer's
+        # entry drops when its last connection closes.
         self._delivered_lock = threading.Lock()
         self._delivered: dict[str, set] = {}
+        self._peer_conns: dict[str, int] = {}
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="secure-broker-accept"
         )
@@ -288,9 +292,23 @@ class SecureBrokerServer:
             peer_name = str(chan.peer.party.name)
             with self._delivered_lock:
                 delivered = self._delivered.setdefault(peer_name, set())
-            while not self._stop.is_set():
-                req = deserialize(chan.recv())
-                chan.send(serialize(self._dispatch(req, peer_name, delivered)))
+                self._peer_conns[peer_name] = (
+                    self._peer_conns.get(peer_name, 0) + 1
+                )
+            try:
+                while not self._stop.is_set():
+                    req = deserialize(chan.recv())
+                    chan.send(
+                        serialize(self._dispatch(req, peer_name, delivered))
+                    )
+            finally:
+                with self._delivered_lock:
+                    n = self._peer_conns.get(peer_name, 1) - 1
+                    if n <= 0:
+                        self._peer_conns.pop(peer_name, None)
+                        self._delivered.pop(peer_name, None)
+                    else:
+                        self._peer_conns[peer_name] = n
         except (ChannelClosedError, ConnectionError, OSError):
             pass
         except Exception:
@@ -355,7 +373,12 @@ class SecureBrokerServer:
                     return {"ok": False, "error":
                             f"NotAuthorized: {req['msg_id']!r} was not "
                             f"delivered to {peer_name!r} here"}
-                delivered.discard(req["msg_id"])
+                # settle clears the id from EVERY peer's set: a message
+                # redelivered (visibility timeout) to another consumer
+                # must not linger in the first consumer's set forever
+                with self._delivered_lock:
+                    for s in self._delivered.values():
+                        s.discard(req["msg_id"])
                 if op == "ack":
                     self._broker.ack(req["msg_id"])
                 else:

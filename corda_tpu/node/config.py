@@ -91,6 +91,9 @@ class NodeConfiguration:
     # register — the reference's CordappLoader plugins-directory scan
     # (node/.../internal/cordapp/CordappLoader.kt:41) as explicit config
     cordapp_packages: tuple[str, ...] = ()
+    # the reference's plugins-directory scan: every module/package in this
+    # directory loads as an app at boot (node/cordapp.py CordappLoader)
+    cordapp_directory: str | None = None
 
     @property
     def db_path(self) -> str:
@@ -240,6 +243,7 @@ def config_from_dict(d: dict) -> NodeConfiguration:
         flow_timeout_seconds=float(d.get("flowTimeoutSeconds", 120.0)),
         verification_batch_max=int(d.get("verificationBatchMax", 1024)),
         cordapp_packages=tuple(d.get("cordappPackages", [])),
+        cordapp_directory=d.get("cordappDirectory"),
         verification_window_ms=float(d.get("verificationWindowMs", 5.0)),
         database_path=d.get("databasePath"),
     )

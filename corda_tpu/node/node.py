@@ -51,12 +51,17 @@ class Node:
     ):
         self.config = config
         self.messaging = messaging
-        # CorDapp loading (reference: CordappLoader.kt:41) — importing the
-        # package registers its contracts, responder flows and wire types
-        import importlib
+        # CorDapp loading (reference: CordappLoader.kt:41-63) — named
+        # packages plus the plugins-directory scan; the loader records a
+        # manifest of what each app registered (contracts, responders,
+        # wire types) for the provider queries
+        from corda_tpu.node.cordapp import CordappLoader
 
+        self.cordapp_loader = CordappLoader()
         for pkg in config.cordapp_packages:
-            importlib.import_module(pkg)
+            self.cordapp_loader.load_package(pkg)
+        if config.cordapp_directory:
+            self.cordapp_loader.load_directory(config.cordapp_directory)
         name = CordaX500Name.parse(config.my_legal_name) if isinstance(
             config.my_legal_name, str
         ) else config.my_legal_name

@@ -16,9 +16,23 @@ muxing redesigned as plain topic streams.
 from .ops import CordaRPCOps, PermissionException
 from .server import RPCServer
 from .client import CordaRPCClient, RPCConnection, Observable
+from .json_support import (
+    IdentityJsonMapper,
+    JsonMapper,
+    JsonSerializationError,
+    RpcJsonMapper,
+)
+from .string_calls import (
+    CallParseError,
+    ParsedMethodCall,
+    StringToMethodCallParser,
+)
 
 __all__ = [
     "CordaRPCOps", "PermissionException",
     "RPCServer",
     "CordaRPCClient", "RPCConnection", "Observable",
+    "IdentityJsonMapper", "JsonMapper", "JsonSerializationError",
+    "RpcJsonMapper",
+    "CallParseError", "ParsedMethodCall", "StringToMethodCallParser",
 ]

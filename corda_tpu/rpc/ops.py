@@ -14,8 +14,10 @@ from __future__ import annotations
 
 import time as _time
 
+from corda_tpu.crypto.keys import PublicKey
 from corda_tpu.flows import FlowLogic
 from corda_tpu.flows.api import load_class
+from corda_tpu.ledger import CordaX500Name
 from corda_tpu.node.vault import PageSpecification, QueryCriteria, Sort
 
 
@@ -119,9 +121,21 @@ class CordaRPCOps:
     def node_info(self):
         return self._services.my_info
 
-    def well_known_party_from_x500_name(self, name):
+    def well_known_party_from_x500_name(self, name: CordaX500Name):
         info = self._services.network_map_cache.get_node_by_legal_name(name)
         return info.legal_identity if info else None
+
+    def party_from_key(self, key: PublicKey):
+        """reference: CordaRPCOps.partyFromKey — resolve an owning key to
+        its well-known party via the identity service, falling back to the
+        network map."""
+        party = self._services.identity_service.party_from_key(key)
+        if party is not None:
+            return party
+        for info in self._services.network_map_cache.all_nodes():
+            if info.legal_identity.owning_key == key:
+                return info.legal_identity
+        return None
 
     # -------------------------------------------------------- attachments
     def attachment_exists(self, attachment_id) -> bool:

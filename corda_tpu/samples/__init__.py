@@ -10,6 +10,13 @@
   back-chain protocol (samples/attachment-demo).
 - ``bank_demo`` — issuer node serving cash issuance over RPC
   (samples/bank-of-corda-demo).
+- ``simm_demo`` — bilateral IRS portfolio agreement + independent SIMM
+  margin valuation with consensus (samples/simm-valuation-demo; the
+  OpenGamma analytics role is a vectorized sensitivity-aggregation
+  engine).
+- ``network_visualiser`` — records a simulated network's message traffic
+  and renders DOT/HTML artifacts (samples/network-visualiser; the JavaFX
+  map re-targeted at GUI-less rendering).
 
 Each module exposes its flows plus a ``run_demo()`` entry returning a
 result summary (and is runnable via ``python -m corda_tpu.samples.<name>``).

@@ -1,0 +1,370 @@
+"""SIMM valuation demo: portfolio margin agreement between dealers.
+
+Capability parity with the reference's simm-valuation-demo
+(samples/simm-valuation-demo/.../flows/SimmFlow.kt — two parties agree a
+portfolio of IRS trades, INDEPENDENTLY value it with a SIMM
+implementation, come to consensus over the valuations, and record the
+agreed valuation as a revision of the portfolio state; contracts:
+OGTrade.kt, PortfolioSwap.kt; state model: IRSState, PortfolioState,
+PortfolioValuation).
+
+The reference outsources the margin math to OpenGamma's analytics JARs.
+Here the analytics engine is the TPU-native piece: initial margin is the
+ISDA-SIMM-shaped sensitivity aggregation  √(Σᵢⱼ ρᵢⱼ·WSᵢ·WSⱼ)  over
+per-tenor delta sensitivities, vectorized with numpy (device-dispatchable
+— the same math vmaps over portfolios) and rounded to integer cents so
+two parties computing independently agree bit-exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import numpy as np
+
+from corda_tpu.flows import (
+    CollectSignaturesFlow,
+    FinalityFlow,
+    FlowException,
+    FlowLogic,
+    InitiatedBy,
+    SignTransactionFlow,
+)
+from corda_tpu.ledger import (
+    Party,
+    StateRef,
+    TransactionBuilder,
+    register_contract,
+)
+from corda_tpu.serialization import cbe_serializable
+
+IRS_PROGRAM_ID = "samples.simm.OGTrade"
+PORTFOLIO_PROGRAM_ID = "samples.simm.PortfolioSwap"
+
+# SIMM-shaped parameters: per-tenor risk weights (bps of notional) and the
+# inter-tenor correlation matrix (the IR delta block of the ISDA model)
+TENORS = (0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
+_RISK_WEIGHTS = np.array([113.0, 98.0, 69.0, 52.0, 51.0, 63.0])
+_RHO = np.array([
+    [1.00, 0.79, 0.67, 0.53, 0.42, 0.37],
+    [0.79, 1.00, 0.89, 0.74, 0.63, 0.53],
+    [0.67, 0.89, 1.00, 0.90, 0.79, 0.66],
+    [0.53, 0.74, 0.90, 1.00, 0.94, 0.79],
+    [0.42, 0.63, 0.79, 0.94, 1.00, 0.87],
+    [0.37, 0.53, 0.66, 0.79, 0.87, 1.00],
+])
+
+
+@cbe_serializable(name="samples.simm.SwapData")
+@dataclasses.dataclass(frozen=True)
+class SwapData:
+    """One IRS trade (reference: SwapData.kt, simplified legs)."""
+
+    trade_id: str
+    notional: int            # indivisible currency units
+    fixed_rate_bps: int
+    tenor_years: float
+    currency: str = "EUR"
+    buy: bool = True         # True: we pay fixed
+
+
+@cbe_serializable(name="samples.simm.IRSState")
+@dataclasses.dataclass(frozen=True)
+class IRSState:
+    """An agreed swap between buyer and seller (reference: IRSState.kt)."""
+
+    swap: SwapData
+    buyer: Party
+    seller: Party
+
+    @property
+    def participants(self):
+        return [self.buyer, self.seller]
+
+
+@cbe_serializable(name="samples.simm.PortfolioValuation")
+@dataclasses.dataclass(frozen=True)
+class PortfolioValuation:
+    """The agreed margin (reference: PortfolioValuation.kt — trade count +
+    notional + the IM triple; one IM number here)."""
+
+    trades: int
+    total_notional: int
+    initial_margin_cents: int
+
+
+@cbe_serializable(name="samples.simm.PortfolioState")
+@dataclasses.dataclass(frozen=True)
+class PortfolioState:
+    """The bilateral portfolio: refs to agreed trades + the latest agreed
+    valuation (reference: PortfolioState.kt — a RevisionedState)."""
+
+    portfolio: tuple          # tuple[StateRef, ...]
+    party_a: Party
+    party_b: Party
+    valuation: PortfolioValuation | None = None
+
+    @property
+    def participants(self):
+        return [self.party_a, self.party_b]
+
+
+@cbe_serializable(name="samples.simm.Agree")
+@dataclasses.dataclass(frozen=True)
+class Agree:
+    pass
+
+
+@cbe_serializable(name="samples.simm.Update")
+@dataclasses.dataclass(frozen=True)
+class Update:
+    pass
+
+
+@register_contract(IRS_PROGRAM_ID)
+class OGTradeContract:
+    """reference: OGTrade.kt — Agree issues exactly one IRS state."""
+
+    def verify(self, tx) -> None:
+        outs = tx.outputs_of_type(IRSState)
+        if len(outs) != 1:
+            raise ValueError("an IRS agreement must output exactly one swap")
+        if outs[0].swap.notional <= 0:
+            raise ValueError("swap notional must be positive")
+
+
+@register_contract(PORTFOLIO_PROGRAM_ID)
+class PortfolioSwapContract:
+    """reference: PortfolioSwap.kt — Agree creates a portfolio; Update
+    revises it (new valuation), preserving the parties."""
+
+    def verify(self, tx) -> None:
+        outs = tx.outputs_of_type(PortfolioState)
+        if len(outs) != 1:
+            raise ValueError("portfolio transactions output one portfolio")
+        ins = tx.inputs_of_type(PortfolioState)
+        if ins:
+            if set(map(str, ins[0].participants)) != set(
+                map(str, outs[0].participants)
+            ):
+                raise ValueError("a revision cannot change the parties")
+
+
+# ------------------------------------------------------- analytics engine
+
+def delta_sensitivities(swaps: list[SwapData]) -> np.ndarray:
+    """(N, len(TENORS)) per-trade delta sensitivities: each swap's DV01
+    assigned to its nearest tenor bucket, signed by direction — the
+    normalized-portfolio step (reference: PortfolioNormalizer +
+    OGSIMMAnalyticsEngine feeding sensitivities into the IM calc)."""
+    tenors = np.array(TENORS)
+    out = np.zeros((len(swaps), len(TENORS)))
+    for i, s in enumerate(swaps):
+        bucket = int(np.argmin(np.abs(tenors - s.tenor_years)))
+        dv01 = s.notional * s.tenor_years * 1e-4  # flat-curve DV01
+        out[i, bucket] = dv01 if s.buy else -dv01
+    return out
+
+
+def initial_margin_cents(swaps: list[SwapData]) -> int:
+    """ISDA-SIMM-shaped IR delta margin: weighted sensitivities aggregated
+    under the tenor correlation matrix, √(WS·ρ·WS). Integer cents so the
+    two dealers' independent computations compare bit-exactly (the
+    consensus step, SimmFlow.kt agree(...valuer) — reference compares
+    InitialMarginTriples)."""
+    if not swaps:
+        return 0
+    ws = (delta_sensitivities(swaps).sum(axis=0)) * _RISK_WEIGHTS * 1e-2
+    margin = float(np.sqrt(np.maximum(ws @ _RHO @ ws, 0.0)))
+    return int(round(margin * 100))
+
+
+def value_portfolio(swaps: list[SwapData]) -> PortfolioValuation:
+    return PortfolioValuation(
+        trades=len(swaps),
+        total_notional=sum(s.notional for s in swaps),
+        initial_margin_cents=initial_margin_cents(swaps),
+    )
+
+
+# ----------------------------------------------------------------- flows
+
+@cbe_serializable(name="samples.simm.TradeOffer")
+@dataclasses.dataclass(frozen=True)
+class TradeOffer:
+    swap: SwapData
+    notary: Party
+
+
+@dataclasses.dataclass
+class IRSTradeFlow(FlowLogic):
+    """Agree one swap bilaterally (reference: IRSTradeFlow.kt)."""
+
+    swap: SwapData
+    counterparty: Party
+    notary: Party
+
+    def call(self):
+        session = self.initiate_flow(self.counterparty)
+        session.send(TradeOffer(self.swap, self.notary))
+        state = IRSState(self.swap, self.our_identity, self.counterparty)
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(state, IRS_PROGRAM_ID)
+        b.add_command(
+            Agree(), self.our_identity.owning_key,
+            self.counterparty.owning_key,
+        )
+        stx = self.sign_builder(b)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        return self.sub_flow(FinalityFlow(stx))
+
+
+@InitiatedBy(IRSTradeFlow)
+class IRSTradeResponder(FlowLogic):
+    def __init__(self, session):
+        self.session = session
+
+    def call(self):
+        offer = self.session.receive(TradeOffer).unwrap(lambda o: o)
+        if offer.swap.notional <= 0:
+            raise FlowException("refusing non-positive notional")
+
+        class _Sign(SignTransactionFlow):
+            def check_transaction(self, stx) -> None:
+                outs = stx.tx.outputs
+                if len(outs) != 1 or outs[0].data.swap != offer.swap:
+                    raise FlowException("signed swap differs from the offer")
+
+        self.sub_flow(_Sign(self.session))
+
+
+@cbe_serializable(name="samples.simm.PortfolioOffer")
+@dataclasses.dataclass(frozen=True)
+class PortfolioOffer:
+    """reference: SimmFlow.OfferMessage."""
+
+    notary: Party
+    trade_refs: tuple
+    state_ref: StateRef | None
+    valuation_date: str
+
+
+@dataclasses.dataclass
+class SimmFlow(FlowLogic):
+    """Agree the portfolio, value it on BOTH sides independently, check
+    consensus, and record the valuation revision (reference:
+    SimmFlow.Requester/Receiver)."""
+
+    counterparty: Party
+    notary: Party
+    valuation_date: str
+
+    def call(self):
+        vault = self.services.vault_service
+        my_trades = [
+            sr for sr in vault.unconsumed_states(IRSState)
+        ]
+        refs = tuple(sorted(
+            (sr.ref for sr in my_trades), key=lambda r: (r.txhash.bytes, r.index)
+        ))
+        session = self.initiate_flow(self.counterparty)
+        session.send(PortfolioOffer(
+            self.notary, refs, None, self.valuation_date
+        ))
+        # both sides value independently; consensus = identical valuation
+        swaps = [sr.state.data.swap for sr in my_trades]
+        mine = value_portfolio(swaps)
+        theirs = session.receive(PortfolioValuation).unwrap(lambda v: v)
+        if theirs != mine:
+            raise FlowException(
+                f"valuation consensus failed: {mine} != {theirs}"
+            )
+        state = PortfolioState(
+            refs, self.our_identity, self.counterparty, valuation=mine
+        )
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(state, PORTFOLIO_PROGRAM_ID)
+        b.add_command(
+            Agree(), self.our_identity.owning_key,
+            self.counterparty.owning_key,
+        )
+        stx = self.sign_builder(b)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        self.sub_flow(FinalityFlow(stx))
+        return mine
+
+
+@InitiatedBy(SimmFlow)
+class SimmResponder(FlowLogic):
+    def __init__(self, session):
+        self.session = session
+
+    def call(self):
+        offer = self.session.receive(PortfolioOffer).unwrap(lambda o: o)
+        vault = self.services.vault_service
+        by_ref = {
+            sr.ref: sr for sr in vault.unconsumed_states(IRSState)
+        }
+        swaps = []
+        for ref in offer.trade_refs:
+            sr = by_ref.get(ref)
+            if sr is None:
+                raise FlowException(f"unknown trade in portfolio: {ref}")
+            swaps.append(sr.state.data.swap)
+        valuation = value_portfolio(swaps)
+        self.session.send(valuation)
+
+        class _Sign(SignTransactionFlow):
+            def check_transaction(self, stx) -> None:
+                out = stx.tx.outputs[0].data
+                if out.valuation != valuation:
+                    raise FlowException(
+                        "portfolio carries a valuation we did not compute"
+                    )
+                if tuple(out.portfolio) != tuple(offer.trade_refs):
+                    raise FlowException("portfolio trade set changed")
+
+        self.sub_flow(_Sign(self.session))
+
+
+# ------------------------------------------------------------- the demo
+
+def run_demo(n_trades: int = 5, verbose: bool = True) -> dict:
+    from corda_tpu.testing import MockNetworkNodes
+
+    t0 = time.time()
+    with MockNetworkNodes() as net:
+        dealer_a = net.create_node("Dealer A")
+        dealer_b = net.create_node("Dealer B")
+        notary = net.create_notary_node("Notary", validating=True)
+
+        for i in range(n_trades):
+            swap = SwapData(
+                trade_id=f"swap-{i}",
+                notional=10_000_000 * (i + 1),
+                fixed_rate_bps=150 + 10 * i,
+                tenor_years=TENORS[i % len(TENORS)],
+                buy=(i % 2 == 0),
+            )
+            dealer_a.run_flow(
+                IRSTradeFlow(swap, dealer_b.party, notary.party), timeout=60
+            )
+        valuation = dealer_a.run_flow(
+            SimmFlow(dealer_b.party, notary.party, "2026-07-30"), timeout=60
+        )
+        pa = dealer_a.services.vault_service.unconsumed_states(PortfolioState)
+        pb = dealer_b.services.vault_service.unconsumed_states(PortfolioState)
+        summary = {
+            "trades": n_trades,
+            "initial_margin_cents": valuation.initial_margin_cents,
+            "portfolio_recorded_both_sides": len(pa) == len(pb) == 1,
+            "elapsed_s": round(time.time() - t0, 3),
+        }
+    if verbose:
+        print(f"simm-demo: {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    run_demo()

@@ -8,6 +8,7 @@ from .cbe import (
     register_custom,
     serialize,
 )
+from .carpenter import CarpenterError, ClassCarpenter, carpent
 
 __all__ = [
     "GenericRecord",
@@ -18,4 +19,7 @@ __all__ = [
     "encode",
     "register_custom",
     "serialize",
+    "CarpenterError",
+    "ClassCarpenter",
+    "carpent",
 ]

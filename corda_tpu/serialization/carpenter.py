@@ -1,0 +1,139 @@
+"""The class carpenter: synthesize USABLE classes for unknown wire types.
+
+Capability parity with the reference's ClassCarpenter
+(node-api/.../serialization/carpenter/ClassCarpenter.kt — when a peer
+sends an object of a class we don't have, synthesize a JVM class from the
+AMQP schema at runtime so the object is a first-class value, not an
+opaque blob; MetaCarpenter handles nested schemas). Here the wire format
+is CBE and unknown types decode to :class:`GenericRecord` (read-only);
+the carpenter turns those into real frozen dataclasses — constructible,
+attribute-complete, re-encodable under the original type name — and
+REGISTERS them so subsequent decodes of the same type produce instances
+directly.
+
+Evolution: a later record carrying additional fields WIDENS the
+synthesized class (re-synthesized with the union of fields, new ones
+defaulting to None) — the carpenter analogue of the AMQP
+EvolutionSerializer's default-filling.
+
+Safety: the carpenter never shadows a genuinely registered class — if the
+type name is already bound to a real implementation, that wins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import keyword
+import re
+import threading
+
+from .cbe import _ENCODERS, _REGISTRY, GenericRecord, SerializationError
+
+_NAME_RE = re.compile(r"[A-Za-z_][A-Za-z0-9_]*$")
+
+
+class CarpenterError(SerializationError):
+    pass
+
+
+class ClassCarpenter:
+    """Synthesizes and registers dataclasses from wire schemas."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._built: dict[str, type] = {}
+
+    # ------------------------------------------------------------ schema
+
+    @staticmethod
+    def _check_fields(type_name: str, field_names) -> list[str]:
+        out = []
+        for f in field_names:
+            if (not isinstance(f, str) or not _NAME_RE.match(f)
+                    or keyword.iskeyword(f) or f.startswith("__")):
+                # dunder names would override object protocol methods on
+                # the synthesized class — a hostile peer must not get that
+                raise CarpenterError(
+                    f"cannot carpent {type_name!r}: invalid field {f!r}"
+                )
+            out.append(f)
+        return out
+
+    def build(self, type_name: str, field_names) -> type:
+        """Get-or-synthesize the class for ``type_name`` with (at least)
+        ``field_names``. Registered real classes always win."""
+        existing = _REGISTRY.get(type_name)
+        if existing is not None and existing[0] not in self._built.values():
+            return existing[0]
+        fields = self._check_fields(type_name, field_names)
+        with self._lock:
+            cls = self._built.get(type_name)
+            if cls is not None:
+                have = [f.name for f in dataclasses.fields(cls)]
+                missing = [f for f in fields if f not in have]
+                if not missing:
+                    return cls
+                fields = have + missing  # widen (schema evolution)
+            cls = dataclasses.make_dataclass(
+                type_name.rpartition(".")[2] or "Carpented",
+                [(f, object, dataclasses.field(default=None)) for f in fields],
+                frozen=True,
+                namespace={
+                    "__cbe_name__": type_name,
+                    "__carpented__": True,
+                    "__module__": __name__,
+                },
+            )
+            self._register(type_name, cls)
+            self._built[type_name] = cls
+            return cls
+
+    def _register(self, type_name: str, cls: type) -> None:
+        field_names = [f.name for f in dataclasses.fields(cls)]
+        known = set(field_names)
+
+        def to_fields(obj) -> dict:
+            return {fn: getattr(obj, fn) for fn in field_names}
+
+        def from_fields(d: dict):
+            extra = set(d) - known
+            if extra:
+                # decode-time schema widening: the peer evolved the type —
+                # re-synthesize with the union and decode through that
+                wider = self.build(type_name, list(d))
+                if wider is not cls:
+                    _, wider_from = _REGISTRY[type_name]
+                    return wider_from(d)
+            return cls(**{k: v for k, v in d.items() if k in known})
+
+        _REGISTRY[type_name] = (cls, from_fields)
+        _ENCODERS[cls] = (type_name, to_fields)
+
+    # ------------------------------------------------------------ values
+
+    def carpent(self, value):
+        """Recursively convert GenericRecords inside ``value`` into
+        synthesized-class instances (MetaCarpenter's nested-schema role)."""
+        if isinstance(value, GenericRecord):
+            cls = self.build(value.type_name, [k for k, _ in value.fields])
+            if not getattr(cls, "__carpented__", False):
+                # a real class got registered meanwhile: decode through it
+                _, from_fields = _REGISTRY[value.type_name]
+                return from_fields({
+                    k: self.carpent(v) for k, v in value.fields
+                })
+            return cls(**{k: self.carpent(v) for k, v in value.fields})
+        if isinstance(value, dict):
+            return {k: self.carpent(v) for k, v in value.items()}
+        if isinstance(value, (list, tuple)):
+            out = [self.carpent(v) for v in value]
+            return type(value)(out) if isinstance(value, tuple) else out
+        return value
+
+
+_default_carpenter = ClassCarpenter()
+
+
+def carpent(value):
+    """Module-level convenience over a shared carpenter instance."""
+    return _default_carpenter.carpent(value)

@@ -75,10 +75,25 @@ class InteractiveShell:
                 self._vault(args)
             elif cmd == "run":
                 if not args:
-                    self._p("usage: run <op> [args…]")
+                    self._p("usage: run <op> [args… | key: value, …]")
                 else:
-                    fn = getattr(self._ops, args[0])
-                    self._p(fn(*[_parse_arg(a) for a in args[1:]]))
+                    rest = line.strip().partition(" ")[2]
+                    if ":" in rest:
+                        # named-argument form through the jackson-tier
+                        # parser: values convert to the op's annotated
+                        # types (parties by X.500 name, hashes from hex)
+                        from corda_tpu.rpc.json_support import RpcJsonMapper
+                        from corda_tpu.rpc.string_calls import (
+                            StringToMethodCallParser,
+                        )
+
+                        parser = StringToMethodCallParser(
+                            self._ops, RpcJsonMapper(self._ops)
+                        )
+                        self._p(parser.invoke(rest))
+                    else:
+                        fn = getattr(self._ops, args[0])
+                        self._p(fn(*[_parse_arg(a) for a in args[1:]]))
             else:
                 self._p(f"unknown command {cmd!r} — try 'help'")
         except Exception as e:

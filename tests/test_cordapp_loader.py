@@ -1,0 +1,110 @@
+"""CorDapp discovery tests — the reference's CordappLoader coverage
+(CordappLoaderTest: directory scan finds apps, manifests list contracts
+and initiated flows, provider maps contract → attachment id)."""
+
+import textwrap
+
+from corda_tpu.node.cordapp import CordappLoader
+
+
+APP_SOURCE = textwrap.dedent(
+    """
+    import dataclasses
+
+    from corda_tpu.flows import FlowLogic, InitiatedBy
+    from corda_tpu.ledger import register_contract
+    from corda_tpu.serialization import cbe_serializable
+
+
+    @register_contract("testapp.Widget")
+    class WidgetContract:
+        def verify(self, tx):
+            pass
+
+
+    @cbe_serializable(name="testapp.WidgetMsg")
+    @dataclasses.dataclass(frozen=True)
+    class WidgetMsg:
+        text: str
+
+
+    @dataclasses.dataclass
+    class WidgetFlow(FlowLogic):
+        def call(self):
+            return "widget"
+
+
+    @InitiatedBy(WidgetFlow)
+    class WidgetResponder(FlowLogic):
+        def __init__(self, session):
+            self.session = session
+
+        def call(self):
+            return None
+    """
+)
+
+
+class TestCordappLoader:
+    def test_directory_scan_builds_manifest(self, tmp_path):
+        appdir = tmp_path / "cordapps"
+        appdir.mkdir()
+        (appdir / "widget_app.py").write_text(APP_SOURCE)
+        (appdir / "_ignored.py").write_text("raise AssertionError")
+        loader = CordappLoader()
+        apps = loader.load_directory(appdir)
+        assert [a.name for a in apps] == ["widget_app"]
+        app = apps[0]
+        assert "testapp.Widget" in app.contracts
+        assert any("WidgetFlow" in f for f in app.flow_classes)
+        assert app.initiated_flows  # responder registered
+        assert "testapp.WidgetMsg" in app.serializable_types
+        # provider face: contract → pseudo-attachment id
+        att = loader.contract_attachment_id("testapp.Widget")
+        assert att is not None
+        assert loader.cordapp_for_contract("testapp.Widget") is app
+        assert loader.cordapp_for_contract("nope.Missing") is None
+
+    def test_broken_app_skipped(self, tmp_path):
+        appdir = tmp_path / "cordapps"
+        appdir.mkdir()
+        (appdir / "broken_app.py").write_text("raise RuntimeError('boom')")
+        (appdir / "widget_app2.py").write_text(
+            APP_SOURCE.replace("testapp.", "testapp2.")
+            .replace("WidgetFlow", "Widget2Flow")
+        )
+        loader = CordappLoader()
+        apps = loader.load_directory(appdir)
+        assert [a.name for a in apps] == ["widget_app2"]
+
+    def test_node_boot_loads_directory(self, tmp_path):
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node import Node, NodeConfiguration
+
+        appdir = tmp_path / "cordapps"
+        appdir.mkdir()
+        (appdir / "boot_app.py").write_text(
+            APP_SOURCE.replace("testapp.", "bootapp.")
+            .replace("WidgetFlow", "BootFlow")
+        )
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            cfg = NodeConfiguration(
+                my_legal_name="O=AppNode,L=London,C=GB",
+                cordapp_directory=str(appdir),
+            )
+            node = Node(
+                cfg, net.create_node("O=AppNode, L=London, C=GB")
+            ).start()
+            apps = node.cordapp_loader.cordapps
+            assert any("bootapp.Widget" in a.contracts for a in apps)
+            # the discovered flow is startable end-to-end
+            import importlib
+
+            flow_cls = getattr(importlib.import_module("boot_app"), "BootFlow")
+            result = node.run_flow(flow_cls())
+            assert result == "widget"
+            node.stop()
+        finally:
+            net.stop_pumping()

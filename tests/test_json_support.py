@@ -1,0 +1,177 @@
+"""client/jackson tier tests — the reference's JacksonSupportTest +
+StringToMethodCallParserTest coverage: core-type JSON round trips, party
+resolution through identity/RPC backends, and human-typed method-call
+strings dispatching real operations."""
+
+import dataclasses
+
+import pytest
+
+from corda_tpu.crypto import SecureHash, generate_keypair, sha256
+from corda_tpu.ledger import (
+    Amount,
+    AnonymousParty,
+    CordaX500Name,
+    Issued,
+    Party,
+    PartyAndReference,
+    StateRef,
+)
+from corda_tpu.rpc import (
+    CallParseError,
+    IdentityJsonMapper,
+    JsonMapper,
+    JsonSerializationError,
+    StringToMethodCallParser,
+)
+from corda_tpu.serialization import cbe_serializable
+
+
+def _party(org):
+    kp = generate_keypair()
+    return Party(CordaX500Name(org, "London", "GB"), kp.public), kp
+
+
+class TestJsonMapper:
+    def test_core_type_wire_forms(self):
+        m = JsonMapper()
+        h = sha256(b"x")
+        assert m.to_json_value(h) == str(h)
+        assert m.to_json_value(StateRef(h, 3)) == f"{h}(3)"
+        assert m.to_json_value(Amount(100, "GBP")) == "100 GBP"
+        assert m.to_json_value(b"\x01\x02") == "AQI="
+        party, kp = _party("Bank A")
+        assert m.to_json_value(party) == "O=Bank A, L=London, C=GB"
+        key_form = m.to_json_value(kp.public)
+        assert key_form.startswith(f"{kp.public.scheme_id}:")
+
+    def test_round_trips_without_resolution(self):
+        m = JsonMapper()
+        h = sha256(b"y")
+        assert m.parse(m.to_json_value(h), SecureHash) == h
+        ref = StateRef(h, 7)
+        assert m.parse(m.to_json_value(ref), StateRef) == ref
+        amt = Amount(250, "USD")
+        assert m.parse(m.to_json_value(amt), Amount) == amt
+        kp = generate_keypair()
+        assert m.parse(m.to_json_value(kp.public), type(kp.public)) == kp.public
+        anon = AnonymousParty(kp.public)
+        assert m.parse(m.to_json_value(anon), AnonymousParty) == anon
+        assert m.parse(m.to_json_value(b"hello"), bytes) == b"hello"
+
+    def test_issued_amount_structural_form(self):
+        m = JsonMapper()
+        party, _ = _party("Issuer")
+        token = Issued(PartyAndReference(party, b"\x01"), "GBP")
+        v = m.to_json_value(Amount(5, token))
+        assert v["quantity"] == 5 and v["token"]["@type"]
+
+    def test_registered_type_round_trip(self):
+        @cbe_serializable(name="test.JsonThing")
+        @dataclasses.dataclass(frozen=True)
+        class JsonThing:
+            tag: str
+            ref: StateRef
+
+        m = JsonMapper()
+        obj = JsonThing("hello", StateRef(sha256(b"z"), 1))
+        v = m.to_json_value(obj)
+        assert v["@type"] == "test.JsonThing"
+        back = m.parse(v, JsonThing)
+        assert back == obj
+
+    def test_party_needs_resolution_backend(self):
+        m = JsonMapper()
+        with pytest.raises(JsonSerializationError):
+            m.parse("O=Bank A, L=London, C=GB", Party)
+
+    def test_identity_backed_party_resolution(self):
+        from corda_tpu.node.identity import IdentityService
+        from corda_tpu.ledger.identity import NameKeyCertificate, PartyAndCertificate
+
+        party, kp = _party("Bank A")
+        ca = generate_keypair()
+        leaf = NameKeyCertificate.issue(
+            party.name, kp.public, ca.public, ca.private
+        )
+        ids = IdentityService(trust_root_key=ca.public)
+        ids.register_identity(PartyAndCertificate(party, (leaf,)))
+        m = IdentityJsonMapper(ids)
+        assert m.parse("O=Bank A, L=London, C=GB", Party) == party
+        assert m.party_from_key(kp.public) == party
+
+
+class TestStringToMethodCallParser:
+    class Target:
+        def greet(self, who: str, excited: bool = False) -> str:
+            return f"hello {who}{'!' if excited else ''}"
+
+        def pay(self, amount: Amount, ref: StateRef) -> str:
+            return f"{amount.quantity} {amount.token} vs {ref.index}"
+
+        def total(self, values: list) -> int:
+            return sum(values)
+
+    def test_bareword_and_named_args(self):
+        p = StringToMethodCallParser(self.Target())
+        assert p.invoke("greet who: world") == "hello world"
+        assert p.invoke("greet who: world, excited: true") == "hello world!"
+
+    def test_typed_conversion(self):
+        p = StringToMethodCallParser(self.Target())
+        h = sha256(b"w")
+        out = p.invoke(f"pay amount: 100 GBP, ref: \"{h}(2)\"")
+        assert out == "100 GBP vs 2"
+
+    def test_list_argument(self):
+        p = StringToMethodCallParser(self.Target())
+        assert p.invoke("total values: [1, 2, 3]") == 6
+
+    def test_errors_are_informative(self):
+        p = StringToMethodCallParser(self.Target())
+        with pytest.raises(CallParseError, match="missing argument"):
+            p.parse("greet")
+        with pytest.raises(CallParseError, match="unknown argument"):
+            p.parse("greet who: x, nope: 1")
+        with pytest.raises(CallParseError, match="no such method"):
+            p.parse("bogus x: 1")
+
+    def test_against_live_rpc_ops(self):
+        """The production wiring: parse a call against a node's real RPC
+        surface with RPC-backed party resolution — the shell's 'run'
+        command path."""
+        from corda_tpu.rpc.json_support import RpcJsonMapper
+        from corda_tpu.testing import MockNetworkNodes
+
+        with MockNetworkNodes() as net:
+            node = net.create_node("Bank A")
+            from corda_tpu.rpc import CordaRPCOps
+
+            ops = CordaRPCOps(node.services, node.smm)
+            parser = StringToMethodCallParser(ops, RpcJsonMapper(ops))
+            assert "network_map_snapshot" in parser.available_commands()
+            assert parser.invoke("ping") == "pong"
+            snapshot = parser.invoke("network_map_snapshot")
+            assert len(snapshot) == 1
+
+
+class TestShellNamedRun:
+    def test_run_with_named_args(self):
+        import io
+
+        from corda_tpu.rpc import CordaRPCOps
+        from corda_tpu.testing import MockNetworkNodes
+        from corda_tpu.tools.shell import InteractiveShell
+
+        with MockNetworkNodes() as net:
+            node = net.create_node("Bank A")
+            ops = CordaRPCOps(node.services, node.smm)
+            out = io.StringIO()
+            shell = InteractiveShell(ops, out=out)
+            shell.run_command("run ping")
+            assert "pong" in out.getvalue()
+            shell.run_command(
+                "run well_known_party_from_x500_name "
+                "name: \"O=Bank A, L=London, C=GB\""
+            )
+            assert "Bank A" in out.getvalue()
