@@ -172,23 +172,33 @@ class BatchedNotaryService(NotaryService):
 
     # ---------------------------------------------------------- sync core
 
-    def dispatch_batch(self, requests):
+    def dispatch_ids(self, requests):
+        """Enqueue the batch's device Merkle-id sweep — receive-path
+        integrity: every tx's id is recomputed from its component bytes
+        (reference gets this implicitly from WireTransaction.kt:139-195 —
+        the id IS the content hash); the signature batch then checks each
+        signer actually signed that recomputed root. Returns a pending
+        whose ``collect()`` primes the id caches (None on host tiers)."""
+        if not self._use_device:
+            return None
+        from corda_tpu.ops.txid import dispatch_prime_ids
+
+        return dispatch_prime_ids([r[0] for r in requests])
+
+    def dispatch_batch(self, requests, pending_ids=None):
         """Enqueue the device half (signature ladders) of a batch; the
         returned pending check settles in ``settle_batch``. Splitting the
         two is what hides the interconnect round trip: while batch k's
         ladders run on device, the host validates/commits/signs batch k-1
-        (see ``process_stream``)."""
+        (see ``process_stream``). ``pending_ids`` is an already-enqueued
+        id sweep (its round trip overlapped with earlier batches);
+        without one the sweep runs inline."""
         from corda_tpu.verifier import dispatch_transactions
 
-        if self._use_device:
-            # receive-path integrity: recompute every tx's Merkle id from
-            # its component bytes in one batched device sweep (reference
-            # gets this implicitly from WireTransaction.kt:139-195 — the
-            # id IS the content hash); the signature batch below then
-            # checks each signer actually signed that recomputed root
-            from corda_tpu.ops.txid import prime_ids
-
-            prime_ids([r[0] for r in requests])
+        if pending_ids is None:
+            pending_ids = self.dispatch_ids(requests)
+        if pending_ids is not None:
+            pending_ids.collect()
         return dispatch_transactions(
             [r[0] for r in requests],
             [{self.identity.owning_key}] * len(requests),
@@ -216,16 +226,25 @@ class BatchedNotaryService(NotaryService):
         """
         from collections import deque
 
+        priming: deque = deque()     # (batch, pending id sweep)
         verifying: deque = deque()   # (batch, pending sig-check)
         signing: deque = deque()     # (results, live idxs, ids, pending sigs)
         out: list = []
         for batch in batches:
-            verifying.append((batch, self.dispatch_batch(batch)))
+            # stage 0: enqueue the id sweep — its readback happens a
+            # depth later, overlapped with other batches' device time
+            priming.append((batch, self.dispatch_ids(batch)))
+            if len(priming) >= depth:
+                b, ids = priming.popleft()
+                verifying.append((b, self.dispatch_batch(b, ids)))
             if len(verifying) >= depth:
                 b, pending = verifying.popleft()
                 signing.append(self.settle_commit(b, pending))
             if len(signing) >= depth:
                 out.append(self.finalize_batch(*signing.popleft()))
+        while priming:
+            b, ids = priming.popleft()
+            verifying.append((b, self.dispatch_batch(b, ids)))
         while verifying:
             b, pending = verifying.popleft()
             signing.append(self.settle_commit(b, pending))

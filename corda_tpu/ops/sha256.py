@@ -210,7 +210,14 @@ def sha256_batch(messages: list[bytes]) -> list[bytes]:
     and are sliced off."""
     if not messages:
         return []
+    return digest_words_to_bytes(np.asarray(sha256_batch_words(messages)))
+
+
+def sha256_batch_words(messages: list[bytes]) -> jax.Array:
+    """Like ``sha256_batch`` but returns the (N, 8) uint32 digest words ON
+    DEVICE with no readback — for consumers that feed the digests straight
+    into further device hashing (the Merkle id sweep), where a bytes
+    round trip would cost a full interconnect round trip and re-upload."""
     padded, nblocks = bucket_batch(messages, 64)
     blocks, counts = pad_sha256(padded, nblocks=nblocks)
-    out = digest_words_to_bytes(np.asarray(sha256_blocks(blocks, counts)))
-    return out[: len(messages)]
+    return sha256_blocks(blocks, counts)[: len(messages)]

@@ -28,9 +28,9 @@ import numpy as np
 from corda_tpu.crypto import SecureHash, ZERO_HASH
 
 from .sha256 import (
-    bytes_to_digest_words,
     digest_words_to_bytes,
     sha256_batch,
+    sha256_batch_words,
     sha256_pair,
 )
 
@@ -44,26 +44,30 @@ def _pow2(n: int) -> int:
     return b
 
 
-def _merkle_levels(
-    trees: list[list[int]], pool: np.ndarray
-) -> tuple[list[int], np.ndarray]:
+def _merkle_levels(trees: list[list[int]], pool) -> tuple[list[int], "object"]:
     """Reduce many Merkle trees together, one device dispatch per LEVEL.
 
     ``trees``: per tree, the indices (into ``pool``, an (N, 8) uint32 word
     array) of its pow2-padded leaf row. Returns ``(root_indices,
     grown_pool)`` — interior-node digests append to the pool, so callers
-    MUST index roots into the returned pool, not the argument."""
+    MUST index roots into the returned pool, not the argument.
+
+    DEVICE-RESIDENT: the level structure is static host bookkeeping, so
+    every level's gather + pair-hash chains on device with NO intermediate
+    readback — the returned pool is a device array, and callers pay ONE
+    readback for the final ids. (The per-level ``np.asarray`` this
+    replaces cost a full interconnect round trip per level — ~10 per
+    cohort — which would have dominated the notary's id sweep over the
+    ~100 ms-latency tunneled link.)"""
     import jax.numpy as jnp
 
     trees = [list(t) for t in trees]
-    pool_list = [pool]
-
-    def flat_pool():
-        return np.concatenate(pool_list, axis=0)
+    pool_list = [jnp.asarray(pool)]
+    total = int(pool_list[0].shape[0])
 
     while any(len(t) > 1 for t in trees):
         left_idx, right_idx = [], []
-        base = sum(p.shape[0] for p in pool_list)
+        base = total
         for t in trees:
             if len(t) == 1:
                 continue
@@ -73,25 +77,42 @@ def _merkle_levels(
                 right_idx.append(t[i + 1])
                 new_t.append(base + len(left_idx) - 1)
             t[:] = new_t
-        cat = flat_pool()
-        out = np.asarray(
-            sha256_pair(
-                jnp.asarray(cat[np.asarray(left_idx)]),
-                jnp.asarray(cat[np.asarray(right_idx)]),
-            )
+        cat = jnp.concatenate(pool_list, axis=0)
+        out = sha256_pair(
+            jnp.take(cat, jnp.asarray(np.array(left_idx)), axis=0),
+            jnp.take(cat, jnp.asarray(np.array(right_idx)), axis=0),
         )
         pool_list.append(out)
-    final = flat_pool()
-    return [t[0] for t in trees], final
+        total += out.shape[0]
+    return [t[0] for t in trees], jnp.concatenate(pool_list, axis=0)
+
+
+def _fetch_ids(pool, roots) -> list[SecureHash]:
+    """The ONE readback: gather the root digests from the device pool."""
+    import jax.numpy as jnp
+
+    id_words = np.asarray(
+        jnp.take(pool, jnp.asarray(np.array(roots)), axis=0)
+    )
+    return [SecureHash(b) for b in digest_words_to_bytes(id_words)]
 
 
 def compute_tx_ids(wtxs: list) -> list[SecureHash]:
     """Recompute every transaction's Merkle id with batched device hashing.
     Returns ids in input order; bit-identical to ``WireTransaction.id``."""
-    from corda_tpu.ledger.wire import ComponentGroupType
-
     if not wtxs:
         return []
+    top_roots, pool = _tx_id_roots(wtxs)
+    return _fetch_ids(pool, top_roots)
+
+
+def _tx_id_roots(wtxs: list):
+    """Enqueue the id computation; returns (root_indices, device pool).
+    One host round trip remains inside (the nonce digests, needed to
+    assemble the variable-length leaf messages); everything after the
+    leaves chains on device, and callers pay the single digest readback
+    via ``_fetch_ids`` when they need the ids."""
+    from corda_tpu.ledger.wire import ComponentGroupType
 
     # ---- flatten: every (tx, group, index) component across the cohort
     nonce_msgs: list[bytes] = []
@@ -114,19 +135,23 @@ def compute_tx_ids(wtxs: list) -> list[SecureHash]:
             cursor += len(raws)
         spans.append(tx_spans)
 
-    # ---- stage 1+2: nonces, then leaves = sha256(nonce ‖ component)
+    import jax.numpy as jnp
+
+    # ---- stage 1+2: nonces, then leaves = sha256(nonce ‖ component).
+    # The nonce readback is inherent (leaf messages are host-assembled
+    # variable-length concatenations); the LEAF digests stay on device —
+    # they only feed the Merkle reduction.
     nonces = sha256_batch(nonce_msgs)
-    leaves = sha256_batch(
-        [n + c for n, c in zip(nonces, comp_bytes)]
+    leaf_words = (
+        sha256_batch_words([n + c for n, c in zip(nonces, comp_bytes)])
+        if nonces
+        else jnp.zeros((0, 8), jnp.uint32)
     )
 
     # ---- stage 3: all group trees reduce level-by-level together
-    leaf_words = (
-        bytes_to_digest_words(leaves)
-        if leaves
-        else np.zeros((0, 8), np.uint32)
+    pool = jnp.concatenate(
+        [leaf_words, jnp.asarray(_ZERO_WORDS[None, :])], axis=0
     )
-    pool = np.concatenate([leaf_words, _ZERO_WORDS[None, :]], axis=0)
     zero_idx = pool.shape[0] - 1
     trees: list[list[int]] = []
     tree_of: list[list[int | None]] = []  # per tx: group -> tree index|None
@@ -152,16 +177,36 @@ def compute_tx_ids(wtxs: list) -> list[SecureHash]:
         ]
         row += [zero_idx] * (_pow2(len(row)) - len(row))
         top_trees.append(row)
-    top_roots, pool = _merkle_levels(top_trees, pool)
-
-    id_bytes = digest_words_to_bytes(pool[np.asarray(top_roots)])
-    return [SecureHash(b) for b in id_bytes]
+    return _merkle_levels(top_trees, pool)
 
 
-def prime_ids(stxs: list) -> None:
-    """Device-recompute and prime the Merkle id of every SignedTransaction
-    whose wire tx has a cold id cache — one batched hashing sweep instead of
-    per-tx host hashlib.
+class PendingIds:
+    """An ENQUEUED id sweep: the Merkle reduction is chained on device;
+    ``collect()`` pays the one readback and primes the wire-tx id caches.
+    Splitting dispatch from collect lets a pipelined caller (the notary
+    stream) overlap this batch's interconnect round trip with other
+    batches' host work."""
+
+    __slots__ = ("_cold", "_pool", "_roots")
+
+    def __init__(self, cold, pool, roots):
+        self._cold = cold
+        self._pool = pool
+        self._roots = roots
+
+    def collect(self) -> None:
+        if not self._cold:
+            return
+        for stx, computed in zip(
+            self._cold, _fetch_ids(self._pool, self._roots)
+        ):
+            object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+        self._cold = []
+
+
+def dispatch_prime_ids(stxs: list) -> PendingIds:
+    """Enqueue the device id sweep for every SignedTransaction whose wire
+    tx has a cold id cache; ``collect()`` primes the caches.
 
     This is the notary's receive-path integrity work (reference:
     WireTransaction.kt:139-195 — the id IS the Merkle root over the
@@ -174,10 +219,14 @@ def prime_ids(stxs: list) -> None:
         if "_id" not in object.__getattribute__(stx.tx, "__dict__")
     ]
     if not cold:
-        return
-    ids = compute_tx_ids([stx.tx for stx in cold])
-    for stx, computed in zip(cold, ids):
-        object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+        return PendingIds([], None, [])
+    roots, pool = _tx_id_roots([stx.tx for stx in cold])
+    return PendingIds(cold, pool, roots)
+
+
+def prime_ids(stxs: list) -> None:
+    """Synchronous wrapper: enqueue + collect in one call."""
+    dispatch_prime_ids(stxs).collect()
 
 
 def check_and_prime_ids(stxs: dict) -> None:
