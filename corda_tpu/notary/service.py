@@ -457,31 +457,56 @@ class BatchedNotaryService(NotaryService):
         )
         committer.start()
         finalizer.start()
+        def take_window():
+            # cap every flush at max_batch: an uncapped drain under burst
+            # load would exceed the pinned kernel bucket and stall this
+            # thread behind a fresh compile
+            with self._lock:
+                batch = self._pending[: self._max_batch]
+                self._pending = self._pending[self._max_batch :]
+                return batch, self._stopped
+
         try:
             while True:
                 self._wake.wait(timeout=self._window_s)
                 self._wake.clear()
+                # one-window-ahead id overlap: enqueue window k+1's id
+                # sweep BEFORE window k's (blocking) sweep collect inside
+                # dispatch_batch, so the interconnect round trip of each
+                # sweep runs under the previous window's dispatch
+                ahead = None  # (batch, requests, pending id sweep)
                 while True:
-                    # cap every flush at max_batch: an uncapped drain under
-                    # burst load would exceed the pinned kernel bucket and
-                    # stall this thread behind a fresh compile
-                    with self._lock:
-                        batch = self._pending[: self._max_batch]
-                        self._pending = self._pending[self._max_batch :]
-                        stopped = self._stopped
-                    if not batch:
+                    batch, stopped = take_window()
+                    if batch:
+                        reqs = [
+                            (r.stx, r.resolve_state, r.caller) for r in batch
+                        ]
+                        try:
+                            nxt = (batch, reqs, self.dispatch_ids(reqs))
+                        except Exception as e:
+                            for req in batch:
+                                try:
+                                    req.future.set_exception(e)
+                                except Exception:
+                                    pass
+                            nxt = None
+                    else:
+                        nxt = None
+                    if ahead is not None:
+                        a_batch, a_reqs, a_ids = ahead
+                        try:
+                            commit_q.put(
+                                (a_batch, self.dispatch_batch(a_reqs, a_ids))
+                            )
+                        except Exception as e:
+                            for req in a_batch:
+                                try:
+                                    req.future.set_exception(e)
+                                except Exception:
+                                    pass
+                    ahead = nxt
+                    if ahead is None:
                         break
-                    try:
-                        pending = self.dispatch_batch(
-                            [(r.stx, r.resolve_state, r.caller) for r in batch]
-                        )
-                        commit_q.put((batch, pending))
-                    except Exception as e:
-                        for req in batch:
-                            try:
-                                req.future.set_exception(e)
-                            except Exception:
-                                pass
                 if stopped:
                     return
         finally:

@@ -62,12 +62,11 @@ def _merkle_levels(trees: list[list[int]], pool) -> tuple[list[int], "object"]:
     import jax.numpy as jnp
 
     trees = [list(t) for t in trees]
-    pool_list = [jnp.asarray(pool)]
-    total = int(pool_list[0].shape[0])
+    cat = jnp.asarray(pool)
 
     while any(len(t) > 1 for t in trees):
         left_idx, right_idx = [], []
-        base = total
+        base = int(cat.shape[0])
         for t in trees:
             if len(t) == 1:
                 continue
@@ -77,14 +76,12 @@ def _merkle_levels(trees: list[list[int]], pool) -> tuple[list[int], "object"]:
                 right_idx.append(t[i + 1])
                 new_t.append(base + len(left_idx) - 1)
             t[:] = new_t
-        cat = jnp.concatenate(pool_list, axis=0)
         out = sha256_pair(
             jnp.take(cat, jnp.asarray(np.array(left_idx)), axis=0),
             jnp.take(cat, jnp.asarray(np.array(right_idx)), axis=0),
         )
-        pool_list.append(out)
-        total += out.shape[0]
-    return [t[0] for t in trees], jnp.concatenate(pool_list, axis=0)
+        cat = jnp.concatenate([cat, out], axis=0)
+    return [t[0] for t in trees], cat
 
 
 def _fetch_ids(pool, roots) -> list[SecureHash]:
@@ -181,26 +178,26 @@ def _tx_id_roots(wtxs: list):
 
 
 class PendingIds:
-    """An ENQUEUED id sweep: the Merkle reduction is chained on device;
+    """An ENQUEUED id sweep: the Merkle reduction AND the root gather are
+    chained on device (only the compact (n, 8) digest rows stay live —
+    the full leaf/interior pool frees as soon as it computes);
     ``collect()`` pays the one readback and primes the wire-tx id caches.
     Splitting dispatch from collect lets a pipelined caller (the notary
     stream) overlap this batch's interconnect round trip with other
     batches' host work."""
 
-    __slots__ = ("_cold", "_pool", "_roots")
+    __slots__ = ("_cold", "_id_words")
 
-    def __init__(self, cold, pool, roots):
+    def __init__(self, cold, id_words):
         self._cold = cold
-        self._pool = pool
-        self._roots = roots
+        self._id_words = id_words
 
     def collect(self) -> None:
         if not self._cold:
             return
-        for stx, computed in zip(
-            self._cold, _fetch_ids(self._pool, self._roots)
-        ):
-            object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+        id_bytes = digest_words_to_bytes(np.asarray(self._id_words))
+        for stx, raw in zip(self._cold, id_bytes):
+            object.__getattribute__(stx.tx, "__dict__")["_id"] = SecureHash(raw)
         self._cold = []
 
 
@@ -214,14 +211,17 @@ def dispatch_prime_ids(stxs: list) -> PendingIds:
     the id each signature is checked against is recomputed from the
     component bytes here, and the signature batch then fails any lane whose
     signer signed a different root."""
+    import jax.numpy as jnp
+
     cold = [
         stx for stx in stxs
         if "_id" not in object.__getattribute__(stx.tx, "__dict__")
     ]
     if not cold:
-        return PendingIds([], None, [])
+        return PendingIds([], None)
     roots, pool = _tx_id_roots([stx.tx for stx in cold])
-    return PendingIds(cold, pool, roots)
+    id_words = jnp.take(pool, jnp.asarray(np.array(roots)), axis=0)
+    return PendingIds(cold, id_words)
 
 
 def prime_ids(stxs: list) -> None:

@@ -1,0 +1,133 @@
+"""DemoBench: spawn and drive a local node ensemble interactively.
+
+Capability parity with the reference's DemoBench desktop app
+(tools/demobench/.../DemoBench.kt — spawn local nodes with attached
+terminals, add nodes on demand, tear everything down on exit). The TPU
+build's equivalent is terminal-native: an ensemble manager over the
+process driver (`testing/driver.py`) with an interactive console —
+``add`` spawns another node, ``shell <node>`` attaches the interactive
+shell over RPC, ``explorer <node>`` serves the browser explorer.
+
+    python -m corda_tpu.tools.demobench            # notary + 2 banks
+    python -m corda_tpu.tools.demobench --secure   # authenticated fabric
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+class DemoBench:
+    """Programmatic ensemble manager (the DemoBench window, sans window)."""
+
+    def __init__(self, base_dir: str | None = None, secure: bool = False):
+        from corda_tpu.testing.driver import DriverDSL
+
+        import tempfile
+
+        self._dsl = DriverDSL(
+            base_dir or tempfile.mkdtemp(prefix="corda-tpu-demobench-"),
+            secure=secure,
+        )
+        self._explorers: list = []
+
+    # ------------------------------------------------------------- nodes
+    @property
+    def nodes(self):
+        return list(self._dsl.nodes)
+
+    def add_notary(self, name: str = "O=Notary,L=Zurich,C=CH"):
+        return self._dsl.start_node(name, notary=True)
+
+    def add_node(self, name: str):
+        return self._dsl.start_node(name)
+
+    def rpc(self, node):
+        return self._dsl.rpc(node)
+
+    def shell(self, node, out=sys.stdout):
+        """An InteractiveShell attached to the node over RPC (the
+        reference's per-node terminal pane)."""
+        from corda_tpu.tools.shell import InteractiveShell
+
+        return InteractiveShell(self.rpc(node).proxy, out=out)
+
+    def explorer(self, node):
+        """Serve the browser explorer for one node; returns the server."""
+        from corda_tpu.tools.explorer import ExplorerServer
+
+        server = ExplorerServer(self.rpc(node).proxy).start()
+        self._explorers.append(server)
+        return server
+
+    def shutdown(self) -> None:
+        for ex in self._explorers:
+            try:
+                ex.stop()
+            except Exception:
+                pass
+        self._dsl.shutdown()
+
+    def __enter__(self) -> "DemoBench":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="corda-tpu-demobench")
+    ap.add_argument("--secure", action="store_true",
+                    help="run the ensemble over the authenticated fabric")
+    ap.add_argument("--banks", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    with DemoBench(secure=args.secure) as bench:
+        print("starting notary…")
+        bench.add_notary()
+        handles = []
+        for i in range(args.banks):
+            name = f"O=Bank {chr(65 + i)},L=London,C=GB"
+            print(f"starting {name}…")
+            handles.append(bench.add_node(name))
+        print("\nensemble up:")
+        for h in bench.nodes:
+            print(f"  {h.name}  (pid {h.process.pid})")
+        print(
+            "\ncommands: nodes | shell <n> | explorer <n> | add <X500> | quit"
+        )
+        while True:
+            try:
+                line = input("demobench> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not line:
+                continue
+            cmd, _, rest = line.partition(" ")
+            if cmd in ("quit", "exit"):
+                break
+            elif cmd == "nodes":
+                for i, h in enumerate(bench.nodes):
+                    state = "up" if h.alive else "DOWN"
+                    print(f"  [{i}] {h.name}  {state}")
+            elif cmd == "add" and rest:
+                bench.add_node(rest)
+                print("started")
+            elif cmd == "shell" and rest.isdigit():
+                shell = bench.shell(bench.nodes[int(rest)])
+                print("attached — 'quit' returns to demobench")
+                shell.repl()
+            elif cmd == "explorer" and rest.isdigit():
+                server = bench.explorer(bench.nodes[int(rest)])
+                print(f"explorer at http://127.0.0.1:{server.port}/")
+            else:
+                print("commands: nodes | shell <n> | explorer <n> "
+                      "| add <X500> | quit")
+        print("shutting down…")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,210 @@
+"""Node Explorer: a browser-based ledger/network observability UI.
+
+Capability parity with the reference's JavaFX Explorer
+(tools/explorer/.../Main.kt + ExplorerSimulation.kt — a GUI over the RPC
+feeds showing the vault, transactions, network map and state machines).
+The TPU build has no desktop toolkit; the same observability ships as a
+self-contained single-page app (vanilla JS, auto-refreshing) served by a
+small HTTP façade over ``CordaRPCOps`` — the identical data the JavaFX
+client binds via client/jfx, rendered in any browser.
+
+    python -m corda_tpu.tools.explorer --config node.conf   # standalone
+    ExplorerServer(ops).start()                             # embedded
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import urlparse
+
+from .webserver import _jsonable
+
+_PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"><title>corda_tpu explorer</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f4f6f8;color:#1c2733}
+ header{background:#1c2733;color:#fff;padding:10px 20px;display:flex;
+        justify-content:space-between;align-items:baseline}
+ header h1{font-size:18px;margin:0} header span{font-size:12px;opacity:.8}
+ main{display:grid;grid-template-columns:1fr 1fr;gap:14px;padding:14px}
+ section{background:#fff;border-radius:6px;box-shadow:0 1px 3px rgba(0,0,0,.12);
+         padding:12px;overflow:auto;max-height:44vh}
+ h2{font-size:14px;margin:0 0 8px;color:#44546a}
+ table{border-collapse:collapse;width:100%;font-size:12px}
+ td,th{border-bottom:1px solid #e3e8ee;padding:4px 6px;text-align:left;
+       font-family:ui-monospace,monospace;word-break:break-all}
+ th{color:#7a8aa0;font-weight:600}
+ .pill{display:inline-block;background:#e8f0fe;border-radius:8px;
+       padding:1px 8px;font-size:11px}
+</style></head><body>
+<header><h1>corda_tpu explorer</h1><span id="who"></span></header>
+<main>
+ <section><h2>Network map</h2><table id="peers"></table></section>
+ <section><h2>Notaries</h2><table id="notaries"></table></section>
+ <section><h2>Vault (unconsumed states)</h2><table id="vault"></table></section>
+ <section><h2>State machines (in flight)</h2><table id="flows"></table></section>
+ <section style="grid-column:1/3"><h2>Registered flows</h2>
+   <div id="regflows"></div></section>
+</main>
+<script>
+async function j(u){const r=await fetch(u);return r.json()}
+function rows(el, header, data, f){
+  const t=document.getElementById(el);
+  t.innerHTML='<tr>'+header.map(h=>`<th>${h}</th>`).join('')+'</tr>'+
+    data.map(d=>'<tr>'+f(d).map(c=>`<td>${c}</td>`).join('')+'</tr>').join('');
+}
+async function refresh(){
+  try{
+    const s=await j('/api/status');
+    document.getElementById('who').textContent=
+      `${s.identity} — ${new Date(s.time*1000).toISOString()}`;
+    const peers=await j('/api/peers');
+    rows('peers',['legal name','addresses'],peers,
+         p=>[p.legal_name,p.addresses.join(', ')]);
+    const nots=await j('/api/notaries');
+    rows('notaries',['notary'],nots,n=>[n]);
+    const v=await j('/api/vault');
+    rows('vault',['ref','contract state'],v.states,
+         s=>[s.ref,JSON.stringify(s.state).slice(0,300)]);
+    const f=await j('/api/flows');
+    rows('flows',['flow id'],f.map(x=>[x]),x=>x);
+    document.getElementById('regflows').innerHTML=
+      (await j('/api/registered-flows'))
+        .map(n=>`<span class="pill">${n}</span> `).join('');
+  }catch(e){console.error(e)}
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+class ExplorerServer:
+    """Serves the explorer page + its JSON feeds over a CordaRPCOps-shaped
+    object (local or an RPC connection proxy)."""
+
+    def __init__(self, ops, host: str = "127.0.0.1", port: int = 0):
+        self._ops = ops
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply_json(self, payload) -> None:
+                body = json.dumps(_jsonable(payload)).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_html(self, page: str) -> None:
+                body = page.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                try:
+                    outer._get(self)
+                except Exception as e:
+                    try:
+                        self._reply_json(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        )
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------ routes
+    def _get(self, req) -> None:
+        path = urlparse(req.path).path.rstrip("/") or "/"
+        ops = self._ops
+        if path == "/":
+            req._reply_html(_PAGE)
+        elif path == "/api/status":
+            info = ops.node_info()
+            req._reply_json({
+                "identity": str(info.legal_identity.name),
+                "time": ops.current_node_time(),
+            })
+        elif path == "/api/peers":
+            req._reply_json([
+                {
+                    "legal_name": str(i.legal_identity.name),
+                    "addresses": list(i.addresses),
+                }
+                for i in ops.network_map_snapshot()
+            ])
+        elif path == "/api/notaries":
+            req._reply_json([str(p.name) for p in ops.notary_identities()])
+        elif path == "/api/vault":
+            page = ops.vault_query_by()
+            req._reply_json({
+                "total": page.total_states_available,
+                "states": [
+                    {"ref": str(sr.ref), "state": sr.state.data}
+                    for sr in page.states
+                ],
+            })
+        elif path == "/api/flows":
+            req._reply_json(ops.state_machines_snapshot())
+        elif path == "/api/registered-flows":
+            req._reply_json(ops.registered_flows())
+        else:
+            req._reply_json({"error": f"unknown path {path!r}"})
+
+    # --------------------------------------------------------- lifecycle
+    def start(self) -> "ExplorerServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="explorer"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from corda_tpu.messaging import BrokerMessagingClient, DurableQueueBroker
+    from corda_tpu.rpc import CordaRPCClient
+
+    ap = argparse.ArgumentParser(prog="corda-tpu-explorer")
+    ap.add_argument("--broker", default="broker.db",
+                    help="shared broker file of the node ensemble")
+    ap.add_argument("--node", required=True,
+                    help="X.500 name of the node to explore")
+    ap.add_argument("--username", default="explorer")
+    ap.add_argument("--password", default="explorer")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    broker = DurableQueueBroker(args.broker)
+    endpoint = BrokerMessagingClient(broker, "explorer-ui")
+    conn = CordaRPCClient(endpoint, args.node).start(
+        args.username, args.password
+    )
+    server = ExplorerServer(conn.proxy, port=args.port).start()
+    print(f"explorer serving http://127.0.0.1:{server.port}/")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
