@@ -27,7 +27,8 @@ from .contracts import CASH_PROGRAM_ID, CashState, Exit, Issue, Move
 
 
 def select_cash(
-    flow: FlowLogic, currency: str, quantity: int, *, attempts: int = 10,
+    flow: FlowLogic, currency: str, quantity: int, *,
+    retry_window_s: float = 15.0,
 ) -> list:
     """Currency-level coin selection over the vault: unconsumed, UNLOCKED
     CashStates of any issuer in ``currency``, smallest-first, soft-locked
@@ -35,25 +36,33 @@ def select_cash(
     CashSelectionH2Impl.unconsumedCashStatesForSpending).
 
     Query→pick→reserve races with concurrent spends are RETRIED with a
-    fresh query, like the reference's selection loop (attemptSpend retries
-    on lock contention) — only exhausted retries surface as a failure."""
+    fresh query for up to ``retry_window_s`` (reference: the selection's
+    retry/sleep loop). The window is TIME-based, not attempt-counted:
+    rival flows legitimately hold their locks from selection to finality,
+    which can span seconds under load — the loser must outwait a trade,
+    not a scheduler blip."""
     import random as _random
     import time as _time
 
-    last_conflict = None
-    for attempt in range(attempts):
+    deadline = _time.monotonic() + retry_window_s
+    attempt = 0
+    while True:
         try:
             return _select_cash_once(flow, currency, quantity)
         except SoftLockError as e:
             # lost a race between query and reserve: another flow locked
-            # one of our picks — back off briefly and re-query (the loser
-            # sees the winner's locks excluded next round)
-            last_conflict = e
-            _time.sleep(0.005 * (attempt + 1) * (1 + _random.random()))
-    raise FlowException(
-        f"cash selection conflict persisted after {attempts} attempts: "
-        f"{last_conflict}"
-    ) from last_conflict
+            # one of our picks — back off and re-query (the loser sees the
+            # winner's locks excluded, and its change states appear once
+            # the winning trade completes)
+            if _time.monotonic() >= deadline:
+                raise FlowException(
+                    f"cash selection conflict persisted for "
+                    f"{retry_window_s:.0f}s: {e}"
+                ) from e
+            attempt += 1
+            _time.sleep(
+                min(0.5, 0.01 * attempt) * (1 + _random.random())
+            )
 
 
 def _select_cash_once(flow: FlowLogic, currency: str, quantity: int) -> list:
