@@ -521,6 +521,53 @@ def bench_notary_raft_cluster(moves, resolve, notary_id) -> tuple[float, float]:
     return statistics.median(rates), max(rates)
 
 
+def bench_notary_bft_cluster(moves, resolve, notary_id) -> tuple[float, float]:
+    """The BFT flavor of config #5: the batched device notary committing
+    each window as ONE total-order slot through a 4-replica (f=1) PBFT
+    cluster (notary/bft.py commit_batch) → (median, best) tx/sec."""
+    from corda_tpu.messaging import InMemoryMessagingNetwork
+    from corda_tpu.notary import BatchedNotaryService, BFTUniquenessProvider
+
+    chunks = [
+        [(stx, resolve, "bench") for stx in moves[i : i + NOTARY_CHUNK]]
+        for i in range(0, len(moves), NOTARY_CHUNK)
+    ]
+
+    def run_round(tag: str, chunk_list):
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix=f"{tag}-replica"
+            )
+            provider = make_client(f"{tag}-client")
+            svc = BatchedNotaryService(
+                notary_id[0], notary_id[1], provider,
+                use_device=True, validating=True,
+                max_batch=NOTARY_CHUNK, window_s=0.005,
+            )
+            _clear_id_caches(moves)
+            t0 = time.perf_counter()
+            results = svc.process_stream(chunk_list, depth=3)
+            dt = time.perf_counter() - t0
+            n_ok = sum(
+                1 for batch in results for r in batch
+                if not isinstance(r, Exception)
+            )
+            n = sum(len(c) for c in chunk_list)
+            assert n_ok == n, f"only {n_ok}/{n} notarised via bft"
+            svc.shutdown()
+            for r in replicas:
+                r.stop()
+            return n / dt
+        finally:
+            net.stop_pumping()
+
+    run_round("warm", chunks[:2])
+    rates = [run_round(f"run{i}", chunks) for i in range(3)]
+    return statistics.median(rates), max(rates)
+
+
 def make_back_chain(hops: int):
     """A 1k-hop Cash back-chain (BASELINE config #4: ResolveTransactionsFlow
     deep-chain shape — issue, then `hops` sequential self-moves)."""
@@ -864,6 +911,14 @@ def main() -> int:
     if raft:
         p.data["notary_raft_cluster_tx_per_sec"] = round(raft[0], 1)
         p.data["notary_raft_cluster_best_tx_per_sec"] = round(raft[1], 1)
+
+    bft = p.run(
+        "notary_bft_cluster",
+        lambda: bench_notary_bft_cluster(moves, resolve, notary_id),
+    )
+    if bft:
+        p.data["notary_bft_cluster_tx_per_sec"] = round(bft[0], 1)
+        p.data["notary_bft_cluster_best_tx_per_sec"] = round(bft[1], 1)
 
     trader_dev = p.run(
         "device_trader", lambda: bench_trader_demo(device=True)
