@@ -166,3 +166,20 @@ class TestWebServer:
             assert e.value.code == 404
         finally:
             server.stop()
+
+
+class TestGraphs:
+    """tools/graphs parity (reference: gradle dependency-graph scripts):
+    the package dependency graph extracts, renders, and layer-checks."""
+
+    def test_edges_dot_and_layering(self):
+        from corda_tpu.tools.graphs import (
+            layering_violations, package_edges, to_dot,
+        )
+
+        edges = package_edges()
+        assert "notary" in edges and "crypto" in edges["notary"]
+        dot = to_dot(edges)
+        assert dot.startswith("digraph") and '"notary" -> "crypto"' in dot
+        # the architecture holds: no module-level import points UP the map
+        assert layering_violations(edges) == []
