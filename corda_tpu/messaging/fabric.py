@@ -44,6 +44,7 @@ class SecureFabricClient:
         self, address: tuple | str,
         identity: PartyAndCertificate, identity_private: PrivateKey,
         trust_root: PublicKey, timeout_s: float = 10.0,
+        reconnect_attempts: int = 5, reconnect_backoff_s: float = 0.2,
     ):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
@@ -53,6 +54,12 @@ class SecureFabricClient:
         self._private = identity_private
         self._trust_root = trust_root
         self._timeout_s = timeout_s
+        # reconnect policy (the Artemis bridge retry role — reference:
+        # bridge retry config, NodeConfiguration.kt:57-61): a dropped
+        # connection re-handshakes with exponential backoff before the
+        # failure surfaces to callers
+        self._reconnect_attempts = reconnect_attempts
+        self._reconnect_backoff_s = reconnect_backoff_s
         self._closed = False
         self._lock = threading.Lock()
         self._control = self._connect()
@@ -60,6 +67,7 @@ class SecureFabricClient:
         # object lets dead threads' channels be pruned (and guards against
         # a reused thread id silently sharing a predecessor's channel)
         self._consumers: dict[int, tuple] = {}
+        self._consume_fails = threading.local()
 
     def _connect(self) -> SecureBrokerConnection:
         return SecureBrokerConnection(
@@ -112,27 +120,131 @@ class SecureFabricClient:
                 raise QueueClosedError(str(e)) from None
             raise
 
+    def _reconnect_control(self, failed, attempt: int) -> bool:
+        """Replace ``failed`` as the control channel; True when a usable
+        control channel exists afterwards. Only the thread whose
+        connection actually failed performs the swap — a concurrent
+        failure on an ALREADY-replaced connection must not churn through
+        (and close) the healthy replacement under other threads."""
+        import time
+
+        time.sleep(self._reconnect_backoff_s * (2 ** attempt))
+        with self._lock:
+            if self._closed:
+                return False
+            if self._control is not failed:
+                return True  # another thread already swapped it
+        try:
+            fresh = self._connect()
+        except Exception:
+            return False
+        with self._lock:
+            if self._closed:
+                fresh.close()
+                return False
+            if self._control is failed:
+                self._control = fresh
+            else:
+                fresh.close()  # lost the swap race; theirs is healthy
+        try:
+            failed.close()
+        except Exception:
+            pass
+        logger.info("fabric control channel reconnected to %s", self._address)
+        return True
+
+    def _control_op(self, fn, settled_ok: bool = False):
+        """Run a control-channel op, re-handshaking on a dropped
+        connection. Retrying is duplicate-safe only because callers make
+        it so: ``publish`` pins a client-generated msg id (broker
+        dedupes), and for ack/nack ``settled_ok`` treats a NotAuthorized
+        reply AFTER a reconnect as success — the drop lost either the
+        reply to a settle that landed, or the delivered-set entry (the
+        message redelivers; at-least-once either way)."""
+        last: Exception | None = None
+        reconnected = False
+        for attempt in range(self._reconnect_attempts + 1):
+            with self._lock:
+                if self._closed:
+                    raise QueueClosedError("fabric client closed")
+                conn = self._control
+            try:
+                return self._map_closed(lambda: fn(conn))
+            except RuntimeError as e:
+                if (settled_ok and reconnected
+                        and "NotAuthorized" in str(e)):
+                    return None
+                raise
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt == self._reconnect_attempts:
+                    break  # no point handshaking with no retry left
+                if not self._reconnect_control(conn, attempt):
+                    break
+                reconnected = True
+        raise last if last is not None else QueueClosedError("fabric closed")
+
     # ------------------------------------------------- broker surface
     def publish(self, queue: str, payload: bytes, *, msg_id: str | None = None,
                 sender: str = "", reply_to: str = "") -> str:
         # ``sender`` is accepted for surface parity but the BROKER stamps
-        # the channel identity — a peer cannot publish as someone else
-        return self._map_closed(lambda: self._control.publish(
+        # the channel identity — a peer cannot publish as someone else.
+        # The msg id is pinned CLIENT-side before the retry loop: a retry
+        # after an ambiguous drop re-publishes under the same id and the
+        # broker's publisher dedupe absorbs the duplicate (a None id would
+        # get a fresh broker id per attempt — undetectable duplication).
+        msg_id = msg_id or Message.fresh_id()
+        return self._control_op(lambda c: c.publish(
             queue, payload, msg_id=msg_id, reply_to=reply_to
         ))
 
     def consume(self, queue: str, timeout: float = 0.0) -> Message | None:
-        conn = self._consumer_conn()
-        return self._map_closed(lambda: conn.consume(queue, timeout=timeout))
+        try:
+            conn = self._consumer_conn()
+            msg = self._map_closed(
+                lambda: conn.consume(queue, timeout=timeout)
+            )
+            self._consume_fails.n = 0
+            return msg
+        except (ConnectionError, OSError):
+            # drop the dead per-thread channel; the NEXT consume from this
+            # thread re-handshakes lazily via _consumer_conn (consumer
+            # loops poll, so one None result is indistinguishable from an
+            # empty queue — the clean retry point). Sleep the poll window
+            # so a down broker costs one connect attempt per poll, not a
+            # busy spin; a REFUSED handshake (HandshakeError) still
+            # propagates — auth failures must not retry silently.
+            # CONSECUTIVE failures are bounded: past the reconnect budget
+            # the error propagates so transport-blind consumer loops
+            # (broker_client, verifier worker) exit instead of polling a
+            # permanently-dead broker forever.
+            import time
+
+            me = threading.current_thread()
+            with self._lock:
+                if self._closed:
+                    raise QueueClosedError("fabric client closed") from None
+                entry = self._consumers.pop(me.ident, None)
+            if entry is not None:
+                try:
+                    entry[1].close()
+                except Exception:
+                    pass
+            fails = getattr(self._consume_fails, "n", 0) + 1
+            self._consume_fails.n = fails
+            if fails > self._reconnect_attempts:
+                raise
+            time.sleep(max(0.05, min(timeout, 0.5)))
+            return None
 
     def ack(self, msg_id: str) -> None:
-        self._map_closed(lambda: self._control.ack(msg_id))
+        self._control_op(lambda c: c.ack(msg_id), settled_ok=True)
 
     def nack(self, msg_id: str) -> None:
-        self._map_closed(lambda: self._control.nack(msg_id))
+        self._control_op(lambda c: c.nack(msg_id), settled_ok=True)
 
     def depth(self, queue: str) -> int:
-        return self._map_closed(lambda: self._control.depth(queue))
+        return self._control_op(lambda c: c.depth(queue))
 
     def close(self) -> None:
         with self._lock:

@@ -396,6 +396,14 @@ class SecureBrokerServer:
 
     def close(self) -> None:
         self._stop.set()
+        # shutdown BEFORE close: close() alone does not wake the accept
+        # thread, whose blocked accept() keeps the open file description —
+        # and thus the PORT — alive, so a restart on the same port would
+        # fail with EADDRINUSE until process exit
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

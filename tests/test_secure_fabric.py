@@ -190,6 +190,68 @@ class TestSecureFabricClient:
             server.close()
             broker.close()
 
+    def test_client_reconnects_after_broker_restart(self):
+        """The Artemis-bridge-retry role: the fabric server drops (restart
+        on the same port), and the client's next operations re-handshake
+        and continue — consumers see one empty poll, publishes retry
+        through the reconnect."""
+        host_ident = issue_identity("O=RHost,L=Zurich,C=CH", generate_keypair())
+        broker = DurableQueueBroker()
+        server = SecureBrokerServer(
+            broker, host_ident.certificate, host_ident.keypair.private,
+            host_ident.trust_root,
+        )
+        port = server.address[1]
+        try:
+            ident, fab = _fabric_client(server.address, "Reconnector")
+            fab.publish("rq", b"before")
+            m = fab.consume("rq", timeout=1.0)
+            assert m.payload == b"before"
+            fab.ack(m.msg_id)
+
+            # restart the server on the SAME port (fresh broker store —
+            # the durable state normally lives in the sqlite file)
+            server.close()
+            broker.close()
+            broker = DurableQueueBroker()
+            server = SecureBrokerServer(
+                broker, host_ident.certificate, host_ident.keypair.private,
+                host_ident.trust_root, port=port,
+            )
+            # control lane: publish re-handshakes and lands
+            fab.publish("rq", b"after")
+            assert broker.depth("rq") == 1
+            # consumer lane: first poll absorbs the dead channel, a later
+            # poll delivers
+            deadline = time.monotonic() + 10
+            got = None
+            while got is None and time.monotonic() < deadline:
+                got = fab.consume("rq", timeout=0.5)
+            assert got is not None and got.payload == b"after"
+            fab.ack(got.msg_id)
+            fab.close()
+        finally:
+            server.close()
+            broker.close()
+
+    def test_consume_gives_up_on_permanently_dead_broker(self):
+        """Reconnect is BOUNDED: past the retry budget the error
+        propagates so consumer loops exit instead of polling a dead
+        address forever."""
+        broker = DurableQueueBroker()
+        _, server = _fabric_server(broker)
+        ident, fab = _fabric_client(server.address, "Bounded")
+        fab._reconnect_attempts = 2
+        server.close()
+        broker.close()
+        polls = 0
+        with pytest.raises((ConnectionError, OSError)):
+            for _ in range(50):
+                polls += 1
+                fab.consume("q", timeout=0.05)
+        assert polls <= 4  # budget + the failing poll, not 50
+        fab.close()
+
     def test_concurrent_consumers_get_own_channels(self):
         import threading
 
