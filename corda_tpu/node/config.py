@@ -94,6 +94,9 @@ class NodeConfiguration:
     # the reference's plugins-directory scan: every module/package in this
     # directory loads as an app at boot (node/cordapp.py CordappLoader)
     cordapp_directory: str | None = None
+    # device-mesh fan-out for signature batches (SURVEY §2.9 P3): None =
+    # auto (on when >1 accelerator device is visible), true/false forces
+    mesh_fan_out: bool | None = None
 
     @property
     def db_path(self) -> str:
@@ -244,6 +247,9 @@ def config_from_dict(d: dict) -> NodeConfiguration:
         verification_batch_max=int(d.get("verificationBatchMax", 1024)),
         cordapp_packages=tuple(d.get("cordappPackages", [])),
         cordapp_directory=d.get("cordappDirectory"),
+        mesh_fan_out=(
+            bool(d["meshFanOut"]) if "meshFanOut" in d else None
+        ),
         verification_window_ms=float(d.get("verificationWindowMs", 5.0)),
         database_path=d.get("databasePath"),
     )

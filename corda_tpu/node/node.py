@@ -62,6 +62,12 @@ class Node:
             self.cordapp_loader.load_package(pkg)
         if config.cordapp_directory:
             self.cordapp_loader.load_directory(config.cordapp_directory)
+        if config.mesh_fan_out is not None:
+            # force the device-mesh fan-out policy (default: auto when
+            # multiple accelerator devices are visible)
+            from corda_tpu.parallel import enable_service_mesh
+
+            enable_service_mesh(config.mesh_fan_out)
         name = CordaX500Name.parse(config.my_legal_name) if isinstance(
             config.my_legal_name, str
         ) else config.my_legal_name

@@ -520,3 +520,26 @@ class TestServiceHub:
         assert snap["flows.started"]["count"] == 1
         assert snap["verify.success"]["count"] == 5
         assert snap["verify.duration"]["count"] == 1
+
+
+class TestMeshConfig:
+    def test_mesh_fan_out_config_forces_policy(self):
+        """meshFanOut config drives the service-mesh routing policy
+        (SURVEY §2.9 P3) like the reference's verifierType knob."""
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node import Node, NodeConfiguration
+        from corda_tpu.parallel import enable_service_mesh, service_mesh_active
+
+        net = InMemoryMessagingNetwork()
+        try:
+            cfg = NodeConfiguration(
+                my_legal_name="O=MeshNode,L=London,C=GB", mesh_fan_out=True
+            )
+            node = Node(cfg, net.create_node("O=MeshNode, L=London, C=GB"))
+            assert service_mesh_active()
+            node.stop()
+        finally:
+            # restore the auto policy for other tests
+            import corda_tpu.parallel.mesh as m
+
+            m._service_mesh_enabled = None
