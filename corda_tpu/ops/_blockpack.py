@@ -25,12 +25,27 @@ def pow2_at_least(n: int, floor: int = 1) -> int:
     return b
 
 
+def pallas_block(env_var: str, default: int = 128) -> int:
+    """Production pallas block width, env-tunable so a block-sweep result
+    (tools_block_sweep.py) applies without a code change."""
+    import os
+
+    try:
+        return int(os.environ.get(env_var, "") or default)
+    except ValueError:
+        return default
+
+
+ED25519_BLOCK = pallas_block("CORDA_TPU_ED25519_BLOCK")
+ECDSA_BLOCK = pallas_block("CORDA_TPU_ECDSA_BLOCK")
+
+
 def bucket_floor(min_bucket: int | None, on_tpu: bool) -> int:
     """Pad-bucket floor for the crypto kernels: caller-pinned ``min_bucket``
     rounded UP to a power of two (services pass their max batch, which need
-    not be one), never below the pallas block width (128) on TPU."""
+    not be one), never below the pallas block width on TPU."""
     if on_tpu:
-        return pow2_at_least(min_bucket or 0, 128)
+        return pow2_at_least(min_bucket or 0, ED25519_BLOCK)
     return pow2_at_least(min_bucket or 0, 8)
 
 

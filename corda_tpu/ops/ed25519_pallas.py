@@ -535,12 +535,15 @@ def verify_pallas_windows(
     sign: jax.Array,       # (B,) int32 pubkey x-parity bit
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
-    block: int = 128,
+    block: int | None = None,
 ) -> jax.Array:
     """Launch the kernel with the challenge already in window form (the
     fused on-device SHA-512→mod-L path lands here)."""
     from jax.experimental import pallas as pl
 
+    from ._blockpack import ED25519_BLOCK
+
+    block = block or ED25519_BLOCK
     b = y_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
@@ -579,7 +582,7 @@ def ed25519_verify_pallas(
     sign: jax.Array,       # (B,) int32 pubkey x-parity bit
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
-    block: int = 128,
+    block: int | None = None,
 ) -> jax.Array:
     return verify_pallas_windows(
         y_bytes, r_bytes, s_bytes, bytes_to_windows_t(h_bytes),

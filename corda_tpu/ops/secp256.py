@@ -574,7 +574,9 @@ def ecdsa_verify_dispatch(
     if n_real == 0:
         return jnp.zeros((0,), dtype=bool)
     on_tpu = jax.default_backend() == "tpu"
-    floor = max(min_bucket or 0, 128 if on_tpu else 8)
+    from ._blockpack import ECDSA_BLOCK
+
+    floor = max(min_bucket or 0, ECDSA_BLOCK if on_tpu else 8)
     b = pow2_at_least(n_real, floor)
     qx, qy, u1b, u2b, ra, rb, rb_ok, pre = _prep_byte_planes(
         curve_name, pubkeys, signatures, messages, b

@@ -458,15 +458,17 @@ def ecdsa_verify_pallas(
     rb_ok: jax.Array,      # (B,) bool second candidate validity
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
-    block: int = 128,
+    block: int | None = None,
 ) -> jax.Array:
     """Launch the windowed ECDSA kernel; device-side prep (transpose +
     window extraction) fuses into this jit so the host ships compact
     uint8 planes — one upload per plane, like the ed25519 path."""
     from jax.experimental import pallas as pl
 
+    from ._blockpack import ECDSA_BLOCK
     from .ed25519_pallas import bytes_to_windows_t
 
+    block = block or ECDSA_BLOCK
     b = qx_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
