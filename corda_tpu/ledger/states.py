@@ -18,7 +18,7 @@ from __future__ import annotations
 import dataclasses
 import functools as _functools
 import uuid as _uuid
-from typing import Any, ClassVar, Protocol, runtime_checkable
+from typing import Any, Protocol, runtime_checkable
 
 from corda_tpu.crypto import PublicKey, SecureHash, sha256
 from corda_tpu.serialization import encode, register_custom

@@ -23,7 +23,6 @@ from corda_tpu.crypto import (
     generate_keypair,
     sign_tx_id,
 )
-from corda_tpu.crypto.keys import PrivateKey
 from corda_tpu.ledger import (
     AnonymousParty,
     CordaX500Name,

@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 
-from corda_tpu.ledger import CordaX500Name, Party, PartyAndCertificate
+from corda_tpu.ledger import CordaX500Name, Party
 from corda_tpu.serialization import deserialize, register_custom, serialize
 
 

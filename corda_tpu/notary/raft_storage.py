@@ -32,7 +32,7 @@ import threading
 from corda_tpu.crypto import SecureHash
 from corda_tpu.ledger import StateRef
 
-from .uniqueness import ConsumedStateDetails, NotaryError, UniquenessConflict
+from .uniqueness import ConsumedStateDetails, UniquenessConflict
 
 
 def _ref_key(ref: StateRef) -> bytes:

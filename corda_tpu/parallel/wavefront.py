@@ -27,7 +27,6 @@ from concurrent.futures import ThreadPoolExecutor
 
 from corda_tpu.crypto import SecureHash
 from corda_tpu.ledger import SignedTransaction, StateRef
-from corda_tpu.ledger.states import TransactionVerificationException
 
 
 class DagVerificationError(Exception):

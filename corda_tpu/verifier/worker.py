@@ -24,7 +24,6 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
-import traceback
 from concurrent.futures import Future
 
 from corda_tpu.ledger import LedgerTransaction, SignedTransaction

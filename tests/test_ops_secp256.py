@@ -4,7 +4,6 @@ the JCA-vector tier of the reference's crypto tests (CryptoUtilsTest.kt)
 for scheme ids 2 and 3. Adversarial cases are the point: high-S twins,
 corrupted r/s/msg, wrong keys, off-curve/garbage pubkeys, r=0."""
 
-import hashlib
 import random
 
 import numpy as np

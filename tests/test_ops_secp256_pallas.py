@@ -24,7 +24,6 @@ mixed-scheme bench; set ``RUN_SLOW_INTERPRET=1`` to run the (hours-slow)
 interpret-mode check of the full pallas_call locally.
 """
 
-import hashlib
 import os
 import random
 

@@ -2,7 +2,6 @@
 the reference's crypto unit tests, core/src/test/.../crypto/)."""
 
 import hashlib
-import os
 import random
 
 import numpy as np

@@ -9,8 +9,6 @@ out-of-process verifier all ride it, and an uncertified peer is refused
 at handshake before touching any queue.
 """
 
-import subprocess
-import sys
 import time
 
 import pytest

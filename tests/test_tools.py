@@ -9,7 +9,6 @@ import urllib.request
 import pytest
 
 from corda_tpu.finance import CashIssueFlow
-from corda_tpu.node.config import RpcUser
 from corda_tpu.rpc import CordaRPCOps
 from corda_tpu.testing import MockNetworkNodes
 from corda_tpu.tools.loadtest import (

@@ -3,13 +3,11 @@ scenarios: single worker verifies, invalid transactions are rejected with
 the error propagated, N workers split the load (competing consumers), and
 un-acked work redistributes when a worker dies mid-request."""
 
-import threading
 import time
 
 import pytest
 
 from corda_tpu.messaging import DurableQueueBroker
-from corda_tpu.serialization import deserialize
 from corda_tpu.testing import GeneratedLedger
 from corda_tpu.verifier.worker import (
     VERIFICATION_REQUESTS_QUEUE,
