@@ -201,6 +201,43 @@ def bytes_to_digest_words(digests: list[bytes]) -> np.ndarray:
     return arr.astype(np.uint32)
 
 
+def sha256_bytes_device(msg: jax.Array) -> jax.Array:
+    """Hash DEVICE-RESIDENT equal-length byte rows: (B, L) uint8 → (B, 8)
+    uint32 digest words, fully on device (padding, word packing, and the
+    compression chain all trace into the caller's program — no host
+    round trip). L is static, so each call-site length compiles once.
+
+    This is the primitive for hash CHAINS whose inputs mix constants with
+    digests produced by earlier device hashes (the SPHINCS+ verification
+    structure): composing via host bytes would cost an interconnect round
+    trip per chain step."""
+    b, length = msg.shape
+    nblocks = (length + 9 + 63) // 64
+    total = nblocks * 64
+    padded = jnp.zeros((b, total), dtype=jnp.uint8)
+    padded = padded.at[:, :length].set(msg)
+    padded = padded.at[:, length].set(0x80)
+    lenb = np.frombuffer((length * 8).to_bytes(8, "big"), np.uint8)
+    padded = padded.at[:, total - 8:].set(jnp.asarray(lenb))
+    w = padded.astype(jnp.uint32)
+    words = (
+        (w[:, 0::4] << 24) | (w[:, 1::4] << 16) | (w[:, 2::4] << 8)
+        | w[:, 3::4]
+    ).reshape(b, nblocks, 16)
+    return sha256_blocks(words)
+
+
+def digest_words_to_device_bytes(digest: jax.Array) -> jax.Array:
+    """(B, 8) uint32 big-endian words → (B, 32) uint8, on device."""
+    d = digest.astype(jnp.uint32)
+    b = d.shape[0]
+    out = jnp.stack(
+        [(d >> 24) & 0xFF, (d >> 16) & 0xFF, (d >> 8) & 0xFF, d & 0xFF],
+        axis=2,
+    )
+    return out.reshape(b, 32).astype(jnp.uint8)
+
+
 def sha256_batch(messages: list[bytes]) -> list[bytes]:
     """Convenience host API: batch-hash arbitrary messages.
 

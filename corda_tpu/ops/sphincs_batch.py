@@ -1,0 +1,265 @@
+"""Batched device verification for the SPHINCS+-shaped scheme (id 5).
+
+The last scheme without a device tier (reference: Crypto.SPHINCS256_SHA256,
+core/.../crypto/Crypto.kt:138 — verified one signature at a time through
+BCPQC). SPHINCS+ verification is PURE HASHING — FORS authentication paths,
+Winternitz chains, XMSS roots — which batches perfectly: every sequential
+step of the structure becomes ONE device SHA-256 dispatch over all lanes
+(and all chains/trees of all lanes at once), with digests staying device-
+resident between steps.
+
+Structure per lane (mirrors crypto/sphincs._verify_inner exactly):
+
+  1. FORS: K=14 leaf hashes + A=8 masked Merkle levels (each level one
+     dispatch over B·K rows; sibling order by the host-known leaf index),
+     then the FORS pk hash over the K roots.
+  2. D=4 hypertree layers: 67 Winternitz chains per lane walk W−1=15
+     masked steps (one dispatch per step over B·67 rows; a row applies the
+     step iff k ≥ its digit — digits are computed ON DEVICE from the
+     previous layer's digest, so layers chain with no host round trip),
+     the WOTS pk compresses the 67 tips, and HT=6 auth-path levels lift it
+     to the subtree root.
+  3. Verdict: final root equals the signature's claimed root, AND the
+     host prechecks (structure, pk binding, index check) pass.
+
+Host prep is one message digest + field slicing per lane; everything else
+is ~100 enqueued kernel steps and ONE readback for the verdict mask.
+Differential tests pin bit-equality against the host implementation,
+including tamper/garbage lanes (tests/test_ops_sphincs_batch.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import struct
+
+import jax.numpy as jnp
+import numpy as np
+
+from corda_tpu.crypto.sphincs import (
+    A,
+    D,
+    FORS_LAYER,
+    H,
+    HT,
+    K,
+    LEN,
+    LEN2,
+    N,
+    SIG_LEN,
+    W,
+    _fors_indices,
+    _msg_digest,
+)
+
+from .sha256 import digest_words_to_device_bytes, sha256_bytes_device
+
+
+def _addr(layer: int, tree: int, leaf: int, j: int) -> bytes:
+    return struct.pack(">IQII", layer, tree, leaf, j)
+
+
+def _u8(arr_bytes: list[bytes]) -> np.ndarray:
+    return np.frombuffer(b"".join(arr_bytes), np.uint8).reshape(
+        len(arr_bytes), -1
+    )
+
+
+def _device_digits(digest_bytes: jnp.ndarray) -> jnp.ndarray:
+    """(B, 32) uint8 digest → (B, LEN) int32 Winternitz digits, ON DEVICE
+    (the digit computation of sphincs._digits: 64 nibbles + 3 checksum
+    nibbles). Device-side because layer l's digits come from layer l−1's
+    device-computed root — a host detour would serialize the layers on
+    interconnect round trips."""
+    hi = (digest_bytes >> 4).astype(jnp.int32)
+    lo = (digest_bytes & 0xF).astype(jnp.int32)
+    digs = jnp.stack([hi, lo], axis=2).reshape(digest_bytes.shape[0], 64)
+    checksum = jnp.sum((W - 1) - digs, axis=1)
+    checks = [
+        ((checksum >> (4 * i)) & 0xF) for i in range(LEN2)
+    ]
+    return jnp.concatenate([digs, jnp.stack(checks, axis=1)], axis=1)
+
+
+def sphincs_verify_batch(
+    pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+) -> np.ndarray:
+    """Batch-verify scheme-5 signatures → (B,) bool (blocking)."""
+    n = len(pubkeys)
+    if n == 0:
+        if len(signatures) or len(messages):
+            raise ValueError("batch length mismatch")
+        return np.zeros(0, dtype=bool)
+    return np.asarray(
+        sphincs_verify_dispatch(pubkeys, signatures, messages)
+    )[:n]
+
+
+def sphincs_verify_dispatch(
+    pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+    min_bucket: int | None = None,
+) -> jnp.ndarray:
+    """Prep + ENQUEUE (async like the other schemes' dispatches): returns
+    the bucket-padded device verdict mask; slice ``[:len(pubkeys)]`` after
+    ``np.asarray``. Pad lanes fail the precheck and compute garbage
+    harmlessly."""
+    from ._blockpack import pow2_at_least
+
+    n_real = len(pubkeys)
+    if not (len(signatures) == len(messages) == n_real):
+        raise ValueError("batch length mismatch")
+    # the floor rounds to a power of two (one compile per bucket) but is
+    # CAPPED at 32: SPHINCS is the cold scheme — a service pinning its
+    # notary-sized min_bucket (e.g. 1024) must not pad a handful of
+    # scheme-5 rows into a thousand lanes of wasted hash chains
+    floor = pow2_at_least(min(min_bucket or 8, 32))
+    n_lanes = pow2_at_least(max(n_real, 1), floor)
+    pad = n_lanes - n_real
+    pubkeys = list(pubkeys) + [b""] * pad
+    signatures = list(signatures) + [b""] * pad
+    messages = list(messages) + [b""] * pad
+
+    # ---------------------------------------------------------- host prep
+    pre = np.zeros(n_lanes, dtype=bool)
+    pub_seeds = [bytes(N)] * n_lanes
+    roots = [bytes(N)] * n_lanes
+    fors_dgs = [bytes(N)] * n_lanes
+    idxs = [0] * n_lanes
+    sigs = [bytes(SIG_LEN)] * n_lanes
+    for i in range(n_lanes):
+        sig = bytes(signatures[i])
+        pk = bytes(pubkeys[i])
+        if len(pk) != 33 or pk[0] != 0x02 or len(sig) != SIG_LEN:
+            continue
+        randomizer = sig[:N]
+        (idx,) = struct.unpack(">Q", sig[N:N + 8])
+        if idx >= 1 << H:
+            continue
+        pub_seed = sig[-2 * N:-N]
+        root = sig[-N:]
+        if hashlib.sha256(pub_seed + root).digest() != pk[1:]:
+            continue
+        fors_dg, expect_idx = _msg_digest(
+            randomizer, pub_seed, root, bytes(messages[i])
+        )
+        if idx != expect_idx:
+            continue
+        pre[i] = True
+        pub_seeds[i], roots[i], fors_dgs[i], idxs[i], sigs[i] = (
+            pub_seed, root, fors_dg, idx, sig
+        )
+
+    # ------------------------------------------------------------- FORS
+    # rows: (lane, tree) flattened to B·K; invalid lanes compute garbage
+    # harmlessly behind the precheck mask
+    off0 = N + 8
+    fors_prefix, fors_sks, fors_auth = [], [], [[] for _ in range(A)]
+    fors_even = np.zeros((n_lanes * K, A), dtype=bool)
+    fors_node_prefix = [[] for _ in range(A)]
+    for i in range(n_lanes):
+        indices = _fors_indices(fors_dgs[i])
+        off = off0
+        for t in range(K):
+            leaf = indices[t]
+            fors_prefix.append(
+                b"forsleaf" + pub_seeds[i] + _addr(FORS_LAYER, idxs[i], t, leaf)
+            )
+            fors_sks.append(sigs[i][off:off + N])
+            off += N
+            pos = leaf
+            for lvl in range(A):
+                fors_auth[lvl].append(sigs[i][off:off + N])
+                off += N
+                fors_even[i * K + t, lvl] = pos % 2 == 0
+                fors_node_prefix[lvl].append(
+                    b"forsnode" + pub_seeds[i]
+                    + _addr(FORS_LAYER, idxs[i], (t << 8) | (lvl + 1), pos // 2)
+                )
+                pos //= 2
+
+    node = sha256_bytes_device(jnp.asarray(np.concatenate(
+        [_u8(fors_prefix), _u8(fors_sks)], axis=1
+    )))
+    node = digest_words_to_device_bytes(node)
+    for lvl in range(A):
+        prefix = jnp.asarray(_u8(fors_node_prefix[lvl]))
+        sib = jnp.asarray(_u8(fors_auth[lvl]))
+        even = jnp.asarray(fors_even[:, lvl])[:, None]
+        first = jnp.where(even, node, sib)
+        second = jnp.where(even, sib, node)
+        node = digest_words_to_device_bytes(sha256_bytes_device(
+            jnp.concatenate([prefix, first, second], axis=1)
+        ))
+    fors_roots = node.reshape(n_lanes, K * N)
+    forspk_prefix = _u8([
+        b"forspk" + pub_seeds[i] + _addr(FORS_LAYER, idxs[i], 0, 0)
+        for i in range(n_lanes)
+    ])
+    digest = digest_words_to_device_bytes(sha256_bytes_device(
+        jnp.concatenate([jnp.asarray(forspk_prefix), fors_roots], axis=1)
+    ))  # (B, 32): the value layer 0 signs
+
+    # -------------------------------------------------------- hypertree
+    sig_arr = _u8(sigs)
+    off = off0 + K * (N + A * N)
+    for layer in range(D):
+        tree_leaf = []
+        for i in range(n_lanes):
+            t = idxs[i] >> (HT * layer)
+            tree_leaf.append((t >> HT, t & ((1 << HT) - 1)))
+        # 67 chains per lane: rows (B·LEN); start digit from the DEVICE
+        # digest of the previous stage
+        digs = _device_digits(digest).reshape(n_lanes * LEN)
+        chain_prefix = _u8([
+            b"ch" + pub_seeds[i]
+            + _addr(layer, tree_leaf[i][0], tree_leaf[i][1], j << 8)
+            for i in range(n_lanes) for j in range(LEN)
+        ])
+        k_byte = chain_prefix.shape[1] - 1  # low byte of (j<<8)|k
+        wots = sig_arr[:, off:off + LEN * N]
+        off += LEN * N
+        x = jnp.asarray(
+            wots.reshape(n_lanes * LEN, N)
+        )
+        prefix_dev = jnp.asarray(chain_prefix)
+        for k in range(W - 1):
+            stepped = digest_words_to_device_bytes(sha256_bytes_device(
+                jnp.concatenate(
+                    [prefix_dev.at[:, k_byte].set(k), x], axis=1
+                )
+            ))
+            x = jnp.where((k >= digs)[:, None], stepped, x)
+        tips = x.reshape(n_lanes, LEN * N)
+        wotspk_prefix = _u8([
+            b"wotspk" + pub_seeds[i]
+            + _addr(layer, tree_leaf[i][0], tree_leaf[i][1], 0)
+            for i in range(n_lanes)
+        ])
+        node = digest_words_to_device_bytes(sha256_bytes_device(
+            jnp.concatenate([jnp.asarray(wotspk_prefix), tips], axis=1)
+        ))
+        # XMSS auth walk: HT levels, sibling order by host-known parity
+        pos = [tree_leaf[i][1] for i in range(n_lanes)]
+        for lvl in range(1, HT + 1):
+            sib = jnp.asarray(sig_arr[:, off:off + N])
+            off += N
+            node_prefix = _u8([
+                b"node" + pub_seeds[i]
+                + _addr(layer, tree_leaf[i][0], lvl, pos[i] // 2)
+                for i in range(n_lanes)
+            ])
+            even = jnp.asarray(
+                np.array([p % 2 == 0 for p in pos])
+            )[:, None]
+            first = jnp.where(even, node, sib)
+            second = jnp.where(even, sib, node)
+            node = digest_words_to_device_bytes(sha256_bytes_device(
+                jnp.concatenate([jnp.asarray(node_prefix), first, second],
+                                axis=1)
+            ))
+            pos = [p // 2 for p in pos]
+        digest = node  # next layer signs this subtree root
+
+    # ----------------------------------------------------------- verdict
+    claimed = jnp.asarray(_u8(roots))
+    return jnp.all(digest == claimed, axis=1) & jnp.asarray(pre)

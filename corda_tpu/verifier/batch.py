@@ -9,8 +9,10 @@ Crypto.findSignatureScheme (Crypto.kt:236-267) — and each bucket goes to its
 best engine in one shot:
 
   scheme 4 (ed25519)  → one batched device kernel (ops/ed25519.py)
-  schemes 2/3 (ECDSA) → batched complete-formula ladder (ops/secp256.py)
-  schemes 1/5 (RSA, SPHINCS — cold paths) → host loop
+  schemes 2/3 (ECDSA) → batched windowed ladder (ops/secp256.py / _pallas)
+  scheme 5 (SPHINCS)  → batched hash-chain sweep (ops/sphincs_batch.py)
+                        on accelerator backends; host loop on CPU
+  scheme 1 (RSA — cold path) → host loop
 
 Bucketing + padding policy is what decides real MXU utilization (SURVEY.md
 §7 hard part (a)): the ed25519 path pads to power-of-two buckets so XLA
@@ -27,6 +29,7 @@ from corda_tpu.crypto import (
     ECDSA_SECP256K1_SHA256,
     ECDSA_SECP256R1_SHA256,
     EDDSA_ED25519_SHA512,
+    SPHINCS256_SHA256,
     SecureHash,
     TransactionSignature,
     is_fulfilled_by,
@@ -41,6 +44,23 @@ _DEVICE_SCHEMES = {
     ECDSA_SECP256K1_SHA256,
     ECDSA_SECP256R1_SHA256,
 }
+
+
+def _effective_device_schemes(use_device: bool) -> set:
+    """The device-capable scheme set for this dispatch. SPHINCS batches on
+    device too (pure hashing — ~100 chained SHA-256 dispatches,
+    ops/sphincs_batch.py), but only on an accelerator backend: its many
+    small eager steps are profitable on a chip and a compile tarpit on
+    the XLA:CPU test tier, where the host loop wins. Only consulted when
+    ``use_device`` — host-only callers never touch (or initialize) jax."""
+    if not use_device:
+        return set()
+    schemes = set(_DEVICE_SCHEMES)
+    import jax
+
+    if jax.default_backend() == "tpu":
+        schemes.add(SPHINCS256_SHA256)
+    return schemes
 
 
 class PendingRows:
@@ -88,8 +108,9 @@ def dispatch_signature_rows(
     for i, (key, _sig, _msg) in enumerate(rows):
         buckets.setdefault(key.scheme_id, []).append(i)
 
+    device_schemes = _effective_device_schemes(use_device)
     for scheme_id, idxs in buckets.items():
-        if use_device and scheme_id in _DEVICE_SCHEMES:
+        if scheme_id in device_schemes:
             keys = [rows[i][0].encoded for i in idxs]
             sigs = [rows[i][1] for i in idxs]
             msgs = [rows[i][2] for i in idxs]
@@ -112,6 +133,14 @@ def dispatch_signature_rows(
                     mask = ed25519_verify_dispatch(
                         keys, sigs, msgs, min_bucket=min_bucket
                     )
+            elif scheme_id == SPHINCS256_SHA256:
+                from corda_tpu.ops.sphincs_batch import (
+                    sphincs_verify_dispatch,
+                )
+
+                mask = sphincs_verify_dispatch(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
             else:
                 # async like the ed25519 bucket: the ECDSA ladder queues on
                 # device and collects later, so mixed-scheme batches overlap
@@ -240,10 +269,9 @@ def dispatch_transactions(
     pending = dispatch_signature_rows(
         rows, use_device=use_device, min_bucket=min_bucket
     )
-    n_device = (
-        sum(1 for key, _s, _m in rows if key.scheme_id in _DEVICE_SCHEMES)
-        if use_device
-        else 0
+    device_schemes = _effective_device_schemes(use_device)
+    n_device = sum(
+        1 for key, _s, _m in rows if key.scheme_id in device_schemes
     )
     return PendingTxCheck(
         stxs, allowed_missing, pending, row_tx, row_sig, n_device

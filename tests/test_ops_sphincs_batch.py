@@ -1,0 +1,82 @@
+"""Differential tests for the batched SPHINCS+ device verifier vs the
+host implementation (crypto/sphincs.py) — the last scheme to gain a
+device tier. Bit-equality on valid signatures; every tamper mode the host
+tier pins must also reject here; hostile garbage lanes fail cleanly
+behind the precheck."""
+
+import struct
+
+import numpy as np
+import pytest
+
+from corda_tpu.crypto import sphincs
+from corda_tpu.ops.sphincs_batch import (
+    sphincs_verify_batch,
+    sphincs_verify_dispatch,
+)
+
+
+@pytest.fixture(scope="module")
+def keys_and_sigs():
+    out = []
+    for i in range(3):
+        pk, sk = sphincs.generate(bytes([i]) * 32)
+        msg = b"sphincs batch message %d" % i
+        out.append((pk, sk, msg, sphincs.sign(sk, msg)))
+    return out
+
+
+class TestSphincsBatch:
+    def test_valid_batch_matches_host(self, keys_and_sigs):
+        pks = [pk for pk, _sk, _m, _s in keys_and_sigs]
+        msgs = [m for _pk, _sk, m, _s in keys_and_sigs]
+        sigs = [s for _pk, _sk, _m, s in keys_and_sigs]
+        host = np.array([
+            sphincs.verify(pk, s, m) for pk, s, m in zip(pks, sigs, msgs)
+        ])
+        assert host.all()  # sanity: host accepts
+        got = sphincs_verify_batch(pks, sigs, msgs)
+        assert (got == host).all()
+
+    def test_tamper_modes_rejected(self, keys_and_sigs):
+        pk, _sk, msg, sig = keys_and_sigs[0]
+        n = sphincs.N
+        lanes_pk, lanes_sig, lanes_msg = [], [], []
+        # one valid lane + every host-pinned tamper offset + wrong message
+        lanes_pk.append(pk); lanes_sig.append(sig); lanes_msg.append(msg)
+        for off in (0, n, n + 9, n + 8 + n + 2, len(sig) - 1,
+                    len(sig) - n - 1):
+            bad = sig[:off] + bytes([sig[off] ^ 1]) + sig[off + 1:]
+            lanes_pk.append(pk); lanes_sig.append(bad); lanes_msg.append(msg)
+        lanes_pk.append(pk); lanes_sig.append(sig)
+        lanes_msg.append(b"different message")
+        # hypertree index steering (the instance-selection binding)
+        (idx,) = struct.unpack(">Q", sig[n:n + 8])
+        steered = (
+            sig[:n] + struct.pack(">Q", (idx + 1) % (1 << sphincs.H))
+            + sig[n + 8:]
+        )
+        lanes_pk.append(pk); lanes_sig.append(steered); lanes_msg.append(msg)
+        # garbage lanes
+        lanes_pk.append(b"\x00"); lanes_sig.append(b"junk")
+        lanes_msg.append(msg)
+        lanes_pk.append(pk); lanes_sig.append(sig[:-1]); lanes_msg.append(msg)
+
+        got = sphincs_verify_batch(lanes_pk, lanes_sig, lanes_msg)
+        host = np.array([
+            sphincs.verify(p, s, m)
+            for p, s, m in zip(lanes_pk, lanes_sig, lanes_msg)
+        ])
+        assert not host[1:].any()  # sanity: host rejects every bad lane
+        assert (got == host).all()
+        assert got[0] and not got[1:].any()
+
+    def test_dispatch_pads_to_bucket(self, keys_and_sigs):
+        pk, _sk, msg, sig = keys_and_sigs[1]
+        mask = sphincs_verify_dispatch([pk], [sig], [msg])
+        assert mask.shape[0] == 8  # pow2 bucket
+        got = np.asarray(mask)
+        assert got[0] and not got[1:].any()  # pad lanes reject
+
+    def test_empty_batch(self):
+        assert sphincs_verify_batch([], [], []).shape == (0,)
