@@ -48,7 +48,13 @@ def select_cash(
     attempt = 0
     while True:
         try:
-            return _select_cash_once(flow, currency, quantity)
+            # first attempt: smallest-first (minimal fragmentation);
+            # retries: SHUFFLED candidate order — N concurrent spenders all
+            # greedily picking the same smallest states would otherwise
+            # thunder-herd through the whole window at high concurrency
+            return _select_cash_once(
+                flow, currency, quantity, shuffle=attempt > 0
+            )
         except SoftLockError as e:
             # lost a race between query and reserve: another flow locked
             # one of our picks — back off and re-query (the loser sees the
@@ -65,7 +71,9 @@ def select_cash(
             )
 
 
-def _select_cash_once(flow: FlowLogic, currency: str, quantity: int) -> list:
+def _select_cash_once(
+    flow: FlowLogic, currency: str, quantity: int, shuffle: bool = False,
+) -> list:
     vault = flow.services.vault_service
     page = vault.query_by(
         QueryCriteria(
@@ -79,6 +87,10 @@ def _select_cash_once(flow: FlowLogic, currency: str, quantity: int) -> list:
         sr for sr in page.states
         if sr.state.data.amount.token.product == currency
     ]
+    if shuffle:
+        import random as _random
+
+        _random.shuffle(candidates)
     # a transaction's inputs must share one notary — select within the
     # notary bucket that can cover the amount (cross-notary spends need an
     # explicit NotaryChangeFlow first, as in the reference)
