@@ -188,6 +188,47 @@ class TestSecureFabricClient:
             server.close()
             broker.close()
 
+    def test_garbage_connections_never_wedge_the_server(self):
+        """Hostile bytes at the listener — random frames, truncated
+        handshakes, instant disconnects — must neither crash the accept
+        loop nor block certified peers (the broker faces the network)."""
+        import random
+        import socket as _socket
+
+        broker = DurableQueueBroker()
+        _, server = _fabric_server(broker)
+        try:
+            rng = random.Random(99)
+            for i in range(12):
+                s = _socket.create_connection(server.address, timeout=5)
+                mode = i % 4
+                try:
+                    if mode == 0:
+                        s.close()  # connect-and-drop
+                        continue
+                    if mode == 1:  # random frame of hostile length
+                        s.sendall(
+                            (2 ** 31 - 1).to_bytes(4, "big") + b"\xff" * 64
+                        )
+                    elif mode == 2:  # plausible length, garbage body
+                        body = rng.randbytes(200)
+                        s.sendall(len(body).to_bytes(4, "big") + body)
+                    else:  # truncated: length promised, nothing sent
+                        s.sendall((500).to_bytes(4, "big"))
+                    s.close()
+                except OSError:
+                    pass
+            # a certified peer still gets full service afterwards
+            _, fab = _fabric_client(server.address, "PostFuzz")
+            fab.publish("fz", b"still works")
+            msg = fab.consume("fz", timeout=2.0)
+            assert msg is not None and msg.payload == b"still works"
+            fab.ack(msg.msg_id)
+            fab.close()
+        finally:
+            server.close()
+            broker.close()
+
     def test_client_reconnects_after_broker_restart(self):
         """The Artemis-bridge-retry role: the fabric server drops (restart
         on the same port), and the client's next operations re-handshake
