@@ -428,6 +428,73 @@ class TestSecureVerifierWorker:
             broker.close()
 
 
+class TestProductionModeFabric:
+    def test_operator_provisioned_certs_boot_the_fabric(self, tmp_path):
+        """devMode=false: nodes refuse to self-provision — the operator
+        places identity.cbe/truststore.cbe issued by the REAL network CA,
+        and the ensemble boots over the authenticated fabric with no dev
+        CA anywhere in the chain."""
+        from corda_tpu.node.certificates import NodeIdentity
+        from corda_tpu.node.config import NodeConfiguration
+        from corda_tpu.node.startup import build_node
+
+        network_ca = generate_keypair()  # the real operator root
+
+        def provision(org):
+            name = f"O={org},L=London,C=GB"
+            base = tmp_path / org
+            ident = issue_identity(name, generate_keypair(), ca=network_ca)
+            save_identity(base / "certificates", ident)
+            return name, base
+
+        host_name, host_base = provision("FabricHost")
+        peer_name, peer_base = provision("PeerNode")
+        host_canonical = str(CordaX500Name.parse(host_name))
+
+        host = build_node(
+            NodeConfiguration(
+                my_legal_name=host_name, base_directory=str(host_base),
+                dev_mode=False,
+            ),
+            str(tmp_path / "host-broker.db"),
+            is_network_map=True, fabric_listen="127.0.0.1:0",
+        )
+        try:
+            addr = f"{host.fabric_server.address[0]}:{host.fabric_server.address[1]}"
+            peer = build_node(
+                NodeConfiguration(
+                    my_legal_name=peer_name, base_directory=str(peer_base),
+                    dev_mode=False, network_map_address=host_canonical,
+                ),
+                ":memory:", fabric_address=addr,
+            )
+            try:
+                # the peer registered with the host's network map over the
+                # authenticated channel
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if len(host.services.network_map_cache.all_nodes()) >= 2:
+                        break
+                    time.sleep(0.05)
+                assert len(host.services.network_map_cache.all_nodes()) >= 2
+                # a DEV-CA identity is an outsider on this network
+                dev_ident = issue_identity(
+                    "O=DevPeer,L=London,C=GB", generate_keypair()
+                )
+                with pytest.raises((HandshakeError, ConnectionError)):
+                    fab = SecureFabricClient(
+                        addr, dev_ident.certificate,
+                        dev_ident.keypair.private, network_ca.public,
+                    )
+                    fab.publish("q", b"x")
+                # the peer authenticated the HOST's identity too (mutual)
+                assert str(peer.fabric_client.peer.party.name) == host_canonical
+            finally:
+                peer.stop()
+        finally:
+            host.stop()
+
+
 @pytest.mark.slow
 class TestSecureDriverEnsemble:
     """Real node subprocesses over the authenticated TCP fabric — the
