@@ -70,7 +70,7 @@ class InteractiveShell:
                 for party in self._ops.notary_identities():
                     self._p(f"  {party.name}")
             elif cmd == "flow":
-                self._flow(args)
+                self._flow(args, line)
             elif cmd == "vault":
                 self._vault(args)
             elif cmd == "run":
@@ -100,7 +100,7 @@ class InteractiveShell:
             self._p(f"error: {type(e).__name__}: {e}")
         return True
 
-    def _flow(self, args) -> None:
+    def _flow(self, args, raw_line: str = "") -> None:
         if not args:
             self._p("usage: flow start|list|watch")
             return
@@ -113,11 +113,37 @@ class InteractiveShell:
                 self._p(f"  {fid}")
         elif sub == "start":
             if len(args) < 2:
-                self._p("usage: flow start <ClassPath> [args…]")
+                self._p("usage: flow start <ClassPath> [args… | k: v, …]")
                 return
-            flow_id = self._ops.start_flow_dynamic(
-                args[1], *[_parse_arg(a) for a in args[2:]]
-            )
+            # the RAW remainder keeps quotes intact — shlex tokens would
+            # strip the quoting that protects commas in X.500 names
+            rest = raw_line.partition(args[1])[2].strip()
+            if ":" in rest:
+                # named-argument form (the reference shell's yaml-style
+                # start): values convert to the flow's ANNOTATED field
+                # types — parties by X.500 name, hashes from hex, amounts
+                # from "100 GBP" — via the jackson-tier mapper
+                import typing
+
+                from corda_tpu.flows.api import load_class
+                from corda_tpu.rpc.json_support import RpcJsonMapper
+                from corda_tpu.rpc.string_calls import parse_argument_string
+
+                cls = load_class(args[1])
+                try:
+                    hints = typing.get_type_hints(cls)
+                except Exception:
+                    hints = {}
+                mapper = RpcJsonMapper(self._ops)
+                kwargs = {
+                    k: (mapper.parse(v, hints[k]) if k in hints else v)
+                    for k, v in parse_argument_string(rest).items()
+                }
+                flow_id = self._ops.start_flow_dynamic(args[1], **kwargs)
+            else:
+                flow_id = self._ops.start_flow_dynamic(
+                    args[1], *[_parse_arg(a) for a in args[2:]]
+                )
             self._p(f"started {flow_id}; waiting…")
             result = self._ops.flow_result(flow_id, 120)
             self._p(f"result: {result}")

@@ -155,6 +155,36 @@ class TestStringToMethodCallParser:
             assert len(snapshot) == 1
 
 
+class TestShellNamedFlowStart:
+    def test_flow_start_with_named_typed_args(self):
+        """The reference shell's yaml-style flow start: named arguments
+        convert to the flow's annotated field types (Party by quoted
+        X.500 name, bytes from base64) and the flow runs to completion."""
+        import io
+
+        from corda_tpu.rpc import CordaRPCOps
+        from corda_tpu.testing import MockNetworkNodes
+        from corda_tpu.tools.shell import InteractiveShell
+
+        with MockNetworkNodes() as net:
+            node = net.create_node("Bank A")
+            net.create_notary_node("Notary", validating=True)
+            ops = CordaRPCOps(node.services, node.smm)
+            out = io.StringIO()
+            shell = InteractiveShell(ops, out=out)
+            shell.run_command(
+                "flow start corda_tpu.finance.flows:CashIssueFlow "
+                "quantity: 250, currency: GBP, issuer_ref: \"AQ==\", "
+                "notary: \"O=Notary, L=London, C=GB\""
+            )
+            assert "result:" in out.getvalue(), out.getvalue()
+            from corda_tpu.finance import CashState
+
+            states = node.services.vault_service.unconsumed_states(CashState)
+            assert len(states) == 1
+            assert states[0].state.data.amount.quantity == 250
+
+
 class TestShellNamedRun:
     def test_run_with_named_args(self):
         import io
