@@ -41,7 +41,8 @@ def _notarise_all(service, gen: GeneratedLedger, txs) -> tuple[int, int]:
     return ok, conflicts
 
 
-def run_demo(n_txs: int = 20, modes=("single", "raft", "bft"),
+def run_demo(n_txs: int = 20,
+             modes=("single", "raft", "bft", "batched-raft"),
              verbose: bool = True) -> dict:
     results = {}
     for mode in modes:
@@ -55,9 +56,9 @@ def run_demo(n_txs: int = 20, modes=("single", "raft", "bft"),
         try:
             if mode == "single":
                 uniqueness = InMemoryUniquenessProvider()
-            elif mode == "raft":
+            elif mode in ("raft", "batched-raft"):
                 providers = RaftUniquenessProvider.make_cluster(
-                    [f"raft-{i}" for i in range(3)], net
+                    [f"{mode}-{i}" for i in range(3)], net
                 )
                 cluster_stoppers = [p.node.stop for p in providers]
                 uniqueness = providers[0]
@@ -78,7 +79,33 @@ def run_demo(n_txs: int = 20, modes=("single", "raft", "bft"),
             # the whole DAG in topological (generation) order
             txs = list(gen.generate(n_txs, with_notary_sig=False).values())
             t0 = time.time()
-            ok, conflicts = _notarise_all(service, gen, txs)
+            if mode == "batched-raft":
+                # the round-3 shape: windows of transactions settle as ONE
+                # consensus round each through the batched notary
+                from corda_tpu.crypto import TransactionSignature
+                from corda_tpu.notary import BatchedNotaryService
+
+                batched = BatchedNotaryService(
+                    notary_party, kp, uniqueness,
+                    use_device=False, validating=True, max_batch=8,
+                )
+                resolve = lambda ref: gen.transactions[  # noqa: E731
+                    ref.txhash
+                ].tx.outputs[ref.index]
+                moves = [s for s in txs if s.inputs]
+                chunks = [
+                    [(s, resolve, "demo") for s in moves[i:i + 8]]
+                    for i in range(0, len(moves), 8)
+                ]
+                out = batched.process_stream(chunks, depth=2)
+                ok = sum(
+                    1 for batch in out for r in batch
+                    if isinstance(r, TransactionSignature)
+                )
+                conflicts = sum(len(b) for b in out) - ok
+                batched.shutdown()
+            else:
+                ok, conflicts = _notarise_all(service, gen, txs)
             elapsed = time.time() - t0
             # a double-spend attempt must be rejected by every tier
             moves = [s for s in txs if s.inputs]
