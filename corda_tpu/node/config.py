@@ -14,7 +14,10 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
+import hmac
 import json
+import os
 import re
 from pathlib import Path
 
@@ -63,9 +66,55 @@ class NotaryConfig:
 
 @dataclasses.dataclass(frozen=True)
 class RpcUser:
+    """An RPC credential entry (reference: NodeConfiguration.kt rpcUsers).
+
+    ``password`` holds either a plaintext secret (dev ensembles) or a
+    salted-hash entry of the form ``pbkdf2$<iters>$<salt_hex>$<hash_hex>``
+    produced by :func:`hash_rpc_password` — the at-rest form a production
+    node.conf should carry. Either way, candidate checks go through
+    :meth:`check_password`, which compares in constant time.
+    """
+
     username: str
     password: str
     permissions: tuple[str, ...] = ()
+
+    def check_password(self, candidate: str) -> bool:
+        stored = self.password
+        if stored.startswith("pbkdf2$"):
+            try:
+                _, iters, salt_hex, hash_hex = stored.split("$")
+                expected = bytes.fromhex(hash_hex)
+                derived = hashlib.pbkdf2_hmac(
+                    "sha256", candidate.encode(), bytes.fromhex(salt_hex),
+                    int(iters),
+                )
+            except (ValueError, TypeError):
+                return False
+            return hmac.compare_digest(derived, expected)
+        return hmac.compare_digest(stored.encode(), candidate.encode())
+
+
+def _plain_password(value: str) -> str:
+    """Guard the shared-field encoding: a plaintext ``password`` that starts
+    with the hash-entry prefix would silently become uncheckable (every
+    candidate takes the hash branch and fails) — reject it at load time."""
+    if value.startswith("pbkdf2$"):
+        raise ValueError(
+            "plaintext rpcUsers password may not start with 'pbkdf2$'; "
+            "if this is a hash entry, put it under the passwordHash key"
+        )
+    return value
+
+
+def hash_rpc_password(password: str, *, iterations: int = 120_000,
+                      _salt: bytes | None = None) -> str:
+    """Produce a salted-hash rpcUsers entry for node.conf (``passwordHash``)."""
+    salt = _salt if _salt is not None else os.urandom(16)
+    derived = hashlib.pbkdf2_hmac(
+        "sha256", password.encode(), salt, iterations
+    )
+    return f"pbkdf2${iterations}${salt.hex()}${derived.hex()}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -227,7 +276,15 @@ def _notary_from(d: dict) -> NotaryConfig:
 
 def config_from_dict(d: dict) -> NodeConfiguration:
     users = tuple(
-        RpcUser(u["username"], u["password"], tuple(u.get("permissions", [])))
+        RpcUser(
+            u["username"],
+            # passwordHash carries a pbkdf2$... entry (hash_rpc_password);
+            # check_password dispatches on the prefix, so both land in the
+            # same field
+            u["passwordHash"] if "passwordHash" in u
+            else _plain_password(u["password"]),
+            tuple(u.get("permissions", [])),
+        )
         for u in d.get("rpcUsers", [])
     )
     return NodeConfiguration(

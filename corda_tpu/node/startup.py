@@ -32,6 +32,19 @@ BANNER = r"""
 """
 
 
+def _is_loopback_address(addr: str) -> bool:
+    """True when ``host[:port]`` names the local host, including the IPv6
+    forms ``[::1]:port`` and bare ``::1`` (rpartition-on-colon would
+    mangle those)."""
+    if addr.startswith("["):                       # [v6-host]:port
+        host = addr[1:].partition("]")[0]
+    elif addr.count(":") > 1:                      # bare IPv6 literal
+        host = addr
+    else:
+        host, _, _ = addr.partition(":")
+    return host in ("localhost", "127.0.0.1", "::1")
+
+
 def build_node(
     config, broker_path: str, is_network_map: bool = False,
     fabric_listen: str | None = None, fabric_address: str | None = None,
@@ -81,6 +94,20 @@ def build_node(
         raise ValueError(
             "--fabric-listen and --fabric are mutually exclusive: a node "
             "either embeds the broker or connects to a remote one"
+        )
+    # RPC rides the fabric; a non-localhost rpcAddress without the
+    # authenticated transport would send credentials in clear (the
+    # reference always rides TLS — ArtemisMessagingServer required
+    # client certs). Dev ensembles keep rpcAddress on localhost.
+    if (
+        config.rpc_address
+        and not _is_loopback_address(config.rpc_address)
+        and not (fabric_listen or fabric_address)
+    ):
+        raise ValueError(
+            f"rpcAddress {config.rpc_address!r} is not localhost: serving "
+            "RPC off-host requires the secure fabric (--fabric-listen / "
+            "--fabric), otherwise credentials cross the wire in clear"
         )
     fabric_server = None
     keypair = None

@@ -21,11 +21,21 @@ import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
 
+from corda_tpu.node.config import RpcUser
 from corda_tpu.serialization import cbe_serializable, deserialize, serialize
 
 from .ops import CordaRPCOps, PermissionException, start_flow_permission
 
 logger = logging.getLogger(__name__)
+
+# decoy for unknown usernames: runs the same constant-time plaintext
+# compare a known dev-mode user would, so unknown-vs-known timing is
+# equalized for the plaintext (dev default) case without handing
+# unauthenticated callers a pbkdf2 CPU-amplification lever. (For hashed
+# entries the pbkdf2 cost itself still differs from the decoy; hashing
+# at rest trades that residual username-timing signal for not storing
+# secrets in clear.)
+_DUMMY_USER = RpcUser("", "\x00corda-tpu-rpc-decoy\x00", ())
 
 RPC_REQUEST_TOPIC = "rpc.request"
 
@@ -99,7 +109,12 @@ class RPCServer:
     # ------------------------------------------------------------ auth
     def _authenticate(self, req: RpcRequest):
         user = self._users.get(req.username)
-        if user is None or user.password != req.password:
+        # check_password compares in constant time (and handles pbkdf2$
+        # salted-hash at-rest entries); always run it — even for unknown
+        # users, against a dummy — so response timing doesn't leak whether
+        # a username exists
+        candidate = user if user is not None else _DUMMY_USER
+        if not candidate.check_password(req.password) or user is None:
             raise PermissionException("invalid RPC credentials")
         return user
 

@@ -473,6 +473,68 @@ class TestConfig:
                 raft=RaftConfig("a:1"), bft=BFTConfig(0)
             )
 
+    def test_hashed_rpc_password(self):
+        from corda_tpu.node.config import RpcUser, hash_rpc_password
+
+        entry = hash_rpc_password("s3cret", iterations=1000)
+        assert entry.startswith("pbkdf2$1000$")
+        user = RpcUser("ops", entry)
+        assert user.check_password("s3cret")
+        assert not user.check_password("S3cret")
+        assert not user.check_password("")
+        # plaintext entries still check (dev mode), in constant time
+        plain = RpcUser("dev", "hunter2")
+        assert plain.check_password("hunter2")
+        assert not plain.check_password("hunter")
+        # malformed hash entries never match anything — and never raise
+        assert not RpcUser("x", "pbkdf2$bad").check_password("pbkdf2$bad")
+        salt = "00" * 16
+        assert not RpcUser(
+            "x", f"pbkdf2$1000${salt}$zz"   # non-hex hash segment
+        ).check_password("pw")
+        # a plaintext password wearing the hash prefix would be
+        # permanently uncheckable — config load refuses it
+        with pytest.raises(ValueError, match="passwordHash"):
+            config_from_dict({
+                "myLegalName": "O=A, L=L, C=GB",
+                "rpcUsers": [{"username": "u", "password": "pbkdf2$oops"}],
+            })
+
+    def test_password_hash_config_key(self):
+        from corda_tpu.node.config import hash_rpc_password
+
+        entry = hash_rpc_password("pw", iterations=1000)
+        cfg = config_from_dict({
+            "myLegalName": "O=Bank A, L=London, C=GB",
+            "rpcUsers": [
+                {"username": "admin", "passwordHash": entry,
+                 "permissions": ["ALL"]},
+            ],
+        })
+        assert cfg.rpc_users[0].check_password("pw")
+        assert not cfg.rpc_users[0].check_password(entry)
+
+    def test_non_localhost_rpc_requires_secure_fabric(self, tmp_path):
+        from corda_tpu.node.startup import build_node
+
+        cfg = NodeConfiguration(
+            my_legal_name="O=Bank A, L=London, C=GB",
+            base_directory=str(tmp_path),
+            rpc_address="0.0.0.0:10003",
+        )
+        with pytest.raises(ValueError, match="secure fabric"):
+            build_node(cfg, ":memory:")
+
+    def test_loopback_address_forms(self):
+        from corda_tpu.node.startup import _is_loopback_address
+
+        for ok in ("localhost:10003", "127.0.0.1:10003", "[::1]:10003",
+                   "::1", "localhost"):
+            assert _is_loopback_address(ok), ok
+        for bad in ("10.0.0.5:10003", "0.0.0.0:10003", "[2001:db8::1]:80",
+                    "bank.example.com:10003"):
+            assert not _is_loopback_address(bad), bad
+
 
 # ----------------------------------------------------------- service hub
 

@@ -12,7 +12,7 @@ from corda_tpu.finance import CashIssueFlow, CashPaymentFlow, CashState
 from corda_tpu.flows import FlowLogic
 from corda_tpu.flows.api import class_path
 from corda_tpu.node import QueryCriteria
-from corda_tpu.node.config import RpcUser
+from corda_tpu.node.config import RpcUser, hash_rpc_password
 from corda_tpu.rpc import CordaRPCClient, CordaRPCOps, RPCServer
 from corda_tpu.rpc.client import RPCException
 from corda_tpu.rpc.ops import start_flow_permission
@@ -46,6 +46,8 @@ USERS = (
         "InvokeRpc.vault_query_by",
     )),
     RpcUser("nobody", "nobody-pw", ()),
+    # production-shaped entry: only the salted hash is at rest on the node
+    RpcUser("hashed", hash_rpc_password("hash-pw", iterations=1000), ("ALL",)),
 )
 
 
@@ -80,6 +82,15 @@ class TestRPC:
         conn = client.start("admin", "wrong")
         with pytest.raises(RPCException, match="credentials"):
             conn.proxy.ping()
+
+    def test_hashed_user_authenticates(self, rig):
+        _, client = rig
+        conn = client.start("hashed", "hash-pw")
+        assert conn.proxy.ping() == "pong"
+        conn.close()
+        bad = client.start("hashed", "wrong")
+        with pytest.raises(RPCException, match="credentials"):
+            bad.proxy.ping()
 
     def test_start_flow_and_result(self, rig):
         _, client = rig
