@@ -23,7 +23,10 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random as _rand
+import re
 import threading
+import time
 from concurrent.futures import Future
 
 from corda_tpu.ledger import LedgerTransaction, SignedTransaction
@@ -33,6 +36,19 @@ logger = logging.getLogger(__name__)
 
 VERIFICATION_REQUESTS_QUEUE = "verifier.requests"
 VERIFICATION_RESPONSES_QUEUE_PREFIX = "verifier.responses."
+# requests whose payload can't even name a reply queue land here for ops
+# (the reference surfaces these only in the worker log; a queue lets the
+# node count them — see DeadLetter)
+VERIFICATION_DEAD_LETTER_QUEUE = "verifier.dead-letter"
+
+# request msg_ids are "vreq-<reply_queue>-<nonce>[xattempt]"; the routing is
+# recoverable from the id alone, so a worker can reply a structured error
+# even when the payload is garbage (a CBE version skew between node and
+# worker must degrade to an error reply, not a hung future)
+_REQ_MSG_ID = re.compile(
+    r"^vreq-(?P<reply>" + re.escape(VERIFICATION_RESPONSES_QUEUE_PREFIX)
+    + r".+)-(?P<nonce>\d+)(?:x\d+)?$"
+)
 
 
 @cbe_serializable(name="verifier.Request")
@@ -57,6 +73,18 @@ class VerificationResponse:
     error: str = ""   # empty = verified
 
 
+@cbe_serializable(name="verifier.DeadLetter")
+@dataclasses.dataclass(frozen=True)
+class DeadLetter:
+    """A request the worker could neither process nor answer (payload
+    undecodable AND msg_id unparseable): parked on the dead-letter queue
+    with enough context for an operator to diagnose."""
+
+    msg_id: str
+    error: str
+    payload: bytes
+
+
 class VerifierWorker:
     """One stateless worker process/thread (reference: Verifier.main loop
     :66-84)."""
@@ -70,6 +98,7 @@ class VerifierWorker:
         self._thread: threading.Thread | None = None
         self.verified = 0
         self.failed = 0
+        self.malformed = 0
 
     # ------------------------------------------------------------ serving
     def serve_one(self, timeout: float = 0.5) -> bool:
@@ -79,9 +108,19 @@ class VerifierWorker:
             return False
         try:
             req = deserialize(msg.payload)
+            if not isinstance(req, VerificationRequest):
+                raise TypeError(
+                    f"expected VerificationRequest, got {type(req).__name__}"
+                )
             error = self._verify(req)
-        except Exception as e:  # malformed request: reply if we can
+        except Exception as e:
+            # malformed request (e.g. node↔worker CBE version skew): the
+            # node-side future must not hang. Routing is recoverable from
+            # the msg_id even when the payload isn't — reply a structured
+            # error; otherwise dead-letter for ops.
             logger.exception("malformed verification request")
+            self.malformed += 1
+            self._answer_malformed(msg, e)
             self._broker.ack(msg.msg_id)
             return True
         response = VerificationResponse(req.nonce, error)
@@ -97,6 +136,27 @@ class VerifierWorker:
         else:
             self.verified += 1
         return True
+
+    def _answer_malformed(self, msg, exc: Exception) -> None:
+        m = _REQ_MSG_ID.match(msg.msg_id or "")
+        if m is not None:
+            self._broker.publish(
+                m.group("reply"),
+                serialize(VerificationResponse(
+                    int(m.group("nonce")),
+                    f"malformed request: {type(exc).__name__}: {exc}",
+                )),
+                msg_id=f"vresp-{m.group('nonce')}", sender=self.name,
+            )
+            return
+        self._broker.publish(
+            VERIFICATION_DEAD_LETTER_QUEUE,
+            serialize(DeadLetter(
+                msg.msg_id or "", f"{type(exc).__name__}: {exc}",
+                bytes(msg.payload),
+            )),
+            msg_id=f"vdead-{msg.msg_id}", sender=self.name,
+        )
 
     def _verify(self, req: VerificationRequest) -> str:
         try:
@@ -140,17 +200,45 @@ class VerifierWorker:
             self._thread.join(timeout=5)
 
 
+@dataclasses.dataclass
+class _PendingRequest:
+    future: Future
+    payload: bytes           # the serialized request, for retry republish
+    deadline: float
+    attempts: int = 0        # republish count so far
+
+
 class OutOfProcessVerifierService:
     """Node-side TransactionVerifierService publishing to the worker queue
     (reference: OutOfProcessTransactionVerifierService.kt — nonce→future
-    map :32, response consumer :44-60, sendRequest :64-71)."""
+    map :32, response consumer :44-60, sendRequest :64-71).
 
-    def __init__(self, broker, node_name: str = "node"):
+    Every pending future carries a deadline: if no worker answers within
+    ``request_timeout_s`` the request is republished under a fresh msg_id
+    (up to ``max_retries`` times — covering a response lost to a worker
+    crash after ack), then completed exceptionally. The broker's
+    visibility-timeout redelivery handles workers that die mid-request, so
+    the timeout should sit above the redelivery delay; this deadline is the
+    backstop for everything redelivery can't see (no workers online for too
+    long, poisoned responses, skew-dropped replies)."""
+
+    def __init__(self, broker, node_name: str = "node",
+                 request_timeout_s: float = 60.0, max_retries: int = 1):
         self._broker = broker
         self.reply_queue = VERIFICATION_RESPONSES_QUEUE_PREFIX + node_name
+        self._request_timeout_s = request_timeout_s
+        self._max_retries = max_retries
         self._lock = threading.Lock()
-        self._pending: dict[int, Future] = {}
-        self._nonce = 0
+        self._pending: dict[int, _PendingRequest] = {}
+        # run-unique nonce base: the broker's dedupe (msg_id → persistent
+        # acked_ids) would silently drop a "vreq-...-1" republished by a
+        # restarted node whose counter reset, spuriously timing out every
+        # request up to the prior run's high-water mark
+        self._nonce = (int(time.time() * 1e6) << 16) | _rand.getrandbits(16)
+        self._sweep_interval_s = min(0.5, request_timeout_s / 4)
+        self._last_sweep = 0.0
+        self.timeouts = 0
+        self.retries = 0
         self._stop = threading.Event()
         self._consumer = threading.Thread(
             target=self._consume_responses, name="verifier-responses",
@@ -172,12 +260,15 @@ class OutOfProcessVerifierService:
         with self._lock:
             self._nonce += 1
             nonce = self._nonce
-            self._pending[nonce] = fut
+        payload = serialize(VerificationRequest(
+            nonce, stx if stx is not None else 0, ltx, self.reply_queue
+        ))
+        with self._lock:
+            self._pending[nonce] = _PendingRequest(
+                fut, payload, time.monotonic() + self._request_timeout_s
+            )
         self._broker.publish(
-            VERIFICATION_REQUESTS_QUEUE,
-            serialize(VerificationRequest(
-                nonce, stx if stx is not None else 0, ltx, self.reply_queue
-            )),
+            VERIFICATION_REQUESTS_QUEUE, payload,
             msg_id=f"vreq-{self.reply_queue}-{nonce}",
         )
         return fut
@@ -190,22 +281,66 @@ class OutOfProcessVerifierService:
                 msg = self._broker.consume(self.reply_queue, timeout=0.5)
             except (QueueClosedError, ConnectionError):
                 return
-            if msg is None:
-                continue
-            try:
-                resp = deserialize(msg.payload)
-                with self._lock:
-                    fut = self._pending.pop(resp.nonce, None)
-                if fut is not None and not fut.done():
-                    if resp.error:
-                        fut.set_exception(
-                            VerificationFailedError(resp.error)
+            # handle the response in hand BEFORE sweeping: a verdict that
+            # arrives at deadline+ε must win over its own timeout
+            if msg is not None:
+                try:
+                    resp = deserialize(msg.payload)
+                    # validate before popping — a nonce-bearing poisoned
+                    # reply must not orphan the future past the sweep
+                    if not isinstance(resp, VerificationResponse):
+                        raise TypeError(
+                            f"expected VerificationResponse, "
+                            f"got {type(resp).__name__}"
                         )
-                    else:
-                        fut.set_result(None)
-            except Exception:
-                logger.exception("bad verification response dropped")
-            self._broker.ack(msg.msg_id)
+                    with self._lock:
+                        entry = self._pending.pop(resp.nonce, None)
+                    fut = entry.future if entry is not None else None
+                    if fut is not None and not fut.done():
+                        if resp.error:
+                            fut.set_exception(
+                                VerificationFailedError(resp.error)
+                            )
+                        else:
+                            fut.set_result(None)
+                except Exception:
+                    logger.exception("bad verification response dropped")
+                self._broker.ack(msg.msg_id)
+            self._sweep_expired()
+
+    def _sweep_expired(self) -> None:
+        now = time.monotonic()
+        if now - self._last_sweep < self._sweep_interval_s:
+            return       # O(pending) locked scan; don't pay it per message
+        self._last_sweep = now
+        retry, fail = [], []
+        with self._lock:
+            for nonce, entry in self._pending.items():
+                if now < entry.deadline:
+                    continue
+                if entry.attempts < self._max_retries:
+                    entry.attempts += 1
+                    entry.deadline = now + self._request_timeout_s
+                    self.retries += 1
+                    retry.append((nonce, entry))
+                else:
+                    fail.append(nonce)
+            failed = [self._pending.pop(n) for n in fail]
+            self.timeouts += len(fail)
+        for nonce, entry in retry:
+            # fresh msg_id (the x-suffix) so broker dedupe doesn't drop
+            # the republish; responses stay idempotent by nonce
+            self._broker.publish(
+                VERIFICATION_REQUESTS_QUEUE, entry.payload,
+                msg_id=f"vreq-{self.reply_queue}-{nonce}x{entry.attempts}",
+            )
+        for entry in failed:
+            if not entry.future.done():
+                entry.future.set_exception(VerificationTimeoutError(
+                    f"no verification response within "
+                    f"{self._request_timeout_s:g}s "
+                    f"(after {self._max_retries} retries)"
+                ))
 
     def pending_count(self) -> int:
         with self._lock:
@@ -213,10 +348,27 @@ class OutOfProcessVerifierService:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # the sweep stops with the consumer thread: complete anything still
+        # pending so no caller stays blocked in fut.result() past shutdown
+        with self._lock:
+            remaining = list(self._pending.values())
+            self._pending.clear()
+        for entry in remaining:
+            if not entry.future.done():
+                entry.future.set_exception(VerificationTimeoutError(
+                    "verifier service shut down with the request pending"
+                ))
 
 
 class VerificationFailedError(Exception):
     pass
+
+
+class VerificationTimeoutError(VerificationFailedError):
+    """The out-of-process tier never answered: workers offline past the
+    deadline, or the reply was lost/undeliverable (reference contract:
+    VerifierApi.kt:40-58 — a response always carries the outcome; this
+    is the node-side backstop when none arrives)."""
 
 
 def run_worker(

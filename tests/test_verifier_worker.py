@@ -10,9 +10,11 @@ import pytest
 from corda_tpu.messaging import DurableQueueBroker
 from corda_tpu.testing import GeneratedLedger
 from corda_tpu.verifier.worker import (
+    VERIFICATION_DEAD_LETTER_QUEUE,
     VERIFICATION_REQUESTS_QUEUE,
     OutOfProcessVerifierService,
     VerificationFailedError,
+    VerificationTimeoutError,
     VerifierWorker,
 )
 
@@ -88,6 +90,112 @@ class TestVerifierWorker:
         finally:
             for w in workers:
                 w.stop()
+
+    def test_corrupt_payload_completes_future(self, rig):
+        """A request the worker can't even deserialize (CBE version skew)
+        must degrade to an error reply routed via the msg_id — the node's
+        future completes exceptionally instead of hanging forever
+        (reference contract: VerifierApi.kt:40-58, the response always
+        carries the outcome)."""
+        import time as _t
+        from concurrent.futures import Future
+
+        from corda_tpu.verifier.worker import _PendingRequest
+
+        broker, service, gen, txs = rig
+        fut = Future()
+        with service._lock:
+            service._pending[7] = _PendingRequest(
+                fut, b"", _t.monotonic() + 30
+            )
+        broker.publish(
+            VERIFICATION_REQUESTS_QUEUE, b"\xffnot-cbe-at-all",
+            msg_id=f"vreq-{service.reply_queue}-7",
+        )
+        worker = VerifierWorker(broker).start()
+        try:
+            with pytest.raises(VerificationFailedError,
+                               match="malformed request"):
+                fut.result(timeout=10)
+            deadline = time.monotonic() + 5
+            while worker.malformed < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert worker.malformed == 1
+        finally:
+            worker.stop()
+
+    def test_unroutable_garbage_dead_letters(self, rig):
+        """Garbage with no recoverable routing parks on the dead-letter
+        queue with the payload and error attached, instead of vanishing
+        into the worker log."""
+        from corda_tpu.verifier.worker import DeadLetter
+        from corda_tpu.serialization import deserialize
+
+        broker, service, gen, txs = rig
+        broker.publish(
+            VERIFICATION_REQUESTS_QUEUE, b"\x00junk",
+            msg_id="some-foreign-producer-id",
+        )
+        worker = VerifierWorker(broker).start()
+        try:
+            msg = broker.consume(VERIFICATION_DEAD_LETTER_QUEUE, timeout=10)
+            assert msg is not None
+            dead = deserialize(msg.payload)
+            assert isinstance(dead, DeadLetter)
+            assert dead.msg_id == "some-foreign-producer-id"
+            assert dead.payload == b"\x00junk"
+            assert dead.error
+            broker.ack(msg.msg_id)
+        finally:
+            worker.stop()
+
+    def test_no_workers_times_out_future(self):
+        """With the worker tier offline past the deadline + retry budget,
+        the pending future completes exceptionally (the node-side backstop
+        for everything broker redelivery can't see)."""
+        broker = DurableQueueBroker()
+        service = OutOfProcessVerifierService(
+            broker, "test-node", request_timeout_s=0.4, max_retries=1
+        )
+        gen = GeneratedLedger(seed=7)
+        stx = list(gen.generate(1, with_notary_sig=True).values())[0]
+        try:
+            fut = service.verify_stx(stx, _resolver(gen))
+            with pytest.raises(VerificationTimeoutError):
+                fut.result(timeout=15)
+            assert service.pending_count() == 0
+            assert service.timeouts == 1 and service.retries == 1
+        finally:
+            service.shutdown()
+            broker.close()
+
+    def test_retry_recovers_lost_request(self):
+        """A request acked by a worker that then died before replying is
+        invisible to broker redelivery; the node's deadline republishes it
+        and a healthy worker completes the future."""
+        broker = DurableQueueBroker()
+        service = OutOfProcessVerifierService(
+            broker, "test-node", request_timeout_s=0.5, max_retries=2
+        )
+        gen = GeneratedLedger(seed=8)
+        stx = list(gen.generate(1, with_notary_sig=True).values())[0]
+        try:
+            fut = service.verify_stx(stx, _resolver(gen))
+            # "worker" consumes AND acks, then crashes without replying —
+            # the lost-reply case redelivery cannot recover
+            leased = broker.consume(VERIFICATION_REQUESTS_QUEUE, timeout=5)
+            assert leased is not None
+            broker.ack(leased.msg_id)
+            worker = VerifierWorker(broker).start()
+            try:
+                fut.result(timeout=20)   # republish → healthy worker → ok
+                assert service.retries >= 1
+                assert service.timeouts == 0
+            finally:
+                worker.stop()
+        finally:
+            service.shutdown()
+            broker.close()
 
     def test_worker_death_redistributes(self):
         """A request consumed but never acked must redeliver to a healthy
