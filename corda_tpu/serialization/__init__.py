@@ -6,6 +6,7 @@ from .cbe import (
     deserialize,
     encode,
     register_custom,
+    register_rename,
     serialize,
 )
 from .carpenter import CarpenterError, ClassCarpenter, carpent
@@ -18,6 +19,7 @@ __all__ = [
     "deserialize",
     "encode",
     "register_custom",
+    "register_rename",
     "serialize",
     "CarpenterError",
     "ClassCarpenter",

@@ -82,7 +82,9 @@ class GenericRecord:
         return dict(self.fields)
 
 
-def cbe_serializable(cls=None, *, name: str | None = None):
+def cbe_serializable(cls=None, *, name: str | None = None,
+                     renamed_from: tuple = (),
+                     field_aliases: dict | None = None):
     """Class decorator registering a dataclass for CBE object encoding.
 
     The equivalent of the reference's ``@CordaSerializable`` marker
@@ -90,7 +92,25 @@ def cbe_serializable(cls=None, *, name: str | None = None):
     doubles as the serialization *whitelist* (CordaClassResolver parity):
     only registered types round-trip to their Python class; everything else
     surfaces as :class:`GenericRecord`.
+
+    Evolution (the role of the reference's ``EvolutionSerializer``,
+    node-api/.../serialization/amqp/EvolutionSerializer.kt — a rolling
+    upgrade must let old and new versions of a type cross the wire in both
+    directions without wedging either side):
+
+    - **added field** (old writer → new reader): absent fields take the
+      dataclass default; a field added *without* a default raises a clean
+      ``SerializationError`` naming the type, never a bare TypeError.
+    - **removed field** (old writer → new reader): unknown keys in the
+      payload are dropped (the new class no longer carries them).
+    - **renamed field**: ``field_aliases={"new_name": "old_name"}`` maps an
+      old writer's key onto the renamed field (the
+      CordaSerializationTransformRenames role).
+    - **renamed type**: ``renamed_from=("old.wire.Name", ...)`` registers
+      decode aliases so payloads tagged with a retired type name decode
+    through the current class; encoding always uses the current name.
     """
+    aliases = dict(field_aliases or {})
 
     def wrap(c):
         type_name = name or f"{c.__module__.split('.')[-1]}.{c.__qualname__}"
@@ -104,14 +124,47 @@ def cbe_serializable(cls=None, *, name: str | None = None):
         def from_fields(d: dict):
             known = {f.name for f in dataclasses.fields(c)}
             kwargs = {k: v for k, v in d.items() if k in known}
-            return c(**kwargs)  # missing fields must have defaults (evolution)
+            for new, old in aliases.items():
+                if new not in kwargs and old in d:
+                    kwargs[new] = d[old]
+            try:
+                return c(**kwargs)
+            except TypeError as e:
+                raise SerializationError(
+                    f"evolution mismatch decoding {type_name!r}: {e} — a "
+                    "field added after a writer's version must carry a "
+                    "default"
+                ) from None
 
         _REGISTRY[type_name] = (c, from_fields)
         _ENCODERS[c] = (type_name, to_fields)
         c.__cbe_name__ = type_name
+        for old_name in renamed_from:
+            register_rename(old_name, c)
         return c
 
     return wrap(cls) if cls is not None else wrap
+
+
+def register_rename(old_name: str, cls: type) -> None:
+    """Alias a retired wire name onto ``cls``'s current registration, so
+    payloads written by peers still running the old type name decode into
+    the current class (renamed-type evolution). The current name stays the
+    only one encoded."""
+    current = _ENCODERS.get(cls)
+    if current is None:
+        raise SerializationError(
+            f"{cls.__qualname__} must be registered before aliasing "
+            f"{old_name!r} to it"
+        )
+    existing = _REGISTRY.get(old_name)
+    if existing is not None and existing[0] is not cls:
+        raise SerializationError(
+            f"serialization name {old_name!r} already registered for "
+            f"{existing[0].__qualname__}; refusing to alias to "
+            f"{cls.__qualname__}"
+        )
+    _REGISTRY[old_name] = (cls, _REGISTRY[current[0]][1])
 
 
 def register_custom(cls: type, name: str, to_fields, from_fields) -> None:
