@@ -626,6 +626,22 @@ class StateMachineManager:
             live = set(self._flows) | set(self._park_key_of) | self._queued
             return list(live)
 
+    def flows_detail(self) -> dict[str, str]:
+        """flow id → what it is doing ("running", "queued", or
+        "parked@<wake key>") — the operator's first question about a
+        wedged flow is what it is waiting on. Kept separate from
+        ``flows_in_progress`` so id-membership consumers stay stable."""
+        with self._lock:
+            out: dict[str, str] = {}
+            for fid in set(self._flows) | set(self._park_key_of) | self._queued:
+                if fid in self._park_key_of:
+                    out[fid] = f"parked@{self._park_key_of[fid]}"
+                elif fid in self._queued:
+                    out[fid] = "queued"
+                else:
+                    out[fid] = "running"
+            return out
+
     def handle_of(self, flow_id: str) -> FlowHandle | None:
         """Handle for a running flow (None once finished and pruned)."""
         with self._lock:

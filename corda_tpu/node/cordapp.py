@@ -37,6 +37,61 @@ class Cordapp:
     serializable_types: tuple[str, ...]
 
 
+# app-provided node services: (services-hub attribute name, class).
+# Populated at cordapp import time by @CordaService; instantiated per node
+# by install_corda_services (reference: @CordaService classes found by the
+# cordapp scan and built in AbstractNode.installCordaServices).
+_CORDA_SERVICES: list[tuple[str, type]] = []
+
+
+def CordaService(attr_name: str):
+    """Register the decorated class as a node service: every node that
+    loads the defining cordapp instantiates it at boot as
+    ``services.<attr_name>`` with ``cls(services, party, keypair)``
+    (reference: @CordaService + AbstractNode.installCordaServices — the
+    oracle-in-a-node pattern, NodeInterestRates.kt:79)."""
+
+    def deco(cls):
+        _CORDA_SERVICES.append((attr_name, cls))
+        cls._corda_service_attr = attr_name
+        return cls
+
+    return deco
+
+
+def install_corda_services(services, party, keypair,
+                           loaded_modules=None) -> list[str]:
+    """Instantiate registered cordapp services onto a node's ServiceHub.
+    ``loaded_modules`` restricts installation to services whose defining
+    module is among THIS node's loaded cordapps — the registry is
+    process-global, and in multi-node processes (mocknet, tests) a node
+    that never loaded the defining app must not acquire its services
+    (e.g. an oracle signing under the wrong node's identity). One broken
+    service must not stop the boot (mirrors the loader's skip-on-error
+    policy)."""
+    installed = []
+    for attr, cls in _CORDA_SERVICES:
+        if (loaded_modules is not None
+                and cls.__module__ not in loaded_modules):
+            continue
+        if hasattr(services, attr):
+            # never let an app shadow a core hub service ("vault_service",
+            # "metrics", …) — the node would run with a cordapp object
+            # where the vault should be and fail far from the cause
+            logger.error(
+                "refusing to install corda service %r from %s: the name "
+                "collides with an existing ServiceHub attribute",
+                attr, cls.__module__,
+            )
+            continue
+        try:
+            setattr(services, attr, cls(services, party, keypair))
+            installed.append(attr)
+        except Exception:
+            logger.exception("failed to install corda service %r", attr)
+    return installed
+
+
 def _registry_snapshot():
     from corda_tpu.flows.api import _RESPONDERS
     from corda_tpu.ledger.states import _CONTRACT_REGISTRY

@@ -147,8 +147,28 @@ class Node:
         self.rpc_server = RPCServer(
             self.rpc_ops, messaging, rpc_users=config.rpc_users
         )
+        from .scheduler import make_scheduled_flow_starter
+
+        self._start_scheduled_flow = make_scheduled_flow_starter(
+            self.smm, self.party.name
+        )
         self.scheduler = NodeSchedulerService(self._start_scheduled_flow)
         self.services.scheduler_service = self.scheduler
+        # SchedulableState outputs recorded to the vault drive time-based
+        # flow starts (reference: ScheduledActivityObserver wired in
+        # AbstractNode); the track snapshot re-derives schedules on restart
+        self.scheduler.observe_vault(self.services.vault_service)
+        # app-provided node services (reference: @CordaService classes
+        # instantiated in AbstractNode.installCordaServices) — only those
+        # defined by cordapps THIS node's config loaded
+        from corda_tpu.node.cordapp import install_corda_services
+
+        install_corda_services(
+            self.services, self.party, self.keypair,
+            loaded_modules={
+                app.module for app in self.cordapp_loader.cordapps
+            },
+        )
         self._started = False
 
     # ------------------------------------------------------------ assembly
@@ -223,12 +243,6 @@ class Node:
             )
         self._started = True
         return self
-
-    def _start_scheduled_flow(self, flow_class_path: str, args):
-        from corda_tpu.flows.api import load_class
-
-        cls = load_class(flow_class_path)
-        return self.smm.start_flow(cls(*args))
 
     def run_flow(self, flow, timeout: float = 60):
         return self.smm.start_flow(flow).result.result(timeout=timeout)

@@ -185,6 +185,16 @@ def main(argv=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)-7s [%(name)s] %(message)s",
     )
+    # operator stack dump on demand: `kill -USR1 <pid>` writes every
+    # thread's Python stack to stderr (the node log) — the first tool for
+    # a wedged node (reference role: jstack on a JVM node)
+    import faulthandler
+    import signal
+
+    try:
+        faulthandler.register(signal.SIGUSR1)
+    except (AttributeError, ValueError):
+        pass  # platform without SIGUSR1; non-main-thread registration
     if not args.no_banner:
         print(BANNER)
 

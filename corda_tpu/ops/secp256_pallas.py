@@ -236,10 +236,20 @@ def fe_is_zero(env, a):
 # Ports of secp256.point_add / point_double (RCB16 Alg 1 and 3) to the
 # limb-major layout; correct for ALL inputs including the identity.
 
+def _one_hot_first(blk):
+    """Limb plane holding 1: built by concatenation, NOT ``.at[].set`` —
+    scatter has no Mosaic TPU lowering (same lesson as ed25519_pallas
+    block-256 in r1; confirmed again on first chip contact r4)."""
+    return jnp.concatenate(
+        [jnp.ones((1, blk), jnp.int32),
+         jnp.zeros((LIMBS - 1, blk), jnp.int32)],
+        axis=0,
+    )
+
+
 def identity_point(blk):
     zero = jnp.zeros((LIMBS, blk), dtype=jnp.int32)
-    one = zero.at[0, :].set(1)
-    return (zero, one, zero)
+    return (zero, _one_hot_first(blk), zero)
 
 
 def point_add(env: Env, P, Q):
@@ -337,8 +347,7 @@ def _verify_block(env: Env, qx, qy, read_windows, ra, rb, rb_ok, precheck):
     the hardware run. ``read_windows(base_row) -> (u1_rows, u2_rows)``
     abstracts the 8-aligned sublane read."""
     blk = qx.shape[1]
-    one = jnp.zeros((LIMBS, blk), jnp.int32).at[0, :].set(1)
-    Q = (qx, qy, one)
+    Q = (qx, qy, _one_hot_first(blk))
     q_ok = on_curve(env, qx, qy)
 
     # variable-base table: k·Q for k = 0..15 (14 point ops per block)

@@ -132,13 +132,20 @@ def _tx_id_roots(wtxs: list):
             cursor += len(raws)
         spans.append(tx_spans)
 
+    import hashlib
+
     import jax.numpy as jnp
 
     # ---- stage 1+2: nonces, then leaves = sha256(nonce ‖ component).
-    # The nonce readback is inherent (leaf messages are host-assembled
-    # variable-length concatenations); the LEAF digests stay on device —
+    # The NONCES hash on HOST: they are tiny fixed-length messages whose
+    # digests must come back to assemble the variable-length leaf
+    # messages anyway — a device dispatch here would put a full
+    # interconnect round trip (~0.6 s over the tunneled link) INSIDE the
+    # enqueue path, serializing every pipelined caller on it (exactly
+    # what collapsed the r4 notary stream to 492 tx/s; host hashlib does
+    # the same 8k digests in ~10 ms). The LEAF digests stay on device —
     # they only feed the Merkle reduction.
-    nonces = sha256_batch(nonce_msgs)
+    nonces = [hashlib.sha256(m).digest() for m in nonce_msgs]
     leaf_words = (
         sha256_batch_words([n + c for n, c in zip(nonces, comp_bytes)])
         if nonces
@@ -201,24 +208,80 @@ class PendingIds:
         self._cold = []
 
 
+_ids_tier_cache: str | None = None
+
+
+def ids_tier() -> str:
+    """Where the Merkle-id sweep runs: ``"host"`` or ``"device"``.
+
+    The id sweep is BANDWIDTH/LATENCY work, not math: it uploads every
+    component byte to hash them once, so on a tunneled chip (~100 ms
+    round trip) the host's cached-bytes hashlib path wins by ~5× — the
+    chip's margin belongs to the signature ladders, which upload 100
+    bytes per lane and compute thousands of field ops on them. A local
+    PCIe/ICI chip (sub-ms link) amortizes the upload and the device
+    sweep frees the host. Decided once per process from a measured
+    round trip; override with CORDA_TPU_IDS=host|device."""
+    global _ids_tier_cache
+    if _ids_tier_cache is None:
+        import os
+
+        forced = os.environ.get("CORDA_TPU_IDS", "").strip().lower()
+        if forced in ("host", "device"):
+            _ids_tier_cache = forced
+        else:
+            _ids_tier_cache = (
+                "device" if _measured_link_rtt_s() < 0.005 else "host"
+            )
+    return _ids_tier_cache
+
+
+def _measured_link_rtt_s() -> float:
+    """One tiny dispatch+readback, median of 3 (first call pays compile)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        if jax.default_backend() == "cpu":
+            return 0.0
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.zeros((8,), jnp.int32)).block_until_ready()  # compile
+        samples = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(f(jnp.zeros((8,), jnp.int32)))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[1]
+    except Exception:
+        return float("inf")  # unreachable backend: host
+
+
 def dispatch_prime_ids(stxs: list) -> PendingIds:
-    """Enqueue the device id sweep for every SignedTransaction whose wire
-    tx has a cold id cache; ``collect()`` primes the caches.
+    """Enqueue the id sweep for every SignedTransaction whose wire tx has
+    a cold id cache; ``collect()`` primes the caches.
 
     This is the notary's receive-path integrity work (reference:
     WireTransaction.kt:139-195 — the id IS the Merkle root over the
     components, so a peer cannot claim an id its content doesn't hash to):
     the id each signature is checked against is recomputed from the
     component bytes here, and the signature batch then fails any lane whose
-    signer signed a different root."""
-    import jax.numpy as jnp
-
+    signer signed a different root. Tier per ``ids_tier()``: the host path
+    computes (and caches) ids synchronously — returning an empty pending —
+    while the device path enqueues the batched sweep."""
     cold = [
         stx for stx in stxs
         if "_id" not in object.__getattribute__(stx.tx, "__dict__")
     ]
     if not cold:
         return PendingIds([], None)
+    if ids_tier() == "host":
+        _host_prime_ids(cold)
+        return PendingIds([], None)
+    import jax.numpy as jnp
+
     roots, pool = _tx_id_roots([stx.tx for stx in cold])
     id_words = jnp.take(pool, jnp.asarray(np.array(roots)), axis=0)
     return PendingIds(cold, id_words)
@@ -229,13 +292,99 @@ def prime_ids(stxs: list) -> None:
     dispatch_prime_ids(stxs).collect()
 
 
+_id_engine_lib = None
+_id_engine_failed = False
+
+
+def _load_id_engine():
+    """ctypes-bind native/id_engine.cpp (build-on-first-use); None when the
+    toolchain is unavailable — callers fall back to hashlib."""
+    global _id_engine_lib, _id_engine_failed
+    if _id_engine_lib is not None or _id_engine_failed:
+        return _id_engine_lib
+    try:
+        import ctypes
+        from pathlib import Path
+
+        from corda_tpu.native_build import build_and_load
+
+        lib = build_and_load(
+            Path(__file__).resolve().parents[2] / "native" / "id_engine.cpp"
+        )
+        lib.corda_compute_tx_ids.restype = ctypes.c_int
+        lib.corda_compute_tx_ids.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_char_p,
+        ]
+        _id_engine_lib = lib
+    except Exception:
+        _id_engine_failed = True
+    return _id_engine_lib
+
+
+def _host_prime_ids(cold_stxs: list) -> None:
+    """Host id sweep: the native engine runs the whole nonce→leaf→group→top
+    schedule in C++ (~30 digests/tx at ~1 µs each — hashlib's per-call
+    interpreter overhead capped this stage near 7k tx/s); per-tx hashlib
+    is the fallback."""
+    import ctypes
+
+    lib = _load_id_engine()
+    if lib is None:
+        for stx in cold_stxs:
+            stx.tx.id  # property computes + caches via host hashlib
+        return
+    from corda_tpu.ledger.wire import ComponentGroupType
+
+    groups = list(ComponentGroupType)
+    salts = b"".join(
+        bytes(stx.tx.privacy_salt.salt) for stx in cold_stxs
+    )
+    chunks: list[bytes] = []
+    lens: list[int] = []
+    counts: list[int] = []
+    for stx in cold_stxs:
+        for g in groups:
+            rows = stx.tx.component_bytes(g)
+            counts.append(len(rows))
+            for r in rows:
+                chunks.append(r)
+                lens.append(len(r))
+    data = b"".join(chunks)
+    out = ctypes.create_string_buffer(32 * len(cold_stxs))
+    rc = lib.corda_compute_tx_ids(
+        salts, data,
+        (ctypes.c_int32 * len(lens))(*lens),
+        (ctypes.c_int32 * len(counts))(*counts),
+        len(cold_stxs), len(groups), out,
+    )
+    if rc != 0:
+        for stx in cold_stxs:
+            stx.tx.id
+        return
+    raw = out.raw
+    for i, stx in enumerate(cold_stxs):
+        object.__getattribute__(stx.tx, "__dict__")["_id"] = SecureHash(
+            raw[32 * i: 32 * i + 32]
+        )
+
+
 def check_and_prime_ids(stxs: dict) -> None:
-    """Device-recompute the id of every SignedTransaction in
+    """Recompute the id of every SignedTransaction in
     ``{claimed_id: stx}``; raise on any mismatch (forged chain link),
     otherwise PRIME each WireTransaction's id cache so downstream host
-    code never re-hashes (the per-tx hot-path cost this kernel removes)."""
+    code never re-hashes. Same host/device routing as
+    ``dispatch_prime_ids`` (``ids_tier()``)."""
     items = list(stxs.items())
-    ids = compute_tx_ids([stx.tx for _tid, stx in items])
+    if ids_tier() == "host":
+        for _tid, stx in items:
+            # drop any pre-set cache: the check must hash the bytes
+            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+        _host_prime_ids([stx for _tid, stx in items])
+        ids = [stx.tx.id for _tid, stx in items]
+    else:
+        ids = compute_tx_ids([stx.tx for _tid, stx in items])
     for (claimed, stx), computed in zip(items, ids):
         if computed != claimed:
             from corda_tpu.ledger.states import TransactionVerificationException

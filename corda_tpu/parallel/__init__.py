@@ -9,7 +9,9 @@ sharded batches.
 """
 
 from .mesh import (
+    ChunkedMask,
     MeshVerifier,
+    distributed_ecdsa_step,
     distributed_verify_step,
     enable_service_mesh,
     make_mesh,
@@ -27,7 +29,8 @@ from .wavefront import (
 )
 
 __all__ = [
-    "MeshVerifier", "distributed_verify_step", "enable_service_mesh",
+    "ChunkedMask", "MeshVerifier", "distributed_ecdsa_step",
+    "distributed_verify_step", "enable_service_mesh",
     "make_mesh", "service_mesh_active", "service_mesh_verifier",
     "shard_batch",
     "DagVerificationError", "DagVerifyResult", "DoubleSpendInDagError",

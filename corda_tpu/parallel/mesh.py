@@ -95,6 +95,79 @@ def distributed_verify_step(mesh: Mesh, with_spent: bool = True):
     return jax.jit(sharded)
 
 
+def distributed_ecdsa_step(mesh: Mesh, curve_name: str):
+    """Build the jitted multi-chip ECDSA verify step for ``mesh``: the
+    mixed-scheme analogue of ``distributed_verify_step`` (the reference's
+    fan-out load-balances ALL verification work across workers,
+    Verifier.kt:66-84 — not just one scheme). Inputs are the compact uint8
+    byte planes of ``ops.secp256._prep_byte_planes`` batch-sharded on axis
+    0; each device runs the windowed Pallas ladder (TPU) or the XLA
+    bit-serial ladder (CPU tier) on its shard. Verdict-only — the ECDSA
+    bucket never carries the notary spent-gather (that collective rides the
+    dominant ed25519 step once per batch)."""
+    spec = P("batch")
+    on_tpu = jax.default_backend() == "tpu"
+
+    def step(qx, qy, u1, u2, ra, rb, rb_ok, pre):
+        if on_tpu:
+            from corda_tpu.ops.secp256_pallas import ecdsa_verify_pallas
+
+            return ecdsa_verify_pallas(
+                curve_name, qx, qy, u1, u2, ra, rb, rb_ok, pre
+            )
+        from corda_tpu.ops.secp256 import ecdsa_verify_core
+
+        bit = jnp.arange(8, dtype=jnp.int32)
+
+        def bits(x):
+            return ((x[:, :, None].astype(jnp.int32) >> bit) & 1).reshape(
+                x.shape[0], 256
+            )
+
+        return ecdsa_verify_core(
+            curve_name,
+            qx.astype(jnp.int32), qy.astype(jnp.int32),
+            bits(u1), bits(u2),
+            ra.astype(jnp.int32), rb.astype(jnp.int32),
+            rb_ok, pre,
+        )
+
+    return jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(spec,) * 8, out_specs=spec,
+        **_shard_map_compat_kwargs(),
+    ))
+
+
+class ChunkedMask:
+    """Deferred verdict mask assembled from per-device chunk dispatches
+    (the SPHINCS fan-out shape). Quacks like a device array for the two
+    things callers do with a dispatched mask: ``copy_to_host_async()`` and
+    ``np.asarray(mask)[:n]``."""
+
+    __slots__ = ("_parts", "_n")
+
+    def __init__(self, parts: list[tuple[int, int, object]], n: int):
+        self._parts = parts  # (lo, hi, device_mask) per chunk
+        self._n = n
+
+    @property
+    def shape(self) -> tuple[int]:
+        return (self._n,)
+
+    def copy_to_host_async(self) -> None:
+        for _lo, _hi, m in self._parts:
+            try:
+                m.copy_to_host_async()
+            except AttributeError:
+                pass
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.zeros(self._n, dtype=bool)
+        for lo, hi, m in self._parts:
+            out[lo:hi] = np.asarray(m)[: hi - lo]
+        return out if dtype is None else out.astype(dtype)
+
+
 def _shard_map_compat_kwargs() -> dict:
     """Relax replication/varying-axis checking: the kernel's loop carries
     are initialized from constants (unvarying) and become batch-varying
@@ -167,6 +240,7 @@ class MeshVerifier:
         # the spent-set all-gather + psum
         self._step_mask = distributed_verify_step(self.mesh, with_spent=False)
         self._step_spent = distributed_verify_step(self.mesh, with_spent=True)
+        self._ecdsa_steps: dict[str, object] = {}  # curve → compiled step
 
     def _bucket(self, n: int, min_bucket: int | None) -> int:
         from corda_tpu.ops._blockpack import pow2_at_least
@@ -205,3 +279,78 @@ class MeshVerifier:
             shard_batch(self.mesh, a) for a in (*planes, spent)
         )
         return self._step_spent(*args)
+
+    # ------------------------------------------------- mixed-scheme fan-out
+
+    def dispatch_ecdsa_rows(
+        self,
+        curve_name: str,
+        pubkeys: list[bytes],
+        signatures: list[bytes],
+        messages: list[bytes],
+        min_bucket: int | None = None,
+    ):
+        """Shard an ECDSA bucket over the mesh (async, like the single-chip
+        ``ecdsa_verify_dispatch``): returns the bucket-padded device mask;
+        slice ``[:len(pubkeys)]`` after ``np.asarray``. Bucket floor is the
+        per-device pallas block width × mesh size on TPU so every shard
+        satisfies the kernel's block constraint."""
+        from corda_tpu.ops._blockpack import ECDSA_BLOCK, pow2_at_least
+        from corda_tpu.ops.secp256 import _prep_byte_planes
+
+        n = len(pubkeys)
+        per_dev = ECDSA_BLOCK if jax.default_backend() == "tpu" else 8
+        b = pow2_at_least(
+            max(n, 1), max(min_bucket or 0, per_dev * self.n_devices)
+        )
+        planes = _prep_byte_planes(
+            curve_name, pubkeys, signatures, messages, b
+        )
+        step = self._ecdsa_steps.get(curve_name)
+        if step is None:
+            step = self._ecdsa_steps[curve_name] = distributed_ecdsa_step(
+                self.mesh, curve_name
+            )
+        args = tuple(shard_batch(self.mesh, np.asarray(a)) for a in planes)
+        return step(*args)
+
+    def dispatch_sphincs_rows(
+        self,
+        pubkeys: list[bytes],
+        signatures: list[bytes],
+        messages: list[bytes],
+        min_bucket: int | None = None,
+    ) -> ChunkedMask:
+        """Fan a SPHINCS bucket out over the mesh devices by contiguous
+        lane chunks — one ``sphincs_verify_dispatch`` enqueue per device.
+
+        SPHINCS verification is ~100 chained eager hash dispatches with
+        host-known sibling orders between them (ops/sphincs_batch.py), not
+        one jittable core, so the mesh strategy is per-device streams
+        rather than shard_map: every chunk's whole chain enqueues on its
+        own device before any readback, so devices verify concurrently —
+        exactly the reference's N-independent-workers shape
+        (Verifier.kt:66-84) with devices in place of worker processes.
+        Equal-size chunks keep the per-device compiled shapes identical
+        (one compile serves all devices)."""
+        from corda_tpu.ops.sphincs_batch import sphincs_verify_dispatch
+
+        n = len(pubkeys)
+        devs = list(self.mesh.devices.reshape(-1))
+        # lanes-per-chunk floor of 4 keeps tiny batches off an 8-way fan
+        # (each chunk pads to ≥ the scheme's internal floor anyway)
+        n_chunks = max(1, min(len(devs), (n + 3) // 4))
+        bounds = [
+            (n * c // n_chunks, n * (c + 1) // n_chunks)
+            for c in range(n_chunks)
+        ]
+        parts: list[tuple[int, int, object]] = []
+        for dev, (lo, hi) in zip(devs, bounds):
+            if hi == lo:
+                continue
+            with jax.default_device(dev):
+                parts.append((lo, hi, sphincs_verify_dispatch(
+                    pubkeys[lo:hi], signatures[lo:hi], messages[lo:hi],
+                    min_bucket=min_bucket,
+                )))
+        return ChunkedMask(parts, n)

@@ -77,6 +77,12 @@ class CordaRPCOps:
     def state_machines_snapshot(self) -> list[str]:
         return self._smm.flows_in_progress()
 
+    def state_machines_detail(self) -> dict:
+        """flow id → "running" | "queued" | "parked@<wake key>" — the
+        wedged-flow diagnostic surface (what is each live flow waiting
+        on)."""
+        return self._smm.flows_detail()
+
     def registered_flows(self) -> list[str]:
         return list(self._registered_flows)
 

@@ -6,6 +6,10 @@
   (samples/notary-demo — baseline config #5 shape).
 - ``oracle_demo`` — interest-rate-style oracle signing over
   FilteredTransaction tear-offs (samples/irs-demo NodeInterestRates.kt:79).
+- ``irs_demo`` — the full interest-rate-swap lifecycle: fixed/floating
+  legs, SchedulableState fixing schedule, scheduler-fired FixingFlow
+  through the oracle tear-off to maturity (samples/irs-demo
+  contract/IRS.kt + flows/FixingFlow.kt).
 - ``attachment_demo`` — attachment upload + propagation through the
   back-chain protocol (samples/attachment-demo).
 - ``bank_demo`` — issuer node serving cash issuance over RPC

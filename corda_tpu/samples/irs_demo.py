@@ -1,0 +1,672 @@
+"""Interest-rate-swap demo: the full deal lifecycle under the scheduler.
+
+Capability parity with the reference's biggest sample
+(samples/irs-demo/.../contract/IRS.kt:1-749 — fixed/floating legs with
+payment schedules, daycount math, ``Agree``/``Refix``/``Mature`` clauses;
+flows/FixingFlow.kt — a ``@SchedulableFlow`` role-decider both participants'
+schedulers fire at each fixing date, with the deterministic leader driving
+the oracle round; api/NodeInterestRates.kt:79-126 — the rates oracle signing
+a Merkle tear-off). This is the one reference capability chain —
+``SchedulableState`` → scheduler → flow → oracle → notarise — exercised
+end-to-end by a time-driven sample rather than a hand-started flow.
+
+TPU-idiomatic re-design, not a translation: money and rates are integer
+basis points (device-friendly fixed point, no BigDecimal), daycount is an
+explicit ACT/360 integer day span per event, and the schedule separates
+*calendar labels* (what the oracle is asked: ISO dates) from *scheduler
+timestamps* (when the node wakes: unix seconds) so a multi-year schedule
+can be compressed into seconds for demos and driver tests while the
+daycount math stays real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime as _dt
+import time
+
+from corda_tpu.flows import (
+    CollectSignaturesFlow,
+    FinalityFlow,
+    FlowException,
+    FlowLogic,
+    InitiatedBy,
+    SignTransactionFlow,
+)
+from corda_tpu.ledger import (
+    ComponentGroupType,
+    FilteredTransaction,
+    Party,
+    StateAndRef,
+    StateRef,
+    TransactionBuilder,
+    register_contract,
+)
+from corda_tpu.node.cordapp import CordaService
+from corda_tpu.node.scheduler import ScheduledActivity
+from corda_tpu.samples.oracle_demo import (
+    Fix,
+    FixOf,
+    FixQueryFlow,
+    FixSignFlow,
+    RatesOracle,
+)
+from corda_tpu.serialization import cbe_serializable
+
+IRS_PROGRAM_ID = "samples.InterestRateSwap"
+
+UNFIXED = -1  # rate_bp sentinel: floating event awaiting its fixing
+
+
+# ------------------------------------------------------------------ model
+
+@cbe_serializable(name="samples.RatePaymentEvent")
+@dataclasses.dataclass(frozen=True)
+class RatePaymentEvent:
+    """One dated payment obligation on a leg (reference: RatePaymentEvent,
+    IRS.kt:61-103 — here with integer daycount + basis-point fixed
+    point)."""
+
+    index_date: str    # calendar label the oracle quotes for (ISO date)
+    accrual_days: int  # ACT/360 daycount numerator for the period
+    payment_at: float  # unix seconds the net payment falls due
+    fixing_at: float   # unix seconds the rate fixes (0 on the fixed leg)
+    rate_bp: int       # basis points; UNFIXED until the oracle round
+
+    @property
+    def is_fixed(self) -> bool:
+        return self.rate_bp != UNFIXED
+
+    def flow_of(self, notional: int) -> int:
+        """The period's payment amount in currency units (reference:
+        RatePaymentEvent.flow — dayCountFactor × notional × rate)."""
+        if not self.is_fixed:
+            raise ValueError("event has no rate yet")
+        return notional * self.rate_bp * self.accrual_days // (360 * 10_000)
+
+
+@cbe_serializable(name="samples.IRSState")
+@dataclasses.dataclass(frozen=True)
+class IRSState:
+    """The swap deal state (reference: InterestRateSwap.State,
+    IRS.kt:572-637 — FixableDealState + SchedulableState). Implements
+    ``next_scheduled_activity`` so recording it in a vault arms the node
+    scheduler for the next fixing (or maturity once fully fixed)."""
+
+    fixed_rate_payer: Party
+    floating_rate_payer: Party
+    oracle: Party
+    notional: int
+    currency: str
+    index_name: str      # e.g. "LIBOR"
+    index_tenor: str     # e.g. "3M"
+    fixed_rate_bp: int
+    fixed_schedule: tuple     # tuple[RatePaymentEvent, ...]
+    floating_schedule: tuple  # tuple[RatePaymentEvent, ...]
+    maturity_at: float        # unix seconds the deal may be matured
+    linear_id: bytes          # constant through refixes (deal identity)
+
+    @property
+    def participants(self):
+        return [self.fixed_rate_payer, self.floating_rate_payer]
+
+    # -- fixing protocol (reference: FixableDealState.nextFixingOf) -------
+    def next_fixing(self):
+        """(index, FixOf, fixing_at) of the earliest unfixed floating
+        event, or None when fully fixed."""
+        for i, ev in enumerate(self.floating_schedule):
+            if not ev.is_fixed:
+                return i, FixOf(self.index_name, ev.index_date,
+                                self.index_tenor), ev.fixing_at
+        return None
+
+    def with_fix(self, index: int, rate_bp: int) -> "IRSState":
+        ev = self.floating_schedule[index]
+        if ev.is_fixed:
+            raise ValueError("event already fixed")
+        # tuple() both ways: a vault-loaded state's schedule is a
+        # CBE-decoded list, a fresh one a tuple
+        sched = (
+            tuple(self.floating_schedule[:index])
+            + (dataclasses.replace(ev, rate_bp=rate_bp),)
+            + tuple(self.floating_schedule[index + 1:])
+        )
+        return dataclasses.replace(self, floating_schedule=sched)
+
+    # -- scheduler protocol (reference: SchedulableState, IRS.kt:614) -----
+    def next_scheduled_activity(self, ref: StateRef):
+        nxt = self.next_fixing()
+        if nxt is not None:
+            _i, _of, at = nxt
+            return ScheduledActivity(
+                at, "corda_tpu.samples.irs_demo:FixingRoleDecider", (ref,)
+            )
+        return ScheduledActivity(
+            self.maturity_at,
+            "corda_tpu.samples.irs_demo:FixingRoleDecider", (ref,),
+        )
+
+    # -- reporting --------------------------------------------------------
+    def net_payments(self) -> list[dict]:
+        """Per-period settlement report: fixed vs floating flows and the
+        net payer (the role of the reference's IRSExport/CSV table)."""
+        out = []
+        for fe, fl in zip(self.fixed_schedule, self.floating_schedule):
+            fixed_flow = fe.flow_of(self.notional)
+            float_flow = fl.flow_of(self.notional) if fl.is_fixed else None
+            net = None if float_flow is None else fixed_flow - float_flow
+            out.append({
+                "date": fe.index_date,
+                "fixed": fixed_flow,
+                "floating": float_flow,
+                "net_from_fixed_payer": net,
+            })
+        return out
+
+
+# --------------------------------------------------------------- commands
+
+@cbe_serializable(name="samples.IRSAgree")
+@dataclasses.dataclass(frozen=True)
+class Agree:
+    """reference: InterestRateSwap.Commands.Agree (IRS.kt:590)."""
+
+
+@cbe_serializable(name="samples.IRSRefix")
+@dataclasses.dataclass(frozen=True)
+class Refix:
+    """Participants' command on a fixing transaction; the oracle-attested
+    ``Fix`` rides as its own command (reference: Commands.Refix carrying
+    the fix, IRS.kt:591 — split here so the oracle tear-off predicate is
+    exactly 'commands whose value is a Fix', oracle_demo.RatesOracle)."""
+
+
+@cbe_serializable(name="samples.IRSMature")
+@dataclasses.dataclass(frozen=True)
+class Mature:
+    """reference: InterestRateSwap.Commands.Mature (IRS.kt:593)."""
+
+
+# --------------------------------------------------------------- contract
+
+def _require(cond: bool, msg: str) -> None:
+    from corda_tpu.ledger.states import TransactionVerificationException
+
+    if not cond:
+        raise TransactionVerificationException(None, msg)
+
+
+def _schedules_aligned(a: tuple, b: tuple) -> bool:
+    return len(a) == len(b) and all(
+        x.index_date == y.index_date and x.accrual_days == y.accrual_days
+        for x, y in zip(a, b)
+    )
+
+
+@register_contract(IRS_PROGRAM_ID)
+class InterestRateSwap:
+    """Verifies Agree / Refix / Mature (reference: InterestRateSwap.verify
+    dispatching verifyAgreeCommand/verifyFixCommand/verifyMatureCommand,
+    IRS.kt:560-586)."""
+
+    def verify(self, tx) -> None:
+        ins = tx.inputs_of_type(IRSState)
+        outs = tx.outputs_of_type(IRSState)
+        agree = tx.commands_of_type(Agree)
+        refix = tx.commands_of_type(Refix)
+        mature = tx.commands_of_type(Mature)
+        _require(
+            len(agree) + len(refix) + len(mature) == 1,
+            "exactly one IRS command per transaction",
+        )
+        if agree:
+            self._verify_agree(ins, outs, agree[0])
+        elif refix:
+            self._verify_refix(tx, ins, outs, refix[0])
+        else:
+            self._verify_mature(ins, outs, mature[0])
+
+    @staticmethod
+    def _verify_agree(ins, outs, cmd) -> None:
+        # reference: verifyAgreeCommand, IRS.kt:491-511
+        _require(not ins and len(outs) == 1,
+                 "an agreement has no IRS inputs and one IRS output")
+        irs = outs[0]
+        _require(bool(irs.fixed_schedule) and bool(irs.floating_schedule),
+                 "both legs must have payment schedules")
+        _require(irs.notional > 0, "the notional must be positive")
+        _require(irs.fixed_rate_bp > 0, "the fixed rate must be positive")
+        _require(
+            irs.fixed_rate_payer.owning_key
+            != irs.floating_rate_payer.owning_key,
+            "the legs must have distinct payers",
+        )
+        _require(
+            _schedules_aligned(irs.fixed_schedule, irs.floating_schedule),
+            "leg schedules must cover the same periods",
+        )
+        _require(
+            all(ev.rate_bp == irs.fixed_rate_bp for ev in irs.fixed_schedule),
+            "fixed-leg events must carry the agreed fixed rate",
+        )
+        _require(
+            all(not ev.is_fixed for ev in irs.floating_schedule),
+            "floating-leg events must start unfixed",
+        )
+        _require(
+            all(ev.fixing_at > 0 for ev in irs.floating_schedule),
+            "floating-leg events must carry fixing times",
+        )
+        for p in irs.participants:
+            _require(p.owning_key in cmd.signers,
+                     "both participants must sign the agreement")
+
+    @staticmethod
+    def _verify_refix(tx, ins, outs, cmd) -> None:
+        # reference: verifyFixCommand, IRS.kt:513-544
+        _require(len(ins) == 1 and len(outs) == 1,
+                 "a refix consumes and re-issues exactly one deal")
+        prev, cur = ins[0], outs[0]
+        fixes = tx.commands_of_type(Fix)
+        _require(len(fixes) == 1, "a refix carries exactly one Fix command")
+        fix = fixes[0].value
+        _require(cur.oracle.owning_key in fixes[0].signers,
+                 "the deal's oracle must sign the Fix")
+        # length FIRST: the event diff below zips the schedules, which
+        # would silently ignore dropped or appended trailing events — a
+        # truncated schedule must not verify (it would let a deal mature
+        # while skipping contractual payment periods)
+        _require(
+            len(cur.floating_schedule) == len(prev.floating_schedule),
+            "a refix may not add or remove floating events",
+        )
+        diffs = [
+            i for i, (a, b) in enumerate(
+                zip(prev.floating_schedule, cur.floating_schedule)
+            ) if a != b
+        ]
+        _require(len(diffs) == 1, "exactly one floating event may change")
+        i = diffs[0]
+        before = prev.floating_schedule[i]
+        after = cur.floating_schedule[i]
+        _require(not before.is_fixed and after.is_fixed,
+                 "the changed event must gain its first rate")
+        _require(after == dataclasses.replace(before, rate_bp=after.rate_bp),
+                 "only the rate may change on the fixed event")
+        _require(
+            fix.of == FixOf(prev.index_name, before.index_date,
+                            prev.index_tenor)
+            and fix.value_bp == after.rate_bp,
+            "the new rate must be the oracle-attested fix for this event",
+        )
+        nxt = prev.next_fixing()
+        _require(nxt is not None and nxt[0] == i,
+                 "fixings must happen in schedule order")
+        _require(
+            dataclasses.replace(
+                cur, floating_schedule=prev.floating_schedule
+            ) == prev,
+            "everything but the fixed event is constant",
+        )
+        for p in cur.participants:
+            _require(p.owning_key in cmd.signers,
+                     "both participants must sign a refix")
+
+    @staticmethod
+    def _verify_mature(ins, outs, cmd) -> None:
+        # reference: verifyMatureCommand, IRS.kt:552-557
+        _require(len(ins) == 1 and not outs,
+                 "maturing consumes the deal with no re-issue")
+        irs = ins[0]
+        _require(
+            all(ev.is_fixed for ev in irs.floating_schedule),
+            "all floating events must be fixed before maturity",
+        )
+        for p in irs.participants:
+            _require(p.owning_key in cmd.signers,
+                     "both participants must sign the maturity")
+
+
+# ------------------------------------------------------------------ flows
+
+@dataclasses.dataclass
+class IRSDealFlow(FlowLogic):
+    """Propose + agree the swap with the counterparty and notarise it
+    (reference: AutoOfferFlow.Requester over TwoPartyDealFlow)."""
+
+    counterparty: Party
+    notary: Party
+    state: IRSState
+
+    def call(self):
+        b = TransactionBuilder(notary=self.notary)
+        b.add_output_state(self.state, IRS_PROGRAM_ID)
+        b.add_command(
+            Agree(),
+            self.state.fixed_rate_payer.owning_key,
+            self.state.floating_rate_payer.owning_key,
+        )
+        stx = self.sign_builder(b)
+        session = self.initiate_flow(self.counterparty)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        return self.sub_flow(FinalityFlow(stx))
+
+
+@InitiatedBy(IRSDealFlow)
+class IRSDealResponder(SignTransactionFlow):
+    def check_transaction(self, stx) -> None:
+        outs = [ts.data for ts in stx.tx.outputs
+                if isinstance(ts.data, IRSState)]
+        if len(outs) != 1:
+            raise FlowException("proposal is not a single IRS agreement")
+        me = self.our_identity.owning_key
+        if me not in {p.owning_key for p in outs[0].participants}:
+            raise FlowException("we are not a participant of this deal")
+
+
+@dataclasses.dataclass
+class FixingRoleDecider(FlowLogic):
+    """The scheduler-started activity (reference: FixingFlow.FixingRoleDecider,
+    FixingFlow.kt:116-143): BOTH participants' schedulers fire this at each
+    fixing date; the deterministic leader (lowest owning key) drives the
+    round, the other side only responds. Once the deal is fully fixed the
+    same wakeup path matures it."""
+
+    ref: StateRef
+
+    def call(self):
+        # the vault read MUST be a recorded op: this flow's own sub-flows
+        # consume the state (FinalityFlow records before its broadcast
+        # parks), so an unrecorded read re-executed on park/replay would
+        # diverge — the replay would see the ref consumed, return early,
+        # and abandon the parked broadcast mid-protocol (the counterparty
+        # then never receives the transaction)
+        decision = self.record(self._decide)
+        if decision[0] == "skip":
+            return None  # consumed already (peer-led), or we follow
+        sar = decision[1]
+        if decision[0] == "mature":
+            return self.sub_flow(MatureFlow(sar))
+        return self.sub_flow(FixingFlow(sar))
+
+    def _decide(self):
+        live = {
+            sr.ref: sr
+            for sr in self.services.vault_service.unconsumed_states(IRSState)
+        }
+        sar = live.get(self.ref)
+        if sar is None:
+            return ("skip",)
+        deal = sar.state.data
+        leader = sorted(
+            deal.participants,
+            key=lambda p: (p.owning_key.scheme_id, p.owning_key.encoded),
+        )[0]
+        if leader.owning_key != self.our_identity.owning_key:
+            return ("skip",)  # the counterparty leads this activity
+        if deal.next_fixing() is None:
+            return ("mature", sar)
+        return ("fix", sar)
+
+
+@dataclasses.dataclass
+class FixingFlow(FlowLogic):
+    """One fixing round, leader side (reference: FixingFlow.Floater +
+    RatesFixFlow, FixingFlow.kt:59-79): query the oracle, build the refix,
+    get the oracle's tear-off signature, collect the counterparty's, and
+    notarise."""
+
+    deal_ref: StateAndRef
+
+    def call(self):
+        deal = self.deal_ref.state.data
+        nxt = deal.next_fixing()
+        if nxt is None:
+            raise FlowException("deal is fully fixed")
+        i, fix_of, _at = nxt
+        fixes = self.sub_flow(FixQueryFlow(deal.oracle, (fix_of,)))
+        fix = fixes[0]
+        new_deal = deal.with_fix(i, fix.value_bp)
+        b = TransactionBuilder(notary=self.deal_ref.state.notary)
+        b.add_input_state(self.deal_ref)
+        b.add_output_state(new_deal, IRS_PROGRAM_ID)
+        b.add_command(
+            Refix(),
+            deal.fixed_rate_payer.owning_key,
+            deal.floating_rate_payer.owning_key,
+        )
+        b.add_command(fix, deal.oracle.owning_key)
+        stx = self.sign_builder(b)
+        # tear-off: the oracle sees ONLY Fix commands, signs the whole id
+        ftx = FilteredTransaction.build(
+            stx.tx,
+            lambda comp, group: group is ComponentGroupType.COMMANDS
+            and isinstance(getattr(comp, "value", None), Fix),
+        )
+        oracle_sig = self.sub_flow(FixSignFlow(deal.oracle, ftx))
+        stx = stx.with_additional_signature(oracle_sig)
+        me = self.our_identity.owning_key
+        counterparty = next(
+            p for p in deal.participants if p.owning_key != me
+        )
+        session = self.initiate_flow(counterparty)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        return self.sub_flow(FinalityFlow(stx))
+
+
+@InitiatedBy(FixingFlow)
+class FixingResponder(SignTransactionFlow):
+    """Counterparty side of a fixing (reference: FixingFlow.Fixer)."""
+
+    def check_transaction(self, stx) -> None:
+        ins = [
+            sr for sr in (
+                self.services.to_state_and_ref(ref) for ref in stx.inputs
+            ) if isinstance(sr.state.data, IRSState)
+        ]
+        outs = [ts.data for ts in stx.tx.outputs
+                if isinstance(ts.data, IRSState)]
+        if len(ins) != 1 or len(outs) != 1:
+            raise FlowException("not a single-deal refix")
+        deal = ins[0].state.data
+        me = self.our_identity.owning_key
+        if me not in {p.owning_key for p in deal.participants}:
+            raise FlowException("we are not a participant of this deal")
+        # the oracle's tear-off signature must already be on the proposal
+        if deal.oracle.owning_key not in {s.by for s in stx.sigs}:
+            raise FlowException("refix proposal lacks the oracle signature")
+
+
+@dataclasses.dataclass
+class MatureFlow(FlowLogic):
+    """Close out a fully-fixed deal at maturity (reference:
+    Commands.Mature)."""
+
+    deal_ref: StateAndRef
+
+    def call(self):
+        deal = self.deal_ref.state.data
+        b = TransactionBuilder(notary=self.deal_ref.state.notary)
+        b.add_input_state(self.deal_ref)
+        b.add_command(
+            Mature(),
+            deal.fixed_rate_payer.owning_key,
+            deal.floating_rate_payer.owning_key,
+        )
+        stx = self.sign_builder(b)
+        me = self.our_identity.owning_key
+        counterparty = next(
+            p for p in deal.participants if p.owning_key != me
+        )
+        session = self.initiate_flow(counterparty)
+        stx = self.sub_flow(CollectSignaturesFlow(stx, [session]))
+        # no outputs → no derivable participants: name the counterparty
+        # explicitly so it learns its deal state was consumed
+        return self.sub_flow(
+            FinalityFlow(stx, extra_recipients=(counterparty,))
+        )
+
+
+@InitiatedBy(MatureFlow)
+class MatureResponder(SignTransactionFlow):
+    def check_transaction(self, stx) -> None:
+        ins = [
+            sr for sr in (
+                self.services.to_state_and_ref(ref) for ref in stx.inputs
+            ) if isinstance(sr.state.data, IRSState)
+        ]
+        if len(ins) != 1 or any(
+            isinstance(ts.data, IRSState) for ts in stx.tx.outputs
+        ):
+            raise FlowException("not a deal maturity")
+        me = self.our_identity.owning_key
+        if me not in {
+            p.owning_key for p in ins[0].state.data.participants
+        }:
+            raise FlowException("we are not a participant of this deal")
+
+
+# --------------------------------------------------- oracle node service
+
+@CordaService("oracle")
+class NodeRatesOracle(RatesOracle):
+    """The rates oracle as an installable node service (reference:
+    @CordaService NodeInterestRates.Oracle, NodeInterestRates.kt:79):
+    any node loading this cordapp can serve fixes under its own
+    identity; rates arrive via ``AddRatesFlow`` (the role of the
+    reference's rate-file upload API)."""
+
+    def __init__(self, services, party, keypair):
+        RatesOracle.__init__(self, party, keypair)
+
+
+@dataclasses.dataclass
+class AddRatesFlow(FlowLogic):
+    """RPC-startable local flow loading rates into this node's oracle."""
+
+    fixes: tuple  # tuple[Fix, ...]
+
+    def call(self) -> int:
+        oracle = getattr(self.services, "oracle", None)
+        if oracle is None:
+            raise FlowException("this node runs no rates oracle")
+        for f in self.fixes:
+            oracle.add_rate(f.of, f.value_bp)
+        return len(self.fixes)
+
+
+# ------------------------------------------------------------ schedule gen
+
+def make_irs(
+    fixed_rate_payer: Party,
+    floating_rate_payer: Party,
+    oracle: Party,
+    notional: int = 25_000_000,
+    currency: str = "EUR",
+    fixed_rate_bp: int = 170,           # 1.70%
+    index_name: str = "LIBOR",
+    index_tenor: str = "3M",
+    n_periods: int = 4,
+    period_days: int = 90,
+    start_date: str = "2026-08-01",
+    t0: float | None = None,
+    period_s: float = 0.6,
+    linear_id: bytes = b"",
+) -> IRSState:
+    """Build an agreed-but-unfixed swap whose calendar schedule spans
+    ``n_periods × period_days`` (the daycount math) compressed onto
+    ``period_s``-second scheduler wakeups from ``t0`` (the demo clock).
+    Reference shape: InterestRateSwap.State as the IRS demo's
+    trade file deals it."""
+    t0 = time.time() if t0 is None else t0
+    day0 = _dt.date.fromisoformat(start_date)
+    fixed, floating = [], []
+    for i in range(n_periods):
+        label = (day0 + _dt.timedelta(days=i * period_days)).isoformat()
+        pay_at = t0 + (i + 1) * period_s
+        fixed.append(RatePaymentEvent(
+            index_date=label, accrual_days=period_days, payment_at=pay_at,
+            fixing_at=0.0, rate_bp=fixed_rate_bp,
+        ))
+        floating.append(RatePaymentEvent(
+            index_date=label, accrual_days=period_days, payment_at=pay_at,
+            fixing_at=t0 + (i + 0.5) * period_s, rate_bp=UNFIXED,
+        ))
+    import hashlib as _hl
+
+    lid = linear_id or _hl.sha256(
+        b"irs" + start_date.encode() + str(t0).encode()
+    ).digest()[:16]
+    return IRSState(
+        fixed_rate_payer=fixed_rate_payer,
+        floating_rate_payer=floating_rate_payer,
+        oracle=oracle,
+        notional=notional,
+        currency=currency,
+        index_name=index_name,
+        index_tenor=index_tenor,
+        fixed_rate_bp=fixed_rate_bp,
+        fixed_schedule=tuple(fixed),
+        floating_schedule=tuple(floating),
+        maturity_at=t0 + (n_periods + 0.5) * period_s,
+        linear_id=lid,
+    )
+
+
+# ------------------------------------------------------------------- demo
+
+def run_demo(n_periods: int = 3, verbose: bool = True) -> dict:
+    """Two dealers + oracle + notary on a mock network: agree the swap,
+    then let the SCHEDULERS drive every fixing and the maturity — no
+    hand-started fixing flows (the end-to-end chain the reference's IRS
+    demo exists to show)."""
+    from corda_tpu.testing import MockNetworkNodes
+
+    t0 = time.time()
+    with MockNetworkNodes() as net:
+        bank_a = net.create_node("Bank A")
+        bank_b = net.create_node("Bank B")
+        oracle_node = net.create_node("Rates Oracle")
+        notary = net.create_notary_node("Notary")
+        oracle = RatesOracle(oracle_node.party, oracle_node.keypair)
+        oracle_node.services.oracle = oracle
+
+        deal = make_irs(
+            bank_a.party, bank_b.party, oracle_node.party,
+            n_periods=n_periods, period_s=0.4,
+        )
+        for i, ev in enumerate(deal.floating_schedule):
+            oracle.add_rate(
+                FixOf(deal.index_name, ev.index_date, deal.index_tenor),
+                150 + 7 * i,  # a drifting curve, one fix per period
+            )
+        bank_a.run_flow(IRSDealFlow(bank_b.party, notary.party, deal))
+        for node in (bank_a, bank_b):
+            node.scheduler.start(poll_s=0.05)
+        deadline = time.time() + 30 + n_periods
+        while time.time() < deadline:
+            live_a = bank_a.services.vault_service.unconsumed_states(IRSState)
+            if not live_a:
+                break  # matured on the leader; wait for B's broadcast too
+            time.sleep(0.05)
+        while time.time() < deadline and (
+            bank_b.services.vault_service.unconsumed_states(IRSState)
+        ):
+            time.sleep(0.05)
+        for node in (bank_a, bank_b):
+            node.scheduler.stop()
+        matured = not bank_a.services.vault_service.unconsumed_states(
+            IRSState
+        ) and not bank_b.services.vault_service.unconsumed_states(IRSState)
+        summary = {
+            "periods": n_periods,
+            "matured": matured,
+            "elapsed_s": round(time.time() - t0, 3),
+        }
+    if verbose:
+        print(f"irs-demo: {summary}")
+    return summary
+
+
+if __name__ == "__main__":
+    run_demo()

@@ -204,11 +204,13 @@ class _IssuePaper(FlowLogic):
 
 
 def issue_paper(node, notary: Party, face: int = 1000,
-                maturity_days: float = 30.0):
+                maturity_days: float = 30.0, timeout: float = 300.0):
     """Self-issue commercial paper (the role the bank plays in the
-    reference demo)."""
+    reference demo). Generous timeout: the first notarisation through a
+    device notary pays one-time kernel compiles."""
     maturity = time.time() + maturity_days * 86400
-    return node.run_flow(_IssuePaper(notary, face, maturity))
+    return node.run_flow(_IssuePaper(notary, face, maturity),
+                         timeout=timeout)
 
 
 def run_demo(n_trades: int = 1, verbose: bool = True) -> dict:

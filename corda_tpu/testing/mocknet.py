@@ -30,7 +30,7 @@ class MockNode:
 
     def __init__(self, net: InMemoryMessagingNetwork, name: str,
                  network_map: NetworkMapCache, party_resolver,
-                 notary_service_factory=None):
+                 notary_service_factory=None, clock=None):
         self.keypair = generate_keypair()
         self.party = Party(
             CordaX500Name(name, "London", "GB"), self.keypair.public
@@ -55,6 +55,23 @@ class MockNode:
             party_resolver,
             services=self.services,
         )
+        # manual-pump scheduler over SchedulableState vault outputs: tests
+        # inject a clock and call scheduler.pump() to fire due activities
+        # deterministically (the reference's TestClock idiom — production
+        # nodes run the same service threaded, node.py)
+        import time as _time
+
+        from corda_tpu.node.scheduler import (
+            NodeSchedulerService,
+            make_scheduled_flow_starter,
+        )
+
+        self.scheduler = NodeSchedulerService(
+            make_scheduled_flow_starter(self.smm, self.party.name),
+            clock=clock or _time.time,
+        )
+        self.services.scheduler_service = self.scheduler
+        self.scheduler.observe_vault(self.services.vault_service)
 
     def run_flow(self, flow, timeout: float = 60):
         """Start a flow and block for its result."""
@@ -79,10 +96,11 @@ class MockNetworkNodes:
             self.net.start_pumping()
 
     def create_node(self, name: str, notary_service_factory=None,
-                    validating_notary: bool | None = None) -> MockNode:
+                    validating_notary: bool | None = None,
+                    clock=None) -> MockNode:
         node = MockNode(
             self.net, name, self.nmap, self.parties.get,
-            notary_service_factory,
+            notary_service_factory, clock=clock,
         )
         self.parties[str(node.party.name)] = node.party
         self.nmap.add_node(node.info)

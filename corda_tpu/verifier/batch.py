@@ -115,18 +115,23 @@ def dispatch_signature_rows(
             sigs = [rows[i][1] for i in idxs]
             msgs = [rows[i][2] for i in idxs]
             from corda_tpu.ops._blockpack import start_host_copy
+            from corda_tpu.parallel.mesh import service_mesh_active
 
+            # production fan-out: shard EVERY device-capable bucket over
+            # the device mesh (SURVEY §2.9 P3) — the reference's fan-out
+            # load-balances all verification work across workers
+            # (Verifier.kt:66-84), not one scheme. Single chip degrades
+            # transparently to the plain batched dispatches below.
+            on_mesh = service_mesh_active()
+            if on_mesh:
+                from corda_tpu.parallel.mesh import service_mesh_verifier
+
+                mesh_v = service_mesh_verifier()
             if scheme_id == EDDSA_ED25519_SHA512:
-                from corda_tpu.parallel.mesh import service_mesh_active
-
-                if service_mesh_active():
-                    # production fan-out: shard the bucket over the device
-                    # mesh (SURVEY §2.9 P3); single chip degrades to the
-                    # plain batched dispatch below
-                    from corda_tpu.parallel.mesh import service_mesh_verifier
-
-                    mask, _spent, _total = service_mesh_verifier(
-                    ).dispatch_rows(keys, sigs, msgs, min_bucket=min_bucket)
+                if on_mesh:
+                    mask, _spent, _total = mesh_v.dispatch_rows(
+                        keys, sigs, msgs, min_bucket=min_bucket
+                    )
                 else:
                     from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
 
@@ -134,28 +139,38 @@ def dispatch_signature_rows(
                         keys, sigs, msgs, min_bucket=min_bucket
                     )
             elif scheme_id == SPHINCS256_SHA256:
-                from corda_tpu.ops.sphincs_batch import (
-                    sphincs_verify_dispatch,
-                )
+                if on_mesh:
+                    mask = mesh_v.dispatch_sphincs_rows(
+                        keys, sigs, msgs, min_bucket=min_bucket
+                    )
+                else:
+                    from corda_tpu.ops.sphincs_batch import (
+                        sphincs_verify_dispatch,
+                    )
 
-                mask = sphincs_verify_dispatch(
-                    keys, sigs, msgs, min_bucket=min_bucket
-                )
+                    mask = sphincs_verify_dispatch(
+                        keys, sigs, msgs, min_bucket=min_bucket
+                    )
             else:
                 # async like the ed25519 bucket: the ECDSA ladder queues on
                 # device and collects later, so mixed-scheme batches overlap
                 # both ladders instead of serializing on this one (r2
                 # VERDICT weak #2)
-                from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
-
                 curve = (
                     "secp256k1"
                     if scheme_id == ECDSA_SECP256K1_SHA256
                     else "secp256r1"
                 )
-                mask = ecdsa_verify_dispatch(
-                    curve, keys, sigs, msgs, min_bucket=min_bucket
-                )
+                if on_mesh:
+                    mask = mesh_v.dispatch_ecdsa_rows(
+                        curve, keys, sigs, msgs, min_bucket=min_bucket
+                    )
+                else:
+                    from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+
+                    mask = ecdsa_verify_dispatch(
+                        curve, keys, sigs, msgs, min_bucket=min_bucket
+                    )
             start_host_copy(mask)
             pending._deferred.append((idxs, mask))
         else:

@@ -106,6 +106,105 @@ class TestMeshVerifier:
         assert mask.shape[0] == 128
 
 
+def _ecdsa_rows(n, scheme_id, tag=b"mesh-ecdsa"):
+    from corda_tpu.crypto.schemes import derive_keypair_from_entropy, sign
+
+    pks, sigs, msgs = [], [], []
+    for i in range(n):
+        ent = hashlib.sha256(tag + i.to_bytes(4, "little")).digest()
+        kp = derive_keypair_from_entropy(scheme_id, ent)
+        m = b"mesh ecdsa lane %d" % i
+        pks.append(bytes(kp.public.encoded))
+        sigs.append(sign(kp.private, m))
+        msgs.append(m)
+    return pks, sigs, msgs
+
+
+class TestMeshMixedScheme:
+    """The mixed-scheme fan-out (r3 VERDICT weak #5 / task 4): ECDSA
+    buckets shard over the mesh like ed25519; SPHINCS fans out as
+    per-device chunk streams. Reference: the worker fan-out serves ALL
+    verification work, Verifier.kt:66-84."""
+
+    def test_ecdsa_k1_over_mesh(self, mesh_verifier):
+        from corda_tpu.crypto.schemes import ECDSA_SECP256K1_SHA256
+
+        pks, sigs, msgs = _ecdsa_rows(24, ECDSA_SECP256K1_SHA256)
+        # adversarial lanes on distinct shards at bucket 64
+        sigs[2] = sigs[2][:10] + bytes([sigs[2][10] ^ 1]) + sigs[2][11:]
+        msgs[17] = b"wrong message"
+        pks[9] = bytes(33)  # not a curve point
+        mask = mesh_verifier.dispatch_ecdsa_rows(
+            "secp256k1", pks, sigs, msgs
+        )
+        assert mask.shape[0] % 8 == 0
+        got = np.asarray(mask)[:24]
+        expect = np.ones(24, bool)
+        expect[[2, 9, 17]] = False
+        assert (got == expect).all()
+
+    def test_ecdsa_r1_over_mesh_min_bucket(self, mesh_verifier):
+        from corda_tpu.crypto.schemes import ECDSA_SECP256R1_SHA256
+
+        pks, sigs, msgs = _ecdsa_rows(5, ECDSA_SECP256R1_SHA256, b"r1")
+        mask = mesh_verifier.dispatch_ecdsa_rows(
+            "secp256r1", pks, sigs, msgs, min_bucket=128
+        )
+        assert mask.shape[0] == 128
+        assert np.asarray(mask)[:5].all()
+
+    def test_sphincs_chunk_fanout(self, mesh_verifier):
+        from corda_tpu.crypto import sphincs
+
+        pks, sigs, msgs = [], [], []
+        for i in range(3):
+            pk, sk = sphincs.generate(bytes([40 + i]) * 32)
+            m = b"mesh sphincs lane %d" % i
+            pks.append(pk)
+            sigs.append(sphincs.sign(sk, m))
+            msgs.append(m)
+        # duplicate lanes to span several chunks; tamper two of them
+        pks, sigs, msgs = pks * 3, sigs * 3, msgs * 3
+        sigs[1] = sigs[1][:40] + bytes([sigs[1][40] ^ 1]) + sigs[1][41:]
+        msgs[7] = b"wrong"
+        mask = mesh_verifier.dispatch_sphincs_rows(pks, sigs, msgs)
+        got = np.asarray(mask)
+        expect = np.ones(9, bool)
+        expect[[1, 7]] = False
+        assert got.shape == (9,)
+        assert (got == expect).all()
+
+    def test_service_routes_ecdsa_through_mesh(self):
+        """dispatch_signature_rows' ECDSA bucket reaches the mesh when
+        active — the service seam for the mixed-scheme fan-out."""
+        from corda_tpu.crypto.keys import PublicKey
+        from corda_tpu.crypto.schemes import (
+            ECDSA_SECP256K1_SHA256,
+            EDDSA_ED25519_SHA512,
+        )
+        from corda_tpu.verifier import dispatch_signature_rows
+
+        epks, esigs, emsgs = _sigs(6, b"mixed-ed")
+        kpks, ksigs, kmsgs = _ecdsa_rows(6, ECDSA_SECP256K1_SHA256, b"mx")
+        ksigs[3] = ksigs[3][:5] + bytes([ksigs[3][5] ^ 1]) + ksigs[3][6:]
+        esigs[2] = bytes([esigs[2][0] ^ 1]) + esigs[2][1:]
+        rows = [
+            (PublicKey(EDDSA_ED25519_SHA512, pk), sig, msg)
+            for pk, sig, msg in zip(epks, esigs, emsgs)
+        ] + [
+            (PublicKey(ECDSA_SECP256K1_SHA256, pk), sig, msg)
+            for pk, sig, msg in zip(kpks, ksigs, kmsgs)
+        ]
+        enable_service_mesh(True)
+        try:
+            got = dispatch_signature_rows(rows).collect()
+        finally:
+            enable_service_mesh(False)
+        expect = np.ones(12, bool)
+        expect[[2, 9]] = False  # ed lane 2, ecdsa lane 3 (row 6+3)
+        assert (got == expect).all()
+
+
 class TestServiceMeshRouting:
     def test_dispatch_rows_routes_through_mesh(self):
         """The service seam: with the mesh enabled,
