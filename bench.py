@@ -168,6 +168,68 @@ def bench_device_sigs(pubkeys, sigs, msgs) -> tuple[float, float]:
     return statistics.median(rates), max(rates)
 
 
+def bench_device_ecdsa(n: int = 2048) -> tuple[float, float]:
+    """Batched ECDSA (secp256k1 windowed Pallas ladder) → (median, best)
+    sigs/sec over 3 pipelined rounds — the dedicated line behind the MFU
+    table's ECDSA row (the mixed bench interleaves schemes and host
+    work, so it cannot isolate the ladder's throughput)."""
+    import jax.numpy as jnp
+
+    from corda_tpu.crypto.schemes import (
+        ECDSA_SECP256K1_SHA256,
+        derive_keypair_from_entropy,
+        sign,
+    )
+    from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+
+    kp = derive_keypair_from_entropy(
+        ECDSA_SECP256K1_SHA256, hashlib.sha256(b"bench-ecdsa").digest()
+    )
+    pub = bytes(kp.public.encoded)
+    pubs, sigs, msgs = [], [], []
+    for i in range(n):
+        m = b"bench ecdsa lane %d" % i
+        pubs.append(pub)
+        sigs.append(sign(kp.private, m))
+        msgs.append(m)
+    mask = np.asarray(ecdsa_verify_dispatch("secp256k1", pubs, sigs, msgs))
+    assert mask[:n].all(), "ECDSA kernel rejected valid sigs"
+    bad = list(sigs)
+    bad[0] = bad[0][:8] + bytes([bad[0][8] ^ 1]) + bad[0][9:]
+    bm = np.asarray(ecdsa_verify_dispatch("secp256k1", pubs, bad, msgs))
+    assert not bm[0] and bm[1:n].all(), "ECDSA kernel accepted tampered sig"
+    # measure the KERNEL (the chip-side metric the MFU table converts):
+    # host prep — one Python-bigint modular inverse per signature — is
+    # ~100 µs/sig single-core, runs once here, and in the pipelined
+    # service overlaps device time exactly like the ed25519 challenge
+    # hashing; folding it into every rep would measure the host, not
+    # the ladder
+    from corda_tpu.ops._blockpack import ECDSA_BLOCK, pow2_at_least
+    from corda_tpu.ops.secp256 import _prep_byte_planes
+    from corda_tpu.ops.secp256_pallas import ecdsa_verify_pallas
+
+    b = pow2_at_least(n, ECDSA_BLOCK)
+    qx, qy, u1b, u2b, ra, rb, rb_ok, pre = _prep_byte_planes(
+        "secp256k1", pubs, sigs, msgs, b
+    )
+    args = (qx, qy, u1b, u2b, ra, rb,
+            jnp.asarray(rb_ok), jnp.asarray(pre))
+    reps = 4
+    warm = [ecdsa_verify_pallas("secp256k1", *args) for _ in range(reps)]
+    np.asarray(jnp.stack(warm))
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        pending = [
+            ecdsa_verify_pallas("secp256k1", *args) for _ in range(reps)
+        ]
+        ok = np.asarray(jnp.stack(pending))
+        dt = time.perf_counter() - t0
+        assert ok[:, :n].all()
+        rates.append(n * reps / dt)
+    return statistics.median(rates), max(rates)
+
+
 # ------------------------------------------------------------ trader demo
 
 TRADER_TRADES = 48
@@ -510,6 +572,11 @@ def bench_notary_raft_cluster(moves, resolve, notary_id) -> tuple[float, float]:
             providers = RaftUniquenessProvider.make_cluster(
                 [f"{tag}-r0", f"{tag}-r1", f"{tag}-r2"], net
             )
+            for p in providers:
+                # bench hardening: a mid-stream election under host-CPU
+                # load must stall a window, not TimeoutError the section
+                # (the default 2 s window assumes an idle host)
+                p._retry_s = 10.0
             deadline = time.monotonic() + 10
             leader = None
             while time.monotonic() < deadline and leader is None:
@@ -565,6 +632,10 @@ def bench_notary_bft_cluster(moves, resolve, notary_id) -> tuple[float, float]:
                 4, net, prefix=f"{tag}-replica"
             )
             provider = make_client(f"{tag}-client")
+            # bench hardening (same reason as the Raft rounds): a 2048-tx
+            # window serializing into one total-order slot under host-CPU
+            # load can exceed the 5 s client default
+            provider.client._timeout_s = 30.0
             svc = BatchedNotaryService(
                 notary_id[0], notary_id[1], provider,
                 use_device=True, validating=True,
@@ -762,8 +833,25 @@ class _Partial:
     def run(self, name: str, fn):
         try:
             return fn()
-        except Exception as e:  # record, keep benching other sections
-            self.errors[name] = f"{type(e).__name__}: {e}"[:300]
+        except Exception as e:
+            # one retry for TRANSIENT infrastructure failures — the
+            # tunnel's remote-compile helper occasionally drops an HTTP
+            # body mid-read, and cluster sections can lose one round to a
+            # host-load-induced timeout; a deterministic bug fails twice
+            # and is recorded as before
+            msg = f"{type(e).__name__}: {e}"
+            transient = any(s in msg for s in (
+                "remote_compile", "response body", "TimeoutError",
+                "DEADLINE_EXCEEDED",
+            ))
+            if transient:
+                try:
+                    out = fn()
+                    self.errors[f"{name}_first_attempt"] = msg[:200]
+                    return out
+                except Exception as e2:
+                    msg = f"{type(e2).__name__}: {e2}"
+            self.errors[name] = msg[:300]
             return None
 
     def emit(self, status: int = 0) -> int:
@@ -786,6 +874,69 @@ class _Partial:
         out.setdefault("vs_baseline", None)
         print(json.dumps(out), flush=True)
         return status
+
+
+# ------------------------------------------------------------ MFU model
+#
+# Static per-verify op counts derived from the kernel structure docstrings
+# (ed25519_pallas.py:9-27, secp256_pallas.py:9-21), converted with the
+# measured sigs/sec into achieved int32-op throughput vs an assumed VPU
+# peak — the utilization axis VERDICT r3 asked for. MACs count as ONE op
+# (the fused multiply-accumulate view); the peak assumption is explicit in
+# the emitted dict so the number can be re-based when the real per-ALU
+# int32-multiply issue rate is known.
+
+_VPU_PEAK_ASSUMPTION = {
+    # TPU v5e VPU: (8, 128) lanes × 4 ALUs × ~0.94 GHz. int32 multiply
+    # may not issue on all 4 ALUs every cycle — treat as an upper bound.
+    "lanes": 8 * 128, "alus": 4, "clock_ghz": 0.94,
+}
+_VPU_PEAK_OPS = (
+    _VPU_PEAK_ASSUMPTION["lanes"] * _VPU_PEAK_ASSUMPTION["alus"]
+    * _VPU_PEAK_ASSUMPTION["clock_ghz"] * 1e9
+)
+
+_KERNEL_OP_MODEL = {
+    # ed25519 radix-4096: 22-limb schoolbook mul = 484 MACs + ~3 carry
+    # passes × 22 limbs ≈ 550 ops/field-mul. Field muls per verify:
+    # 256 doubles × 7 + 64 fixed-base adds × 7 + 64 var-base adds × 8
+    # + var-table build 15 × 8 + decompression sqrt-ratio chain ≈ 250
+    # + canonical compare ≈ 30  →  ≈ 3,150 muls.
+    "ed25519": {"field_muls_per_verify": 3150, "ops_per_field_mul": 550},
+    # ECDSA radix-256: 32-limb schoolbook = 1,024 MACs + word-fold matrix
+    # + carries ≈ 1,220 ops/field-mul. Muls per verify (complete RCB
+    # formulas): 256 doubles × 9 + 128 adds × 12 + table 14 × 12 +
+    # on-curve/final ≈ 10  →  ≈ 4,020 muls.
+    "ecdsa": {"field_muls_per_verify": 4020, "ops_per_field_mul": 1220},
+}
+
+
+def _mfu_analysis(data: dict) -> None:
+    """Convert measured sig rates into achieved int32-ops/s and VPU
+    utilization; emitted with every device capture (and mirrored in
+    BASELINE.md's roofline table)."""
+    out = {}
+    rates = {
+        "ed25519": data.get("ed25519_sigs_per_sec"),
+        "ecdsa": data.get("ecdsa_sigs_per_sec"),
+    }
+    for name, rate in rates.items():
+        if not rate:
+            continue
+        m = _KERNEL_OP_MODEL[name]
+        ops_per_verify = (
+            m["field_muls_per_verify"] * m["ops_per_field_mul"]
+        )
+        achieved = rate * ops_per_verify
+        out[name] = {
+            "ops_per_verify_millions": round(ops_per_verify / 1e6, 2),
+            "achieved_int32_gops": round(achieved / 1e9, 1),
+            "vpu_peak_assumed_gops": round(_VPU_PEAK_OPS / 1e9, 1),
+            "utilization_pct": round(100 * achieved / _VPU_PEAK_OPS, 1),
+        }
+    if out:
+        out["peak_assumption"] = _VPU_PEAK_ASSUMPTION
+        data["mfu"] = out
 
 
 def _load_cached() -> dict | None:
@@ -900,6 +1051,11 @@ def main() -> int:
         if ref_cpu_rate:
             p.data["ed25519_vs_reference_cpu"] = round(sig_median / ref_cpu_rate, 2)
 
+    ecdsa = p.run("device_ecdsa", bench_device_ecdsa)
+    if ecdsa:
+        p.data["ecdsa_sigs_per_sec"] = round(ecdsa[0], 1)
+        p.data["ecdsa_best_sigs_per_sec"] = round(ecdsa[1], 1)
+
     mixed_rows = make_mixed_rows()
     mixed_host_rate = p.run("host_mixed", lambda: bench_mixed_host(mixed_rows))
     if mixed_host_rate:
@@ -962,6 +1118,7 @@ def main() -> int:
         if dag_host_rate:
             p.data["dag_vs_host"] = round(dag_median / dag_host_rate, 3)
 
+    _mfu_analysis(p.data)
     p.data["sig_batch"] = SIG_BATCH
     p.data["notary_txs"] = NOTARY_TXS
 

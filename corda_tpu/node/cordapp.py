@@ -71,8 +71,12 @@ def install_corda_services(services, party, keypair,
     policy)."""
     installed = []
     for attr, cls in _CORDA_SERVICES:
-        if (loaded_modules is not None
-                and cls.__module__ not in loaded_modules):
+        if loaded_modules is not None and not any(
+            cls.__module__ == m or cls.__module__.startswith(m + ".")
+            for m in loaded_modules
+        ):
+            # defined by a cordapp this node did not load (package match
+            # includes submodules: myapp/oracle.py belongs to app "myapp")
             continue
         if hasattr(services, attr):
             # never let an app shadow a core hub service ("vault_service",

@@ -210,9 +210,28 @@ class Node:
             ValidatingNotaryService,
         )
 
-        # Raft/BFT clusters are wired externally (they span processes);
-        # the container builds the single-replica tiers
-        uniqueness = PersistentUniquenessProvider(db("notary.db"))
+        if cfg.raft is not None:
+            # multi-process CFT cluster: this node is one Raft replica,
+            # speaking raft.* topics over its own fabric endpoint to the
+            # peers named in clusterAddresses (reference: the out-of-
+            # process Copycat cluster, NodeConfiguration.kt:45). Started
+            # with the node (start()/stop()).
+            from corda_tpu.notary import RaftUniquenessProvider
+
+            me = str(self.party.name)
+            names = sorted({me, *cfg.raft.cluster_addresses})
+            storage_path = db("raft.db")
+            uniqueness = RaftUniquenessProvider.make_node_on_endpoint(
+                me, names, self.messaging,
+                storage_path=(
+                    storage_path if storage_path != ":memory:" else None
+                ),
+            )
+        else:
+            # BFT clusters remain externally wired (they need the whole
+            # replica set's keys up front); the container builds the
+            # single-replica and Raft tiers
+            uniqueness = PersistentUniquenessProvider(db("notary.db"))
         self._notary_uniqueness = uniqueness
         cls = ValidatingNotaryService if cfg.validating else SimpleNotaryService
         return cls(self.party, self.keypair, uniqueness)
@@ -234,6 +253,9 @@ class Node:
         # add_node also registers us as a notary when info.notary_mode is
         # set — single source of truth for the mode
         self.services.network_map_cache.add_node(self.info)
+        raft_node = getattr(self._notary_uniqueness, "node", None)
+        if raft_node is not None:
+            raft_node.start()
         self.scheduler.start()
         restored = self.smm.restore()
         if restored:

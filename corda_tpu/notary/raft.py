@@ -647,40 +647,92 @@ class RaftUniquenessProvider(UniquenessProvider):
         return list(self._submit_retrying(command))
 
     @staticmethod
+    def _state_machine_parts(storage_path: str | None):
+        """(storage, apply_fn, install_fn) for one replica: durable when a
+        storage path is given, else the in-memory map with a snapshot-
+        install hook (a durable peer compacted past this replica's log
+        replaces the map wholesale). Shared by every construction path —
+        co-located clusters and node-embedded replicas must run identical
+        state-machine wiring."""
+        if storage_path is not None:
+            storage = RaftStorage(storage_path)
+            return (
+                storage,
+                RaftUniquenessProvider.storage_state_machine(storage),
+                None,
+            )
+        apply_fn, base = RaftUniquenessProvider.state_machine()
+
+        def install_fn(rows, _last_idx, _last_term, base=base):
+            from corda_tpu.crypto import SecureHash
+
+            from .uniqueness import ConsumedStateDetails
+
+            with base._lock:
+                base._map = {
+                    bytes(k): ConsumedStateDetails(
+                        SecureHash(bytes(t)), i, c
+                    )
+                    for (k, t, i, c) in rows
+                }
+
+        return None, apply_fn, install_fn
+
+    @staticmethod
     def make_node(
         name: str, names: list[str], network, storage_dir: str | None = None,
         compact_every: int = 512,
     ) -> "RaftUniquenessProvider":
         """Build (or REBUILD after a crash — state restores from storage)
         one replica."""
-        install_fn = None
-        if storage_dir is not None:
-            storage = RaftStorage(f"{storage_dir}/{name}.db")
-            apply_fn = RaftUniquenessProvider.storage_state_machine(storage)
-        else:
-            storage = None
-            apply_fn, base = RaftUniquenessProvider.state_machine()
-
-            def install_fn(rows, _last_idx, _last_term, base=base):
-                # replace the in-memory consumed map with a leader snapshot
-                # (a durable peer compacted past this replica's log)
-                from corda_tpu.crypto import SecureHash
-
-                from .uniqueness import ConsumedStateDetails
-
-                with base._lock:
-                    base._map = {
-                        bytes(k): ConsumedStateDetails(
-                            SecureHash(bytes(t)), i, c
-                        )
-                        for (k, t, i, c) in rows
-                    }
+        storage, apply_fn, install_fn = (
+            RaftUniquenessProvider._state_machine_parts(
+                f"{storage_dir}/{name}.db" if storage_dir else None
+            )
+        )
         node = RaftNode(
             name, list(names), network.create_node(name), apply_fn,
             storage=storage, compact_every=compact_every,
             install_map_fn=install_fn,
         )
         return RaftUniquenessProvider(node)
+
+    def close(self) -> None:
+        self.node.stop()
+
+    @staticmethod
+    def make_node_on_endpoint(
+        name: str, names: list[str], endpoint,
+        storage_path: str | None = None, compact_every: int = 512,
+        election_timeout_s: tuple[float, float] = (1.0, 2.0),
+        heartbeat_s: float = 0.25,
+    ) -> "RaftUniquenessProvider":
+        """One replica sharing an EXISTING messaging endpoint — the
+        multi-process cluster shape, each replica inside its own node
+        process talking ``raft.*`` topics over the node fabric (the
+        reference runs its Copycat cluster out-of-process over dedicated
+        ports, NodeConfiguration.kt:45). Raft traffic coexists with
+        session traffic because topics are dispatched independently.
+        Default timings are scaled for the polled file broker's ~0.5 s
+        worst-case delivery (failover ≈ one election cycle ≈ 2-3 s);
+        co-located in-memory clusters keep ``make_cluster``'s fast
+        timings. The caller owns start/stop (``provider.node.start()`` /
+        ``provider.close()``)."""
+        storage, apply_fn, install_fn = (
+            RaftUniquenessProvider._state_machine_parts(storage_path)
+        )
+        node = RaftNode(
+            name, list(names), endpoint, apply_fn,
+            election_timeout_s=election_timeout_s, heartbeat_s=heartbeat_s,
+            storage=storage, compact_every=compact_every,
+            install_map_fn=install_fn,
+        )
+        provider = RaftUniquenessProvider(node)
+        # the submit retry window must ride out one full (slowed-down)
+        # election cycle, or a mid-failover commit would surface as a
+        # notary error instead of completing on the new leader
+        provider._retry_s = max(2.0, 3.0 * election_timeout_s[1])
+        return provider
 
     @staticmethod
     def make_cluster(

@@ -111,13 +111,21 @@ def verify_transaction_dag(
         check_and_prime_ids(stxs)
 
     # order-free work first: EVERY signature in the DAG in one bucketed
-    # dispatch (the chain walk below never waits on device round trips)
+    # dispatch (the chain walk below never waits on device round trips).
+    # One-shot shape — route by the link's break-even (a small DAG's
+    # host verify beats paying a tunneled round trip; ops.txid)
     all_ids = list(stxs)
     all_stxs = [stxs[tid] for tid in all_ids]
     allowed_all = [
         allowed_missing_fn(s) if allowed_missing_fn else set()
         for s in all_stxs
     ]
+    if use_device:
+        from corda_tpu.ops.txid import device_verify_worthwhile
+
+        use_device = device_verify_worthwhile(
+            sum(len(s.sigs) for s in all_stxs)
+        )
     report = check_transactions(all_stxs, allowed_all, use_device=use_device)
     report.raise_first()
     n_sigs = report.n_sigs

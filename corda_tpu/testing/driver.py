@@ -75,7 +75,12 @@ class DriverDSL:
     def start_node(self, legal_name: str, notary: bool = False,
                    validating: bool = True, timeout_s: float = 60,
                    cordapps: tuple = ("corda_tpu.finance",),
-                   extra_config: str = "") -> NodeHandle:
+                   extra_config: str = "",
+                   raft_cluster: tuple = ()) -> NodeHandle:
+        """``raft_cluster``: canonical X.500 names of ALL members of a
+        Raft notary cluster this node belongs to (reference: the
+        raft-notary Cordform's clusterAddresses) — each member is its own
+        process, consensus rides the shared fabric."""
         from corda_tpu.ledger import CordaX500Name
 
         canonical = str(CordaX500Name.parse(legal_name))
@@ -84,10 +89,18 @@ class DriverDSL:
         node_dir.mkdir(exist_ok=True)
         user, pw, perms = self.DEFAULT_RPC_USER
         conf = node_dir / "node.conf"
-        notary_block = (
-            f'notary {{ validating = {"true" if validating else "false"} }}'
-            if notary else ""
-        )
+        v = "true" if validating else "false"
+        if notary and raft_cluster:
+            peers = ", ".join(f'"{p}"' for p in raft_cluster)
+            notary_block = (
+                f'notary {{ validating = {v}\n'
+                f'  raft {{ nodeAddress = "{canonical}"\n'
+                f'    clusterAddresses = [{peers}] }} }}'
+            )
+        elif notary:
+            notary_block = f'notary {{ validating = {v} }}'
+        else:
+            notary_block = ""
         # network-map-first start strategy (reference:
         # NetworkMapStartStrategy): the first node serves the map; later
         # nodes register with it by address
@@ -162,6 +175,34 @@ class DriverDSL:
                 return
             time.sleep(0.2)
         raise TimeoutError(f"node {handle.name} did not start in {timeout_s}s")
+
+    # ---------------------------------------------------------- workers
+    def start_verifier_worker(self, name: str = "verifier-worker",
+                              use_device: bool = False) -> NodeHandle:
+        """Spawn an out-of-process verifier worker competing on the
+        fabric's verifier.requests queue (reference: the Verifier jar,
+        Verifier.kt:66-84). In secure mode it joins as a certified peer."""
+        log_path = self.base / f"{name}.log"
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = (
+            str(Path(__file__).resolve().parents[2])
+            + os.pathsep + env.get("PYTHONPATH", "")
+        )
+        args = [sys.executable, "-m", "corda_tpu.verifier.worker",
+                self.broker_path, "--name", name]
+        if not use_device:
+            args.append("--no-device")
+        if self.secure:
+            args += ["--fabric", self.fabric_address]
+        with open(log_path, "wb") as log:
+            process = subprocess.Popen(
+                args, stdout=log, stderr=subprocess.STDOUT, env=env,
+                cwd=str(self.base),
+            )
+        handle = NodeHandle(name, process, log_path)
+        self.nodes.append(handle)  # shutdown() reaps it with the nodes
+        return handle
 
     # -------------------------------------------------------------- rpc
     def rpc(self, node: NodeHandle, username: str | None = None,

@@ -49,18 +49,38 @@ _DEVICE_SCHEMES = {
 def _effective_device_schemes(use_device: bool) -> set:
     """The device-capable scheme set for this dispatch. SPHINCS batches on
     device too (pure hashing — ~100 chained SHA-256 dispatches,
-    ops/sphincs_batch.py), but only on an accelerator backend: its many
-    small eager steps are profitable on a chip and a compile tarpit on
-    the XLA:CPU test tier, where the host loop wins. Only consulted when
-    ``use_device`` — host-only callers never touch (or initialize) jax."""
+    ops/sphincs_batch.py), but only on a LOCAL accelerator: its many
+    small eager steps are profitable on a PCIe/ICI chip, a compile tarpit
+    on the XLA:CPU test tier, and latency-bound over a tunneled link
+    (~100 sequential dispatches × ~100 ms queue-drain round trips
+    collapsed the r4 mixed bench to 0.04× host) — the same link-latency
+    routing as the Merkle-id sweep (ops.txid.ids_tier). Only consulted
+    when ``use_device`` — host-only callers never touch (or initialize)
+    jax."""
     if not use_device:
         return set()
     schemes = set(_DEVICE_SCHEMES)
     import jax
 
-    if jax.default_backend() == "tpu":
+    if jax.default_backend() == "tpu" and _sphincs_on_device():
         schemes.add(SPHINCS256_SHA256)
     return schemes
+
+
+def _sphincs_on_device() -> bool:
+    """Link-locality gate with its own override (CORDA_TPU_SPHINCS=
+    device|host) — deliberately NOT keyed off the id-sweep tier, whose
+    CORDA_TPU_IDS override must not silently drag SPHINCS with it."""
+    import os
+
+    forced = os.environ.get("CORDA_TPU_SPHINCS", "").strip().lower()
+    if forced == "device":
+        return True
+    if forced == "host":
+        return False
+    from corda_tpu.ops.txid import _measured_link_rtt_s
+
+    return _measured_link_rtt_s() < 0.005
 
 
 class PendingRows:

@@ -116,3 +116,59 @@ class TestDeviceTxIds:
     def test_empty_and_single(self, cohort):
         assert compute_tx_ids([]) == []
         assert compute_tx_ids([cohort[0].tx])[0] == cohort[0].id
+
+
+class TestNativeHostIds:
+    """The C++ id engine (native/id_engine.cpp) is the PRODUCTION id path
+    on tunneled-link notaries (ops/txid.ids_tier routes host), but the CPU
+    test tier routes device — without these differentials a divergence
+    between the C++ and Python hash schedules (new group type, nonce
+    format change) would ship unseen and reject every honest transaction
+    on a production notary."""
+
+    def test_native_engine_builds(self):
+        from corda_tpu.ops.txid import _load_id_engine
+
+        assert _load_id_engine() is not None, "native build failed"
+
+    def test_native_matches_host_hashlib(self, cohort):
+        from corda_tpu.ops.txid import _host_prime_ids
+
+        truth = []
+        for stx in cohort:
+            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+            truth.append(stx.tx.id)  # hashlib reference path
+        for stx in cohort:
+            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+        _host_prime_ids(cohort)
+        got = [stx.tx.id for stx in cohort]
+        assert got == truth
+
+    def test_native_matches_on_edge_shapes(self):
+        """Single output, no attachments/time-window (empty groups), and a
+        multi-command signer-dedup shape."""
+        from corda_tpu.ops.txid import _host_prime_ids
+
+        alice, akp = _party("EdgeAlice")
+        b = TransactionBuilder(notary=NOTARY)
+        b.add_output_state(TState(1, alice), "txid.TContract")
+        b.add_command(TCmd("a"), alice.owning_key)
+        b.add_command(TCmd("b"), alice.owning_key)  # dedup in SIGNERS
+        stx = b.sign_initial_transaction(akp)
+        object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+        truth = stx.tx.id
+        object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+        _host_prime_ids([stx])
+        assert stx.tx.id == truth
+
+    def test_host_tier_check_detects_forgery(self, cohort, monkeypatch):
+        """check_and_prime_ids through the FORCED host tier still rejects
+        a forged chain link."""
+        import corda_tpu.ops.txid as txid
+        from corda_tpu.ledger.states import TransactionVerificationException
+
+        monkeypatch.setattr(txid, "_ids_tier_cache", "host")
+        stxs = {stx.id: stx for stx in cohort[:2]}
+        stxs[sha256(b"forged")] = cohort[3]
+        with pytest.raises(TransactionVerificationException, match="mismatch"):
+            check_and_prime_ids(stxs)
