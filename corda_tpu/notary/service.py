@@ -185,26 +185,42 @@ class BatchedNotaryService(NotaryService):
 
         return dispatch_prime_ids([r[0] for r in requests])
 
-    def dispatch_batch(self, requests, pending_ids=None):
+    def dispatch_batch(self, requests, pending_ids=None, pipelined=True):
         """Enqueue the device half (signature ladders) of a batch; the
         returned pending check settles in ``settle_batch``. Splitting the
         two is what hides the interconnect round trip: while batch k's
         ladders run on device, the host validates/commits/signs batch k-1
         (see ``process_stream``). ``pending_ids`` is an already-enqueued
         id sweep (its round trip overlapped with earlier batches);
-        without one the sweep runs inline."""
+        without one the sweep runs inline.
+
+        ``pipelined=False`` marks a SOLO window — nothing else in flight
+        to hide the link round trip behind — and routes it through the
+        one-shot break-even gate (ops.txid): a lightly-loaded service's
+        handful-of-tx window verifies faster on host than it can round-
+        trip a tunneled chip (the r4 trader demo ran 0.4× host before
+        this). Pipelined windows — the throughput shape — always take
+        the device: their round trips overlap neighbouring windows'
+        host work, which is exactly the assumption the break-even
+        formula does NOT hold under."""
         from corda_tpu.verifier import dispatch_transactions
 
         if pending_ids is None:
             pending_ids = self.dispatch_ids(requests)
         if pending_ids is not None:
             pending_ids.collect()
+        use_device = self._use_device
+        if use_device and not pipelined:
+            from corda_tpu.ops.txid import device_verify_worthwhile
+
+            n_rows = sum(len(r[0].sigs) for r in requests)
+            use_device = device_verify_worthwhile(n_rows)
         return dispatch_transactions(
             [r[0] for r in requests],
             [{self.identity.owning_key}] * len(requests),
-            use_device=self._use_device,
+            use_device=use_device,
             # one compiled kernel shape across ragged window flushes
-            min_bucket=self._max_batch if self._use_device else None,
+            min_bucket=self._max_batch if use_device else None,
         )
 
     def process_batch(
@@ -320,7 +336,14 @@ class BatchedNotaryService(NotaryService):
                 )
             else:
                 accepted.append(i)
-        pending_sigs = self._dispatch_sign([requests[i][0].id for i in accepted])
+        # response signing follows the window's VERIFY routing: a window
+        # whose signature check ran on host (solo/below break-even, or a
+        # host-only tier) signs on host too — one coherent decision per
+        # window rather than a second gate with different constants
+        pending_sigs = self._dispatch_sign(
+            [requests[i][0].id for i in accepted],
+            on_device=report.n_device > 0,
+        )
         return results, accepted, pending_sigs
 
     def finalize_batch(
@@ -336,15 +359,17 @@ class BatchedNotaryService(NotaryService):
             )
         return results
 
-    def _dispatch_sign(self, tx_ids: list[SecureHash]):
+    def _dispatch_sign(self, tx_ids: list[SecureHash], on_device: bool = True):
         """Enqueue response signing: one device comb-kernel batch when the
-        notary key is ed25519 (the default scheme), host loop otherwise.
-        Signatures are RFC 8032 deterministic either way, so device and
-        host paths emit identical bytes."""
+        notary key is ed25519 (the default scheme) and the window's verify
+        half ran on device (``on_device`` — see settle_commit), host loop
+        otherwise. Signatures are RFC 8032 deterministic either way, so
+        device and host paths emit identical bytes."""
         from corda_tpu.crypto.schemes import EDDSA_ED25519_SHA512
 
         if (
             self._use_device
+            and on_device
             and tx_ids
             and self._keypair.private.scheme_id == EDDSA_ED25519_SHA512
         ):
@@ -495,9 +520,20 @@ class BatchedNotaryService(NotaryService):
                     if ahead is not None:
                         a_batch, a_reqs, a_ids = ahead
                         try:
-                            commit_q.put(
-                                (a_batch, self.dispatch_batch(a_reqs, a_ids))
-                            )
+                            # sustained load is what fills windows: a
+                            # half-full-or-better window rides the device
+                            # unconditionally (its round trip overlaps
+                            # the neighbouring windows'), while light
+                            # windows — interactive ensembles — take the
+                            # one-shot break-even gate (a burst of tiny
+                            # windows must not serialize on per-window
+                            # device round trips)
+                            commit_q.put((a_batch, self.dispatch_batch(
+                                a_reqs, a_ids,
+                                pipelined=(
+                                    len(a_batch) >= self._max_batch // 2
+                                ),
+                            )))
                         except Exception as e:
                             for req in a_batch:
                                 try:
