@@ -505,6 +505,29 @@ def _decompress_point(curve_name: str, encoded: bytes) -> tuple | None:
 from .ed25519 import _bits_le  # noqa: E402  (shared bit-plane converter)
 
 
+def _batch_invert(values: list[int], n: int) -> list[int]:
+    """Montgomery batch inversion mod ``n``: ONE modular exponentiation +
+    3(k−1) multiplications for k inverses. The per-signature
+    ``pow(s, n-2, n)`` was the dominant host-prep cost (~100 µs each —
+    2048 lanes paid ~0.2 s of pure Python bigint exponentiation per
+    batch); every input must be nonzero mod n (callers pre-check)."""
+    k = len(values)
+    if k == 0:
+        return []
+    prefix = [0] * k  # prefix[i] = v0·v1·…·vi mod n
+    acc = 1
+    for i, v in enumerate(values):
+        acc = acc * v % n
+        prefix[i] = acc
+    inv_all = pow(acc, n - 2, n)
+    out = [0] * k
+    for i in range(k - 1, 0, -1):
+        out[i] = inv_all * prefix[i - 1] % n
+        inv_all = inv_all * values[i] % n
+    out[0] = inv_all
+    return out
+
+
 def _prep_byte_planes(
     curve_name: str,
     pubkeys: list[bytes],
@@ -514,7 +537,8 @@ def _prep_byte_planes(
 ):
     """Host prep shared by the XLA and Pallas tiers: per-lane canonical-form
     checks, point parse, e/s⁻¹ scalar math — emitted as compact uint8
-    little-endian byte planes (for radix-256 these ARE the field limbs)."""
+    little-endian byte planes (for radix-256 these ARE the field limbs).
+    The s⁻¹ computations batch through one Montgomery inversion."""
     cv = _CURVES[curve_name]
     n_real = len(pubkeys)
     qx = np.zeros((b, 32), np.uint8)
@@ -527,6 +551,9 @@ def _prep_byte_planes(
     pre = np.zeros(b, bool)
 
     n = cv.n
+    # pass 1: structural checks + point parse; collect the s values of
+    # surviving lanes for one batched inversion
+    lanes: list[tuple[int, int, int, tuple]] = []  # (i, r, s, point)
     for i in range(n_real):
         sig = signatures[i]
         if len(sig) != 64:
@@ -540,8 +567,12 @@ def _prep_byte_planes(
         pt = _decompress_point(curve_name, bytes(pubkeys[i]))
         if pt is None:
             continue
+        lanes.append((i, r, s, pt))
+
+    # pass 2: scalar math with the batched s⁻¹
+    inverses = _batch_invert([s for (_i, _r, s, _pt) in lanes], n)
+    for (i, r, s, pt), w in zip(lanes, inverses):
         e = int.from_bytes(hashlib.sha256(messages[i]).digest(), "big")
-        w = pow(s, n - 2, n)
         u1 = e * w % n
         u2 = r * w % n
         qx[i] = np.frombuffer(pt[0].to_bytes(32, "little"), np.uint8)
