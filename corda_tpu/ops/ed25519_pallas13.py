@@ -1,0 +1,502 @@
+"""Pallas TPU kernel for batched ed25519 verification — radix-8192 tier.
+
+The r5 widening of the production radix-4096 kernel
+(``ed25519_pallas.py`` — same dual-4-bit-window Straus ladder, same
+reference hot path Crypto.kt:621-624): 20 little-endian 13-bit limbs in
+int32 lanes instead of 22 × 12-bit. Why this helps, measured not assumed:
+the r5 fast-squaring A/B showed the ladder is MAC-bound (a 24% MAC
+reduction bought +25% throughput), and radix-8192 removes another ~17%
+of MACs — 400 per schoolbook mul (210 per square) vs 484 (253).
+
+The prime is MUCH friendlier at this radix:
+
+  2^260 ≡ 608 (mod p)  —  a SINGLE wrap digit at limb 0,
+
+so the column fold is one shifted multiply-accumulate (``lo + 608·hi``,
+no overflow rows, no split-digit terms) and every carry pass wraps with
+one term. Compare the radix-4096 fold: 2^264 ≡ 9728 needs a 2-digit
+split plus a second-level fold of the top column.
+
+What changes vs the radix-4096 kernel is the LAZY DISCIPLINE: 13-bit
+limb products are 26 bits, so two uncarried lazy adds no longer fit a
+schoolbook column in int32 (20·16384² ≈ 5.4e9). Every ``fe_add`` output
+carries one pass before use (the k1-ECDSA discipline), proven by the
+same per-limb interval audit (tests/test_ops_ed25519.py::TestRadix8192):
+fold 2 passes + add 1 + sub 2 converges with fixpoint limb bound 9,407
+and worst accumulation well inside int32 (the design-space audit with a
+looser composite-add shape bounded it at 10,015 / 1.37e9 / 1.56× slack;
+the shipped op set is tighter).
+
+Selected by ``CORDA_TPU_ED25519_RADIX=8192`` (the radix-4096 tier stays
+the default until the on-chip A/B flips it); both tiers share the host
+prep, window extraction, and the (64, B) challenge plane format.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ed25519 import _D, _SQRT_M1, P
+from .ed25519_pallas import _b_table_host, bytes_to_windows_t, _pad8
+
+LIMBS = 20
+RADIX = 13
+MASK = (1 << RADIX) - 1  # 8191
+_WRAP = 608              # 2^260 mod p
+assert (1 << 260) % P == _WRAP
+
+_D2 = (2 * _D) % P
+_SQRT_EXP = (P - 5) // 8
+_INV_EXP = P - 2
+
+
+def int_to_limbs13(x: int) -> np.ndarray:
+    return np.array(
+        [(x >> (RADIX * i)) & MASK for i in range(LIMBS)], dtype=np.int32
+    )
+
+
+def limbs13_to_int(limbs) -> int:
+    return sum(int(v) << (RADIX * i) for i, v in enumerate(np.asarray(limbs)))
+
+
+def _k2_limbs() -> np.ndarray:
+    """A multiple of p with every limb in [16384, 24575] — covers any
+    subtrahend under the audited fixpoint bound (10,015)."""
+    base = 2 * 8192
+    v = base * ((1 << 260) - 1) // MASK
+    fix = (-v) % P
+    limbs = int_to_limbs13(fix).astype(np.int64) + base
+    assert (v + fix) % P == 0 and limbs.max() <= base + MASK
+    return limbs.astype(np.int32)
+
+
+_K2 = _k2_limbs()
+_P13 = int_to_limbs13(P)
+
+# consts matrix rows mirror the radix-4096 kernel's layout:
+# 0 K2, 1 p, 2 d, 3 2d, 4 sqrt(-1), 8+3i..10+3i: B-table entry i
+_CONSTS_HOST = np.zeros((64, 128), dtype=np.int32)
+_CONSTS_HOST[0, :LIMBS] = _K2
+_CONSTS_HOST[1, :LIMBS] = _P13
+_CONSTS_HOST[2, :LIMBS] = int_to_limbs13(_D)
+_CONSTS_HOST[3, :LIMBS] = int_to_limbs13(_D2)
+_CONSTS_HOST[4, :LIMBS] = int_to_limbs13(_SQRT_M1)
+for _i, _row in enumerate(_b_table_host()):
+    for _c in range(3):
+        _CONSTS_HOST[8 + 3 * _i + _c, :LIMBS] = int_to_limbs13(_row[_c])
+
+
+@dataclasses.dataclass
+class Env:
+    """Per-block constants broadcast to (20, blk)."""
+
+    k2: jax.Array
+    p_limbs: jax.Array
+    d: jax.Array
+    d2: jax.Array
+    sqrt_m1: jax.Array
+    b_table: tuple
+
+
+# ------------------------------------------------- limb-major field ops
+
+def _one_hot_first(blk):
+    return jnp.concatenate(
+        [jnp.ones((1, blk), jnp.int32), jnp.zeros((LIMBS - 1, blk), jnp.int32)],
+        axis=0,
+    )
+
+
+def _carry_pass(c):
+    """One radix-8192 carry pass; the top carry wraps as 608·q at limb 0."""
+    q = c >> RADIX
+    r = c - (q << RADIX)
+    top = q[LIMBS - 1 : LIMBS, :]
+    shifted = jnp.concatenate([_WRAP * top, q[: LIMBS - 1, :]], axis=0)
+    return r + shifted
+
+
+def _carry(c, passes):
+    for _ in range(passes):
+        c = _carry_pass(c)
+    return c
+
+
+def _fold_cols40(c, blk):
+    """(40, blk) schoolbook columns → (20, blk) bounded limbs: raw pass,
+    single-digit fold (column 20+j ≡ 608·2^(13j)), two wrap passes."""
+    q = c >> RADIX
+    r = c - (q << RADIX)
+    c = r + jnp.concatenate([jnp.zeros((1, blk), jnp.int32), q[:-1]], axis=0)
+    lo, hi = c[:LIMBS], c[LIMBS:]
+    return _carry(lo + _WRAP * hi, 2)
+
+
+def fe_mul(a, b):
+    blk = a.shape[1]
+    c = jnp.zeros((2 * LIMBS, blk), dtype=jnp.int32)
+    for i in range(LIMBS):
+        c = c + jnp.pad(a[i : i + 1, :] * b, ((i, LIMBS - i), (0, 0)))
+    return _fold_cols40(c, blk)
+
+
+def fe_sq(a):
+    """Dedicated squaring (210 MACs vs fe_mul's 400) — identical column
+    values to fe_mul(a, a); measured +25% on the radix-4096 tier."""
+    blk = a.shape[1]
+    a2 = a + a
+    c = jnp.zeros((2 * LIMBS, blk), dtype=jnp.int32)
+    for i in range(LIMBS):
+        row = a[i : i + 1, :] if i == LIMBS - 1 else jnp.concatenate(
+            [a[i : i + 1, :], a2[i + 1 :, :]], axis=0
+        )
+        c = c + jnp.pad(a[i : i + 1, :] * row, ((2 * i, LIMBS - i), (0, 0)))
+    return _fold_cols40(c, blk)
+
+
+def fe_add(a, b):
+    """Disciplined add: ONE carry pass (13-bit products leave no room for
+    the radix-4096 tier's fully-lazy adds — see the module header)."""
+    return _carry_pass(a + b)
+
+
+def fe_sub(env, a, b):
+    return _carry(a - b + env.k2, 2)
+
+
+def fe_neg(env, a):
+    return fe_sub(env, jnp.zeros_like(a), a)
+
+
+def fe_mul_small(a, k):
+    assert k == 2
+    return _carry_pass(a + a)
+
+
+def fe_pow_const(a, exponent: int):
+    n = exponent.bit_length()
+    r = None
+    for i in range(n):
+        if r is not None:
+            r = fe_sq(r)
+        if (exponent >> (n - 1 - i)) & 1:
+            r = a if r is None else fe_mul(r, a)
+    assert r is not None
+    return r
+
+
+def fe_canonical(env, a):
+    """Exact reduction: limbs in [0, 8191], value in [0, p). Bits ≥ 2^255
+    live in limb 19 >> 8 and fold twice via 2^255 ≡ 19; then at most one
+    conditional subtract of p is needed (two run, as in the 4096 tier)."""
+    blk = a.shape[1]
+
+    def exact_carry(c):
+        rows = []
+        carry = jnp.zeros((1, blk), jnp.int32)
+        for i in range(LIMBS):
+            v = c[i : i + 1, :] + carry
+            rows.append(v & MASK)
+            carry = v >> RADIX
+        out = jnp.concatenate(rows, axis=0)
+        return out + jnp.concatenate(
+            [_WRAP * carry, jnp.zeros((LIMBS - 1, blk), jnp.int32)], axis=0
+        )
+
+    def fold_255(c):
+        t = c[LIMBS - 1 :, :] >> 8
+        return jnp.concatenate(
+            [c[0:1, :] + 19 * t, c[1 : LIMBS - 1, :], c[LIMBS - 1 :, :] & 255],
+            axis=0,
+        )
+
+    c = exact_carry(exact_carry(a))
+    c = exact_carry(fold_255(c))
+    c = exact_carry(fold_255(c))
+
+    def sub_p(v):
+        rows = []
+        borrow = jnp.zeros((1, blk), jnp.int32)
+        for i in range(LIMBS):
+            d = v[i : i + 1, :] - env.p_limbs[i : i + 1, :] - borrow
+            rows.append(d & MASK)
+            borrow = (d < 0).astype(jnp.int32)
+        diff = jnp.concatenate(rows, axis=0)
+        return jnp.where(borrow == 0, diff, v)
+
+    return sub_p(sub_p(c))
+
+
+def fe_eq(env, a, b):
+    return jnp.all(fe_canonical(env, a) == fe_canonical(env, b), axis=0)
+
+
+def fe_is_odd(env, a):
+    return fe_canonical(env, a)[0, :] & 1
+
+
+# --------------------------------------------------- limb-major points
+# Same extended twisted-Edwards structure as the 4096 tier; adds carry.
+
+def identity_point(blk):
+    zero = jnp.zeros((LIMBS, blk), dtype=jnp.int32)
+    one = _one_hot_first(blk)
+    return (zero, one, one, zero)
+
+
+def point_double(env, p, want_t: bool = True):
+    px, py, pz, _ = p
+    a = fe_sq(px)
+    b = fe_sq(py)
+    c = fe_mul_small(fe_sq(pz), 2)
+    h = fe_add(a, b)
+    e = fe_sub(env, h, fe_sq(fe_add(px, py)))
+    g = fe_sub(env, a, b)
+    f = fe_add(c, g)
+    t = fe_mul(e, h) if want_t else p[3]
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), t)
+
+
+def point_add(env, p, q):
+    px, py, pz, pt = p
+    qx, qy, qz, qt = q
+    a = fe_mul(fe_sub(env, py, px), fe_sub(env, qy, qx))
+    bb = fe_mul(fe_add(py, px), fe_add(qy, qx))
+    c = fe_mul(fe_mul(pt, env.d2), qt)
+    d = fe_mul_small(fe_mul(pz, qz), 2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_add(d, c)
+    h = fe_add(bb, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def to_planes(env, p):
+    px, py, pz, pt = p
+    return (
+        fe_sub(env, py, px),
+        fe_add(py, px),
+        fe_mul(pt, env.d2),
+        fe_mul_small(pz, 2),
+    )
+
+
+def _add_q_planes(env, p, planes):
+    ymx, ypx, t2d, z2 = planes
+    px, py, pz, pt = p
+    a = fe_mul(fe_sub(env, py, px), ymx)
+    bb = fe_mul(fe_add(py, px), ypx)
+    c = fe_mul(pt, t2d)
+    d = fe_mul(pz, z2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_add(d, c)
+    h = fe_add(bb, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def _add_b_entry(env, p, entry):
+    ymx, ypx, t2d = entry
+    px, py, pz, pt = p
+    a = fe_mul(fe_sub(env, py, px), ymx)
+    bb = fe_mul(fe_add(py, px), ypx)
+    c = fe_mul(pt, t2d)
+    d = fe_mul_small(pz, 2)
+    e = fe_sub(env, bb, a)
+    f = fe_sub(env, d, c)
+    g = fe_add(d, c)
+    h = fe_add(bb, a)
+    return (fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h))
+
+
+def point_neg(env, p):
+    px, py, pz, pt = p
+    return (fe_neg(env, px), py, pz, fe_neg(env, pt))
+
+
+def _select16(idx_row, entries):
+    level = entries
+    for bit in range(4):
+        b_mask = ((idx_row >> bit) & 1) == 1
+        level = [
+            tuple(
+                jnp.where(b_mask[None, :], hi_p, lo_p)
+                for lo_p, hi_p in zip(lo, hi)
+            )
+            for lo, hi in zip(level[0::2], level[1::2])
+        ]
+    return level[0]
+
+
+def decompress(env, y, sign_row):
+    one = _one_hot_first(y.shape[1])
+    y2 = fe_sq(y)
+    u = fe_sub(env, y2, one)
+    v = fe_add(fe_mul(env.d, y2), one)
+    v3 = fe_mul(fe_sq(v), v)
+    v7 = fe_mul(fe_sq(v3), v)
+    x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), _SQRT_EXP))
+    vx2 = fe_mul(v, fe_sq(x))
+    root_ok = fe_eq(env, vx2, u)
+    flip_ok = fe_eq(env, vx2, fe_neg(env, u))
+    x = jnp.where(flip_ok[None, :], fe_mul(x, env.sqrt_m1), x)
+    ok = root_ok | flip_ok
+    x_is_zero = fe_eq(env, x, jnp.zeros_like(x))
+    ok = ok & ~(x_is_zero & (sign_row == 1))
+    x = jnp.where((fe_is_odd(env, x) != sign_row)[None, :], fe_neg(env, x), x)
+    return (x, y, one, fe_mul(x, y)), ok
+
+
+def compress_y_parity(env, p):
+    px, py, pz, _ = p
+    zinv = fe_pow_const(pz, _INV_EXP)
+    x = fe_canonical(env, fe_mul(px, zinv))
+    y = fe_canonical(env, fe_mul(py, zinv))
+    return y, x[0, :] & 1
+
+
+# ------------------------------------------------------------- kernel
+
+def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
+                   sign_ref, pre_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    blk = a_y_ref.shape[1]
+    consts = consts_ref[:, :]
+
+    def cfull(i):
+        return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
+
+    env = Env(
+        k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+        sqrt_m1=cfull(4),
+        b_table=tuple(
+            (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+            for i in range(16)
+        ),
+    )
+
+    a_y = a_y_ref[:, :][:LIMBS]
+    r13 = r_ref[:, :][:LIMBS]
+    sign_row = sign_ref[0, :]
+
+    a_pt, a_ok = decompress(env, a_y, sign_row)
+    minus_a = point_neg(env, a_pt)
+
+    pts = [identity_point(blk), minus_a]
+    for k in range(2, 16):
+        if k % 2 == 0:
+            pts.append(point_double(env, pts[k // 2]))
+        else:
+            pts.append(point_add(env, pts[k - 1], minus_a))
+    a_table = [to_planes(env, pt) for pt in pts]
+
+    def chunk_body(cj, acc):
+        base_row = 56 - 8 * cj
+        s_rows = s_win_ref[pl.ds(base_row, 8), :]
+        h_rows = h_win_ref[pl.ds(base_row, 8), :]
+        for k in range(7, -1, -1):
+            for i in range(4):
+                acc = point_double(env, acc, want_t=(i == 3))
+            acc = _add_b_entry(env, acc, _select16(s_rows[k, :], env.b_table))
+            acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
+        return acc
+
+    result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
+    enc_y, enc_parity = compress_y_parity(env, result)
+
+    # bit 255 (the sign) lives at limb 19 bit 8; y's limb 19 is 8 bits
+    r_y = jnp.concatenate(
+        [r13[: LIMBS - 1], r13[LIMBS - 1 :] & 255], axis=0
+    )
+    r_sign = (r13[LIMBS - 1, :] >> 8) & 1
+    match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
+    verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
+    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
+
+
+# ------------------------------------------------------- device-side prep
+
+def bytes_to_limb13_t(x_bytes: jax.Array) -> jax.Array:
+    """(B, 32) uint8 → (24, B) int32 radix-8192 limbs (rows 20-23 zero)."""
+    xb = x_bytes.astype(jnp.int32)
+    rows = []
+    for k in range(LIMBS):
+        bit = RADIX * k
+        j, sh = bit >> 3, bit & 7
+        v = xb[:, j] >> sh
+        if j + 1 < 32:
+            v = v | (xb[:, j + 1] << (8 - sh))
+        if sh > 3 and j + 2 < 32:
+            v = v | (xb[:, j + 2] << (16 - sh))
+        rows.append(v & MASK)
+    limbs = jnp.stack(rows, axis=0)
+    return jnp.pad(limbs, ((0, 24 - LIMBS), (0, 0)))
+
+
+def verify_pallas_windows(
+    y_bytes: jax.Array,
+    r_bytes: jax.Array,
+    s_bytes: jax.Array,
+    h_win_t: jax.Array,
+    sign: jax.Array,
+    precheck: jax.Array,
+    interpret: bool = False,
+    block: int | None = None,
+) -> jax.Array:
+    """Same contract as ed25519_pallas.verify_pallas_windows, radix-8192."""
+    from jax.experimental import pallas as pl
+
+    from ._blockpack import ED25519_BLOCK
+
+    block = block or ED25519_BLOCK
+    b = y_bytes.shape[0]
+    assert b % block == 0, (b, block)
+    grid = (b // block,)
+
+    a_y_t = bytes_to_limb13_t(y_bytes)
+    r_t = bytes_to_limb13_t(r_bytes)
+    s_win_t = bytes_to_windows_t(s_bytes)
+
+    def col_spec(rows):
+        return pl.BlockSpec((rows, block), lambda i: (0, i))
+
+    mask = pl.pallas_call(
+        _verify_kernel,
+        out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(_CONSTS_HOST.shape, lambda i: (0, 0)),
+            col_spec(24), col_spec(24), col_spec(64), col_spec(64),
+            col_spec(8), col_spec(8),
+        ],
+        out_specs=col_spec(8),
+        interpret=interpret,
+    )(
+        jnp.asarray(_CONSTS_HOST),
+        a_y_t, r_t, s_win_t, h_win_t, _pad8(sign), _pad8(precheck),
+    )
+    return mask[0] != 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+def ed25519_verify_pallas(
+    y_bytes: jax.Array,
+    r_bytes: jax.Array,
+    s_bytes: jax.Array,
+    h_bytes: jax.Array,
+    sign: jax.Array,
+    precheck: jax.Array,
+    interpret: bool = False,
+    block: int | None = None,
+) -> jax.Array:
+    return verify_pallas_windows(
+        y_bytes, r_bytes, s_bytes, bytes_to_windows_t(h_bytes),
+        sign, precheck, interpret=interpret, block=block,
+    )

@@ -99,10 +99,15 @@ def _consts_host(curve_name: str) -> np.ndarray:
 
 
 class Env:
-    """Per-block broadcast constants + curve-derived static data."""
+    """Per-block broadcast constants + curve-derived static data, plus the
+    field-op method surface (``mul``/``sq``/…) the shared point formulas
+    call — the radix-256 generic tier. ``K1Env4096`` provides the same
+    surface at radix 4096 for secp256k1."""
 
     __slots__ = ("k_sub", "k_fold", "k_canon", "p_limbs", "a", "b", "b3",
                  "g_table", "wrap_inj", "red_rows", "a_is_zero")
+
+    LIMBS = LIMBS
 
     def __init__(self, consts, blk, cv: CurveCtx):
         def cfull(i):
@@ -122,6 +127,34 @@ class Env:
         self.wrap_inj = cv.field.wrap_inj      # static python data
         self.red_rows = cv.field.red_rows
         self.a_is_zero = cv.a_is_zero
+
+    # field-op surface for the shared point formulas
+    def mul(self, a, b):
+        return fe_mul(self, a, b)
+
+    def sq(self, a):
+        return fe_sq(self, a)
+
+    def add(self, a, b):
+        return fe_add(self, a, b)
+
+    def sub(self, a, b):
+        return fe_sub(self, a, b)
+
+    def mul_small(self, a, k):
+        return fe_mul_small(self, a, k)
+
+    def canonical(self, a):
+        return fe_canonical(self, a)
+
+    def eq(self, a, b):
+        return fe_eq(self, a, b)
+
+    def is_zero(self, a):
+        return fe_is_zero(self, a)
+
+    def one_hot(self, blk):
+        return _one_hot_first(blk)
 
 
 # ----------------------------------------------- limb-major field ops
@@ -173,7 +206,22 @@ def fe_mul(env: Env, a, b):
 
 
 def fe_sq(env, a):
-    return fe_mul(env, a, a)
+    """Dedicated squaring: 528 MACs instead of fe_mul's 1024.
+
+    Row i contributes a_i² at column 2i and a_i·(2a_j) at column i+j for
+    j > i — identical column VALUES to fe_mul(a, a), so FieldCtx's proven
+    signed lazy bounds (inputs up to ±2300) carry over verbatim; products
+    a_i·2a_j stay ≤ 2300·4600 < 2^24."""
+    blk = a.shape[1]
+    a2 = a + a
+    c = jnp.zeros((2 * LIMBS, blk), dtype=jnp.int32)
+    for i in range(LIMBS):
+        # zero-size slices don't lower on Mosaic: the last row is a_i alone
+        row = a[i : i + 1, :] if i == LIMBS - 1 else jnp.concatenate(
+            [a[i : i + 1, :], a2[i + 1 :, :]], axis=0
+        )
+        c = c + jnp.pad(a[i : i + 1, :] * row, ((2 * i, LIMBS - i), (0, 0)))
+    return _fold_cols(env, c)
 
 
 def fe_add(env, a, b):
@@ -232,6 +280,255 @@ def fe_is_zero(env, a):
     return jnp.all(fe_canonical(env, a) == 0, axis=0)
 
 
+# ------------------------------------------- secp256k1 radix-4096 field
+#
+# The widened tier (r4 VERDICT task 2): 22 little-endian 12-bit limbs in
+# int32 lanes — 484 MACs per field mul (253 per square) instead of the
+# radix-256 tier's 1024/528, reusing the ed25519 kernel's limb geometry
+# against secp256k1's prime. k1's prime is pseudo-Mersenne with a SPARSE
+# positive radix-4096 wrap:
+#
+#   2^264 ≡ W = 256 + 61·2^12 + 16·2^36 (mod p)     [digits (0,256),(1,61),(3,16)]
+#
+# so schoolbook columns 22..43 fold with three shifted multiply-adds, and
+# the three overflow rows (fold targets ≥ limb 22) substitute through W
+# again with bounded coefficients (≤ 61·256). secp256r1 does NOT get this
+# tier: its 2^264 residue's top signed digit sits at limb 19, so the
+# overflow substitution cascades ~(22−19)-limb steps with ×256 coefficient
+# growth per level — coefficients explode past int32 after 4 levels. r1
+# stays on the proven radix-256 tier above (still fast-squared).
+#
+# Lazy-bound discipline (proven by the per-limb interval audit in
+# tests/test_ops_secp256_pallas.py, which walks these exact pass
+# structures to a fixpoint): add carries 1 pass, sub 2 passes (K1_KSUB
+# base 8192), mul/sq fold + 2 passes, ×4 carries 2 passes. Fixpoint limb
+# bound 4,607; worst internal accumulation 3.75e8 — 5.7× inside int32.
+
+K1_LIMBS = 22
+_K1_RADIX = 12
+_K1_MASK = 4095
+K1_P = 2**256 - 2**32 - 977
+assert (1 << 264) % K1_P == 256 + (61 << 12) + (16 << 36)
+
+
+def _k1_int_to_limbs(x: int) -> np.ndarray:
+    return np.array(
+        [(x >> (_K1_RADIX * i)) & _K1_MASK for i in range(K1_LIMBS)],
+        dtype=np.int32,
+    )
+
+
+def _k1_k_sub() -> np.ndarray:
+    """A multiple of p with every limb in [8192, 12287] — covers any
+    subtrahend the fixpoint bounds produce (≤ 4,607 + carry slack)."""
+    base = 8192
+    v = base * ((1 << 264) - 1) // 4095
+    fix = (-v) % K1_P
+    limbs = _k1_int_to_limbs(fix).astype(np.int64) + base
+    assert (v + fix) % K1_P == 0 and limbs.max() <= base + _K1_MASK
+    return limbs.astype(np.int32)
+
+
+_K1_KSUB = _k1_k_sub()
+_K1_PLIMBS = _k1_int_to_limbs(K1_P)
+
+
+def _k1_carry_pass(c):
+    """One radix-4096 carry pass; the top carry wraps through W's three
+    digits (256@0, 61@1, 16@3)."""
+    q = c >> _K1_RADIX
+    r = c - (q << _K1_RADIX)
+    top = q[K1_LIMBS - 1 : K1_LIMBS, :]
+    shifted = jnp.concatenate(
+        [256 * top, q[0:1, :] + 61 * top, q[1:2, :], q[2:3, :] + 16 * top,
+         q[3 : K1_LIMBS - 1, :]],
+        axis=0,
+    )
+    return r + shifted
+
+
+def _k1_carry(c, passes):
+    for _ in range(passes):
+        c = _k1_carry_pass(c)
+    return c
+
+
+def _k1_fold_cols(c, blk):
+    """(44, blk) schoolbook columns → (22, blk) bounded limbs: raw carry
+    pass, W-fold of columns 22..43 (three shifted MACs), overflow rows
+    22..24 substituted through W·2^(12s), two wrap passes."""
+    q = c >> _K1_RADIX
+    r = c - (q << _K1_RADIX)
+    c = r + jnp.concatenate([jnp.zeros((1, blk), jnp.int32), q[:-1]], axis=0)
+    lo, hi = c[:K1_LIMBS], c[K1_LIMBS:]
+    z1 = jnp.zeros((1, blk), jnp.int32)
+    out = lo + 256 * hi
+    out = out + jnp.concatenate([z1, 61 * hi[: K1_LIMBS - 1]], axis=0)
+    out = out + jnp.concatenate(
+        [jnp.zeros((3, blk), jnp.int32), 16 * hi[: K1_LIMBS - 3]], axis=0
+    )
+    # overflow targets: digit (3,16) from hi rows 19..21 and (1,61) from
+    # row 21 land at limbs 22..24 = W·2^(12s), s = 0..2
+    h19 = hi[19:20]
+    h20 = hi[20:21]
+    h21 = hi[21:22]
+    v22 = 16 * h19 + 61 * h21
+    v23 = 16 * h20
+    v24 = 16 * h21
+    out = out + jnp.concatenate(
+        [256 * v22,
+         61 * v22 + 256 * v23,
+         61 * v23 + 256 * v24,
+         16 * v22 + 61 * v24,
+         16 * v23,
+         16 * v24,
+         jnp.zeros((K1_LIMBS - 6, blk), jnp.int32)],
+        axis=0,
+    )
+    return _k1_carry(out, 2)
+
+
+def k1_mul(a, b):
+    blk = a.shape[1]
+    c = jnp.zeros((2 * K1_LIMBS, blk), dtype=jnp.int32)
+    for i in range(K1_LIMBS):
+        c = c + jnp.pad(a[i : i + 1, :] * b, ((i, K1_LIMBS - i), (0, 0)))
+    return _k1_fold_cols(c, blk)
+
+
+def k1_sq(a):
+    """Dedicated squaring (253 MACs): identical column values to
+    k1_mul(a, a) — see the ed25519 kernel's fe_sq for the argument."""
+    blk = a.shape[1]
+    a2 = a + a
+    c = jnp.zeros((2 * K1_LIMBS, blk), dtype=jnp.int32)
+    for i in range(K1_LIMBS):
+        row = a[i : i + 1, :] if i == K1_LIMBS - 1 else jnp.concatenate(
+            [a[i : i + 1, :], a2[i + 1 :, :]], axis=0
+        )
+        c = c + jnp.pad(a[i : i + 1, :] * row, ((2 * i, K1_LIMBS - i), (0, 0)))
+    return _k1_fold_cols(c, blk)
+
+
+def _k1_canonical(env, a):
+    """Exact reduction: limbs in [0, 4095], value in [0, p). Statically
+    unrolled carry chains; bits ≥ 2^256 fold twice via
+    2^256 ≡ 977 + 256·2^24, then two conditional subtracts of p."""
+    blk = a.shape[1]
+
+    def exact_carry(c):
+        rows = []
+        carry = jnp.zeros((1, blk), jnp.int32)
+        for i in range(K1_LIMBS):
+            v = c[i : i + 1, :] + carry
+            rows.append(v & _K1_MASK)
+            carry = v >> _K1_RADIX
+        out = jnp.concatenate(rows, axis=0)
+        return out + jnp.concatenate(
+            [256 * carry, 61 * carry, jnp.zeros((1, blk), jnp.int32),
+             16 * carry, jnp.zeros((K1_LIMBS - 4, blk), jnp.int32)],
+            axis=0,
+        )
+
+    def fold_256(c):
+        t = c[K1_LIMBS - 1 :, :] >> 4
+        return jnp.concatenate(
+            [c[0:1, :] + 977 * t, c[1:2, :], c[2:3, :] + 256 * t,
+             c[3 : K1_LIMBS - 1, :], c[K1_LIMBS - 1 :, :] & 15],
+            axis=0,
+        )
+
+    c = exact_carry(exact_carry(a))
+    c = exact_carry(fold_256(c))
+    c = exact_carry(fold_256(c))
+
+    def sub_p(v):
+        rows = []
+        borrow = jnp.zeros((1, blk), jnp.int32)
+        for i in range(K1_LIMBS):
+            d = v[i : i + 1, :] - env.p_limbs[i : i + 1, :] - borrow
+            rows.append(d & _K1_MASK)
+            borrow = (d < 0).astype(jnp.int32)
+        diff = jnp.concatenate(rows, axis=0)
+        return jnp.where(borrow == 0, diff, v)
+
+    return sub_p(sub_p(c))
+
+
+class K1Env4096:
+    """secp256k1 field/curve env at radix 4096 — same method surface as
+    ``Env``, consumed by the shared RCB point formulas and
+    ``_verify_block``. Consts matrix rows mirror ``_consts_host``'s row
+    layout (0 k_sub, 3 p, 5 b, 6 b3, 8+3k G-table) with 12-bit limbs."""
+
+    __slots__ = ("k_sub", "p_limbs", "b", "b3", "g_table", "a")
+
+    LIMBS = K1_LIMBS
+    a_is_zero = True
+
+    def __init__(self, consts, blk, cv: CurveCtx | None = None):
+        def cfull(i):
+            return jnp.broadcast_to(
+                consts[i, :K1_LIMBS][:, None], (K1_LIMBS, blk)
+            )
+
+        self.k_sub = cfull(0)
+        self.p_limbs = cfull(3)
+        self.b = cfull(5)
+        self.b3 = cfull(6)
+        self.g_table = tuple(
+            (cfull(8 + 3 * k), cfull(9 + 3 * k), cfull(10 + 3 * k))
+            for k in range(16)
+        )
+        self.a = None  # a = 0: mul_a folds away in the shared formulas
+
+    def mul(self, a, b):
+        return k1_mul(a, b)
+
+    def sq(self, a):
+        return k1_sq(a)
+
+    def add(self, a, b):
+        return _k1_carry_pass(a + b)
+
+    def sub(self, a, b):
+        return _k1_carry(a - b + self.k_sub, 2)
+
+    def mul_small(self, a, k):
+        return _k1_carry(a * np.int32(k), 1 if k == 2 else 2)
+
+    def canonical(self, a):
+        return _k1_canonical(self, a)
+
+    def eq(self, a, b):
+        return jnp.all(self.canonical(a) == self.canonical(b), axis=0)
+
+    def is_zero(self, a):
+        return jnp.all(self.canonical(a) == 0, axis=0)
+
+    def one_hot(self, blk):
+        return jnp.concatenate(
+            [jnp.ones((1, blk), jnp.int32),
+             jnp.zeros((K1_LIMBS - 1, blk), jnp.int32)],
+            axis=0,
+        )
+
+
+@functools.lru_cache(maxsize=1)
+def _consts_host_k1() -> np.ndarray:
+    cv = _CURVES["secp256k1"]
+    m = np.zeros((64, 128), dtype=np.int32)
+    m[0, :K1_LIMBS] = _K1_KSUB
+    m[3, :K1_LIMBS] = _K1_PLIMBS
+    m[5, :K1_LIMBS] = _k1_int_to_limbs(cv.b)
+    m[6, :K1_LIMBS] = _k1_int_to_limbs(3 * cv.b % cv.p)
+    for k, (x, y, z) in enumerate(_g_table_host(cv)):
+        m[8 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(x)
+        m[9 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(y)
+        m[10 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(z)
+    return m
+
+
 # ------------------------------------------------ complete point formulas
 # Ports of secp256.point_add / point_double (RCB16 Alg 1 and 3) to the
 # limb-major layout; correct for ALL inputs including the identity.
@@ -247,77 +544,77 @@ def _one_hot_first(blk):
     )
 
 
-def identity_point(blk):
-    zero = jnp.zeros((LIMBS, blk), dtype=jnp.int32)
-    return (zero, _one_hot_first(blk), zero)
+def identity_point(env, blk):
+    zero = jnp.zeros((env.LIMBS, blk), dtype=jnp.int32)
+    return (zero, env.one_hot(blk), zero)
 
 
-def point_add(env: Env, P, Q):
+def point_add(env, P, Q):
     X1, Y1, Z1 = P
     X2, Y2, Z2 = Q
 
     def mul_a(v):
-        return jnp.zeros_like(v) if env.a_is_zero else fe_mul(env, env.a, v)
+        return jnp.zeros_like(v) if env.a_is_zero else env.mul(env.a, v)
 
-    t0 = fe_mul(env, X1, X2)
-    t1 = fe_mul(env, Y1, Y2)
-    t2 = fe_mul(env, Z1, Z2)
-    t3 = fe_sub(env, fe_mul(env, fe_add(env, X1, Y1), fe_add(env, X2, Y2)),
-                fe_add(env, t0, t1))
-    t4 = fe_sub(env, fe_mul(env, fe_add(env, X1, Z1), fe_add(env, X2, Z2)),
-                fe_add(env, t0, t2))
-    t5 = fe_sub(env, fe_mul(env, fe_add(env, Y1, Z1), fe_add(env, Y2, Z2)),
-                fe_add(env, t1, t2))
-    Z3 = fe_add(env, fe_mul(env, env.b3, t2), mul_a(t4))
-    X3 = fe_sub(env, t1, Z3)
-    Z3 = fe_add(env, t1, Z3)
-    Y3 = fe_mul(env, X3, Z3)
-    t1 = fe_add(env, fe_add(env, t0, t0), t0)
+    t0 = env.mul(X1, X2)
+    t1 = env.mul(Y1, Y2)
+    t2 = env.mul(Z1, Z2)
+    t3 = env.sub(env.mul(env.add(X1, Y1), env.add(X2, Y2)),
+                 env.add(t0, t1))
+    t4 = env.sub(env.mul(env.add(X1, Z1), env.add(X2, Z2)),
+                 env.add(t0, t2))
+    t5 = env.sub(env.mul(env.add(Y1, Z1), env.add(Y2, Z2)),
+                 env.add(t1, t2))
+    Z3 = env.add(env.mul(env.b3, t2), mul_a(t4))
+    X3 = env.sub(t1, Z3)
+    Z3 = env.add(t1, Z3)
+    Y3 = env.mul(X3, Z3)
+    t1 = env.add(env.add(t0, t0), t0)
     t2a = mul_a(t2)
-    t4b = fe_mul(env, env.b3, t4)
-    t1 = fe_add(env, t1, t2a)
-    t2 = mul_a(fe_sub(env, t0, t2a))
-    t4 = fe_add(env, t4b, t2)
-    Y3 = fe_add(env, Y3, fe_mul(env, t1, t4))
-    X3n = fe_sub(env, fe_mul(env, X3, t3), fe_mul(env, t5, t4))
-    Z3n = fe_add(env, fe_mul(env, t5, Z3), fe_mul(env, t3, t1))
+    t4b = env.mul(env.b3, t4)
+    t1 = env.add(t1, t2a)
+    t2 = mul_a(env.sub(t0, t2a))
+    t4 = env.add(t4b, t2)
+    Y3 = env.add(Y3, env.mul(t1, t4))
+    X3n = env.sub(env.mul(X3, t3), env.mul(t5, t4))
+    Z3n = env.add(env.mul(t5, Z3), env.mul(t3, t1))
     return (X3n, Y3, Z3n)
 
 
-def point_double(env: Env, P):
+def point_double(env, P):
     X, Y, Z = P
 
     def mul_a(v):
-        return jnp.zeros_like(v) if env.a_is_zero else fe_mul(env, env.a, v)
+        return jnp.zeros_like(v) if env.a_is_zero else env.mul(env.a, v)
 
-    t0 = fe_sq(env, X)
-    t1 = fe_sq(env, Y)
-    t2 = fe_sq(env, Z)
-    t3 = fe_mul_small(env, fe_mul(env, X, Y), 2)
-    Z3 = fe_mul_small(env, fe_mul(env, X, Z), 2)
-    Y3 = fe_add(env, fe_mul(env, env.b3, t2), mul_a(Z3))
-    X3 = fe_sub(env, t1, Y3)
-    Y3 = fe_add(env, t1, Y3)
-    Y3 = fe_mul(env, X3, Y3)
-    X3 = fe_mul(env, t3, X3)
-    Z3 = fe_mul(env, env.b3, Z3)
+    t0 = env.sq(X)
+    t1 = env.sq(Y)
+    t2 = env.sq(Z)
+    t3 = env.mul_small(env.mul(X, Y), 2)
+    Z3 = env.mul_small(env.mul(X, Z), 2)
+    Y3 = env.add(env.mul(env.b3, t2), mul_a(Z3))
+    X3 = env.sub(t1, Y3)
+    Y3 = env.add(t1, Y3)
+    Y3 = env.mul(X3, Y3)
+    X3 = env.mul(t3, X3)
+    Z3 = env.mul(env.b3, Z3)
     t2a = mul_a(t2)
-    t3n = fe_add(env, mul_a(fe_sub(env, t0, t2a)), Z3)
-    Z3 = fe_add(env, fe_add(env, t0, t0), t0)
-    t0 = fe_add(env, Z3, t2a)
-    t0 = fe_mul(env, t0, t3n)
-    Y3 = fe_add(env, Y3, t0)
-    t2 = fe_mul_small(env, fe_mul(env, Y, Z), 2)
-    X3 = fe_sub(env, X3, fe_mul(env, t2, t3n))
-    Z3n = fe_mul_small(env, fe_mul(env, t2, t1), 4)
+    t3n = env.add(mul_a(env.sub(t0, t2a)), Z3)
+    Z3 = env.add(env.add(t0, t0), t0)
+    t0 = env.add(Z3, t2a)
+    t0 = env.mul(t0, t3n)
+    Y3 = env.add(Y3, t0)
+    t2 = env.mul_small(env.mul(Y, Z), 2)
+    X3 = env.sub(X3, env.mul(t2, t3n))
+    Z3n = env.mul_small(env.mul(t2, t1), 4)
     return (X3, Y3, Z3n)
 
 
-def on_curve(env: Env, x, y):
-    rhs = fe_add(env, fe_mul(env, fe_sq(env, x), x), env.b)
+def on_curve(env, x, y):
+    rhs = env.add(env.mul(env.sq(x), x), env.b)
     if not env.a_is_zero:
-        rhs = fe_add(env, rhs, fe_mul(env, env.a, x))
-    return fe_eq(env, fe_sq(env, y), rhs)
+        rhs = env.add(rhs, env.mul(env.a, x))
+    return env.eq(env.sq(y), rhs)
 
 
 def _select16(idx_row, entries):
@@ -347,11 +644,11 @@ def _verify_block(env: Env, qx, qy, read_windows, ra, rb, rb_ok, precheck):
     the hardware run. ``read_windows(base_row) -> (u1_rows, u2_rows)``
     abstracts the 8-aligned sublane read."""
     blk = qx.shape[1]
-    Q = (qx, qy, _one_hot_first(blk))
+    Q = (qx, qy, env.one_hot(blk))
     q_ok = on_curve(env, qx, qy)
 
     # variable-base table: k·Q for k = 0..15 (14 point ops per block)
-    pts = [identity_point(blk), Q]
+    pts = [identity_point(env, blk), Q]
     for k in range(2, 16):
         if k % 2 == 0:
             pts.append(point_double(env, pts[k // 2]))
@@ -370,24 +667,45 @@ def _verify_block(env: Env, qx, qy, read_windows, ra, rb, rb_ok, precheck):
             acc = point_add(env, acc, _select16(u2r[k, :], q_table))
         return acc
 
-    X, _Y, Z = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
+    X, _Y, Z = jax.lax.fori_loop(0, 8, chunk_body, identity_point(env, blk))
 
-    nonzero = ~fe_is_zero(env, Z)
-    match = fe_eq(env, X, fe_mul(env, ra, Z)) | (
-        rb_ok & fe_eq(env, X, fe_mul(env, rb, Z))
+    nonzero = ~env.is_zero(Z)
+    match = env.eq(X, env.mul(ra, Z)) | (
+        rb_ok & env.eq(X, env.mul(rb, Z))
     )
     return precheck & q_ok & nonzero & match
 
 
+def _env_class(curve_name: str):
+    """Field tier per curve. The r5 on-chip A/B measured the secp256k1
+    radix-4096 tier at 47.6k sigs/s vs the generic radix-256 tier's
+    68.4k under identical conditions — the widening halves the MACs but
+    its reduction machinery (carry-on-add passes, multi-piece wrap
+    concatenates, single-row overflow substitutions) costs more on
+    Mosaic than the MACs it saves. Default therefore stays radix-256;
+    CORDA_TPU_K1_RADIX=4096 opts k1 into the widened tier (kept as a
+    correct, interval-audited alternative for re-evaluation on future
+    toolchains/hardware)."""
+    import os
+
+    if curve_name == "secp256k1" and os.environ.get(
+        "CORDA_TPU_K1_RADIX", "256"
+    ).strip() == "4096":
+        return K1Env4096
+    return Env
+
+
 def _make_kernel(curve_name: str):
     cv = _CURVES[curve_name]
+    env_cls = _env_class(curve_name)
 
     def kernel(consts_ref, qx_ref, qy_ref, u1w_ref, u2w_ref,
                ra_ref, rb_ref, flags_ref, out_ref):
         from jax.experimental import pallas as pl
 
         blk = qx_ref.shape[1]
-        env = Env(consts_ref[:, :], blk, cv)
+        env = env_cls(consts_ref[:, :], blk, cv)
+        lm = env.LIMBS
 
         def read_windows(base_row):
             # 8-aligned sublane reads, as in the ed25519 kernel
@@ -397,8 +715,8 @@ def _make_kernel(curve_name: str):
             )
 
         verdict = _verify_block(
-            env, qx_ref[:, :], qy_ref[:, :], read_windows,
-            ra_ref[:, :], rb_ref[:, :],
+            env, qx_ref[:, :][:lm], qy_ref[:, :][:lm], read_windows,
+            ra_ref[:, :][:lm], rb_ref[:, :][:lm],
             flags_ref[1, :] == 1, flags_ref[0, :] == 1,
         ).astype(jnp.int32)
         out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
@@ -417,12 +735,19 @@ def ecdsa_verify_shadow(
     """Pure-jnp entry over the SAME block body as the pallas kernel — the
     CPU differential-test tier (interpret-mode execution of the full
     ladder is impractically slow; this compiles once and runs the
-    identical math)."""
+    identical math). Curve routing matches the kernel: secp256k1 runs
+    the radix-4096 field here too, so the CPU tier differentially tests
+    the widened math."""
     from .ed25519_pallas import bytes_to_windows_t
 
     cv = _CURVES[curve_name]
     blk = qx_bytes.shape[0]
-    env = Env(jnp.asarray(_consts_host(curve_name)), blk, cv)
+    if _env_class(curve_name) is K1Env4096:
+        env = K1Env4096(jnp.asarray(_consts_host_k1()), blk, cv)
+    else:
+        env = Env(jnp.asarray(_consts_host(curve_name)), blk, cv)
+    limbs_t = _limbs_t_for(curve_name)
+    lm = env.LIMBS
     u1w = bytes_to_windows_t(u1_bytes)
     u2w = bytes_to_windows_t(u2_bytes)
 
@@ -433,9 +758,9 @@ def ecdsa_verify_shadow(
         )
 
     return _verify_block(
-        env, _bytes_to_limbs_t(qx_bytes), _bytes_to_limbs_t(qy_bytes),
-        read_windows, _bytes_to_limbs_t(ra_bytes),
-        _bytes_to_limbs_t(rb_bytes), rb_ok, precheck,
+        env, limbs_t(qx_bytes)[:lm], limbs_t(qy_bytes)[:lm],
+        read_windows, limbs_t(ra_bytes)[:lm],
+        limbs_t(rb_bytes)[:lm], rb_ok, precheck,
     )
 
 
@@ -443,6 +768,21 @@ def _bytes_to_limbs_t(x_bytes: jax.Array) -> jax.Array:
     """(B, 32) uint8 little-endian bytes → (32, B) int32 limb planes —
     the radix-256 repack is a pure transpose (bytes ARE the limbs)."""
     return x_bytes.astype(jnp.int32).T
+
+
+def _limbs_t_for(curve_name: str):
+    """Byte-plane → limb-plane repack for the curve's field tier: k1 packs
+    to 12-bit limbs ((24, B), rows 22/23 zero — the ed25519 kernel's
+    repack, 8-aligned for sublane reads); others transpose to bytes."""
+    if _env_class(curve_name) is K1Env4096:
+        from .ed25519_pallas import bytes_to_limb12_t
+
+        return bytes_to_limb12_t
+    return _bytes_to_limbs_t
+
+
+def _in_rows(curve_name: str) -> int:
+    return 24 if _env_class(curve_name) is K1Env4096 else 32
 
 
 def _flags(precheck: jax.Array, rb_ok: jax.Array) -> jax.Array:
@@ -481,9 +821,15 @@ def ecdsa_verify_pallas(
     b = qx_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
+    limbs_t = _limbs_t_for(curve_name)
+    rows = _in_rows(curve_name)
+    consts = (
+        _consts_host_k1() if _env_class(curve_name) is K1Env4096
+        else _consts_host(curve_name)
+    )
 
-    def col_spec(rows):
-        return pl.BlockSpec((rows, block), lambda i: (0, i))
+    def col_spec(nrows):
+        return pl.BlockSpec((nrows, block), lambda i: (0, i))
 
     mask = pl.pallas_call(
         _make_kernel(curve_name),
@@ -491,19 +837,19 @@ def ecdsa_verify_pallas(
         grid=grid,
         in_specs=[
             pl.BlockSpec((64, 128), lambda i: (0, 0)),
-            col_spec(32), col_spec(32), col_spec(64), col_spec(64),
-            col_spec(32), col_spec(32), col_spec(8),
+            col_spec(rows), col_spec(rows), col_spec(64), col_spec(64),
+            col_spec(rows), col_spec(rows), col_spec(8),
         ],
         out_specs=col_spec(8),
         interpret=interpret,
     )(
-        jnp.asarray(_consts_host(curve_name)),
-        _bytes_to_limbs_t(qx_bytes),
-        _bytes_to_limbs_t(qy_bytes),
+        jnp.asarray(consts),
+        limbs_t(qx_bytes),
+        limbs_t(qy_bytes),
         bytes_to_windows_t(u1_bytes),
         bytes_to_windows_t(u2_bytes),
-        _bytes_to_limbs_t(ra_bytes),
-        _bytes_to_limbs_t(rb_bytes),
+        limbs_t(ra_bytes),
+        limbs_t(rb_bytes),
         _flags(precheck, rb_ok),
     )
     return mask[0] != 0

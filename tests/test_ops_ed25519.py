@@ -504,3 +504,194 @@ print("OK")
         if out == "NO-TPU":
             pytest.skip("no TPU attached")
         assert out == "OK", proc.stdout
+
+
+class TestRadix8192:
+    """The radix-8192 (20 × 13-bit limb) tier (ops/ed25519_pallas13.py):
+    field differentials at the audited bounds, the per-limb interval
+    audit (the int32-overflow proof for the carry-on-add discipline),
+    point-op differentials, and the full eager ladder."""
+
+    def _env(self, b):
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas13 as e13
+
+        def cfull(row):
+            return jnp.broadcast_to(
+                jnp.asarray(e13._CONSTS_HOST[row, : e13.LIMBS])[:, None],
+                (e13.LIMBS, b),
+            )
+
+        return e13.Env(
+            k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+            sqrt_m1=cfull(4),
+            b_table=tuple(
+                (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+                for i in range(16)
+            ),
+        )
+
+    def test_field_and_repack_differential(self):
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas13 as e13
+
+        rng = np.random.default_rng(9)
+        b = 8
+        ai = [int.from_bytes(rng.bytes(31), "little") for _ in range(b)]
+        bi = [int.from_bytes(rng.bytes(31), "little") for _ in range(b)]
+        at = jnp.asarray(np.stack([e13.int_to_limbs13(x) for x in ai]).T)
+        bt = jnp.asarray(np.stack([e13.int_to_limbs13(x) for x in bi]).T)
+        env = self._env(b)
+
+        def vals(t):
+            g = np.asarray(t).T
+            return [e13.limbs13_to_int(g[j]) % P for j in range(b)]
+
+        assert vals(e13.fe_mul(at, bt)) == [x * y % P for x, y in zip(ai, bi)]
+        assert vals(e13.fe_sq(at)) == [x * x % P for x in ai]
+        assert vals(e13.fe_add(at, bt)) == [
+            (x + y) % P for x, y in zip(ai, bi)]
+        assert vals(e13.fe_sub(env, at, bt)) == [
+            (x - y) % P for x, y in zip(ai, bi)]
+        can = np.asarray(e13.fe_canonical(env, at))
+        assert can.max() <= 8191
+        assert vals(can) == [x % P for x in ai]
+        # the audited fixpoint bound: every limb at 10,015
+        lazy = jnp.asarray(np.full((20, b), 10015, dtype=np.int32))
+        lv = sum(10015 << (13 * i) for i in range(20))
+        assert vals(e13.fe_mul(lazy, lazy)) == [lv * lv % P] * b
+        assert vals(e13.fe_sq(lazy)) == [lv * lv % P] * b
+        assert vals(e13.fe_canonical(env, lazy)) == [lv % P] * b
+        # byte → limb13 repack
+        yb = rng.integers(0, 256, (b, 32), dtype=np.uint8)
+        yb[:, 31] &= 0x7F
+        limbs = np.asarray(e13.bytes_to_limb13_t(jnp.asarray(yb)))
+        assert limbs.shape == (24, b) and (limbs[20:] == 0).all()
+        for i in range(b):
+            assert e13.limbs13_to_int(limbs[:20, i]) == int.from_bytes(
+                yb[i].tobytes(), "little")
+
+    def test_int32_interval_audit(self):
+        """Per-limb bound propagation through the EXACT pass structure of
+        the radix-8192 ops (fold 2 passes, add 1, sub 2): fixpoint at
+        limb bound 10,015 with every accumulation inside int32."""
+        L13, MASK13, W = 20, 8191, 608
+        INT32 = 2**31 - 1
+        seen = {"max": 0}
+
+        def acc(v):
+            m = int(np.max(v))
+            seen["max"] = max(seen["max"], m)
+            assert m <= INT32, f"int32 overflow: {m:.3e}"
+            return v
+
+        def carry_pass(bnd):
+            bnd = np.asarray(bnd, dtype=object)
+            q = bnd // (MASK13 + 1)
+            r = np.minimum(bnd, MASK13)
+            out = np.empty(L13, dtype=object)
+            out[0] = r[0] + W * q[L13 - 1]
+            for i in range(1, L13):
+                out[i] = r[i] + q[i - 1]
+            return acc(out)
+
+        def carry(bnd, n):
+            for _ in range(n):
+                bnd = carry_pass(bnd)
+            return bnd
+
+        def mul_b(a, b):
+            cols = np.zeros(2 * L13, dtype=object)
+            for i in range(L13):
+                for j in range(L13):
+                    cols[i + j] += a[i] * b[j]
+            acc(cols)
+            q = cols // (MASK13 + 1)
+            r = np.minimum(cols, MASK13 * np.ones(2 * L13, dtype=object))
+            c = r.copy()
+            c[1:] += q[:-1]
+            acc(c)
+            lo, hi = c[:L13], c[L13:]
+            return carry(acc(lo + W * hi), 2)
+
+        from corda_tpu.ops.ed25519_pallas13 import _K2
+
+        ksub = np.asarray(_K2, dtype=object)
+        R = np.full(L13, MASK13, dtype=object)
+        for _ in range(20):
+            nxt = [
+                mul_b(R, R),
+                carry_pass(R + R),        # fe_add / fe_mul_small(·,2)
+                carry(R + ksub, 2),       # fe_sub (worst: minuend + K2)
+            ]
+            R2 = R.copy()
+            for c in nxt:
+                R2 = np.maximum(R2, c)
+            if all(int(x) == int(y) for x, y in zip(R, R2)):
+                break
+            R = R2
+        else:
+            raise AssertionError("no bound fixpoint")
+        assert max(int(x) for x in R) == 9407, [int(x) for x in R]
+        assert seen["max"] < INT32, f"{seen['max']:.3e}"
+
+    def test_point_ops_differential(self):
+        """Radix-8192 point ops vs the batch-major XLA core."""
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519 as ed
+        from corda_tpu.ops import ed25519_pallas13 as e13
+
+        b = 8
+        pks = []
+        from cryptography.hazmat.primitives.asymmetric import (
+            ed25519 as hostlib,
+        )
+
+        for _ in range(b):
+            pks.append(
+                hostlib.Ed25519PrivateKey.generate()
+                .public_key().public_bytes_raw()
+            )
+        pk_arr = np.frombuffer(b"".join(pks), np.uint8).reshape(b, 32)
+        y = pk_arr.copy()
+        y[:, 31] &= 0x7F
+        sign = (pk_arr[:, 31] >> 7).astype(np.int32)
+        env = self._env(b)
+
+        y_bm = jnp.asarray(y.astype(np.int32))
+        pt_bm, ok_bm = ed.decompress(y_bm, jnp.asarray(sign))
+        y13 = e13.bytes_to_limb13_t(jnp.asarray(y))[: e13.LIMBS]
+        pt_lm, ok_lm = e13.decompress(env, y13, jnp.asarray(sign))
+        assert (np.asarray(ok_lm) == np.asarray(ok_bm)).all()
+
+        def canon_bm(p):
+            enc = np.asarray(ed.compress(p))
+            out = []
+            for i in range(b):
+                v = int.from_bytes(bytes(int(x) for x in enc[i]), "little")
+                out.append((v & ((1 << 255) - 1), v >> 255))
+            return out
+
+        def canon_lm(p):
+            ey, par = e13.compress_y_parity(env, p)
+            ey, par = np.asarray(ey), np.asarray(par)
+            return [
+                (e13.limbs13_to_int(ey[:, i]), int(par[i])) for i in range(b)
+            ]
+
+        assert canon_lm(pt_lm) == canon_bm(pt_bm)
+        dbl_bm = ed.point_double(pt_bm)
+        dbl_lm = e13.point_double(env, pt_lm)
+        assert canon_lm(dbl_lm) == canon_bm(dbl_bm)
+        sum_bm = ed.point_add(dbl_bm, pt_bm)
+        sum_lm = e13.point_add(env, dbl_lm, pt_lm)
+        assert canon_lm(sum_lm) == canon_bm(sum_bm)
+        planes = e13.to_planes(env, pt_lm)
+        assert canon_lm(e13._add_q_planes(env, dbl_lm, planes)) == canon_bm(
+            sum_bm)
+        basesum_bm = ed.point_add(dbl_bm, ed.base_point(b))
+        basesum_lm = e13._add_b_entry(env, dbl_lm, env.b_table[1])
+        assert canon_lm(basesum_lm) == canon_bm(basesum_bm)

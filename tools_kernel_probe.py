@@ -1,0 +1,101 @@
+"""Focused on-chip kernel throughput probe (ed25519 / ECDSA verify).
+
+One chip job: measures sigs/sec for the production kernels at the bench
+shapes (batch 8192, block 128), median of 3 timed reps after a warm-up.
+Used for head-to-head kernel comparisons between full bench runs without
+paying the whole driver-shape suite. Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+
+def probe_ed25519(batch: int = 8192, reps: int = 3) -> dict:
+    import random
+
+    from cryptography.hazmat.primitives.asymmetric import ed25519 as hostlib
+
+    from corda_tpu.ops.ed25519 import ed25519_verify_batch
+
+    rng = random.Random(11)
+    base = 256  # distinct keypairs; lanes tile them
+    pks, sigs, msgs = [], [], []
+    for _ in range(base):
+        sk = hostlib.Ed25519PrivateKey.generate()
+        m = rng.randbytes(44)
+        pks.append(sk.public_key().public_bytes_raw())
+        sigs.append(sk.sign(m))
+        msgs.append(m)
+    reps_n = batch // base
+    pks, sigs, msgs = pks * reps_n, sigs * reps_n, msgs * reps_n
+    assert ed25519_verify_batch(pks, sigs, msgs).all()  # warm + correct
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        mask = ed25519_verify_batch(pks, sigs, msgs)
+        dt = time.perf_counter() - t0
+        assert mask.all()
+        rates.append(batch / dt)
+    return {"ed25519_sigs_per_sec": round(statistics.median(rates), 1),
+            "ed25519_best": round(max(rates), 1)}
+
+
+def probe_ecdsa(batch: int = 4096, reps: int = 3) -> dict:
+    import hashlib
+    import random
+
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives import hashes
+
+    from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+    )
+
+    N_K1 = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+    rng = random.Random(12)
+    base = 128
+    keys, sgs, msgs = [], [], []
+    for _ in range(base):
+        sk = ec.generate_private_key(ec.SECP256K1())
+        m = rng.randbytes(44)
+        nums = sk.public_key().public_numbers()
+        enc = b"\x04" + nums.x.to_bytes(32, "big") + nums.y.to_bytes(32, "big")
+        keys.append(enc)
+        r, s = decode_dss_signature(sk.sign(m, ec.ECDSA(hashes.SHA256())))
+        s = min(s, N_K1 - s)  # low-S canonical (the framework wire form)
+        sgs.append(r.to_bytes(32, "big") + s.to_bytes(32, "big"))
+        msgs.append(m)
+    import numpy as np
+
+    reps_n = batch // base
+    keys, sgs, msgs = keys * reps_n, sgs * reps_n, msgs * reps_n
+    mask = np.asarray(ecdsa_verify_dispatch("secp256k1", keys, sgs, msgs))
+    assert mask.all()  # warm + correct
+    rates = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        mask = np.asarray(ecdsa_verify_dispatch("secp256k1", keys, sgs, msgs))
+        dt = time.perf_counter() - t0
+        assert mask.all()
+        rates.append(batch / dt)
+    return {"ecdsa_sigs_per_sec": round(statistics.median(rates), 1),
+            "ecdsa_best": round(max(rates), 1)}
+
+
+if __name__ == "__main__":
+    import jax
+
+    out = {"device": str(jax.devices()[0])}
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("ed25519", "both"):
+        out.update(probe_ed25519())
+        print(json.dumps(out), flush=True)  # partial: survive later aborts
+    if which in ("ecdsa", "both"):
+        out.update(probe_ecdsa())
+    print(json.dumps(out))
