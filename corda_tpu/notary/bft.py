@@ -592,30 +592,52 @@ class BFTClusterClient:
         return list(outcome["conflicts"]), sigs
 
     def _submit_command(self, command: bytes):
+        return self._submit_command_async(command).collect()
+
+    def _submit_command_async(self, command: bytes):
+        """Broadcast the request and return a pending; ``collect()`` waits
+        for the f+1 quorum, re-broadcasting once per view-timeout. The
+        broadcast goes out NOW, so the cluster's three-phase rounds for
+        consecutive notary windows pipeline (phases are per-sequence-slot)
+        while the caller settles other windows."""
         d = _digest(command)
         fut: Future = Future()
         with self._lock:
             self._futures[d] = fut
         payload = serialize({"command": command, "client": self.name})
-        deadline = time.monotonic() + self._timeout_s
-        try:
-            while True:
-                for r in self._replicas:
-                    self._messaging.send(r, T_REQUEST, payload)
+        for r in self._replicas:
+            self._messaging.send(r, T_REQUEST, payload)
+        client = self
+
+        class _PendingSubmit:
+            def collect(_self):
+                # the quorum-wait budget starts HERE, not at dispatch: a
+                # pipelined caller may dwell several windows between
+                # dispatch and collect, and that dwell must not consume
+                # the timeout (the slot has been replicating meanwhile)
+                deadline = time.monotonic() + client._timeout_s
                 try:
-                    outcome_bytes, sigs = fut.result(
-                        timeout=min(self._retry_every_s,
-                                    max(0.01, deadline - time.monotonic()))
-                    )
-                    break
-                except TimeoutError:
-                    if time.monotonic() >= deadline:
-                        raise
-        finally:
-            with self._lock:
-                self._futures.pop(d, None)
-                self._replies.pop(d, None)
-        return deserialize(outcome_bytes), sigs
+                    while True:
+                        try:
+                            outcome_bytes, sigs = fut.result(
+                                timeout=min(
+                                    client._retry_every_s,
+                                    max(0.01, deadline - time.monotonic()),
+                                )
+                            )
+                            break
+                        except TimeoutError:
+                            if time.monotonic() >= deadline:
+                                raise
+                            for r in client._replicas:
+                                client._messaging.send(r, T_REQUEST, payload)
+                finally:
+                    with client._lock:
+                        client._futures.pop(d, None)
+                        client._replies.pop(d, None)
+                return deserialize(outcome_bytes), sigs
+
+        return _PendingSubmit()
 
 
 class BFTUniquenessProvider(UniquenessProvider):
@@ -634,10 +656,27 @@ class BFTUniquenessProvider(UniquenessProvider):
     def commit_batch(self, requests):
         """One total-order broadcast for the whole window (r2 VERDICT weak
         #4); the f+1 quorum certifies the per-request conflict list."""
+        return self.commit_batch_async(requests).collect()
+
+    def commit_batch_async(self, requests):
+        """Put the window's total-order slot in flight and return — the
+        three-phase broadcast for window N replicates while the notary
+        pipeline verifies window N+1 on device (same stall fix as the
+        Raft provider's commit_batch_async)."""
+        from .uniqueness import PendingCommit
+
         if not requests:
-            return []
-        conflicts, _sigs = self.client.submit_batch(requests)
-        return conflicts
+            return PendingCommit([])
+        pending = self.client._submit_command_async(serialize(
+            ("batch", [(list(s), t, c) for (s, t, c) in requests])
+        ))
+
+        class _PendingBFTCommit:
+            def collect(_self):
+                outcome, _sigs = pending.collect()
+                return list(outcome["conflicts"])
+
+        return _PendingBFTCommit()
 
     @staticmethod
     def make_cluster(n: int, network, prefix: str = "bft-replica",

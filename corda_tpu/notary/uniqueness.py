@@ -53,6 +53,19 @@ class NotaryError(Exception):
         self.conflict = conflict
 
 
+class PendingCommit:
+    """A batch commit already settled (or already in flight): ``collect()``
+    yields the per-request conflict list."""
+
+    __slots__ = ("_conflicts",)
+
+    def __init__(self, conflicts):
+        self._conflicts = conflicts
+
+    def collect(self):
+        return self._conflicts
+
+
 class UniquenessProvider:
     def commit(self, states: list[StateRef], tx_id: SecureHash,
                caller_name: str) -> None:
@@ -73,6 +86,16 @@ class UniquenessProvider:
             except NotaryError as e:
                 out.append(e.conflict)
         return out
+
+    def commit_batch_async(self, requests) -> PendingCommit:
+        """Enqueue the batch commit; ``collect()`` on the returned pending
+        yields the conflict list. Local providers settle eagerly (a map or
+        SQLite round-trip is sub-ms — nothing to overlap); the consensus
+        providers (raft/bft) override this to put a full replication round
+        in flight, which the batched notary's pipeline overlaps with the
+        NEXT window's device signature checks (the ``process_stream``
+        depth pattern) instead of serializing on it."""
+        return PendingCommit(self.commit_batch(requests))
 
 
 def _ref_key(ref: StateRef) -> bytes:

@@ -33,6 +33,7 @@ from __future__ import annotations
 import hashlib
 import struct
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -149,9 +150,11 @@ def sphincs_verify_dispatch(
             pub_seed, root, fors_dg, idx, sig
         )
 
-    # ------------------------------------------------------------- FORS
-    # rows: (lane, tree) flattened to B·K; invalid lanes compute garbage
-    # harmlessly behind the precheck mask
+    # ------------------------------------------------- host plane packing
+    # Every prefix, sibling, parity and offset is host-known BEFORE any
+    # device work (digits chain on device) — so the whole hash structure
+    # packs into static-shaped planes and the device half becomes a pure
+    # function of them (``_sphincs_pipeline``).
     off0 = N + 8
     fors_prefix, fors_sks, fors_auth = [], [], [[] for _ in range(A)]
     fors_even = np.zeros((n_lanes * K, A), dtype=bool)
@@ -177,51 +180,105 @@ def sphincs_verify_dispatch(
                 )
                 pos //= 2
 
-    node = sha256_bytes_device(jnp.asarray(np.concatenate(
-        [_u8(fors_prefix), _u8(fors_sks)], axis=1
-    )))
-    node = digest_words_to_device_bytes(node)
-    for lvl in range(A):
-        prefix = jnp.asarray(_u8(fors_node_prefix[lvl]))
-        sib = jnp.asarray(_u8(fors_auth[lvl]))
-        even = jnp.asarray(fors_even[:, lvl])[:, None]
-        first = jnp.where(even, node, sib)
-        second = jnp.where(even, sib, node)
-        node = digest_words_to_device_bytes(sha256_bytes_device(
-            jnp.concatenate([prefix, first, second], axis=1)
-        ))
-    fors_roots = node.reshape(n_lanes, K * N)
-    forspk_prefix = _u8([
-        b"forspk" + pub_seeds[i] + _addr(FORS_LAYER, idxs[i], 0, 0)
-        for i in range(n_lanes)
-    ])
-    digest = digest_words_to_device_bytes(sha256_bytes_device(
-        jnp.concatenate([jnp.asarray(forspk_prefix), fors_roots], axis=1)
-    ))  # (B, 32): the value layer 0 signs
-
-    # -------------------------------------------------------- hypertree
     sig_arr = _u8(sigs)
     off = off0 + K * (N + A * N)
+    chain_prefixes, wots_blocks, wotspk_prefixes = [], [], []
+    xmss_prefixes, xmss_sibs, xmss_evens = [], [], []
     for layer in range(D):
         tree_leaf = []
         for i in range(n_lanes):
             t = idxs[i] >> (HT * layer)
             tree_leaf.append((t >> HT, t & ((1 << HT) - 1)))
-        # 67 chains per lane: rows (B·LEN); start digit from the DEVICE
-        # digest of the previous stage
-        digs = _device_digits(digest).reshape(n_lanes * LEN)
-        chain_prefix = _u8([
+        chain_prefixes.append(_u8([
             b"ch" + pub_seeds[i]
             + _addr(layer, tree_leaf[i][0], tree_leaf[i][1], j << 8)
             for i in range(n_lanes) for j in range(LEN)
-        ])
-        k_byte = chain_prefix.shape[1] - 1  # low byte of (j<<8)|k
-        wots = sig_arr[:, off:off + LEN * N]
-        off += LEN * N
-        x = jnp.asarray(
-            wots.reshape(n_lanes * LEN, N)
+        ]))
+        wots_blocks.append(
+            sig_arr[:, off:off + LEN * N].reshape(n_lanes * LEN, N)
         )
-        prefix_dev = jnp.asarray(chain_prefix)
+        off += LEN * N
+        wotspk_prefixes.append(_u8([
+            b"wotspk" + pub_seeds[i]
+            + _addr(layer, tree_leaf[i][0], tree_leaf[i][1], 0)
+            for i in range(n_lanes)
+        ]))
+        pos = [tree_leaf[i][1] for i in range(n_lanes)]
+        l_prefix, l_sib, l_even = [], [], []
+        for lvl in range(1, HT + 1):
+            l_sib.append(sig_arr[:, off:off + N])
+            off += N
+            l_prefix.append(_u8([
+                b"node" + pub_seeds[i]
+                + _addr(layer, tree_leaf[i][0], lvl, pos[i] // 2)
+                for i in range(n_lanes)
+            ]))
+            l_even.append(np.array([p % 2 == 0 for p in pos]))
+            pos = [p // 2 for p in pos]
+        xmss_prefixes.append(l_prefix)
+        xmss_sibs.append(l_sib)
+        xmss_evens.append(l_even)
+
+    planes: tuple = (
+        np.concatenate([_u8(fors_prefix), _u8(fors_sks)], axis=1),
+        np.stack([_u8(p) for p in fors_node_prefix]),       # (A, B·K, L1)
+        np.stack([_u8(s) for s in fors_auth]),              # (A, B·K, N)
+        fors_even,                                          # (B·K, A)
+        _u8([b"forspk" + pub_seeds[i] + _addr(FORS_LAYER, idxs[i], 0, 0)
+             for i in range(n_lanes)]),
+        np.stack(chain_prefixes),                           # (D, B·LEN, L2)
+        np.stack(wots_blocks),                              # (D, B·LEN, N)
+        np.stack(wotspk_prefixes),                          # (D, B, L3)
+        np.stack([np.stack(p) for p in xmss_prefixes]),     # (D, HT, B, L4)
+        np.stack([np.stack(s) for s in xmss_sibs]),         # (D, HT, B, N)
+        np.stack([np.stack(e) for e in xmss_evens]),        # (D, HT, B)
+        _u8(roots),
+        pre,
+    )
+    if jax.default_backend() == "cpu":
+        # eager chaining: ~100 small cached jits — the fused graph is an
+        # XLA:CPU compile tarpit, and the CPU tier has no link latency to
+        # amortize anyway
+        return _sphincs_pipeline(*(jnp.asarray(p) for p in planes))
+    # accelerator: ONE fused jit = ONE dispatch = ONE link round trip.
+    # The r4 eager chain was ~100 sequential queue-drain round trips —
+    # structurally latency-bound on a tunneled link (0.04× host); fused,
+    # the whole hypertree walk is a single enqueued unit whose latency
+    # overlaps the other schemes' buckets in a mixed dispatch.
+    return _sphincs_pipeline_jit(*(jnp.asarray(p) for p in planes))
+
+
+def _sphincs_pipeline(
+    fors_leaf, fors_node_prefix, fors_auth, fors_even, forspk_prefix,
+    chain_prefix, wots, wotspk_prefix, xmss_prefix, xmss_sib, xmss_even,
+    claimed, pre,
+):
+    """The whole device half — FORS, D hypertree layers, verdict — as a
+    pure function of the host-packed planes. Shared verbatim by the CPU
+    eager path and the fused TPU jit (``_sphincs_pipeline_jit``)."""
+    n_lanes = forspk_prefix.shape[0]
+
+    node = digest_words_to_device_bytes(sha256_bytes_device(fors_leaf))
+    for lvl in range(A):
+        even = fors_even[:, lvl][:, None]
+        sib = fors_auth[lvl]
+        first = jnp.where(even, node, sib)
+        second = jnp.where(even, sib, node)
+        node = digest_words_to_device_bytes(sha256_bytes_device(
+            jnp.concatenate([fors_node_prefix[lvl], first, second], axis=1)
+        ))
+    fors_roots = node.reshape(n_lanes, K * N)
+    digest = digest_words_to_device_bytes(sha256_bytes_device(
+        jnp.concatenate([forspk_prefix, fors_roots], axis=1)
+    ))  # (B, 32): the value layer 0 signs
+
+    k_byte = chain_prefix.shape[2] - 1  # low byte of (j<<8)|k
+    for layer in range(D):
+        # 67 chains per lane; start digit from the DEVICE digest of the
+        # previous stage (layers chain with no host round trip)
+        digs = _device_digits(digest).reshape(n_lanes * LEN)
+        x = wots[layer]
+        prefix_dev = chain_prefix[layer]
         for k in range(W - 1):
             stepped = digest_words_to_device_bytes(sha256_bytes_device(
                 jnp.concatenate(
@@ -230,36 +287,22 @@ def sphincs_verify_dispatch(
             ))
             x = jnp.where((k >= digs)[:, None], stepped, x)
         tips = x.reshape(n_lanes, LEN * N)
-        wotspk_prefix = _u8([
-            b"wotspk" + pub_seeds[i]
-            + _addr(layer, tree_leaf[i][0], tree_leaf[i][1], 0)
-            for i in range(n_lanes)
-        ])
         node = digest_words_to_device_bytes(sha256_bytes_device(
-            jnp.concatenate([jnp.asarray(wotspk_prefix), tips], axis=1)
+            jnp.concatenate([wotspk_prefix[layer], tips], axis=1)
         ))
         # XMSS auth walk: HT levels, sibling order by host-known parity
-        pos = [tree_leaf[i][1] for i in range(n_lanes)]
-        for lvl in range(1, HT + 1):
-            sib = jnp.asarray(sig_arr[:, off:off + N])
-            off += N
-            node_prefix = _u8([
-                b"node" + pub_seeds[i]
-                + _addr(layer, tree_leaf[i][0], lvl, pos[i] // 2)
-                for i in range(n_lanes)
-            ])
-            even = jnp.asarray(
-                np.array([p % 2 == 0 for p in pos])
-            )[:, None]
+        for lvl in range(HT):
+            sib = xmss_sib[layer, lvl]
+            even = xmss_even[layer, lvl][:, None]
             first = jnp.where(even, node, sib)
             second = jnp.where(even, sib, node)
             node = digest_words_to_device_bytes(sha256_bytes_device(
-                jnp.concatenate([jnp.asarray(node_prefix), first, second],
+                jnp.concatenate([xmss_prefix[layer, lvl], first, second],
                                 axis=1)
             ))
-            pos = [p // 2 for p in pos]
         digest = node  # next layer signs this subtree root
 
-    # ----------------------------------------------------------- verdict
-    claimed = jnp.asarray(_u8(roots))
-    return jnp.all(digest == claimed, axis=1) & jnp.asarray(pre)
+    return jnp.all(digest == claimed, axis=1) & pre
+
+
+_sphincs_pipeline_jit = jax.jit(_sphincs_pipeline)

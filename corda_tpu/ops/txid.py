@@ -219,55 +219,75 @@ def ids_tier() -> str:
     chip's margin belongs to the signature ladders, which upload 100
     bytes per lane and compute thousands of field ops on them. A local
     PCIe/ICI chip (sub-ms link) amortizes the upload and the device
-    sweep frees the host. Decided once per process from a measured
-    round trip; override with CORDA_TPU_IDS=host|device."""
-    global _ids_tier_cache
-    if _ids_tier_cache is None:
-        import os
+    sweep frees the host. Derived from the measured round trip (re-probed
+    on the RTT cache's TTL, so a link whose latency changes — tunnel →
+    local attach, or congestion — re-routes within a minute instead of
+    keeping stale routing for the process lifetime); override with
+    CORDA_TPU_IDS=host|device. Tests may pin ``_ids_tier_cache``."""
+    if _ids_tier_cache is not None:
+        return _ids_tier_cache
+    import os
 
-        forced = os.environ.get("CORDA_TPU_IDS", "").strip().lower()
-        if forced in ("host", "device"):
-            _ids_tier_cache = forced
-        else:
-            _ids_tier_cache = (
-                "device" if _measured_link_rtt_s() < 0.005 else "host"
-            )
-    return _ids_tier_cache
+    forced = os.environ.get("CORDA_TPU_IDS", "").strip().lower()
+    if forced in ("host", "device"):
+        return forced
+    return "device" if _measured_link_rtt_s() < 0.005 else "host"
 
 
 _link_rtt_cache: float | None = None
+_link_rtt_measured_at: float = 0.0
+_LINK_RTT_TTL_S = 60.0
+_rtt_probe_fn = None
+_rtt_lock = __import__("threading").Lock()
 
 
 def _measured_link_rtt_s() -> float:
-    """One tiny dispatch+readback, median of 3 — measured ONCE per
-    process and cached: callers sit on hot paths (the DAG verifier calls
-    the break-even gate per resolve), and an uncached probe would pay a
-    fresh jit compile + round trips inside the measured work (it cost the
-    r4 DAG bench 4× when first landed uncached)."""
-    global _link_rtt_cache
-    if _link_rtt_cache is not None:
-        return _link_rtt_cache
+    """One tiny dispatch+readback, median of 3 — cached with a 60 s TTL:
+    callers sit on hot paths (the DAG verifier calls the break-even gate
+    per resolve), and an uncached probe would pay a fresh jit compile +
+    round trips inside the measured work (it cost the r4 DAG bench 4×
+    when first landed uncached). The TTL keeps the routing honest when
+    the link itself changes (r4 VERDICT weak #6): a re-probe reuses the
+    already-compiled probe fn, so refreshes cost only the 3 round trips
+    they measure."""
+    global _link_rtt_cache, _link_rtt_measured_at, _rtt_probe_fn
     import time
 
-    import jax
-    import jax.numpy as jnp
-
-    try:
-        if jax.default_backend() == "cpu":
-            _link_rtt_cache = 0.0
+    # one probe at a time: a warm-up thread (the batched notary's boot
+    # warm) and the first gate call must not interleave their samples on
+    # the device queue — contended samples inflate the median and can
+    # mis-route for a full TTL; latecomers reuse the winner's fresh value
+    with _rtt_lock:
+        now = time.monotonic()
+        if (
+            _link_rtt_cache is not None
+            and now - _link_rtt_measured_at < _LINK_RTT_TTL_S
+        ):
             return _link_rtt_cache
-        f = jax.jit(lambda x: x + 1)
-        f(jnp.zeros((8,), jnp.int32)).block_until_ready()  # compile
-        samples = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            np.asarray(f(jnp.zeros((8,), jnp.int32)))
-            samples.append(time.perf_counter() - t0)
-        samples.sort()
-        _link_rtt_cache = samples[1]
-    except Exception:
-        _link_rtt_cache = float("inf")  # unreachable backend: host
-    return _link_rtt_cache
+
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            if jax.default_backend() == "cpu":
+                _link_rtt_cache = 0.0
+            else:
+                if _rtt_probe_fn is None:
+                    _rtt_probe_fn = jax.jit(lambda x: x + 1)
+                    _rtt_probe_fn(
+                        jnp.zeros((8,), jnp.int32)
+                    ).block_until_ready()  # compile
+                samples = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    np.asarray(_rtt_probe_fn(jnp.zeros((8,), jnp.int32)))
+                    samples.append(time.perf_counter() - t0)
+                samples.sort()
+                _link_rtt_cache = samples[1]
+        except Exception:
+            _link_rtt_cache = float("inf")  # unreachable backend: host
+        _link_rtt_measured_at = time.monotonic()
+        return _link_rtt_cache
 
 
 def device_verify_worthwhile(n_rows: int) -> bool:

@@ -340,9 +340,12 @@ class MeshVerifier:
         # lanes-per-chunk floor of 4 keeps tiny batches off an 8-way fan
         # (each chunk pads to ≥ the scheme's internal floor anyway)
         n_chunks = max(1, min(len(devs), (n + 3) // 4))
+        # fixed ceil(n/n_chunks) chunk size (last chunk short): uneven
+        # n*c//n_chunks splits put chunks in different pow2 pad buckets
+        # and trigger extra per-shape compiles
+        step = -(-n // n_chunks)
         bounds = [
-            (n * c // n_chunks, n * (c + 1) // n_chunks)
-            for c in range(n_chunks)
+            (c * step, min(n, (c + 1) * step)) for c in range(n_chunks)
         ]
         parts: list[tuple[int, int, object]] = []
         for dev, (lo, hi) in zip(devs, bounds):

@@ -23,7 +23,6 @@ the host-side mirror of the mesh's all-gathered spent-state hashes
 from __future__ import annotations
 
 import dataclasses
-from concurrent.futures import ThreadPoolExecutor
 
 from corda_tpu.crypto import SecureHash
 from corda_tpu.ledger import SignedTransaction, StateRef
@@ -84,6 +83,8 @@ def verify_transaction_dag(
     max_workers: int = 8,
     check_contracts: bool = True,
     recompute_ids: bool = True,
+    window: int = 256,
+    depth: int = 3,
 ) -> DagVerifyResult:
     """Verify a set of interdependent SignedTransactions wavefront-parallel.
 
@@ -94,50 +95,71 @@ def verify_transaction_dag(
     (e.g. the notary key during assembly); defaults to none.
 
     With ``recompute_ids`` (device path), every transaction's Merkle id is
-    RECOMPUTED for the whole DAG in one batched device sweep
-    (ops/txid.py) — a forged chain link (claimed id ≠ recomputed id) fails
-    here, and the verified ids prime the per-tx caches so no host hashing
-    remains on the hot path. (Host id computation is the reference's
-    per-tx cost in ResolveTransactionsFlow.kt:91-99.)
+    RECOMPUTED in batched sweeps (ops/txid.py) — a forged chain link
+    (claimed id ≠ recomputed id) fails here, and the verified ids prime the
+    per-tx caches so no host hashing remains on the hot path. (Host id
+    computation is the reference's per-tx cost in
+    ResolveTransactionsFlow.kt:91-99.)
+
+    Pipelining (the notary ``process_stream`` shape, applied to resolve):
+    the topological levels are grouped into level-aligned windows of
+    ≥ ``window`` transactions, and up to ``depth`` windows' signature
+    batches ride the device concurrently while earlier windows run the
+    order-DEPENDENT host walk (double-spend set, input resolution,
+    contract semantics). A one-shot whole-DAG dispatch (the r4 shape)
+    paid one un-overlapped link round trip before the walk could start —
+    exactly what sank config #4 to 0.9× host; windows hide the round
+    trips under the walk. The walk itself batches contract semantics per
+    window through ``verify_ledger_batch`` (once per contract class, the
+    fungible fast path) instead of per-tx ``ltx.verify`` calls — sound
+    because a window's outputs feed later resolution only if nothing in
+    the window raised, and ANY contract failure in the window raises.
 
     Raises the first verification failure; on success returns the ordering
     + consumed-set report.
     """
-    from corda_tpu.verifier import check_transactions
-
-    if recompute_ids and use_device and stxs:
-        from corda_tpu.ops.txid import check_and_prime_ids
-
-        check_and_prime_ids(stxs)
-
-    # order-free work first: EVERY signature in the DAG in one bucketed
-    # dispatch (the chain walk below never waits on device round trips).
-    # One-shot shape — route by the link's break-even (a small DAG's
-    # host verify beats paying a tunneled round trip; ops.txid)
-    all_ids = list(stxs)
-    all_stxs = [stxs[tid] for tid in all_ids]
-    allowed_all = [
-        allowed_missing_fn(s) if allowed_missing_fn else set()
-        for s in all_stxs
-    ]
-    if use_device:
-        from corda_tpu.ops.txid import device_verify_worthwhile
-
-        use_device = device_verify_worthwhile(
-            sum(len(s.sigs) for s in all_stxs)
-        )
-    report = check_transactions(all_stxs, allowed_all, use_device=use_device)
-    report.raise_first()
-    n_sigs = report.n_sigs
+    del max_workers  # kept for API compat; the walk batches per window now
+    from corda_tpu.verifier import dispatch_transactions
 
     deps: dict = {}
     for tid, stx in stxs.items():
         deps[tid] = {ref.txhash for ref in stx.inputs if ref.txhash in stxs}
     levels = topological_levels(deps)
 
+    # level-aligned windows of >= `window` transactions
+    windows: list[list[list]] = []
+    cur: list[list] = []
+    cnt = 0
+    for level in levels:
+        cur.append(level)
+        cnt += len(level)
+        if cnt >= window:
+            windows.append(cur)
+            cur, cnt = [], 0
+    if cur:
+        windows.append(cur)
+
+    def allowed_for(s):
+        return allowed_missing_fn(s) if allowed_missing_fn else set()
+
+    # the id recompute-and-check is an INTEGRITY property, decided by the
+    # caller's use_device before any perf downgrade below — the break-even
+    # gate must never silently drop the forged-chain-link check
+    check_ids = recompute_ids and use_device
+    pipelined = use_device and len(windows) > 1
+    if use_device and not pipelined:
+        # solo window: no neighbours to hide the link round trip behind —
+        # one-shot break-even gate (ops.txid)
+        from corda_tpu.ops.txid import device_verify_worthwhile
+
+        use_device = device_verify_worthwhile(
+            sum(len(s.sigs) for s in stxs.values())
+        )
+
     outputs: dict = {}  # StateRef -> TransactionState, from verified txs
     consumed: set = set()
     order: list = []
+    n_sigs = 0
 
     def resolve(ref: StateRef, tid: SecureHash):
         if ref in outputs:
@@ -148,9 +170,29 @@ def verify_transaction_dag(
                 return st
         raise UnresolvedStateError(ref, tid)
 
-    pool = ThreadPoolExecutor(max_workers=max_workers) if check_contracts else None
-    try:
-        for level in levels:
+    def dispatch_window(win_levels):
+        """Order-free work for one window: id recompute-and-check, then
+        the scheme-bucketed signature batch (enqueued, not collected)."""
+        tids = [tid for lvl in win_levels for tid in lvl]
+        if check_ids:
+            from corda_tpu.ops.txid import check_and_prime_ids
+
+            check_and_prime_ids({tid: stxs[tid] for tid in tids})
+        win_stxs = [stxs[tid] for tid in tids]
+        return dispatch_transactions(
+            win_stxs, [allowed_for(s) for s in win_stxs],
+            use_device=use_device,
+        )
+
+    def walk_window(win_levels, pending):
+        """Collect the window's signature verdicts, then the
+        order-dependent walk over its levels."""
+        nonlocal n_sigs
+        report = pending.collect()
+        report.raise_first()
+        n_sigs += report.n_sigs
+        ltx_batch: list = []
+        for level in win_levels:
             # consumed-set update is sequential (cheap set algebra); it is
             # the correctness gate for double-spends within the DAG
             for tid in level:
@@ -158,47 +200,40 @@ def verify_transaction_dag(
                     if ref in consumed:
                         raise DoubleSpendInDagError(ref, tid)
                     consumed.add(ref)
-
             # structural input resolution is not optional: every input must
             # resolve inside the DAG or via resolve_external even when
             # contract semantics are skipped
             for tid in level:
-                for ref in stxs[tid].inputs:
+                stx = stxs[tid]
+                for ref in stx.inputs:
                     resolve(ref, tid)
-
-            if check_contracts:
-                def run_contracts(tid):
-                    stx = stxs[tid]
-                    ltx = stx.tx.to_ledger_transaction(
-                        lambda ref: resolve(ref, tid)
-                    )
-                    ltx.verify()
-
-                for err in pool.map(_trap(run_contracts), level):
-                    if err is not None:
-                        raise err
-
-            # publish outputs only after the whole level verified
+                if check_contracts:
+                    ltx_batch.append(stx.tx.to_ledger_transaction(
+                        lambda ref, t=tid: resolve(ref, t)
+                    ))
+            # publish outputs so the next level resolves; the window's
+            # contract verdicts gate anything beyond this window
             for tid in level:
                 wtx = stxs[tid].tx
                 for i, ts in enumerate(wtx.outputs):
                     outputs[StateRef(tid, i)] = ts
             order.extend(level)
-    finally:
-        if pool is not None:
-            # wait so no background thread touches the caller's resolver
-            # after we return/raise
-            pool.shutdown(wait=True, cancel_futures=True)
+        if check_contracts:
+            from corda_tpu.ledger.ledger_tx import verify_ledger_batch
+
+            for err in verify_ledger_batch(ltx_batch):
+                if err is not None:
+                    raise err
+
+    from collections import deque
+
+    in_flight: deque = deque()  # (win_levels, pending sig-check)
+    live_depth = depth if pipelined else 1
+    for win_levels in windows:
+        in_flight.append((win_levels, dispatch_window(win_levels)))
+        if len(in_flight) >= live_depth:
+            walk_window(*in_flight.popleft())
+    while in_flight:
+        walk_window(*in_flight.popleft())
 
     return DagVerifyResult(order, levels, n_sigs, consumed)
-
-
-def _trap(fn):
-    def wrapped(arg):
-        try:
-            fn(arg)
-            return None
-        except Exception as e:  # propagated by the caller
-            return e
-
-    return wrapped

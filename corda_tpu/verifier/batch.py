@@ -48,13 +48,13 @@ _DEVICE_SCHEMES = {
 
 def _effective_device_schemes(use_device: bool) -> set:
     """The device-capable scheme set for this dispatch. SPHINCS batches on
-    device too (pure hashing — ~100 chained SHA-256 dispatches,
-    ops/sphincs_batch.py), but only on a LOCAL accelerator: its many
-    small eager steps are profitable on a PCIe/ICI chip, a compile tarpit
-    on the XLA:CPU test tier, and latency-bound over a tunneled link
-    (~100 sequential dispatches × ~100 ms queue-drain round trips
-    collapsed the r4 mixed bench to 0.04× host) — the same link-latency
-    routing as the Merkle-id sweep (ops.txid.ids_tier). Only consulted
+    device too (pure hashing, ops/sphincs_batch.py) on accelerator
+    backends: since r5 the whole FORS+hypertree walk is ONE fused jit —
+    one dispatch, one link round trip — so it survives a tunneled link
+    (the r4 eager chain was ~100 sequential queue-drain round trips and
+    collapsed the mixed bench to 0.04× host, which is why it used to be
+    host-pinned by measured RTT). The XLA:CPU test tier still runs the
+    host loop (the fused graph is a CPU compile tarpit). Only consulted
     when ``use_device`` — host-only callers never touch (or initialize)
     jax."""
     if not use_device:
@@ -68,19 +68,16 @@ def _effective_device_schemes(use_device: bool) -> set:
 
 
 def _sphincs_on_device() -> bool:
-    """Link-locality gate with its own override (CORDA_TPU_SPHINCS=
-    device|host) — deliberately NOT keyed off the id-sweep tier, whose
-    CORDA_TPU_IDS override must not silently drag SPHINCS with it."""
+    """Override hook (CORDA_TPU_SPHINCS=device|host); defaults to device
+    on accelerator backends now the pipeline is a single fused dispatch —
+    its one round trip overlaps the other schemes' buckets in a mixed
+    dispatch, so link locality no longer gates it."""
     import os
 
     forced = os.environ.get("CORDA_TPU_SPHINCS", "").strip().lower()
-    if forced == "device":
-        return True
     if forced == "host":
         return False
-    from corda_tpu.ops.txid import _measured_link_rtt_s
-
-    return _measured_link_rtt_s() < 0.005
+    return True
 
 
 class PendingRows:

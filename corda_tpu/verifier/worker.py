@@ -160,6 +160,9 @@ class VerifierWorker:
 
     def _verify(self, req: VerificationRequest) -> str:
         try:
+            # contract-only requests carry stx=None (CBE encodes None
+            # natively); `0` is accepted for wire skew with pre-r5 writers
+            # that punned the absent field as an int
             if req.stx is not None and req.stx != 0:
                 from corda_tpu.verifier.batch import check_transactions
 
@@ -261,7 +264,7 @@ class OutOfProcessVerifierService:
             self._nonce += 1
             nonce = self._nonce
         payload = serialize(VerificationRequest(
-            nonce, stx if stx is not None else 0, ltx, self.reply_queue
+            nonce, stx, ltx, self.reply_queue
         ))
         with self._lock:
             self._pending[nonce] = _PendingRequest(

@@ -184,6 +184,33 @@ class TestWireSkewAcrossTiers:
             service.shutdown()
             broker.close()
 
+    def test_contract_only_request_none_vs_legacy_zero_sentinel(self):
+        """node ↔ verifier: contract-only requests carry ``stx=None``
+        (CBE's native null form) since r5; pre-r5 writers punned the
+        absent field as the int ``0``. A current worker must treat BOTH
+        wire shapes as "no signed form — skip signature checking" (the
+        skew test the r4 review asked for when retiring the pun)."""
+        from corda_tpu.verifier.worker import VerificationRequest
+
+        class _LtxStub:
+            notary = None
+
+            def verify(self):
+                self.verified = True
+
+        from corda_tpu.verifier.worker import VerifierWorker
+
+        worker = VerifierWorker.__new__(VerifierWorker)
+        worker._use_device = False
+        for legacy_stx in (None, 0):
+            raw = serialize(VerificationRequest(9, legacy_stx, None, "q"))
+            req = deserialize(raw)
+            assert req.stx == legacy_stx
+            ltx = _LtxStub()
+            req = VerificationRequest(req.nonce, req.stx, ltx, req.reply_to)
+            assert worker._verify(req) == ""
+            assert ltx.verified
+
     def test_newer_rpc_client_against_old_server(self):
         """RPC client ↔ node: a client one version ahead sends a request
         carrying a field this server's RpcRequest doesn't know; the server
