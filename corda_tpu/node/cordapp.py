@@ -52,7 +52,23 @@ def CordaService(attr_name: str):
     oracle-in-a-node pattern, NodeInterestRates.kt:79)."""
 
     def deco(cls):
-        _CORDA_SERVICES.append((attr_name, cls))
+        # idempotent AND current: the same class re-registered (module
+        # imported under two package paths, importlib.reload in a
+        # long-lived multi-node process) must not duplicate the entry —
+        # the second install would otherwise hit the ServiceHub-attribute
+        # guard and log a misleading "collides with core hub attribute"
+        # on every boot. A reload REPLACES the entry so nodes booted
+        # after it instantiate the reloaded class, not the stale one.
+        for i, (a, c) in enumerate(_CORDA_SERVICES):
+            if (
+                a == attr_name
+                and c.__qualname__ == cls.__qualname__
+                and c.__module__ == cls.__module__
+            ):
+                _CORDA_SERVICES[i] = (attr_name, cls)
+                break
+        else:
+            _CORDA_SERVICES.append((attr_name, cls))
         cls._corda_service_attr = attr_name
         return cls
 

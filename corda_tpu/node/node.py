@@ -219,6 +219,17 @@ class Node:
             from corda_tpu.notary import RaftUniquenessProvider
 
             me = str(self.party.name)
+            # the replica name IS the fabric endpoint name (this node's
+            # X.500 name); a nodeAddress that differs would yield
+            # divergent membership sets across replicas — peers named in
+            # clusterAddresses would never resolve on the fabric and the
+            # cluster would hang without quorum. Fail fast instead.
+            if cfg.raft.node_address and cfg.raft.node_address != me:
+                raise ValueError(
+                    f"raft nodeAddress {cfg.raft.node_address!r} must equal "
+                    f"this node's name {me!r} (replicas are addressed by "
+                    "node name on the messaging fabric)"
+                )
             names = sorted({me, *cfg.raft.cluster_addresses})
             storage_path = db("raft.db")
             uniqueness = RaftUniquenessProvider.make_node_on_endpoint(

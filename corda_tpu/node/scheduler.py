@@ -142,18 +142,21 @@ class NodeSchedulerService:
                     continue
             try:
                 self._start_flow(activity.flow_class_path, activity.flow_args)
+                fired += 1
             except Exception:
                 # a bad flow path / mismatched args (cordapp bug, version
                 # skew) must cost ONE activity, not the scheduler thread —
                 # an escaped exception here would kill the loop and
-                # silently stop every future activity on the node
+                # silently stop every future activity on the node. Failed
+                # starts do NOT count toward `fired` (callers pump until
+                # n activities fire — overcounting would end them early
+                # while the activity was actually lost).
                 import logging
 
                 logging.getLogger(__name__).exception(
                     "failed to start scheduled flow %s%r",
                     activity.flow_class_path, tuple(activity.flow_args),
                 )
-            fired += 1
 
     def start(self, poll_s: float = 0.05) -> None:
         def loop():
