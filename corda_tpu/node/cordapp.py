@@ -53,18 +53,30 @@ def CordaService(attr_name: str):
 
     def deco(cls):
         # idempotent AND current: the same class re-registered (module
-        # imported under two package paths, importlib.reload in a
-        # long-lived multi-node process) must not duplicate the entry —
-        # the second install would otherwise hit the ServiceHub-attribute
-        # guard and log a misleading "collides with core hub attribute"
-        # on every boot. A reload REPLACES the entry so nodes booted
-        # after it instantiate the reloaded class, not the stale one.
+        # imported under two package paths — matched by defining source
+        # file — or importlib.reload in a long-lived multi-node process)
+        # must not duplicate the entry — the second install would
+        # otherwise hit the ServiceHub-attribute guard and log a
+        # misleading "collides with core hub attribute" on every boot.
+        # A re-registration REPLACES the entry so nodes booted after it
+        # instantiate the newest class, not the stale one. Distinct
+        # classes that merely share a name keep both entries: claiming
+        # the same attr IS a genuine collision install_corda_services
+        # must surface.
+        def same_class(existing):
+            if existing.__qualname__ != cls.__qualname__:
+                return False
+            if existing.__module__ == cls.__module__:
+                return True
+            import inspect
+
+            try:
+                return inspect.getfile(existing) == inspect.getfile(cls)
+            except Exception:
+                return False
+
         for i, (a, c) in enumerate(_CORDA_SERVICES):
-            if (
-                a == attr_name
-                and c.__qualname__ == cls.__qualname__
-                and c.__module__ == cls.__module__
-            ):
+            if a == attr_name and same_class(c):
                 _CORDA_SERVICES[i] = (attr_name, cls)
                 break
         else:

@@ -219,17 +219,32 @@ class Node:
             from corda_tpu.notary import RaftUniquenessProvider
 
             me = str(self.party.name)
-            # the replica name IS the fabric endpoint name (this node's
-            # X.500 name); a nodeAddress that differs would yield
-            # divergent membership sets across replicas — peers named in
-            # clusterAddresses would never resolve on the fabric and the
-            # cluster would hang without quorum. Fail fast instead.
+            # replica names ARE fabric endpoint names (canonical X.500
+            # node names — the shape the process driver generates); a
+            # nodeAddress differing from this node's name, or a peer
+            # entry that isn't an X.500 name (e.g. a reference-style
+            # host:port), would yield divergent/unresolvable membership
+            # and the cluster would hang without quorum. Fail fast.
             if cfg.raft.node_address and cfg.raft.node_address != me:
                 raise ValueError(
                     f"raft nodeAddress {cfg.raft.node_address!r} must equal "
                     f"this node's name {me!r} (replicas are addressed by "
                     "node name on the messaging fabric)"
                 )
+            from corda_tpu.ledger import CordaX500Name
+
+            for peer in cfg.raft.cluster_addresses:
+                try:
+                    canonical = str(CordaX500Name.parse(peer))
+                except Exception:
+                    canonical = None
+                if canonical != peer:
+                    raise ValueError(
+                        f"raft clusterAddresses entry {peer!r} is not a "
+                        "canonical X.500 node name — replicas are "
+                        "addressed by node name on the messaging fabric, "
+                        "not host:port"
+                    )
             names = sorted({me, *cfg.raft.cluster_addresses})
             storage_path = db("raft.db")
             uniqueness = RaftUniquenessProvider.make_node_on_endpoint(
