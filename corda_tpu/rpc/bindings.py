@@ -1,0 +1,560 @@
+"""Reactive data-binding over RPC feeds — the client/jfx re-target.
+
+The reference ships a JavaFX data-binding library
+(client/jfx/src/main/kotlin/net/corda/client/jfx/): observable-list
+combinators (``MappedList.kt``, ``ConcatenatedList.kt``,
+``AggregatedList.kt``, ``AssociatedList.kt``, ``FlattenedList.kt``,
+``ChosenList.kt``, ``MapValuesList.kt``, ``LeftOuterJoinedMap.kt``,
+``ReplayedList.kt``), rx→FX bridges (``ObservableFold.kt``), amount
+aggregation (``AmountBindings.kt``), and the model tier that wires a
+node's RPC feeds into those collections (``model/NodeMonitorModel.kt``,
+``model/ContractStateModel.kt``). The CAPABILITY is composing live node
+feeds into derived, incrementally-updated UI state; the JavaFX widget
+toolkit itself is the GUI host, which this framework re-targets to the
+browser explorer / terminal shells.
+
+This module provides that capability GUI-free:
+
+- ``ObservableValue`` / ``ObservableList`` / ``ObservableMap`` — plain
+  thread-safe observables with granular change events.
+- Combinators mirroring the jfx-utils set: ``map``, ``filtered`` (with a
+  dynamic ``ObservableValue`` predicate), ``sorted``, ``concat``,
+  ``flatten_values``, ``aggregated``, ``associated_by``,
+  ``left_outer_join``, ``values_list``, ``ChosenList``, ``replayed``.
+- ``fold_feed`` / ``accumulate_feed`` — the rx→observable bridge
+  (``ObservableFold.kt``): an ``rpc.client.Observable`` feed folds into
+  an ``ObservableValue`` or accumulates into an ``ObservableList``.
+- ``sum_amounts`` — ``AmountBindings.kt``'s token-filtered quantity sum
+  as a live value.
+- ``NodeMonitorModel`` — wires one RPC proxy's vault / transaction /
+  network-map feeds into observable collections
+  (``model/NodeMonitorModel.kt:31-61``'s role).
+
+Change events are coarse-typed (add/remove/update/reset) and delivered
+synchronously on the mutating thread; derived views update their backing
+store incrementally (``sorted`` re-inserts by bisection; ``aggregated``
+rebuilds only the touched group).
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import threading
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Change:
+    """One granular collection change ('reset' carries the new snapshot)."""
+
+    kind: str              # add | remove | update | reset
+    index: int = -1
+    element: Any = None
+    old_element: Any = None
+
+
+class _Observable:
+    def __init__(self):
+        self._listeners: list[Callable] = []
+        self._lock = threading.RLock()
+
+    def on_change(self, listener: Callable) -> Callable:
+        """Register; returns the listener for unhook bookkeeping."""
+        with self._lock:
+            self._listeners.append(listener)
+        return listener
+
+    def remove_listener(self, listener: Callable) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def _emit(self, event) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            fn(event)
+
+
+class ObservableValue(_Observable):
+    """A current value + change notifications (reference:
+    ObservableValue/SimpleObjectProperty as used across client/jfx)."""
+
+    def __init__(self, value=None):
+        super().__init__()
+        self._value = value
+
+    def get(self):
+        with self._lock:
+            return self._value
+
+    def set(self, value) -> None:
+        with self._lock:
+            old = self._value
+            self._value = value
+        if old != value:
+            self._emit((old, value))
+
+    def map(self, fn: Callable) -> "ObservableValue":
+        """Derived value (reference: EasyBind.map / ObservableUtilities)."""
+        out = ObservableValue(fn(self.get()))
+        self.on_change(lambda ch: out.set(fn(ch[1])))
+        return out
+
+    @staticmethod
+    def combine(fn: Callable, *sources: "ObservableValue") -> "ObservableValue":
+        """fn over several live values, recomputed on any change."""
+        out = ObservableValue(fn(*(s.get() for s in sources)))
+
+        def recompute(_ch):
+            out.set(fn(*(s.get() for s in sources)))
+
+        for s in sources:
+            s.on_change(recompute)
+        return out
+
+
+class ObservableList(_Observable):
+    """A list with granular change events; every combinator returns a new
+    live-updating ObservableList (the jfx-utils composition style)."""
+
+    def __init__(self, initial=()):
+        super().__init__()
+        self._items: list = list(initial)
+
+    # ------------------------------------------------------------ mutation
+    def append(self, element) -> None:
+        with self._lock:
+            self._items.append(element)
+            idx = len(self._items) - 1
+        self._emit(Change("add", idx, element))
+
+    def insert(self, index: int, element) -> None:
+        with self._lock:
+            self._items.insert(index, element)
+        self._emit(Change("add", index, element))
+
+    def remove_at(self, index: int):
+        with self._lock:
+            element = self._items.pop(index)
+        self._emit(Change("remove", index, element))
+        return element
+
+    def remove(self, element) -> bool:
+        with self._lock:
+            try:
+                idx = self._items.index(element)
+            except ValueError:
+                return False
+            self._items.pop(idx)
+        self._emit(Change("remove", idx, element))
+        return True
+
+    def update_at(self, index: int, element) -> None:
+        with self._lock:
+            old = self._items[index]
+            self._items[index] = element
+        self._emit(Change("update", index, element, old))
+
+    def reset(self, items) -> None:
+        with self._lock:
+            self._items = list(items)
+            snap = list(self._items)
+        self._emit(Change("reset", element=snap))
+
+    # ------------------------------------------------------------- reading
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._items)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def __iter__(self):
+        return iter(self.snapshot())
+
+    def __getitem__(self, i):
+        with self._lock:
+            return self._items[i]
+
+    # --------------------------------------------------------- combinators
+    def map(self, fn: Callable) -> "ObservableList":
+        """reference: MappedList.kt — element-wise transform, updated
+        per-change (no full recompute)."""
+        out = ObservableList(fn(x) for x in self.snapshot())
+
+        def on_change(ch: Change):
+            if ch.kind == "add":
+                out.insert(ch.index, fn(ch.element))
+            elif ch.kind == "remove":
+                out.remove_at(ch.index)
+            elif ch.kind == "update":
+                out.update_at(ch.index, fn(ch.element))
+            else:
+                out.reset(fn(x) for x in ch.element)
+
+        self.on_change(on_change)
+        return out
+
+    def filtered(self, predicate) -> "ObservableList":
+        """reference: FilteredList as used by ChosenList consumers; the
+        predicate may be a plain callable or an ObservableValue holding
+        one (dynamic re-filter on predicate change). Granular source
+        changes update incrementally (an ``included`` mask maps source
+        indices to output indices); only a predicate change rebuilds."""
+        dynamic = isinstance(predicate, ObservableValue)
+
+        def pred():
+            return predicate.get() if dynamic else predicate
+
+        included = [pred()(x) for x in self.snapshot()]
+        out = ObservableList(
+            x for x, ok in zip(self.snapshot(), included) if ok
+        )
+
+        def out_index(src_idx: int) -> int:
+            return sum(1 for ok in included[:src_idx] if ok)
+
+        def on_change(ch: Change):
+            if ch.kind == "add":
+                ok = pred()(ch.element)
+                included.insert(ch.index, ok)
+                if ok:
+                    out.insert(out_index(ch.index), ch.element)
+            elif ch.kind == "remove":
+                was = included.pop(ch.index)
+                if was:
+                    out.remove_at(out_index(ch.index))
+            elif ch.kind == "update":
+                was = included[ch.index]
+                now = pred()(ch.element)
+                pos = out_index(ch.index)
+                included[ch.index] = now
+                if was and now:
+                    out.update_at(pos, ch.element)
+                elif was:
+                    out.remove_at(pos)
+                elif now:
+                    out.insert(pos, ch.element)
+            else:
+                included[:] = [pred()(x) for x in ch.element]
+                out.reset(
+                    x for x, ok in zip(ch.element, included) if ok
+                )
+
+        self.on_change(on_change)
+        if dynamic:
+            def re_filter(_ch):
+                included[:] = [pred()(x) for x in self.snapshot()]
+                out.reset(
+                    x for x, ok in zip(self.snapshot(), included) if ok
+                )
+
+            predicate.on_change(re_filter)
+        return out
+
+    def sorted(self, key: Callable = lambda x: x) -> "ObservableList":
+        """reference: SortedList role — bisection insert per add."""
+        out = ObservableList(sorted(self.snapshot(), key=key))
+
+        def on_change(ch: Change):
+            if ch.kind == "add":
+                keys = [key(x) for x in out.snapshot()]
+                out.insert(bisect.bisect_right(keys, key(ch.element)),
+                           ch.element)
+            elif ch.kind == "remove":
+                out.remove(ch.element)
+            elif ch.kind == "update":
+                out.remove(ch.old_element)
+                keys = [key(x) for x in out.snapshot()]
+                out.insert(bisect.bisect_right(keys, key(ch.element)),
+                           ch.element)
+            else:
+                out.reset(sorted(ch.element, key=key))
+
+        self.on_change(on_change)
+        return out
+
+    def aggregated(self, group_key: Callable,
+                   assemble: Callable) -> "ObservableList":
+        """reference: AggregatedList.kt — one assembled row per distinct
+        group key; only the touched group rebuilds on change."""
+        out = ObservableList()
+        groups: dict = {}
+
+        def rebuild_group(k):
+            members = [x for x in self.snapshot() if group_key(x) == k]
+            row = assemble(k, members) if members else None
+            if k in groups:
+                idx = list(groups).index(k)  # rows mirror key order
+                if row is None:
+                    del groups[k]
+                    out.remove_at(idx)
+                else:
+                    groups[k] = row
+                    out.update_at(idx, row)
+            elif row is not None:
+                groups[k] = row
+                out.append(row)
+
+        def on_change(ch: Change):
+            if ch.kind in ("add", "remove"):
+                rebuild_group(group_key(ch.element))
+            elif ch.kind == "update":
+                for k in {group_key(ch.old_element), group_key(ch.element)}:
+                    rebuild_group(k)
+            else:
+                groups.clear()
+                rows = []
+                for x in ch.element:
+                    k = group_key(x)
+                    if k not in groups:
+                        members = [y for y in ch.element
+                                   if group_key(y) == k]
+                        groups[k] = assemble(k, members)
+                        rows.append(groups[k])
+                out.reset(rows)
+
+        on_change(Change("reset", element=self.snapshot()))
+        self.on_change(on_change)
+        return out
+
+    def associated_by(self, key: Callable) -> "ObservableMap":
+        """reference: AssociatedList.kt — live key→element map (last
+        writer wins per key, as the reference's unique-key contract)."""
+        out = ObservableMap({key(x): x for x in self.snapshot()})
+
+        def on_change(ch: Change):
+            if ch.kind == "add" or ch.kind == "update":
+                if ch.kind == "update":
+                    old_k = key(ch.old_element)
+                    if old_k != key(ch.element):
+                        out.discard(old_k)
+                out.put(key(ch.element), ch.element)
+            elif ch.kind == "remove":
+                out.discard(key(ch.element))
+            else:
+                out.reset({key(x): x for x in ch.element})
+
+        self.on_change(on_change)
+        return out
+
+    def replayed(self) -> "ObservableList":
+        """reference: ReplayedList.kt — a decoupled copy whose listeners
+        observe a stable snapshot-consistent view (thread-hop isolation
+        without the FX thread)."""
+        out = ObservableList(self.snapshot())
+
+        def on_change(ch: Change):
+            if ch.kind == "add":
+                out.insert(ch.index, ch.element)
+            elif ch.kind == "remove":
+                out.remove_at(ch.index)
+            elif ch.kind == "update":
+                out.update_at(ch.index, ch.element)
+            else:
+                out.reset(ch.element)
+
+        self.on_change(on_change)
+        return out
+
+
+def concat(lists: list[ObservableList]) -> ObservableList:
+    """reference: ConcatenatedList.kt — a live concatenation view."""
+    out = ObservableList(x for lst in lists for x in lst.snapshot())
+
+    def rebuild(_ch=None):
+        out.reset(x for lst in lists for x in lst.snapshot())
+
+    for lst in lists:
+        lst.on_change(rebuild)
+    return out
+
+
+def flatten_values(values: list[ObservableValue]) -> ObservableList:
+    """reference: FlattenedList.kt — ObservableValues presented as a live
+    list of their current contents."""
+    out = ObservableList(v.get() for v in values)
+    for i, v in enumerate(values):
+        v.on_change(lambda ch, i=i: out.update_at(i, ch[1]))
+    return out
+
+
+class ObservableMap(_Observable):
+    """Key→value with put/discard events (reference:
+    ReadOnlyBackedObservableMapBase.kt roles)."""
+
+    def __init__(self, initial: dict | None = None):
+        super().__init__()
+        self._map: dict = dict(initial or {})
+
+    def get(self, k, default=None):
+        with self._lock:
+            return self._map.get(k, default)
+
+    def put(self, k, v) -> None:
+        with self._lock:
+            self._map[k] = v
+        self._emit(("put", k, v))
+
+    def discard(self, k) -> None:
+        with self._lock:
+            if k not in self._map:
+                return
+            v = self._map.pop(k)
+        self._emit(("discard", k, v))
+
+    def reset(self, mapping: dict) -> None:
+        with self._lock:
+            self._map = dict(mapping)
+            snap = dict(self._map)
+        self._emit(("reset", None, snap))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._map)
+
+    def values_list(self) -> ObservableList:
+        """reference: MapValuesList.kt — live list of the map's values."""
+        out = ObservableList(self.snapshot().values())
+        self.on_change(lambda _e: out.reset(self.snapshot().values()))
+        return out
+
+    def left_outer_join(self, right: "ObservableMap",
+                        join: Callable) -> "ObservableMap":
+        """reference: LeftOuterJoinedMap.kt — every left key mapped to
+        join(left_value, right_value_or_None), live on both sides."""
+        def build():
+            rs = right.snapshot()
+            return {
+                k: join(v, rs.get(k)) for k, v in self.snapshot().items()
+            }
+
+        out = ObservableMap(build())
+        self.on_change(lambda _e: out.reset(build()))
+        right.on_change(lambda _e: out.reset(build()))
+        return out
+
+
+class ChosenList(ObservableList):
+    """reference: ChosenList.kt — presents whichever ObservableList an
+    ObservableValue currently holds, re-wiring on choice change."""
+
+    def __init__(self, chosen: ObservableValue):
+        current = chosen.get()
+        super().__init__(current.snapshot() if current else ())
+        self._hook = None
+        self._wire(current)
+        chosen.on_change(lambda ch: self._rewire(ch[0], ch[1]))
+
+    def _wire(self, source: ObservableList | None):
+        if source is None:
+            return
+
+        def on_change(ch: Change):
+            if ch.kind == "add":
+                self.insert(ch.index, ch.element)
+            elif ch.kind == "remove":
+                self.remove_at(ch.index)
+            elif ch.kind == "update":
+                self.update_at(ch.index, ch.element)
+            else:
+                self.reset(ch.element)
+
+        self._hook = (source, source.on_change(on_change))
+
+    def _rewire(self, _old, new: ObservableList | None):
+        if self._hook is not None:
+            src, fn = self._hook
+            src.remove_listener(fn)
+            self._hook = None
+        self._wire(new)
+        self.reset(new.snapshot() if new else ())
+
+
+# ------------------------------------------------------- rx→binding bridge
+
+def fold_feed(feed, initial, folder: Callable) -> ObservableValue:
+    """reference: ObservableFold.kt foldToObservableValue — an
+    ``rpc.client.Observable`` (snapshot + pushed updates) folded into a
+    live value. A LIST/TUPLE snapshot seeds the fold element-wise; a
+    non-sequence snapshot (e.g. the vault's Page) is NOT update-shaped
+    and is left to the caller to seed explicitly."""
+    out = ObservableValue(initial)
+    state = {"acc": initial}
+    lock = threading.Lock()
+
+    def on_update(update):
+        with lock:
+            state["acc"] = folder(state["acc"], update)
+            out.set(state["acc"])
+
+    snap = getattr(feed, "snapshot", None)
+    if isinstance(snap, (list, tuple)):
+        for item in snap:
+            on_update(item)
+    feed.subscribe(on_update)
+    return out
+
+
+def accumulate_feed(feed, extract: Callable = lambda u: [u]) -> ObservableList:
+    """reference: ObservableFold.kt foldToObservableList — feed updates
+    appended into a live list (``extract`` maps one update to zero or
+    more elements, e.g. produced states out of a vault update). Snapshot
+    seeding follows ``fold_feed``'s rule: only sequence snapshots are
+    update-shaped."""
+    out = ObservableList()
+
+    def on_update(update):
+        for el in extract(update):
+            out.append(el)
+
+    snap = getattr(feed, "snapshot", None)
+    if isinstance(snap, (list, tuple)):
+        for item in snap:
+            on_update(item)
+    feed.subscribe(on_update)
+    return out
+
+
+def sum_amounts(states: ObservableList, token) -> ObservableValue:
+    """reference: AmountBindings.kt — live sum of Amount quantities for
+    one token over an observable list of amounts."""
+    def total():
+        return sum(
+            a.quantity for a in states.snapshot() if a.token == token
+        )
+
+    out = ObservableValue(total())
+    states.on_change(lambda _ch: out.set(total()))
+    return out
+
+
+# ------------------------------------------------------------- model tier
+
+class NodeMonitorModel:
+    """Wire one RPC proxy's feeds into observable collections
+    (reference: model/NodeMonitorModel.kt:31-61 — the model every jfx
+    screen consumes). Feeds used: ``vault_track`` (produced/consumed
+    states), ``validated_transactions_track``, ``network_map_feed``."""
+
+    def __init__(self, proxy):
+        vault_feed = proxy.vault_track()
+        # the vault feed's snapshot is a Page (not update-shaped):
+        # vault_updates carries the pushed Update stream; produced_states
+        # is the FLAT live list of states — pre-existing page states
+        # seeded explicitly, then each update's produced set appended
+        self.vault_updates = accumulate_feed(vault_feed)
+        self.produced_states = accumulate_feed(
+            vault_feed,
+            extract=lambda u: list(getattr(u, "produced", ())),
+        )
+        page = getattr(vault_feed, "snapshot", None)
+        for sar in list(getattr(page, "states", ()) or ()):
+            self.produced_states.append(sar)
+        self.transactions = accumulate_feed(
+            proxy.validated_transactions_track()
+        )
+        self.network_nodes = accumulate_feed(proxy.network_map_feed())

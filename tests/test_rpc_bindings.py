@@ -1,0 +1,192 @@
+"""The client data-binding tier (rpc/bindings.py) — the jfx-utils
+re-target: combinator chains must update incrementally and consistently
+under granular changes, and the rx→binding bridge must fold live RPC
+feeds (reference: client/jfx/src/test's MappedList/AggregatedList/
+ChosenList/AssociatedList tests)."""
+
+import dataclasses
+
+from corda_tpu.rpc.bindings import (
+    ChosenList,
+    ObservableList,
+    ObservableMap,
+    ObservableValue,
+    accumulate_feed,
+    concat,
+    flatten_values,
+    fold_feed,
+    sum_amounts,
+)
+
+
+class TestObservableValue:
+    def test_map_and_combine(self):
+        a = ObservableValue(2)
+        b = ObservableValue(3)
+        doubled = a.map(lambda x: 2 * x)
+        total = ObservableValue.combine(lambda x, y: x + y, a, b)
+        assert doubled.get() == 4 and total.get() == 5
+        a.set(10)
+        assert doubled.get() == 20 and total.get() == 13
+        b.set(-10)
+        assert total.get() == 0
+
+
+class TestListCombinators:
+    def test_map_granular(self):
+        src = ObservableList([1, 2, 3])
+        out = src.map(lambda x: x * x)
+        assert out.snapshot() == [1, 4, 9]
+        src.append(4)
+        src.insert(0, 0)
+        src.update_at(2, 20)      # replaces element '2'
+        src.remove_at(1)          # removes element '1'
+        assert out.snapshot() == [x * x for x in src.snapshot()]
+
+    def test_filtered_with_dynamic_predicate(self):
+        src = ObservableList(range(10))
+        pred = ObservableValue(lambda x: x % 2 == 0)
+        out = src.filtered(pred)
+        assert out.snapshot() == [0, 2, 4, 6, 8]
+        src.append(12)
+        assert 12 in out.snapshot()
+        pred.set(lambda x: x > 5)           # dynamic re-filter
+        assert out.snapshot() == [6, 7, 8, 9, 12]
+
+    def test_filtered_incremental_index_math(self):
+        """Granular add/remove/update must keep output order aligned with
+        the source's filtered order (the included-mask index mapping)."""
+        src = ObservableList([1, 2, 3, 4, 5, 6])
+        out = src.filtered(lambda x: x % 2 == 0)
+        assert out.snapshot() == [2, 4, 6]
+        src.insert(2, 10)                   # between 2 and 3
+        assert out.snapshot() == [2, 10, 4, 6]
+        src.update_at(0, 8)                 # 1 -> 8: enters the view
+        assert out.snapshot() == [8, 2, 10, 4, 6]
+        src.update_at(3, 9)                 # 3 -> 9: stays excluded
+        assert out.snapshot() == [8, 2, 10, 4, 6]
+        src.update_at(1, 7)                 # 2 -> 7: leaves the view
+        assert out.snapshot() == [8, 10, 4, 6]
+        src.remove_at(2)                    # removes 10
+        assert out.snapshot() == [8, 4, 6]
+        assert out.snapshot() == [x for x in src.snapshot() if x % 2 == 0]
+
+    def test_sorted_stays_sorted(self):
+        src = ObservableList([5, 1, 4])
+        out = src.sorted()
+        assert out.snapshot() == [1, 4, 5]
+        src.append(3)
+        src.append(0)
+        assert out.snapshot() == [0, 1, 3, 4, 5]
+        src.remove(4)
+        src.update_at(0, 9)       # 5 -> 9
+        assert out.snapshot() == [0, 1, 3, 9]
+
+    def test_concat_and_flatten(self):
+        a = ObservableList([1, 2])
+        b = ObservableList([3])
+        cat = concat([a, b])
+        assert cat.snapshot() == [1, 2, 3]
+        b.append(4)
+        a.remove_at(0)
+        assert cat.snapshot() == [2, 3, 4]
+        v1, v2 = ObservableValue("x"), ObservableValue("y")
+        flat = flatten_values([v1, v2])
+        v2.set("z")
+        assert flat.snapshot() == ["x", "z"]
+
+    def test_aggregated_by_group(self):
+        src = ObservableList(["apple", "avocado", "banana"])
+        out = src.aggregated(lambda s: s[0], lambda k, xs: (k, len(xs)))
+        assert sorted(out.snapshot()) == [("a", 2), ("b", 1)]
+        src.append("blueberry")
+        assert ("b", 2) in out.snapshot()
+        src.remove("apple")
+        src.remove("avocado")
+        assert sorted(out.snapshot()) == [("b", 2)]
+
+    def test_associated_and_joined_maps(self):
+        src = ObservableList([("alice", 1), ("bob", 2)])
+        by_name = src.associated_by(lambda kv: kv[0])
+        assert by_name.get("alice") == ("alice", 1)
+        src.append(("carol", 3))
+        assert by_name.get("carol") == ("carol", 3)
+        src.remove(("bob", 2))
+        assert by_name.get("bob") is None
+        right = ObservableMap({"alice": "L"})
+        joined = by_name.left_outer_join(right, lambda l, r: (l[1], r))
+        assert joined.get("alice") == (1, "L")
+        assert joined.get("carol") == (3, None)
+        right.put("carol", "R")
+        assert joined.get("carol") == (3, "R")
+        vals = by_name.values_list()
+        assert sorted(vals.snapshot()) == [("alice", 1), ("carol", 3)]
+
+    def test_chosen_list_rewires(self):
+        a = ObservableList([1])
+        b = ObservableList([10, 20])
+        choice = ObservableValue(a)
+        chosen = ChosenList(choice)
+        assert chosen.snapshot() == [1]
+        a.append(2)
+        assert chosen.snapshot() == [1, 2]
+        choice.set(b)
+        assert chosen.snapshot() == [10, 20]
+        b.append(30)
+        assert chosen.snapshot() == [10, 20, 30]
+        a.append(3)  # no longer chosen: must NOT leak through
+        assert chosen.snapshot() == [10, 20, 30]
+
+    def test_replayed_is_decoupled_copy(self):
+        src = ObservableList([1])
+        copy = src.replayed()
+        src.append(2)
+        assert copy.snapshot() == [1, 2]
+        copy.append(99)           # local mutation does not touch source
+        assert src.snapshot() == [1, 2]
+
+
+class _FakeFeed:
+    """Minimal stand-in for rpc.client.Observable: snapshot + push."""
+
+    def __init__(self, snapshot):
+        self.snapshot = snapshot
+        self._subs = []
+
+    def subscribe(self, cb):
+        self._subs.append(cb)
+
+    def push(self, update):
+        for cb in self._subs:
+            cb(update)
+
+
+@dataclasses.dataclass(frozen=True)
+class _Amt:
+    quantity: int
+    token: str
+
+
+class TestFeedBridge:
+    def test_fold_feed(self):
+        feed = _FakeFeed(snapshot=[1, 2])
+        total = fold_feed(feed, 0, lambda acc, u: acc + u)
+        assert total.get() == 3          # snapshot seeds the fold
+        feed.push(10)
+        assert total.get() == 13
+
+    def test_accumulate_feed_with_extract(self):
+        feed = _FakeFeed(snapshot=[{"produced": ["s1", "s2"]}])
+        out = accumulate_feed(feed, extract=lambda u: u["produced"])
+        assert out.snapshot() == ["s1", "s2"]
+        feed.push({"produced": ["s3"]})
+        assert out.snapshot() == ["s1", "s2", "s3"]
+
+    def test_sum_amounts_live(self):
+        amounts = ObservableList([_Amt(5, "GBP"), _Amt(7, "USD")])
+        gbp = sum_amounts(amounts, "GBP")
+        assert gbp.get() == 5
+        amounts.append(_Amt(10, "GBP"))
+        assert gbp.get() == 15
+        amounts.remove(_Amt(5, "GBP"))
+        assert gbp.get() == 10
