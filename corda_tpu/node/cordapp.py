@@ -52,31 +52,20 @@ def CordaService(attr_name: str):
     oracle-in-a-node pattern, NodeInterestRates.kt:79)."""
 
     def deco(cls):
-        # idempotent AND current: the same class re-registered (module
-        # imported under two package paths — matched by defining source
-        # file — or importlib.reload in a long-lived multi-node process)
-        # must not duplicate the entry — the second install would
-        # otherwise hit the ServiceHub-attribute guard and log a
-        # misleading "collides with core hub attribute" on every boot.
-        # A re-registration REPLACES the entry so nodes booted after it
-        # instantiate the newest class, not the stale one. Distinct
-        # classes that merely share a name keep both entries: claiming
-        # the same attr IS a genuine collision install_corda_services
-        # must surface.
-        def same_class(existing):
-            if existing.__qualname__ != cls.__qualname__:
-                return False
-            if existing.__module__ == cls.__module__:
-                return True
-            import inspect
-
-            try:
-                return inspect.getfile(existing) == inspect.getfile(cls)
-            except Exception:
-                return False
-
+        # idempotent AND current: importlib.reload re-runs the decorator
+        # with a new class under the SAME module path — that REPLACES
+        # the entry so nodes booted after a reload instantiate the
+        # reloaded class, not the stale one. The same source file
+        # imported under TWO package paths keeps one entry per path
+        # (each node's loaded_modules filter must match its own path);
+        # install_corda_services recognizes such same-source duplicates
+        # at install time instead of mislabelling them collisions.
         for i, (a, c) in enumerate(_CORDA_SERVICES):
-            if a == attr_name and same_class(c):
+            if (
+                a == attr_name
+                and c.__qualname__ == cls.__qualname__
+                and c.__module__ == cls.__module__
+            ):
                 _CORDA_SERVICES[i] = (attr_name, cls)
                 break
         else:
@@ -85,6 +74,19 @@ def CordaService(attr_name: str):
         return cls
 
     return deco
+
+
+def _same_service_source(a, b) -> bool:
+    """Two registry classes that are really one service: same qualname
+    and same defining source file (the two-package-path import shape)."""
+    if a.__qualname__ != b.__qualname__:
+        return False
+    import inspect
+
+    try:
+        return inspect.getfile(a) == inspect.getfile(b)
+    except Exception:
+        return False
 
 
 def install_corda_services(services, party, keypair,
@@ -107,6 +109,11 @@ def install_corda_services(services, party, keypair,
             # includes submodules: myapp/oracle.py belongs to app "myapp")
             continue
         if hasattr(services, attr):
+            existing = getattr(services, attr)
+            if _same_service_source(type(existing), cls):
+                # the same service registered under two import paths —
+                # already installed on this hub; benign, not a collision
+                continue
             # never let an app shadow a core hub service ("vault_service",
             # "metrics", …) — the node would run with a cordapp object
             # where the vault should be and fail far from the cause

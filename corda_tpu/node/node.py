@@ -233,19 +233,20 @@ class Node:
                 )
             from corda_tpu.ledger import CordaX500Name
 
+            peers = set()
             for peer in cfg.raft.cluster_addresses:
                 try:
-                    canonical = str(CordaX500Name.parse(peer))
+                    # accept any valid X.500 spelling; members resolve by
+                    # the CANONICAL form (which is what node endpoints
+                    # register as)
+                    peers.add(str(CordaX500Name.parse(peer)))
                 except Exception:
-                    canonical = None
-                if canonical != peer:
                     raise ValueError(
-                        f"raft clusterAddresses entry {peer!r} is not a "
-                        "canonical X.500 node name — replicas are "
-                        "addressed by node name on the messaging fabric, "
-                        "not host:port"
-                    )
-            names = sorted({me, *cfg.raft.cluster_addresses})
+                        f"raft clusterAddresses entry {peer!r} is not an "
+                        "X.500 node name — replicas are addressed by node "
+                        "name on the messaging fabric, not host:port"
+                    ) from None
+            names = sorted({me, *peers})
             storage_path = db("raft.db")
             uniqueness = RaftUniquenessProvider.make_node_on_endpoint(
                 me, names, self.messaging,

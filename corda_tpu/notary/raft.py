@@ -115,6 +115,17 @@ class NotLeaderError(Exception):
         super().__init__(f"not leader; known leader: {leader}")
 
 
+def _retryable_submit_error(e: Exception) -> bool:
+    """Leadership churn is retryable, in EVERY wrapping: a direct
+    NotLeaderError, a submit timeout, or a "not leader" that travelled as
+    a generic error-string reply (older peers / any wrap path). The
+    substring contract with _on_submit_reply's error wrap lives here and
+    only here."""
+    if isinstance(e, (NotLeaderError, TimeoutError)):
+        return True
+    return "not leader" in str(e)
+
+
 class RaftNode:
     """One Raft replica. ``apply_fn(command_bytes, abs_index) ->
     result_bytes`` is the deterministic state machine."""
@@ -504,13 +515,32 @@ class RaftNode:
                 serialize({"corr": req["corr"], "redirect": leader}),
             )
             return
-        fut = self.submit(req["command"])
+        try:
+            fut = self.submit(req["command"])
+        except NotLeaderError as e:
+            # lost leadership between the check above and the append (a
+            # mid-election race): answer with a REDIRECT, not a generic
+            # error — clients treat redirects as retryable, while an
+            # error string propagated as a terminal NotaryError (the
+            # r5 cluster-bench failure mode)
+            self._messaging.send(
+                msg.sender, T_SUBMIT_REPLY,
+                serialize({"corr": req["corr"], "redirect": e.leader}),
+            )
+            return
 
         def done(f, corr=req["corr"], sender=msg.sender):
             try:
                 self._messaging.send(
                     sender, T_SUBMIT_REPLY,
                     serialize({"corr": corr, "result": f.result()}),
+                )
+            except NotLeaderError as e:
+                # the entry was displaced by a leadership change while
+                # replicating — retryable: redirect, don't error
+                self._messaging.send(
+                    sender, T_SUBMIT_REPLY,
+                    serialize({"corr": corr, "redirect": e.leader}),
                 )
             except Exception as e:
                 self._messaging.send(
@@ -619,7 +649,9 @@ class RaftUniquenessProvider(UniquenessProvider):
             try:
                 fut = self.node.submit_anywhere(command)
                 return deserialize(fut.result(timeout=self._retry_s))
-            except (NotLeaderError, TimeoutError):
+            except (NotLeaderError, TimeoutError, NotaryError) as e:
+                if not _retryable_submit_error(e):
+                    raise
                 if time.monotonic() > deadline:
                     raise
                 time.sleep(0.02)
@@ -671,8 +703,9 @@ class RaftUniquenessProvider(UniquenessProvider):
                         return list(deserialize(
                             fut.result(timeout=provider._retry_s)
                         ))
-                    except (NotLeaderError, TimeoutError):
-                        pass
+                    except (NotLeaderError, TimeoutError, NotaryError) as e:
+                        if not _retryable_submit_error(e):
+                            raise
                 return list(provider._submit_retrying(command))
 
         return _PendingRaftCommit()

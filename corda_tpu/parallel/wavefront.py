@@ -147,14 +147,34 @@ def verify_transaction_dag(
     # gate must never silently drop the forged-chain-link check
     check_ids = recompute_ids and use_device
     pipelined = use_device and len(windows) > 1
-    if use_device and not pipelined:
-        # solo window: no neighbours to hide the link round trip behind —
-        # one-shot break-even gate (ops.txid)
-        from corda_tpu.ops.txid import device_verify_worthwhile
-
-        use_device = device_verify_worthwhile(
-            sum(len(s.sigs) for s in stxs.values())
+    if use_device:
+        # Routing economics differ from the notary stream: a resolve's
+        # host walk per window is tiny (contract semantics on a thin
+        # chain), so over a high-RTT link even a depth-D pipeline leaves
+        # most round trips exposed — the r5 capture measured the windowed
+        # device path at 0.76× host on the tunnel, WORSE than the r4
+        # one-shot's 0.90×. Pipelining never makes a batch CHEAPER than
+        # one-shot on rows, so the one-shot break-even on the WHOLE
+        # resolve is the honest gate here (unlike the notary, whose fat
+        # per-window host settle genuinely hides the trips); a local
+        # sub-ms link skips the gate — per-window dispatch always wins
+        # there, and the windows then also bound device memory.
+        from corda_tpu.ops.txid import (
+            _measured_link_rtt_s,
+            device_verify_worthwhile,
         )
+
+        if _measured_link_rtt_s() >= 0.005:
+            use_device = device_verify_worthwhile(
+                sum(len(s.sigs) for s in stxs.values())
+            )
+            if use_device:
+                # above break-even on a high-RTT link: collapse to ONE
+                # window — the one-shot shape the break-even formula
+                # actually models; keeping per-window dispatch here
+                # would pay a round trip per window again
+                windows = [[lvl for win in windows for lvl in win]]
+                pipelined = False
 
     outputs: dict = {}  # StateRef -> TransactionState, from verified txs
     consumed: set = set()
