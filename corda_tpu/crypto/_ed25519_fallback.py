@@ -1,0 +1,133 @@
+"""Pure-Python RFC 8032 ed25519 — the no-dependency fallback engine.
+
+``schemes.py`` signs/verifies through OpenSSL (the ``cryptography``
+package) when it is installed; environments without it (minimal
+containers, the bare jax_graft image) fall back here so the flow, notary
+and messaging tiers stay runnable — graceful degradation of the crypto
+host path itself, same posture as the verifier's device→host failover.
+ECDSA and RSA have no portable fallback and raise on use.
+
+Extended homogeneous coordinates, constant-formulae point arithmetic.
+This is the correctness path, not the fast path: ~1 ms per operation,
+fine for protocol tests and low-volume signing; bulk verification rides
+the device kernels regardless."""
+
+from __future__ import annotations
+
+import hashlib
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493
+_D = (-121665 * pow(121666, P - 2, P)) % P
+_I = pow(2, (P - 1) // 4, P)
+
+
+def _recover_x(y: int, sign: int) -> int | None:
+    if y >= P:
+        return None
+    x2 = (y * y - 1) * pow(_D * y * y + 1, P - 2, P) % P
+    if x2 == 0:
+        return None if sign else 0
+    x = pow(x2, (P + 3) // 8, P)
+    if (x * x - x2) % P != 0:
+        x = x * _I % P
+    if (x * x - x2) % P != 0:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return x
+
+
+# extended coordinates (X, Y, Z, T) with x = X/Z, y = Y/Z, T = XY/Z
+_NEUTRAL = (0, 1, 1, 0)
+
+
+def _add(p, q):
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * _D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _mul(s: int, p):
+    q = _NEUTRAL
+    while s > 0:
+        if s & 1:
+            q = _add(q, p)
+        p = _add(p, p)
+        s >>= 1
+    return q
+
+
+_BY = 4 * pow(5, P - 2, P) % P
+_BX = _recover_x(_BY, 0)
+_B = (_BX, _BY, 1, _BX * _BY % P)
+
+
+def _compress(p) -> bytes:
+    x, y, z, _t = p
+    zi = pow(z, P - 2, P)
+    x, y = x * zi % P, y * zi % P
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _decompress(b: bytes):
+    if len(b) != 32:
+        return None
+    enc = int.from_bytes(b, "little")
+    y = enc & ((1 << 255) - 1)
+    x = _recover_x(y, enc >> 255)
+    if x is None:
+        return None
+    return (x, y, 1, x * y % P)
+
+
+def _clamp(h32: bytes) -> int:
+    a = int.from_bytes(h32, "little")
+    a &= (1 << 254) - 8
+    a |= 1 << 254
+    return a
+
+
+def public_from_seed(seed: bytes) -> bytes:
+    a = _clamp(hashlib.sha512(seed).digest()[:32])
+    return _compress(_mul(a, _B))
+
+
+def sign(seed: bytes, msg: bytes) -> bytes:
+    h = hashlib.sha512(seed).digest()
+    a = _clamp(h[:32])
+    pub = _compress(_mul(a, _B))
+    r = int.from_bytes(hashlib.sha512(h[32:] + msg).digest(), "little") % L
+    rb = _compress(_mul(r, _B))
+    k = int.from_bytes(hashlib.sha512(rb + pub + msg).digest(), "little") % L
+    s = (r + k * a) % L
+    return rb + s.to_bytes(32, "little")
+
+
+def verify(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    if len(sig) != 64:
+        return False
+    a = _decompress(pub)
+    rp = _decompress(sig[:32])
+    if a is None or rp is None:
+        return False
+    s = int.from_bytes(sig[32:], "little")
+    if s >= L:
+        return False
+    k = int.from_bytes(hashlib.sha512(sig[:32] + pub + msg).digest(), "little") % L
+    lhs = _mul(s, _B)
+    rhs = _add(rp, _mul(k, a))
+    # compare projectively: X1 Z2 == X2 Z1 and Y1 Z2 == Y2 Z1
+    return (
+        (lhs[0] * rhs[2] - rhs[0] * lhs[2]) % P == 0
+        and (lhs[1] * rhs[2] - rhs[1] * lhs[2]) % P == 0
+    )
+
+
+def point_decodable(pub: bytes) -> bool:
+    return len(pub) == 32 and _decompress(pub) is not None

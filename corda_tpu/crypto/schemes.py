@@ -21,13 +21,24 @@ import functools
 import hashlib
 import secrets
 
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519, padding, rsa
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import (
+        ec,
+        ed25519,
+        padding,
+        rsa,
+    )
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
 
+    _HAVE_OPENSSL = True
+except ModuleNotFoundError:  # minimal container: pure-Python ed25519 only
+    _HAVE_OPENSSL = False
+
+from . import _ed25519_fallback as _ed_fb
 from . import sphincs
 from .keys import KeyPair, PrivateKey, PublicKey
 
@@ -73,6 +84,17 @@ DEFAULT_SIGNATURE_SCHEME = EDDSA_ED25519_SHA512
 
 class CryptoError(Exception):
     pass
+
+
+def _require_openssl(what: str) -> None:
+    """Schemes without a portable fallback fail loudly (not silently
+    invalid) when the ``cryptography`` package is absent; ed25519 and
+    SPHINCS degrade to the pure-Python engines instead."""
+    if not _HAVE_OPENSSL:
+        raise CryptoError(
+            f"{what} requires the 'cryptography' package, which is not "
+            "installed in this environment"
+        )
 
 
 def find_scheme(scheme_id: int) -> SignatureScheme:
@@ -136,6 +158,7 @@ def generate_keypair(scheme_id: int = DEFAULT_SIGNATURE_SCHEME) -> KeyPair:
     if scheme_id == SPHINCS256_SHA256:
         return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
     if scheme_id == RSA_SHA256:
+        _require_openssl("RSA key generation")
         priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
         pub_der = priv.public_key().public_bytes(
             serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
@@ -156,10 +179,14 @@ def derive_keypair_from_entropy(scheme_id: int, entropy: bytes) -> KeyPair:
     reference)."""
     if scheme_id == EDDSA_ED25519_SHA512:
         seed = hashlib.sha512(b"ctpu.ed25519" + entropy).digest()[:32]
-        priv = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
-        pub = priv.public_key().public_bytes_raw()
+        if _HAVE_OPENSSL:
+            priv = ed25519.Ed25519PrivateKey.from_private_bytes(seed)
+            pub = priv.public_key().public_bytes_raw()
+        else:
+            pub = _ed_fb.public_from_seed(seed)
         return KeyPair(PublicKey(scheme_id, pub), PrivateKey(scheme_id, seed))
     if scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+        _require_openssl("ECDSA key derivation")
         n = _order(scheme_id)
         d = (int.from_bytes(hashlib.sha512(b"ctpu.ecdsa" + entropy).digest(), "big") % (n - 1)) + 1
         priv = ec.derive_private_key(d, _curve(scheme_id))
@@ -192,8 +219,11 @@ def sign(private: PrivateKey, data: bytes) -> bytes:
     RSA = PKCS#1 v1.5 over SHA-256; SPHINCS = packed WOTS/Merkle opening."""
     sid = private.scheme_id
     if sid == EDDSA_ED25519_SHA512:
+        if not _HAVE_OPENSSL:
+            return _ed_fb.sign(private.encoded, data)
         return _ed_priv_from_encoded(private.encoded).sign(data)
     if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+        _require_openssl("ECDSA signing")
         der = _ec_priv_from_encoded(sid, private.encoded).sign(
             data, ec.ECDSA(hashes.SHA256())
         )
@@ -203,6 +233,7 @@ def sign(private: PrivateKey, data: bytes) -> bytes:
             s = n - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
     if sid == RSA_SHA256:
+        _require_openssl("RSA signing")
         priv = _rsa_priv_from_der(private.encoded)
         return priv.sign(data, padding.PKCS1v15(), hashes.SHA256())
     if sid == SPHINCS256_SHA256:
@@ -227,9 +258,12 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
     sid = public.scheme_id
     try:
         if sid == EDDSA_ED25519_SHA512:
+            if not _HAVE_OPENSSL:
+                return _ed_fb.verify(public.encoded, signature, data)
             _ed_pub_from_encoded(public.encoded).verify(signature, data)
             return True
         if sid in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):
+            _require_openssl("ECDSA verification")
             if len(signature) != 64:
                 return False
             r = int.from_bytes(signature[:32], "big")
@@ -246,6 +280,7 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
             )
             return True
         if sid == RSA_SHA256:
+            _require_openssl("RSA verification")
             pub = _rsa_pub_from_der(public.encoded)
             pub.verify(signature, data, padding.PKCS1v15(), hashes.SHA256())
             return True
@@ -265,8 +300,15 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
 
 def public_key_on_curve(public: PublicKey) -> bool:
     """Point/key validation (reference: Crypto.publicKeyOnCurve, Crypto.kt:875)."""
+    if public.scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256,
+                            RSA_SHA256):
+        # raise OUTSIDE the broad except below: a missing dependency must
+        # not masquerade as "key not on curve"
+        _require_openssl("ECDSA/RSA key validation")
     try:
         if public.scheme_id == EDDSA_ED25519_SHA512:
+            if not _HAVE_OPENSSL:
+                return _ed_fb.point_decodable(public.encoded)
             ed25519.Ed25519PublicKey.from_public_bytes(public.encoded)
             return len(public.encoded) == 32
         if public.scheme_id in (ECDSA_SECP256K1_SHA256, ECDSA_SECP256R1_SHA256):

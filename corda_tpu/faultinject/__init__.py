@@ -1,0 +1,31 @@
+"""Deterministic fault injection (see plan.py for the design contract)."""
+
+from .chaos import ChaosOrchestrator
+from .plan import (
+    CrashEvent,
+    DeliveryVerdict,
+    FaultInjector,
+    FaultPlan,
+    InjectedEvent,
+    InjectedFault,
+    Partition,
+    active,
+    check_site,
+    clear,
+    install,
+)
+
+__all__ = [
+    "ChaosOrchestrator",
+    "CrashEvent",
+    "DeliveryVerdict",
+    "FaultInjector",
+    "FaultPlan",
+    "InjectedEvent",
+    "InjectedFault",
+    "Partition",
+    "active",
+    "check_site",
+    "clear",
+    "install",
+]

@@ -1,0 +1,259 @@
+"""Seeded, deterministic fault plans + the injector that executes them.
+
+The chaos engine of the robustness tier (reference inspiration:
+InternalMockNetwork's message-altering hooks and Disruption.kt's
+kill-the-node loadtest disruptions — here unified behind ONE seeded plan):
+a ``FaultPlan`` declares *what* may go wrong (message drop / delay /
+duplicate / reorder probabilities, link partitions, replica crash
+schedules, broker-level loss and redelivery, injected device-op
+failures); a ``FaultInjector`` executes it and records every injected
+event in a trace.
+
+Determinism contract: every decision is a pure function of
+``(seed, decision kind, site key, attempt count)`` — derived by hashing,
+NOT by consuming a shared RNG stream — so the same logical message
+stream receives the same faults regardless of thread interleaving, and a
+replay of an identical driven scenario produces a bit-identical trace
+(``trace_digest``). Probabilities only shape *which* keys fail; the
+mapping from key to outcome is fixed by the seed.
+
+Hook points live in ``messaging/network.py`` (delivery faults),
+``messaging/queue.py`` (broker publish loss + forced redelivery),
+``messaging/fabric.py`` (connection-drop injection on control ops), and
+``verifier/batch.py`` (device-op failures via the module-level
+``check_site``). Crash schedules are driven by
+``faultinject.chaos.ChaosOrchestrator``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from collections import defaultdict
+
+
+class InjectedFault(Exception):
+    """Raised by ``check_site`` when the active plan injects a failure at
+    that site. Hardened code paths treat it like any other backend/device
+    error — the injection proves the degradation path, it does not get a
+    special-cased rescue."""
+
+
+@dataclasses.dataclass(frozen=True)
+class CrashEvent:
+    """Scheduled crash of one named node at a pump round; the chaos
+    orchestrator restarts it ``down_rounds`` later (0 = never)."""
+
+    at_round: int
+    node: str
+    down_rounds: int = 50
+
+
+@dataclasses.dataclass(frozen=True)
+class Partition:
+    """A link partition active for rounds [from_round, until_round):
+    messages between ``side_a`` and ``side_b`` drop both ways. An empty
+    ``side_b`` means "everyone not in side_a"."""
+
+    from_round: int
+    until_round: int
+    side_a: frozenset
+    side_b: frozenset = frozenset()
+
+    def severs(self, a: str, b: str, rnd: int) -> bool:
+        if not (self.from_round <= rnd < self.until_round):
+            return False
+        in_a, in_b = a in self.side_a, b in self.side_a
+        if in_a == in_b:
+            return False  # same side
+        other = b if in_a else a
+        return not self.side_b or other in self.side_b
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Everything the injector may do, declared up front. Immutable so a
+    plan can be shared, logged, and re-run verbatim."""
+
+    seed: int
+    # ---- transport-level message faults (in-memory network)
+    drop_p: float = 0.0
+    delay_p: float = 0.0
+    delay_rounds: tuple = (1, 4)       # inclusive range of pump rounds
+    duplicate_p: float = 0.0
+    reorder_p: float = 0.0
+    # ---- broker-level faults (durable queue)
+    broker_publish_drop_p: float = 0.0
+    broker_redeliver_p: float = 0.0
+    # ---- named-site op failures (device dispatch, fabric control ops)
+    op_fail_p: float = 0.0
+    fail_sites: tuple = ()             # ((site, nth_call), ...) — explicit
+    # ---- topology faults
+    partitions: tuple = ()             # Partition entries
+    crashes: tuple = ()                # CrashEvent entries
+
+
+@dataclasses.dataclass(frozen=True)
+class InjectedEvent:
+    kind: str      # drop|delay|duplicate|reorder|partition|publish-drop|...
+    site: str      # "sender->recipient" edge, queue name, or op site
+    key: str       # msg id / call ordinal the decision was keyed on
+    round: int     # pump round (or -1 where rounds don't apply)
+
+
+@dataclasses.dataclass
+class DeliveryVerdict:
+    drop: bool = False
+    reason: str = ""
+    delay_rounds: int = 0
+    duplicate: bool = False
+    reorder: bool = False
+
+
+class FaultInjector:
+    """Executes one FaultPlan; thread-safe; owns the event trace."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self._lock = threading.Lock()
+        self._delivery_counts: dict = defaultdict(int)
+        self._site_counts: dict = defaultdict(int)
+        self.trace: list[InjectedEvent] = []
+
+    # ------------------------------------------------------------ decisions
+    def _u(self, *parts) -> float:
+        """Uniform [0,1) derived by hashing — stable across interleavings."""
+        h = hashlib.sha256(
+            ("%d|" % self.plan.seed + "|".join(str(p) for p in parts)).encode()
+        ).digest()
+        return int.from_bytes(h[:8], "big") / 2.0**64
+
+    def _record(self, kind: str, site: str, key: str, rnd: int = -1) -> None:
+        with self._lock:
+            self.trace.append(InjectedEvent(kind, site, key, rnd))
+
+    def trace_digest(self) -> str:
+        """One hash over the whole trace — the bit-for-bit reproducibility
+        check (same seed + same driven scenario → same digest)."""
+        with self._lock:
+            body = "\n".join(
+                f"{e.kind}|{e.site}|{e.key}|{e.round}" for e in self.trace
+            )
+        return hashlib.sha256(body.encode()).hexdigest()
+
+    # ------------------------------------------------- transport delivery
+    def on_deliver(
+        self, sender: str, recipient: str, msg_id: str, rnd: int
+    ) -> DeliveryVerdict:
+        p = self.plan
+        edge = f"{sender}->{recipient}"
+        with self._lock:
+            nth = self._delivery_counts[(edge, msg_id)]
+            self._delivery_counts[(edge, msg_id)] += 1
+        key = f"{msg_id}#{nth}"
+        for part in p.partitions:
+            if part.severs(sender, recipient, rnd):
+                self._record("partition", edge, key, rnd)
+                return DeliveryVerdict(drop=True, reason="partition")
+        if p.drop_p and self._u("drop", edge, key) < p.drop_p:
+            self._record("drop", edge, key, rnd)
+            return DeliveryVerdict(drop=True, reason="drop")
+        v = DeliveryVerdict()
+        if p.delay_p and self._u("delay", edge, key) < p.delay_p:
+            lo, hi = p.delay_rounds
+            v.delay_rounds = lo + int(
+                self._u("delay-n", edge, key) * (hi - lo + 1)
+            )
+            self._record("delay", edge, key, rnd)
+            return v
+        if p.duplicate_p and self._u("dup", edge, key) < p.duplicate_p:
+            v.duplicate = True
+            self._record("duplicate", edge, key, rnd)
+        if p.reorder_p and self._u("reorder", edge, key) < p.reorder_p:
+            v.reorder = True
+            self._record("reorder", edge, key, rnd)
+        return v
+
+    # ------------------------------------------------------------- broker
+    def on_broker_publish(self, queue: str, msg_id: str) -> bool:
+        """True → the publish is silently lost (wire loss before the
+        journal; exercises client retry / at-least-once recovery)."""
+        p = self.plan
+        if p.broker_publish_drop_p and self._u(
+            "pub-drop", queue, msg_id
+        ) < p.broker_publish_drop_p:
+            self._record("publish-drop", queue, msg_id)
+            return True
+        return False
+
+    def on_broker_deliver(self, queue: str, msg_id: str) -> bool:
+        """True → leave the message leasable so it redelivers immediately
+        (a forced visibility-timeout duplicate; exercises consumer-side
+        idempotency)."""
+        p = self.plan
+        if not p.broker_redeliver_p:
+            return False
+        with self._lock:
+            nth = self._site_counts[("redeliver", queue, msg_id)]
+            self._site_counts[("redeliver", queue, msg_id)] += 1
+        if nth == 0 and self._u("redeliver", queue, msg_id) < p.broker_redeliver_p:
+            self._record("redeliver", queue, msg_id)
+            return True
+        return False
+
+    # ---------------------------------------------------------- op sites
+    def fail_op(self, site: str) -> bool:
+        """Probabilistic / scheduled failure for a named op site; the
+        caller turns True into its own error type (the fabric raises
+        ConnectionError to drive its reconnect path)."""
+        with self._lock:
+            nth = self._site_counts[site] = self._site_counts[site] + 1
+        for want_site, want_nth in self.plan.fail_sites:
+            if want_site == site and want_nth == nth:
+                self._record("op-fail", site, str(nth))
+                return True
+        if self.plan.op_fail_p and self._u("op", site, nth) < self.plan.op_fail_p:
+            self._record("op-fail", site, str(nth))
+            return True
+        return False
+
+    def check_site(self, site: str) -> None:
+        """Raise InjectedFault when the plan fails this site's nth call."""
+        if self.fail_op(site):
+            raise InjectedFault(f"injected fault at {site}")
+
+
+# -------------------------------------------------- module-level install
+# The device-op hook point (verifier/batch.py) sits below every call
+# signature that could thread an injector through, so the active injector
+# installs process-globally — exactly one at a time, tests install/clear
+# around each scenario.
+
+_active: FaultInjector | None = None
+_install_lock = threading.Lock()
+
+
+def install(injector: FaultInjector) -> FaultInjector:
+    global _active
+    with _install_lock:
+        _active = injector
+    return injector
+
+
+def clear() -> None:
+    global _active
+    with _install_lock:
+        _active = None
+
+
+def active() -> FaultInjector | None:
+    return _active
+
+
+def check_site(site: str) -> None:
+    """No-op unless a plan is installed — the production-path cost of the
+    hook is one global read."""
+    inj = _active
+    if inj is not None:
+        inj.check_site(site)

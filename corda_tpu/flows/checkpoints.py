@@ -40,14 +40,22 @@ class CheckpointStorage:
         # the persisted processed-message table (reference:
         # NodeMessagingClient.kt:187 — dedupe must survive restarts, or a
         # redelivered SessionInit after the responder completed would spawn
-        # a second responder)
+        # a second responder). ``rid`` orders entries so the table trims
+        # FIFO like the broker's duplicate-ID cache instead of growing for
+        # the node's lifetime; pre-existing databases with the older
+        # two-column schema keep working (inserts name their columns, the
+        # trim no-ops without ``rid``).
         self._db.execute(
             """CREATE TABLE IF NOT EXISTS processed_inits (
-                 msg_id TEXT PRIMARY KEY,
+                 rid INTEGER PRIMARY KEY AUTOINCREMENT,
+                 msg_id TEXT UNIQUE,
                  flow_id TEXT NOT NULL
                )"""
         )
         self._db.commit()
+        self._inits_since_trim = 0
+
+    INITS_CACHE_MAX = 100_000
 
     # ------------------------------------------------------------- flows
     def add_flow(self, flow_id: str, flow_blob: bytes, our_name: str,
@@ -67,10 +75,14 @@ class CheckpointStorage:
             self._db.commit()
 
     def all_flows(self) -> list[tuple[str, bytes, str, float]]:
+        """Checkpointed flows in deterministic (started_at, flow_id) order
+        — restore after a crash replays flows in a stable sequence, so a
+        restart under chaos reproduces rather than reshuffles."""
         with self._lock:
             return list(
                 self._db.execute(
-                    "SELECT flow_id, flow_blob, our_name, started_at FROM flows"
+                    "SELECT flow_id, flow_blob, our_name, started_at "
+                    "FROM flows ORDER BY started_at, flow_id"
                 )
             )
 
@@ -112,11 +124,34 @@ class CheckpointStorage:
         """True if this call claimed the init; False if already processed."""
         with self._lock:
             cur = self._db.execute(
-                "INSERT OR IGNORE INTO processed_inits VALUES (?,?)",
+                "INSERT OR IGNORE INTO processed_inits (msg_id, flow_id) "
+                "VALUES (?,?)",
                 (msg_id, flow_id),
             )
+            self._inits_since_trim += 1
+            if self._inits_since_trim >= 4096:
+                self._inits_since_trim = 0
+                try:
+                    self._db.execute(
+                        """DELETE FROM processed_inits WHERE rid <=
+                             (SELECT MAX(rid) FROM processed_inits) - ?""",
+                        (self.INITS_CACHE_MAX,),
+                    )
+                except sqlite3.OperationalError:
+                    pass  # legacy schema without rid: unbounded as before
             self._db.commit()
             return cur.rowcount == 1
+
+    def mark_init_rejected(self, msg_id: str, reason: str) -> None:
+        """Re-mark a claimed init as rejected (``rejected:<reason>``), so a
+        retransmitted init of a rejected open repeats the rejection rather
+        than being mistaken for a completed responder."""
+        with self._lock:
+            self._db.execute(
+                "UPDATE processed_inits SET flow_id=? WHERE msg_id=?",
+                (f"rejected:{reason}", msg_id),
+            )
+            self._db.commit()
 
     def init_flow_id(self, msg_id: str) -> str | None:
         with self._lock:

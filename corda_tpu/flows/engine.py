@@ -36,6 +36,7 @@ open reuses the same id.
 from __future__ import annotations
 
 import hashlib
+import random
 import secrets
 import threading
 import time
@@ -43,6 +44,8 @@ from collections import deque
 from concurrent.futures import Future
 
 from corda_tpu.ledger import Party
+from corda_tpu.messaging.queue import Message
+from corda_tpu.messaging.retry import RetryPolicy
 from corda_tpu.serialization import deserialize, serialize
 
 from .api import (
@@ -57,12 +60,21 @@ from .api import (
 from .checkpoints import CheckpointStorage
 from .sessions import (
     SESSION_TOPIC,
+    SessionAck,
     SessionConfirm,
     SessionData,
     SessionEnd,
     SessionInit,
     SessionReject,
 )
+
+
+def _logical_id(msg_id: str) -> str:
+    """Strip a retransmission suffix: retransmits travel under
+    ``<base>~<attempt>`` so transport-level dedupe (which is per wire id)
+    lets them through, while ALL protocol-level dedupe — the consumed set,
+    responder-init claims, session acks — keys on the stable base id."""
+    return msg_id.split("~", 1)[0]
 
 
 class FlowKilledException(Exception):
@@ -79,6 +91,12 @@ class _FlowParked(BaseException):
     on replay — "crash at the suspension point" semantics. Cleanup that
     must span a suspension (e.g. vault soft locks) therefore needs a
     replay hook re-establishing it (``FlowLogic.record(fn, replay=...)``)."""
+
+
+_DEFAULT_RETRY_POLICY = RetryPolicy(
+    base_s=0.25, multiplier=2.0, max_backoff_s=2.0, jitter=0.25,
+    deadline_s=60.0,
+)
 
 
 class FlowHandle:
@@ -106,6 +124,29 @@ class _SessionState:
         self.inbound: deque = deque()  # ("data"|"end", payload/error, msg_id, ack)
         self.executor = executor
         self.rejected: str | None = None
+
+
+class _Retrans:
+    """One unacknowledged outbound session message: retransmitted with
+    exponential backoff + jitter until its SessionAck (or, for Init, the
+    Confirm/Reject) arrives or the deadline expires — the flow-session
+    half of at-least-once delivery over a transport that may drop."""
+
+    __slots__ = ("base_id", "party_name", "payload", "kind", "sid",
+                 "attempt", "backoff_s", "next_at", "deadline")
+
+    def __init__(self, base_id: str, party_name: str, payload: bytes,
+                 kind: str, sid: int, policy: RetryPolicy, rng,
+                 deadline_s: float):
+        self.base_id = base_id
+        self.party_name = party_name
+        self.payload = payload
+        self.kind = kind            # "init" | "data"
+        self.sid = sid              # LOCAL sid of the sending session
+        self.attempt = 0
+        self.backoff_s = policy.backoff_s(0, rng)
+        self.next_at = time.monotonic() + self.backoff_s
+        self.deadline = time.monotonic() + deadline_s
 
 
 class _FlowExecutor:
@@ -176,6 +217,15 @@ class _FlowExecutor:
 
         self._do_op(effect)
 
+    def _retry_deadline_s(self) -> float | None:
+        """Deadline propagation: a flow declaring ``retry_deadline_s``
+        bounds every retransmit window it opens (sessions inherit the
+        flow's budget); otherwise the SMM policy default applies."""
+        flow_budget = getattr(self.flow, "retry_deadline_s", None)
+        if flow_budget is None or self.smm._retry_policy is None:
+            return None
+        return min(flow_budget, self.smm._retry_policy.deadline_s)
+
     def _send_data(self, local_sid: int, payload: bytes, idx: int):
         sess = self.smm.session(local_sid)
         if sess.peer_sid is None:
@@ -183,17 +233,22 @@ class _FlowExecutor:
         self.smm.send_to(
             sess.peer, SessionData(sess.peer_sid, payload),
             msg_id=f"{self.flow_id}:op{idx}",
+            track_kind="data", track_sid=local_sid,
+            deadline_s=self._retry_deadline_s(),
         )
 
     def op_receive(self, local_sid: int):
         def effect(idx):
             sess = self.smm.session(local_sid)
-            item = self.smm.wait_or_killed(
+            self.smm.wait_or_killed(
                 lambda: sess.inbound[0] if sess.inbound else None,
                 executor=self, park_key=("sid", local_sid),
             )
-            sess.inbound.popleft()
-            kind, body, msg_id, ack = item
+            # pop + mark-consumed atomically: a retransmit landing between
+            # the two would pass both dedupe checks (not buffered, not yet
+            # consumed) and be re-buffered — a later receive would then
+            # consume the stale duplicate as its own message
+            kind, body, msg_id, ack = self.smm.consume_inbound(sess)
             if kind == "end":
                 rec = {"end": body if body else "peer ended session"}
             else:
@@ -201,7 +256,9 @@ class _FlowExecutor:
             # record BEFORE ack: consumed-and-durable, then delete from queue
             self.smm.checkpoints.record_op(self.flow_id, idx, rec)
             if msg_id:
-                self.smm.mark_consumed(msg_id)
+                # session-level ack: the peer's retransmit buffer settles;
+                # a lost ack just means one more (deduped) retransmit
+                self.smm.ack_session_msg(sess.peer, msg_id)
             if ack:
                 ack()
             return rec
@@ -225,6 +282,8 @@ class _FlowExecutor:
                 party,
                 SessionInit(sid, class_path(type(flow)), b""),
                 msg_id=f"{self.flow_id}:op{idx}",
+                track_kind="init", track_sid=sid,
+                deadline_s=self._retry_deadline_s(),
             )
             self.smm.wait_or_killed(
                 lambda: sess.peer_sid is not None or sess.rejected is not None,
@@ -277,6 +336,8 @@ class _FlowExecutor:
                 self.smm.send_to(
                     sess.peer, SessionEnd(sess.peer_sid, error),
                     msg_id=f"{self.flow_id}:op{idx}",
+                    track_kind="data", track_sid=local_sid,
+                    deadline_s=self._retry_deadline_s(),
                 )
             return {"i": idx}
 
@@ -337,6 +398,8 @@ class _FlowExecutor:
                     self.smm.send_to(
                         sess.peer, SessionEnd(sess.peer_sid, error_msg),
                         msg_id=f"{self.flow_id}:end{sid}",
+                        track_kind="data", track_sid=sid,
+                        deadline_s=self._retry_deadline_s(),
                     )
             except Exception:
                 pass
@@ -376,10 +439,26 @@ class StateMachineManager:
         services=None,
         max_workers: int = 16,
         parking_grace_s: float = 0.05,
+        retry_policy: "RetryPolicy | None" = _DEFAULT_RETRY_POLICY,
     ):
         self.messaging = messaging
         self.checkpoints = checkpoints
         self.our_identity = our_identity
+        # per-session retransmission of unacked Init/Data/End messages
+        # (exponential backoff, jitter, hard deadline — see _Retrans).
+        # The default policy keeps first retransmits past the grace of an
+        # in-order transport; chaos tests tighten it. Pass None to disable
+        # retransmission (a transport with its own delivery guarantees).
+        self._retry_policy = retry_policy
+        self._retx_rng = random.Random(f"retx:{our_identity.name}")
+        self._unacked: dict[str, _Retrans] = {}
+        self._retx_timer: threading.Thread | None = None
+        # sids of FINISHED flows (bounded FIFO): distinguishes an End for
+        # a completed-and-pruned session (safe to ack away) from one for
+        # a session a crash-replayed flow has not re-registered YET
+        # (must stay unacked so the broker redelivers it post-replay)
+        self._finished_sids: set[int] = set()
+        self._finished_sids_order: deque[int] = deque(maxlen=4096)
         self.services = services
         if services is not None and hasattr(services, "add_commit_listener"):
             # a PARKED wait_for_ledger_commit only resumes via its wake
@@ -668,9 +747,18 @@ class StateMachineManager:
             self._lock.notify_all()
         return True
 
-    def mark_consumed(self, msg_id: str) -> None:
+    def consume_inbound(self, sess: _SessionState):
+        """Pop the head of a session's inbound queue AND mark its logical
+        id consumed in one locked step (see op_receive for the retransmit
+        race this closes). The id is marked in-memory only — durability
+        still rides the op-log record; on a crash before the record, the
+        set is gone with the process and the peer's retransmit re-offers
+        the message to the replayed flow."""
         with self._lock:
-            self._consumed_msg_ids.add(msg_id)
+            item = sess.inbound.popleft()
+            if item[2]:
+                self._consumed_msg_ids.add(item[2])
+            return item
 
     def notify_ledger_commit(self, stx) -> None:
         with self._lock:
@@ -722,9 +810,128 @@ class StateMachineManager:
                 sess.executor = executor
             return sess
 
-    def send_to(self, party: Party, obj, *, msg_id: str) -> None:
-        self.messaging.send(str(party.name), SESSION_TOPIC, serialize(obj),
+    def send_to(self, party: Party, obj, *, msg_id: str,
+                track_kind: str | None = None, track_sid: int = 0,
+                deadline_s: float | None = None) -> None:
+        payload = serialize(obj)
+        # register BEFORE transmitting: a fast peer's reply (Confirm/Ack)
+        # can be processed in the window after send — it must find the
+        # entry to settle, not race past an empty map and leave a zombie
+        # retransmitting to its deadline
+        if track_kind is not None and self._retry_policy is not None:
+            self._track_unacked(str(party.name), payload, msg_id,
+                                track_kind, track_sid, deadline_s)
+        self.messaging.send(str(party.name), SESSION_TOPIC, payload,
                             msg_id=msg_id)
+
+    # ----------------------------------------------- session retransmission
+    def _track_unacked(self, party_name: str, payload: bytes, base_id: str,
+                       kind: str, sid: int, deadline_s: float | None) -> None:
+        policy = self._retry_policy
+        entry = _Retrans(
+            base_id, party_name, payload, kind, sid, policy, self._retx_rng,
+            deadline_s if deadline_s is not None else policy.deadline_s,
+        )
+        with self._lock:
+            if self._closed or base_id in self._unacked:
+                return
+            self._unacked[base_id] = entry
+            self._start_retx_timer_locked()
+
+    def _start_retx_timer_locked(self) -> None:
+        if self._retx_timer is not None and self._retx_timer.is_alive():
+            return
+
+        def loop():
+            while True:
+                with self._lock:
+                    if self._closed or not self._unacked:
+                        self._retx_timer = None
+                        return
+                    now = time.monotonic()
+                    resend: list[tuple[str, bytes, str]] = []
+                    for e in list(self._unacked.values()):
+                        if now >= e.deadline:
+                            # budget exhausted: the SENDING flow learns —
+                            # a session that cannot deliver is failed
+                            # locally rather than hanging forever
+                            self._unacked.pop(e.base_id, None)
+                            self._fail_session_locked(
+                                e.sid, e.kind,
+                                "session retry deadline exceeded "
+                                f"(peer {e.party_name} unreachable)",
+                            )
+                            continue
+                        if e.next_at <= now:
+                            e.attempt += 1
+                            e.backoff_s = self._retry_policy.backoff_s(
+                                e.attempt, self._retx_rng
+                            )
+                            e.next_at = now + e.backoff_s
+                            resend.append((
+                                e.party_name, e.payload,
+                                f"{e.base_id}~{e.attempt}",
+                            ))
+                for name, payload, wire_id in resend:
+                    try:
+                        self.messaging.send(
+                            name, SESSION_TOPIC, payload, msg_id=wire_id
+                        )
+                    except Exception:
+                        pass  # transport down: the next tick retries
+                # sleep until the soonest retransmit/deadline instead of a
+                # fixed high-rate poll — an idle buffer with a 2s backoff
+                # must not contend the SMM lock 50 times a second. The
+                # condition wakes early on any SMM notify (new entries
+                # notify via _track_unacked's lock exit), and the wait
+                # re-evaluates from scratch either way.
+                with self._lock:
+                    if self._closed:
+                        self._retx_timer = None
+                        return
+                    now = time.monotonic()
+                    nxt = min(
+                        (min(e.next_at, e.deadline)
+                         for e in self._unacked.values()),
+                        default=now + 0.5,
+                    )
+                    self._lock.wait(timeout=max(0.005, min(nxt - now, 0.5)))
+
+        self._retx_timer = threading.Thread(
+            target=loop, daemon=True, name="flow-session-retx"
+        )
+        self._retx_timer.start()
+
+    def _fail_session_locked(self, sid: int, kind: str, error: str) -> None:
+        sess = self._sessions.get(sid)
+        if sess is None:
+            return  # flow already finished; nothing is waiting
+        if kind == "init":
+            sess.rejected = error   # open_session waits on rejected/confirm
+        else:
+            sess.inbound.append(("end", error, "", None))
+        self._wake_key_locked(("sid", sid))
+        self._lock.notify_all()
+
+    def ack_session_msg(self, party: Party, logical_id: str) -> None:
+        """Receiver side: acknowledge a consumed Data/End message (fresh
+        wire id per ack so transport dedupe never swallows a re-ack)."""
+        try:
+            self.messaging.send(
+                str(party.name), SESSION_TOPIC,
+                serialize(SessionAck(logical_id)),
+            )
+        except Exception:
+            pass  # sender will retransmit; we re-ack the duplicate
+
+    def _drop_unacked_for_sid(self, sid: int, kind: str | None = None) -> None:
+        """Confirm/Reject arrival settles the Init retransmit for a sid."""
+        with self._lock:
+            for bid in [
+                b for b, e in self._unacked.items()
+                if e.sid == sid and (kind is None or e.kind == kind)
+            ]:
+                self._unacked.pop(bid, None)
 
     def wait_or_killed(self, predicate, timeout: float | None = None,
                        executor=None, park_key=None, sleep_deadline=None):
@@ -777,15 +984,38 @@ class StateMachineManager:
             self._sleepers.pop(ex.flow_id, None)
             for sid in ex.sessions:
                 self._sessions.pop(sid, None)
+                if sid not in self._finished_sids:
+                    if (len(self._finished_sids_order)
+                            == self._finished_sids_order.maxlen):
+                        self._finished_sids.discard(
+                            self._finished_sids_order[0]
+                        )
+                    self._finished_sids_order.append(sid)
+                    self._finished_sids.add(sid)
 
     # ------------------------------------------------------------ dispatch
     def _on_message(self, msg, ack=None) -> None:
-        with self._lock:
-            if msg.msg_id in self._consumed_msg_ids:
-                if ack:
-                    ack()
-                return
+        logical = _logical_id(msg.msg_id)
         obj = deserialize(msg.payload)
+        if isinstance(obj, SessionAck):
+            with self._lock:
+                self._unacked.pop(obj.msg_id, None)
+            if ack:
+                ack()
+            return
+        with self._lock:
+            consumed = logical in self._consumed_msg_ids
+        if consumed:
+            # duplicate of an already-consumed message (retransmit whose
+            # ack was lost, or broker redelivery): re-ack so the sender's
+            # retransmit buffer settles, then drop
+            if isinstance(obj, (SessionData, SessionEnd)):
+                peer = self._party_resolver(msg.sender)
+                if peer is not None:
+                    self.ack_session_msg(peer, logical)
+            if ack:
+                ack()
+            return
         if isinstance(obj, SessionInit):
             self._handle_init(msg, obj, ack)
         elif isinstance(obj, SessionConfirm):
@@ -795,6 +1025,7 @@ class StateMachineManager:
                     sess.peer_sid = obj.responder_session_id
                     self._wake_key_locked(("sid", obj.initiator_session_id))
                     self._lock.notify_all()
+            self._drop_unacked_for_sid(obj.initiator_session_id, "init")
             if ack:
                 ack()
         elif isinstance(obj, SessionReject):
@@ -804,33 +1035,71 @@ class StateMachineManager:
                     sess.rejected = obj.error
                     self._wake_key_locked(("sid", obj.initiator_session_id))
                     self._lock.notify_all()
+            self._drop_unacked_for_sid(obj.initiator_session_id, "init")
             if ack:
                 ack()
         elif isinstance(obj, SessionData):
             self._buffer(obj.recipient_session_id, "data", obj.payload,
-                         msg.msg_id, ack)
+                         logical, ack, msg.sender)
         elif isinstance(obj, SessionEnd):
             self._buffer(obj.recipient_session_id, "end", obj.error,
-                         msg.msg_id, ack)
+                         logical, ack, msg.sender)
 
-    def _buffer(self, sid: int, kind: str, body, msg_id: str, ack) -> None:
+    def _buffer(self, sid: int, kind: str, body, msg_id: str, ack,
+                sender: str = "") -> None:
+        ack_peer = None
+        transport_ack = False
         with self._lock:
             sess = self._sessions.get(sid)
             if sess is None:
-                # session may not be re-registered yet during replay; park
-                # by leaving unacked (broker redelivers) or drop on mock
+                # session may not be re-registered yet during replay: park
+                # by leaving the transport unacked (broker redelivers) or
+                # rely on the peer's session-level retransmit on mock. An
+                # END to a session a FINISHED flow pruned instead settles
+                # BOTH acks — an unacked End would otherwise retransmit to
+                # its full deadline (and redeliver every broker visibility
+                # window) after every completed flow. The finished-sids
+                # check is what distinguishes that case from the replay
+                # window, where acking away the End would strand the
+                # replayed flow's receive.
+                if (kind == "end" and msg_id and sender
+                        and sid in self._finished_sids):
+                    ack_peer = self._party_resolver(sender)
+                    transport_ack = True
+            elif msg_id and msg_id in self._consumed_msg_ids:
+                # RE-CHECK consumed under the append lock: the dispatch-
+                # entry check ran before this message waited on the lock,
+                # and the original may have been consumed in between — a
+                # stale append here would be replayed as a LATER message
+                ack_peer = sess.peer
+                transport_ack = True
+                sess = None  # handled: fall through to the ack block
+            elif any(q[2] == msg_id for q in sess.inbound if q[2]):
+                # retransmit already buffered but not yet consumed: settle
+                # this duplicate's transport lease (the buffered original's
+                # own ack + session retransmit carry the delivery guarantee)
+                transport_ack = True
+                sess = None
+            else:
+                sess.inbound.append((kind, body, msg_id, ack))
+                self._wake_key_locked(("sid", sid))
+                self._lock.notify_all()
                 return
-            sess.inbound.append((kind, body, msg_id, ack))
-            self._wake_key_locked(("sid", sid))
-            self._lock.notify_all()
+        if ack_peer is not None and msg_id:
+            self.ack_session_msg(ack_peer, msg_id)
+        if transport_ack and ack:
+            ack()
 
     def _handle_init(self, msg, init: SessionInit, ack) -> None:
-        flow_id = f"resp-{msg.msg_id}"
-        if not self.checkpoints.mark_init_processed(msg.msg_id, flow_id):
-            # duplicate Init (crash-replayed by the initiator). If our
-            # responder is still running, its Confirm may have been lost —
-            # re-send it (dedupe makes it harmless); a completed responder
-            # means the initiator cannot still be waiting on Confirm.
+        logical = _logical_id(msg.msg_id)
+        flow_id = f"resp-{logical}"
+        if not self.checkpoints.mark_init_processed(logical, flow_id):
+            # duplicate Init (crash-replayed or retransmitted by the
+            # initiator): our Confirm may have been lost — re-send it
+            # (dedupe makes it harmless). Session ids derive determini-
+            # stically from (flow id, op 0), so the Confirm can be
+            # reconstructed even after the responder finished and its
+            # session state was pruned.
             with self._lock:
                 ex = self._flows.get(flow_id)
                 resend = None
@@ -840,8 +1109,38 @@ class StateMachineManager:
                         if sess is not None and sess.peer_sid == init.initiator_session_id:
                             resend = (sess.peer, SessionConfirm(sess.peer_sid, sid),
                                       f"{flow_id}:confirm")
+            if resend is None:
+                peer = self._party_resolver(msg.sender)
+                claimed = self.checkpoints.init_flow_id(logical)
+                if claimed is not None and claimed.startswith("rejected:"):
+                    # the original open was REJECTED: repeat the verdict,
+                    # never fabricate a Confirm for a responder that was
+                    # never spawned
+                    self.messaging.send(
+                        msg.sender, SESSION_TOPIC,
+                        serialize(SessionReject(
+                            init.initiator_session_id,
+                            claimed[len("rejected:"):],
+                        )),
+                        msg_id=f"reject-{msg.msg_id}",
+                    )
+                elif peer is not None and claimed is not None:
+                    resend = (
+                        peer,
+                        SessionConfirm(
+                            init.initiator_session_id, _sid_for(claimed, 0)
+                        ),
+                        f"{claimed}:confirm",
+                    )
             if resend is not None:
-                self.send_to(resend[0], resend[1], msg_id=resend[2])
+                # fresh wire id per resend: the ORIGINAL Confirm may have
+                # been delivered (and its id remembered by the transport's
+                # dedupe) even though the initiator never processed it —
+                # a fixed id would be silently swallowed on every retry
+                self.send_to(
+                    resend[0], resend[1],
+                    msg_id=f"{resend[2]}~{Message.fresh_id()[:8]}",
+                )
             if ack:
                 ack()
             return
@@ -852,6 +1151,11 @@ class StateMachineManager:
                 f"no responder registered for {init.flow_name}"
                 if responder is None else f"unknown peer {msg.sender}"
             )
+            # overwrite the claim so a RETRANSMITTED init of a rejected
+            # open re-sends the rejection — without this marker the
+            # duplicate branch would reconstruct a Confirm for a
+            # responder that never existed and the initiator would hang
+            self.checkpoints.mark_init_rejected(logical, reason)
             self.messaging.send(
                 msg.sender, SESSION_TOPIC,
                 serialize(SessionReject(init.initiator_session_id, reason)),

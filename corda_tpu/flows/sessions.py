@@ -47,6 +47,19 @@ class SessionEnd:
     error: str                # "" = normal end
 
 
+@dataclasses.dataclass(frozen=True)
+class SessionAck:
+    """Session-level delivery acknowledgement for Data/End messages,
+    carrying the LOGICAL message id (the deterministic flow-op id, with
+    any retransmission suffix stripped). The sender's retransmit buffer
+    drops the entry on receipt; dedupe on both ends makes the
+    retransmit/ack exchange idempotent, so flows make progress over a
+    transport that drops, duplicates, or reorders (fault-injection
+    hardening — the reference leans on Artemis durability for this)."""
+
+    msg_id: str
+
+
 register_custom(
     SessionInit, "flows.SessionInit",
     to_fields=lambda m: {
@@ -76,4 +89,9 @@ register_custom(
     SessionEnd, "flows.SessionEnd",
     to_fields=lambda m: {"sid": m.recipient_session_id, "error": m.error},
     from_fields=lambda d: SessionEnd(d["sid"], d["error"]),
+)
+register_custom(
+    SessionAck, "flows.SessionAck",
+    to_fields=lambda m: {"msg_id": m.msg_id},
+    from_fields=lambda d: SessionAck(d["msg_id"]),
 )

@@ -27,14 +27,43 @@ from .network import (
     PeerHandle,
 )
 from .broker_client import BrokerMessagingClient, p2p_queue
-from .secure_transport import (
-    ChannelClosedError,
-    HandshakeError,
-    SecureBrokerConnection,
-    SecureBrokerServer,
-    SecureChannel,
-)
-from .fabric import SecureFabricClient
+from .retry import RetryPolicy
+
+try:
+    from .secure_transport import (
+        ChannelClosedError,
+        HandshakeError,
+        SecureBrokerConnection,
+        SecureBrokerServer,
+        SecureChannel,
+    )
+    from .fabric import SecureFabricClient
+
+    SECURE_TRANSPORT_AVAILABLE = True
+except ModuleNotFoundError as _e:  # no 'cryptography': fabric tier gated
+    _secure_import_error = _e
+    SECURE_TRANSPORT_AVAILABLE = False
+
+    class _SecureUnavailable:
+        """Placeholder that fails at USE, not import: the in-memory and
+        broker tiers must stay importable on minimal containers."""
+
+        def __init__(self, *a, **kw):
+            raise ModuleNotFoundError(
+                "the secure fabric transport requires the 'cryptography' "
+                f"package: {_secure_import_error}"
+            )
+
+    class ChannelClosedError(Exception):
+        pass
+
+    class HandshakeError(Exception):
+        pass
+
+    SecureBrokerConnection = _SecureUnavailable
+    SecureBrokerServer = _SecureUnavailable
+    SecureChannel = _SecureUnavailable
+    SecureFabricClient = _SecureUnavailable
 from .native_queue import (
     NativeEngineUnavailable,
     NativeQueueBroker,
@@ -52,6 +81,7 @@ __all__ = [
     "PeerHandle",
     "BrokerMessagingClient",
     "p2p_queue",
+    "RetryPolicy",
     "ChannelClosedError", "HandshakeError",
     "SecureBrokerConnection", "SecureBrokerServer", "SecureChannel",
     "SecureFabricClient",

@@ -45,6 +45,7 @@ class SecureFabricClient:
         identity: PartyAndCertificate, identity_private: PrivateKey,
         trust_root: PublicKey, timeout_s: float = 10.0,
         reconnect_attempts: int = 5, reconnect_backoff_s: float = 0.2,
+        fault_injector=None,
     ):
         if isinstance(address, str):
             host, _, port = address.rpartition(":")
@@ -60,6 +61,9 @@ class SecureFabricClient:
         # failure surfaces to callers
         self._reconnect_attempts = reconnect_attempts
         self._reconnect_backoff_s = reconnect_backoff_s
+        # seeded chaos hook: fail_op("fabric.control") simulates the TCP
+        # connection dying mid-op, driving the reconnect machinery below
+        self._fault_injector = fault_injector
         self._closed = False
         self._lock = threading.Lock()
         self._control = self._connect()
@@ -126,9 +130,16 @@ class SecureFabricClient:
         connection actually failed performs the swap — a concurrent
         failure on an ALREADY-replaced connection must not churn through
         (and close) the healthy replacement under other threads."""
+        import random
         import time
 
-        time.sleep(self._reconnect_backoff_s * (2 ** attempt))
+        # jittered exponential backoff: a broker restart drops EVERY
+        # client at once, and un-jittered clients re-handshake in
+        # synchronized waves
+        time.sleep(
+            self._reconnect_backoff_s * (2 ** attempt)
+            * (1.0 + 0.25 * random.random())
+        )
         with self._lock:
             if self._closed:
                 return False
@@ -169,6 +180,9 @@ class SecureFabricClient:
                     raise QueueClosedError("fabric client closed")
                 conn = self._control
             try:
+                inj = self._fault_injector
+                if inj is not None and inj.fail_op("fabric.control"):
+                    raise ConnectionError("injected connection fault")
                 return self._map_closed(lambda: fn(conn))
             except RuntimeError as e:
                 if (settled_ok and reconnected

@@ -105,12 +105,24 @@ class _InMemoryNode(MessagingClient):
         with self._lock:
             self._handlers.setdefault(topic, []).append(callback)
 
-    def _enqueue(self, msg: TopicMessage) -> None:
+    def _enqueue(self, msg: TopicMessage, *, front: bool = False,
+                 force: bool = False) -> None:
+        """``front`` models fault-injected reordering; ``force`` bypasses
+        the dedupe set — an injected DUPLICATE must reach the handlers
+        (simulating broker visibility-timeout redelivery), because the
+        dedupe being exercised is the protocol layer's, not the
+        transport's."""
         with self._lock:
-            if not self.running or msg.msg_id in self._seen:
-                return  # dedupe / dropped-after-stop
-            self._seen.add(msg.msg_id)
-            self._inbox.append(msg)
+            if not self.running:
+                return
+            if not force:
+                if msg.msg_id in self._seen:
+                    return  # dedupe / dropped-after-stop
+                self._seen.add(msg.msg_id)
+            if front:
+                self._inbox.appendleft(msg)
+            else:
+                self._inbox.append(msg)
 
     def _pump_one(self) -> bool:
         with self._lock:
@@ -146,14 +158,33 @@ class _InMemoryNode(MessagingClient):
 
 class InMemoryMessagingNetwork:
     """The shared fake transport. Deterministic: messages deliver only on
-    ``pump``; round-robin over nodes keeps ordering reproducible."""
+    ``pump``; round-robin over nodes keeps ordering reproducible.
 
-    def __init__(self):
+    With a ``FaultInjector`` attached (``set_fault_injector``) every
+    delivery first passes through the seeded plan: messages may drop,
+    delay (by pump rounds), duplicate past the dedupe set, or jump the
+    queue; partitioned edges drop both ways. Pump hooks
+    (``add_pump_hook``) fire once per round with the round number — the
+    chaos orchestrator drives crash/restart schedules from them."""
+
+    def __init__(self, fault_injector=None):
         self._nodes: dict[str, _InMemoryNode] = {}
         self._lock = threading.Lock()
         self._pump_thread: threading.Thread | None = None
         self._pumping = threading.Event()
         self.dropped: list[tuple[str, TopicMessage]] = []
+        self._injector = fault_injector
+        self._round = 0
+        self._delayed: list[tuple[int, str, TopicMessage]] = []
+        self._pump_hooks: list = []
+
+    def set_fault_injector(self, injector) -> None:
+        self._injector = injector
+
+    def add_pump_hook(self, hook) -> None:
+        """hook(round_number) runs at the start of every pump round."""
+        with self._lock:
+            self._pump_hooks.append(hook)
 
     def create_node(self, name: str) -> MessagingClient:
         with self._lock:
@@ -163,13 +194,34 @@ class InMemoryMessagingNetwork:
             self._nodes[name] = node
             return node
 
-    def _deliver(self, recipient: str, msg: TopicMessage) -> None:
+    def _deliver(self, recipient: str, msg: TopicMessage,
+                 *, matured: bool = False) -> None:
+        inj = self._injector
+        duplicate = reorder = False
+        if inj is not None and not matured:
+            # matured (previously delayed) messages skip re-decision: a
+            # delayed message would otherwise re-roll its fate each round
+            verdict = inj.on_deliver(
+                msg.sender, recipient, msg.msg_id, self._round
+            )
+            if verdict.drop:
+                self.dropped.append((recipient, msg))
+                return
+            if verdict.delay_rounds:
+                with self._lock:
+                    self._delayed.append(
+                        (self._round + verdict.delay_rounds, recipient, msg)
+                    )
+                return
+            duplicate, reorder = verdict.duplicate, verdict.reorder
         with self._lock:
             node = self._nodes.get(recipient)
         if node is None or not node.running:
             self.dropped.append((recipient, msg))
             return
-        node._enqueue(msg)
+        node._enqueue(msg, front=reorder)
+        if duplicate:
+            node._enqueue(msg, force=True)
         if self._pumping.is_set():
             pass  # background pump thread will pick it up
 
@@ -179,7 +231,18 @@ class InMemoryMessagingNetwork:
         The manual deterministic stepper (reference: pumpReceive)."""
         moved = False
         with self._lock:
+            self._round += 1
+            rnd = self._round
+            due = [e for e in self._delayed if e[0] <= rnd]
+            if due:
+                self._delayed = [e for e in self._delayed if e[0] > rnd]
+            hooks = list(self._pump_hooks)
             nodes = list(self._nodes.values())
+        for hook in hooks:
+            hook(rnd)
+        for _rel, recipient, msg in due:
+            self._deliver(recipient, msg, matured=True)
+            moved = True
         for node in nodes:
             moved |= node._pump_one()
         return moved

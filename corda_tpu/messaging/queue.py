@@ -54,8 +54,12 @@ class DurableQueueBroker:
 
     ACKED_CACHE_MAX = 100_000  # Artemis-style bounded duplicate-ID cache
 
-    def __init__(self, path: str = ":memory:", visibility_s: float = 30.0):
+    def __init__(self, path: str = ":memory:", visibility_s: float = 30.0,
+                 fault_injector=None):
         self._visibility_s = visibility_s
+        # seeded chaos hooks (faultinject.plan): publish-time loss and
+        # forced immediate redelivery; None in production
+        self._fault_injector = fault_injector
         self._lock = threading.Condition()
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
@@ -99,6 +103,12 @@ class DurableQueueBroker:
     ) -> str:
         """Enqueue; duplicate msg_id is a silent no-op (dedupe)."""
         msg_id = msg_id or Message.fresh_id()
+        inj = self._fault_injector
+        if inj is not None and inj.on_broker_publish(queue, msg_id):
+            # injected wire loss before the journal: the caller believes
+            # the publish landed — recovery is the publisher's retry (the
+            # pinned msg id makes the eventual duplicate a dedupe no-op)
+            return msg_id
         with self._lock:
             self._check_open()
             self._db.execute(
@@ -148,9 +158,16 @@ class DurableQueueBroker:
         if row is None:
             return None
         seq, msg_id, payload, sender, reply_to, enq, dcount = row
+        inj = self._fault_injector
+        lease_until = now + self._visibility_s
+        if inj is not None and inj.on_broker_deliver(queue, msg_id):
+            # injected duplicate: deliver but leave the row leasable, so
+            # the next consume redelivers it immediately (a forced
+            # visibility-timeout expiry — consumers must be idempotent)
+            lease_until = now
         self._db.execute(
             "UPDATE messages SET leased_until=?, delivery_count=? WHERE seq=?",
-            (now + self._visibility_s, dcount + 1, seq),
+            (lease_until, dcount + 1, seq),
         )
         self._db.commit()
         return Message(

@@ -169,3 +169,16 @@ class MetricRegistry:
     def snapshot(self) -> dict:
         with self._lock:
             return {k: v.snapshot() for k, v in sorted(self._metrics.items())}
+
+
+# Process-global registry for cross-cutting health events that happen
+# below any service object holding its own registry — currently the
+# verifier's device→host failover counters (``verifier.device_failover``,
+# ``verifier.device_failover_rows``). Node services with their own
+# MonitoringService keep using per-node registries; this one is the
+# operator's "did anything degrade in this process" surface.
+_process_registry = MetricRegistry()
+
+
+def node_metrics() -> MetricRegistry:
+    return _process_registry

@@ -36,6 +36,7 @@ import threading
 import time
 from collections import defaultdict, deque
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 
 from corda_tpu.crypto import (
     KeyPair,
@@ -108,6 +109,12 @@ class BFTReplica:
         # entries that nothing will ever prune
         self._executed_digests: deque = deque(maxlen=4096)
         self._executed_set: set[bytes] = set()
+        # digest -> signed reply of the executed command (same bound/
+        # eviction as _executed_digests): a client RETRYING an executed
+        # command — its reply was lost, or it re-submits after a crash —
+        # gets the cached attestation instead of silence (the reference's
+        # BFT-SMaRt replies from its request log the same way)
+        self._executed_replies: dict[bytes, bytes] = {}
         # ----- view-change state
         self._view_timeout_s = view_timeout_s
         self._pending_since: dict[bytes, float] = {}  # digest -> arrival time
@@ -172,7 +179,14 @@ class BFTReplica:
         d = _digest(command)
         with self._lock:
             if d in self._executed_set:
-                return  # late duplicate of an executed command
+                # duplicate of an EXECUTED command: re-send the cached
+                # signed reply — the client is retrying because its
+                # original replies were lost, and silence here would
+                # strand an idempotent resubmission forever
+                reply = self._executed_replies.get(d)
+                if reply is not None:
+                    self._messaging.send(req["client"], T_REPLY, reply)
+                return
             self._commands[d] = command
             self._client_of[d] = req["client"]
             self._pending_since.setdefault(d, time.monotonic())
@@ -282,7 +296,9 @@ class BFTReplica:
                 if d_i != _NULL_DIGEST and d_i not in self._executed_set:
                     if (len(self._executed_digests)
                             == self._executed_digests.maxlen):
-                        self._executed_set.discard(self._executed_digests[0])
+                        evicted = self._executed_digests[0]
+                        self._executed_set.discard(evicted)
+                        self._executed_replies.pop(evicted, None)
                     self._executed_digests.append(d_i)
                     self._executed_set.add(d_i)
         for seq_i, d_i, command, client in to_run:
@@ -305,12 +321,11 @@ class BFTReplica:
             outcome = serialize({"batch": True, "conflicts": conflicts})
             sig = host_sign(self._keypair.private, outcome)
             client = client or (requests[0][2] if requests else None)
-            self._messaging.send(
-                client, T_REPLY,
-                serialize({"digest": d, "replica": self.name,
-                           "outcome": outcome, "sig": sig,
-                           "key": self._keypair.public}),
-            )
+            reply = serialize({"digest": d, "replica": self.name,
+                               "outcome": outcome, "sig": sig,
+                               "key": self._keypair.public})
+            self._executed_replies[d] = reply
+            self._messaging.send(client, T_REPLY, reply)
             return
         states, tx_id, caller = cmd
         try:
@@ -321,11 +336,11 @@ class BFTReplica:
         outcome = serialize({"tx_id": tx_id, "conflict": conflict})
         sig = host_sign(self._keypair.private, outcome)
         client = client or caller
-        self._messaging.send(
-            client, T_REPLY,
-            serialize({"digest": d, "replica": self.name, "outcome": outcome,
-                       "sig": sig, "key": self._keypair.public}),
-        )
+        reply = serialize({"digest": d, "replica": self.name,
+                           "outcome": outcome, "sig": sig,
+                           "key": self._keypair.public})
+        self._executed_replies[d] = reply
+        self._messaging.send(client, T_REPLY, reply)
 
     # ------------------------------------------------------- view change
 
@@ -551,6 +566,21 @@ class BFTClusterClient:
         self._futures: dict[bytes, Future] = {}
         messaging.add_handler(T_REPLY, auto_ack(self._on_reply))
 
+    def _settle(self, d: bytes, fut: Future | None = None) -> None:
+        """Drop all per-digest state. Runs when the quorum resolves the
+        future (the normal path), from collect()'s finally, and from the
+        pending object's finalizer — so an abandoned pending (a pipelined
+        window unwound before collect()) cannot leak its future and keep
+        accumulating stray replica replies for the process lifetime.
+        With ``fut`` given, settles only while that future is still the
+        registered one — a retry of the same command re-registers the
+        digest, and a stale finalizer/collect must not tear the retry's
+        live future down."""
+        if fut is not None and self._futures.get(d) is not fut:
+            return
+        self._futures.pop(d, None)
+        self._replies.pop(d, None)
+
     def _on_reply(self, msg) -> None:
         rep = deserialize(msg.payload)
         replica, outcome, sig = rep["replica"], rep["outcome"], rep["sig"]
@@ -573,6 +603,9 @@ class BFTClusterClient:
             bucket[replica] = sig
             if not fut.done() and len(bucket) >= self.f + 1:
                 fut.set_result((outcome, dict(bucket)))
+                # quorum reached: state cleanup rides the resolution, not
+                # a collect() that may never come
+                self._settle(d)
 
     def submit(self, states, tx_id, caller: str):
         """Returns (conflict_or_None, {replica: sig}) after quorum."""
@@ -626,18 +659,33 @@ class BFTClusterClient:
                                 )
                             )
                             break
-                        except TimeoutError:
+                        except (TimeoutError, FutureTimeoutError):
+                            # both spellings: concurrent.futures raises its
+                            # own TimeoutError before Python 3.11 — the
+                            # re-broadcast retry must fire on either
                             if time.monotonic() >= deadline:
                                 raise
                             for r in client._replicas:
                                 client._messaging.send(r, T_REQUEST, payload)
                 finally:
                     with client._lock:
-                        client._futures.pop(d, None)
-                        client._replies.pop(d, None)
+                        client._settle(d, fut)
                 return deserialize(outcome_bytes), sigs
 
-        return _PendingSubmit()
+        pending = _PendingSubmit()
+        # lifecycle-tied cleanup: a pending abandoned WITHOUT collect()
+        # (an earlier window's failure unwinding a pipelined caller) drops
+        # its digest state when the object is garbage-collected. A time
+        # horizon would be wrong here — a pipelined caller may legally
+        # dwell many windows between dispatch and collect.
+        import weakref
+
+        def _abandoned(client=self, d=d, fut=fut):
+            with client._lock:
+                client._settle(d, fut)
+
+        weakref.finalize(pending, _abandoned)
+        return pending
 
 
 class BFTUniquenessProvider(UniquenessProvider):

@@ -29,6 +29,13 @@ import random
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+
+# Future.result(timeout=) raises concurrent.futures.TimeoutError, which is
+# NOT the builtin TimeoutError before Python 3.11 — catching only the
+# builtin silently disabled every submit-timeout retry on 3.10 (the
+# mid-election resubmit path the chaos soak exercises).
+_TIMEOUT_ERRORS = (TimeoutError, FutureTimeoutError)
 
 from corda_tpu.messaging import auto_ack
 from corda_tpu.serialization import deserialize, serialize
@@ -121,7 +128,7 @@ def _retryable_submit_error(e: Exception) -> bool:
     a generic error-string reply (older peers / any wrap path). The
     substring contract with _on_submit_reply's error wrap lives here and
     only here."""
-    if isinstance(e, (NotLeaderError, TimeoutError)):
+    if isinstance(e, (NotLeaderError, *_TIMEOUT_ERRORS)):
         return True
     return "not leader" in str(e)
 
@@ -154,6 +161,13 @@ class RaftNode:
 
         self._lock = threading.RLock()
         self.role = RaftNode.FOLLOWER
+        # bounded election-storm backoff: each consecutive election that
+        # fails to produce a leader doubles the next timeout draw (cap
+        # ELECTION_BACKOFF_CAP×); hearing from a real leader resets it.
+        # Under a partition or heavy message loss this stops the cluster
+        # burning terms (and bandwidth) at the base cadence, and spreads
+        # candidacies so the first heal round elects instead of splitting.
+        self._elections_since_leader = 0
         self.current_term = 0
         self.voted_for: str | None = None
         self.log = RaftLog()
@@ -212,8 +226,19 @@ class RaftNode:
         if self._thread is not None:
             self._thread.join(timeout=2)
 
+    ELECTION_BACKOFF_CAP = 8.0
+
+    def _election_backoff(self) -> float:
+        # cap the EXPONENT, not just the result: the counter grows without
+        # bound during a long partition and 2.0**1024 overflows, which
+        # would kill the tick thread
+        return min(2.0 ** min(self._elections_since_leader, 6),
+                   RaftNode.ELECTION_BACKOFF_CAP)
+
     def _reset_timer(self) -> None:
-        self._deadline = time.monotonic() + self._rng.uniform(*self._timeout_range)
+        self._deadline = time.monotonic() + (
+            self._rng.uniform(*self._timeout_range) * self._election_backoff()
+        )
 
     def _tick_loop(self) -> None:
         while not self._stop.wait(0.01):
@@ -236,6 +261,7 @@ class RaftNode:
 
     def _start_election(self) -> None:
         self.role = RaftNode.CANDIDATE
+        self._elections_since_leader += 1
         self.current_term += 1
         self.voted_for = self.name
         self._persist_term_vote()
@@ -283,6 +309,7 @@ class RaftNode:
         if self.role == RaftNode.CANDIDATE and len(self._votes) * 2 > len(self.peers) + 1:
             self.role = RaftNode.LEADER
             self.leader = self.name
+            self._elections_since_leader = 0
             n = self.log.last_index() + 1
             self._next_index = {p: n for p in self.peers}
             self._match_index = {p: -1 for p in self.peers}
@@ -352,6 +379,7 @@ class RaftNode:
                 return
             self.role = RaftNode.FOLLOWER
             self.leader = req["leader"]
+            self._elections_since_leader = 0
             self._reset_timer()
             last_idx = req["last_idx"]
             if last_idx > self.last_applied:
@@ -375,6 +403,7 @@ class RaftNode:
             if req["term"] == self.current_term:
                 self.role = RaftNode.FOLLOWER
                 self.leader = req["leader"]
+                self._elections_since_leader = 0  # live leader: no storm
                 self._reset_timer()
                 prev_idx = req["prev_log_index"]
                 entries = req["entries"]
@@ -596,6 +625,16 @@ class RaftUniquenessProvider(UniquenessProvider):
         self.node = node
         # retry window covers one election cycle
         self._retry_s = 2.0
+        # leader-change retries back off exponentially with jitter —
+        # fixed-cadence retries from many clients re-synchronized into
+        # the same election window are their own little storm. Seeded by
+        # replica name so chaos runs reproduce.
+        from corda_tpu.messaging.retry import RetryPolicy
+
+        self._retry_policy = RetryPolicy(
+            base_s=0.02, multiplier=2.0, max_backoff_s=0.4, jitter=0.5
+        )
+        self._retry_rng = random.Random(f"retry:{node.name}")
 
     @staticmethod
     def state_machine(base: UniquenessProvider | None = None):
@@ -643,18 +682,26 @@ class RaftUniquenessProvider(UniquenessProvider):
     def _submit_retrying(self, command: bytes):
         """Submit through whichever replica currently leads, riding out one
         election cycle; re-submission after an ambiguous timeout is safe —
-        the state machine is idempotent per tx_id."""
+        the state machine is idempotent per tx_id. Retries back off
+        exponentially with jitter under the overall ``_retry_s`` deadline
+        (the propagated budget — no attempt outlives it)."""
         deadline = time.monotonic() + self._retry_s
+        attempt = 0
         while True:
             try:
                 fut = self.node.submit_anywhere(command)
-                return deserialize(fut.result(timeout=self._retry_s))
-            except (NotLeaderError, TimeoutError, NotaryError) as e:
+                remaining = max(0.05, deadline - time.monotonic())
+                return deserialize(
+                    fut.result(timeout=min(self._retry_s, remaining))
+                )
+            except (NotLeaderError, *_TIMEOUT_ERRORS, NotaryError) as e:
                 if not _retryable_submit_error(e):
                     raise
                 if time.monotonic() > deadline:
                     raise
-                time.sleep(0.02)
+                pause = self._retry_policy.backoff_s(attempt, self._retry_rng)
+                attempt += 1
+                time.sleep(min(pause, max(0.0, deadline - time.monotonic())))
 
     def commit(self, states, tx_id, caller_name) -> None:
         result = self._submit_retrying(
@@ -703,7 +750,7 @@ class RaftUniquenessProvider(UniquenessProvider):
                         return list(deserialize(
                             fut.result(timeout=provider._retry_s)
                         ))
-                    except (NotLeaderError, TimeoutError, NotaryError) as e:
+                    except (NotLeaderError, *_TIMEOUT_ERRORS, NotaryError) as e:
                         if not _retryable_submit_error(e):
                             raise
                 return list(provider._submit_retrying(command))

@@ -45,7 +45,18 @@ class NotaryInternalException(Exception):
 
 
 class NotaryService:
-    """Base: identity + uniqueness + signing + time-window policy."""
+    """Base: identity + uniqueness + signing + time-window policy.
+
+    Idempotent resubmission: every successful attestation is remembered
+    (bounded, keyed by tx id), so a client retrying after a lost response
+    — leader change mid-commit, dropped reply, crash-replayed flow — gets
+    the ORIGINAL signature back without re-running verification or a
+    consensus round, and without its already-consumed inputs reading as a
+    double-spend (the uniqueness providers are idempotent per tx id for
+    the same reason; the cache is the fast path over that guarantee,
+    matching the reference's re-notarisation behavior)."""
+
+    SIGNED_CACHE_MAX = 8192
 
     def __init__(
         self,
@@ -60,9 +71,33 @@ class NotaryService:
         self._keypair = keypair
         self.uniqueness = uniqueness
         self._clock = clock
+        self._signed_cache: dict = {}
+        self._signed_order: "list" = []
+        self._signed_lock = threading.Lock()
 
     def sign(self, tx_id: SecureHash) -> TransactionSignature:
         return sign_tx_id(self._keypair.private, self._keypair.public, tx_id)
+
+    def cached_signature(self, tx_id: SecureHash) -> TransactionSignature | None:
+        """The original attestation for an already-notarised tx, if still
+        in the bounded cache (a miss just means the full — idempotent —
+        path runs again)."""
+        with self._signed_lock:
+            return self._signed_cache.get(tx_id)
+
+    def remember_signature(
+        self, tx_id: SecureHash, sig: TransactionSignature
+    ) -> None:
+        with self._signed_lock:
+            if tx_id in self._signed_cache:
+                return
+            self._signed_cache[tx_id] = sig
+            self._signed_order.append(tx_id)
+            if len(self._signed_order) > self.SIGNED_CACHE_MAX:
+                evict = self._signed_order[: len(self._signed_order) // 2]
+                del self._signed_order[: len(self._signed_order) // 2]
+                for t in evict:
+                    self._signed_cache.pop(t, None)
 
     def check_time_window(self, tw: TimeWindow | None) -> None:
         """Reject if the notary's now (±tolerance) is outside the window
@@ -91,6 +126,9 @@ class SimpleNotaryService(NotaryService):
     NonValidatingNotaryFlow provides)."""
 
     def process(self, ftx: FilteredTransaction, caller_name: str) -> TransactionSignature:
+        cached = self.cached_signature(ftx.id)
+        if cached is not None:
+            return cached  # duplicate resubmission: original attestation
         ftx.verify()  # adversarial input: every proof must chain to ftx.id
         # inputs, timewindow and notary MUST be fully visible in the
         # tear-off — a requester hiding the timewindow group would
@@ -104,7 +142,9 @@ class SimpleNotaryService(NotaryService):
         self._check_notary(notaries[0] if notaries else None, ftx.id)
         self.check_time_window(tws[0] if tws else None)
         self.uniqueness.commit(list(inputs), ftx.id, caller_name)
-        return self.sign(ftx.id)
+        sig = self.sign(ftx.id)
+        self.remember_signature(ftx.id, sig)
+        return sig
 
 
 class ValidatingNotaryService(NotaryService):
@@ -114,6 +154,9 @@ class ValidatingNotaryService(NotaryService):
     def process(
         self, stx: SignedTransaction, resolve_state, caller_name: str
     ) -> TransactionSignature:
+        cached = self.cached_signature(stx.id)
+        if cached is not None:
+            return cached  # duplicate resubmission: original attestation
         stx.verify_signatures_except({self.identity.owning_key})
         wtx = stx.tx
         self._check_notary(wtx.notary, stx.id)
@@ -121,7 +164,9 @@ class ValidatingNotaryService(NotaryService):
         ltx.verify()
         self.check_time_window(wtx.time_window)
         self.uniqueness.commit(list(wtx.inputs), stx.id, caller_name)
-        return self.sign(stx.id)
+        sig = self.sign(stx.id)
+        self.remember_signature(stx.id, sig)
+        return sig
 
 
 class _PendingRequest:
@@ -382,18 +427,20 @@ class BatchedNotaryService(NotaryService):
         # whose signature check ran on host (solo/below break-even, or a
         # host-only tier) signs on host too — one coherent decision per
         # window rather than a second gate with different constants
-        pending_sigs = self._dispatch_sign(
-            [requests[i][0].id for i in accepted],
-            on_device=on_device,
-        )
-        return results, accepted, pending_sigs
+        accepted_ids = [requests[i][0].id for i in accepted]
+        pending_sigs = self._dispatch_sign(accepted_ids, on_device=on_device)
+        return results, accepted, pending_sigs, accepted_ids
 
     def finalize_batch(
-        self, results, accepted, pending_sigs
+        self, results, accepted, pending_sigs, accepted_ids=None
     ) -> list[TransactionSignature | Exception]:
         """Fill in the (possibly device-batched) response signatures."""
-        for i, sig in zip(accepted, pending_sigs.collect()):
+        for slot, (i, sig) in enumerate(zip(accepted, pending_sigs.collect())):
             results[i] = sig
+            if accepted_ids is not None:
+                # remember attestations so duplicate resubmissions (client
+                # retry after a lost response) return the original success
+                self.remember_signature(accepted_ids[slot], sig)
         if self._metrics is not None:
             self._metrics.meter("notary.requests").mark(len(results))
             self._metrics.meter("notary.committed").mark(
@@ -452,6 +499,13 @@ class BatchedNotaryService(NotaryService):
     # ---------------------------------------------------------- async path
 
     def request(self, stx: SignedTransaction, resolve_state, caller: str) -> Future:
+        cached = self.cached_signature(stx.id)
+        if cached is not None:
+            # duplicate resubmission: answer with the original attestation
+            # without burning a batch slot or a consensus round
+            fut: Future = Future()
+            fut.set_result(cached)
+            return fut
         req = _PendingRequest(stx, resolve_state, caller)
         with self._lock:
             if self._stopped:

@@ -253,6 +253,16 @@ def _measured_link_rtt_s() -> float:
     global _link_rtt_cache, _link_rtt_measured_at, _rtt_probe_fn
     import time
 
+    # TTL-fresh cache hits return WITHOUT the lock: the DAG break-even
+    # gate calls this per resolve, and serializing concurrent verifier
+    # threads on a mutex to read a cached float undid the lock-free read
+    # this cache exists for. Reading the (value, stamp) pair unlocked is
+    # safe under the GIL — worst case a racing refresher makes us read a
+    # value one probe staler, which the TTL tolerates by design.
+    cached, stamp = _link_rtt_cache, _link_rtt_measured_at
+    if cached is not None and time.monotonic() - stamp < _LINK_RTT_TTL_S:
+        return cached
+
     # one probe at a time: a warm-up thread (the batched notary's boot
     # warm) and the first gate call must not interleave their samples on
     # the device queue — contended samples inflate the median and can

@@ -71,6 +71,13 @@ class _Observable:
                 self._listeners.remove(listener)
 
     def _emit(self, event) -> None:
+        # Mutators call this while STILL HOLDING self._lock (an RLock, so
+        # the re-acquire here is free): releasing before emitting let two
+        # concurrent mutators deliver index-carrying Change events out of
+        # order, silently diverging every derived view (map/filtered/
+        # replayed mirror the source by ch.index). Listener code therefore
+        # runs under the source's lock — listeners are synchronous and
+        # must not block on other threads' mutations of the same source.
         with self._lock:
             listeners = list(self._listeners)
         for fn in listeners:
@@ -93,8 +100,8 @@ class ObservableValue(_Observable):
         with self._lock:
             old = self._value
             self._value = value
-        if old != value:
-            self._emit((old, value))
+            if old != value:
+                self._emit((old, value))
 
     def map(self, fn: Callable) -> "ObservableValue":
         """Derived value (reference: EasyBind.map / ObservableUtilities)."""
@@ -128,17 +135,17 @@ class ObservableList(_Observable):
         with self._lock:
             self._items.append(element)
             idx = len(self._items) - 1
-        self._emit(Change("add", idx, element))
+            self._emit(Change("add", idx, element))
 
     def insert(self, index: int, element) -> None:
         with self._lock:
             self._items.insert(index, element)
-        self._emit(Change("add", index, element))
+            self._emit(Change("add", index, element))
 
     def remove_at(self, index: int):
         with self._lock:
             element = self._items.pop(index)
-        self._emit(Change("remove", index, element))
+            self._emit(Change("remove", index, element))
         return element
 
     def remove(self, element) -> bool:
@@ -148,20 +155,20 @@ class ObservableList(_Observable):
             except ValueError:
                 return False
             self._items.pop(idx)
-        self._emit(Change("remove", idx, element))
+            self._emit(Change("remove", idx, element))
         return True
 
     def update_at(self, index: int, element) -> None:
         with self._lock:
             old = self._items[index]
             self._items[index] = element
-        self._emit(Change("update", index, element, old))
+            self._emit(Change("update", index, element, old))
 
     def reset(self, items) -> None:
         with self._lock:
             self._items = list(items)
             snap = list(self._items)
-        self._emit(Change("reset", element=snap))
+            self._emit(Change("reset", element=snap))
 
     # ------------------------------------------------------------- reading
     def snapshot(self) -> list:
@@ -397,20 +404,20 @@ class ObservableMap(_Observable):
     def put(self, k, v) -> None:
         with self._lock:
             self._map[k] = v
-        self._emit(("put", k, v))
+            self._emit(("put", k, v))
 
     def discard(self, k) -> None:
         with self._lock:
             if k not in self._map:
                 return
             v = self._map.pop(k)
-        self._emit(("discard", k, v))
+            self._emit(("discard", k, v))
 
     def reset(self, mapping: dict) -> None:
         with self._lock:
             self._map = dict(mapping)
             snap = dict(self._map)
-        self._emit(("reset", None, snap))
+            self._emit(("reset", None, snap))
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -54,30 +54,31 @@ def _effective_device_schemes(use_device: bool) -> set:
     (the r4 eager chain was ~100 sequential queue-drain round trips and
     collapsed the mixed bench to 0.04× host, which is why it used to be
     host-pinned by measured RTT). The XLA:CPU test tier still runs the
-    host loop (the fused graph is a CPU compile tarpit). Only consulted
-    when ``use_device`` — host-only callers never touch (or initialize)
-    jax."""
+    host loop (the fused graph is a CPU compile tarpit) unless the
+    documented CORDA_TPU_SPHINCS=device override forces the device path —
+    the override outranks the backend gate (it exists precisely to pin
+    routing on non-TPU accelerator backends). Only consulted when
+    ``use_device`` — host-only callers never touch (or initialize) jax."""
     if not use_device:
         return set()
     schemes = set(_DEVICE_SCHEMES)
+    forced = _sphincs_override()
     import jax
 
-    if jax.default_backend() == "tpu" and _sphincs_on_device():
+    if forced == "device" or (
+        forced != "host" and jax.default_backend() == "tpu"
+    ):
         schemes.add(SPHINCS256_SHA256)
     return schemes
 
 
-def _sphincs_on_device() -> bool:
-    """Override hook (CORDA_TPU_SPHINCS=device|host); defaults to device
-    on accelerator backends now the pipeline is a single fused dispatch —
-    its one round trip overlaps the other schemes' buckets in a mixed
-    dispatch, so link locality no longer gates it."""
+def _sphincs_override() -> str:
+    """The CORDA_TPU_SPHINCS routing override: "device", "host", or ""
+    (no override — route by backend)."""
     import os
 
     forced = os.environ.get("CORDA_TPU_SPHINCS", "").strip().lower()
-    if forced == "host":
-        return False
-    return True
+    return forced if forced in ("device", "host") else ""
 
 
 class PendingRows:
@@ -89,20 +90,49 @@ class PendingRows:
     verifier service queue loop) overlap the device ladder time — dominated
     by the tunneled interconnect's ~100 ms round trip — with host work on a
     previous batch.
+
+    Degradation contract: a device bucket whose READBACK fails (device
+    reset, link loss, injected fault) re-verifies on the host reference
+    path via the fallback closure stored with it — the batch always
+    completes, and the failover is counted in the process metrics
+    (``verifier.device_failover``). ``device_rows`` reflects where rows
+    actually settled, so downstream routing decisions (the notary's
+    response-sign tiering) track reality rather than intent.
     """
 
-    __slots__ = ("_n", "_deferred", "_out")
+    __slots__ = ("_n", "_deferred", "_out", "device_rows")
 
     def __init__(self, n: int):
         self._n = n
-        self._deferred: list[tuple[list[int], object]] = []
+        self._deferred: list[tuple[list[int], object, object]] = []
         self._out = np.zeros(n, dtype=bool)
+        self.device_rows = 0
 
     def collect(self) -> np.ndarray:
-        for idxs, mask in self._deferred:
-            self._out[idxs] = np.asarray(mask)[: len(idxs)]
+        for idxs, mask, fallback in self._deferred:
+            try:
+                self._out[idxs] = np.asarray(mask)[: len(idxs)]
+            except Exception:
+                _note_device_failover(len(idxs), "collect")
+                self.device_rows -= len(idxs)
+                fallback()
         self._deferred = []
         return self._out
+
+
+def _note_device_failover(n_rows: int, stage: str) -> None:
+    """Record a device→host failover in the process metrics (the counters
+    the chaos acceptance criteria assert on)."""
+    import logging
+
+    from corda_tpu.node.monitoring import node_metrics
+
+    node_metrics().counter("verifier.device_failover").inc()
+    node_metrics().counter("verifier.device_failover_rows").inc(n_rows)
+    logging.getLogger(__name__).warning(
+        "device verification failed at %s; %d rows fell back to the host "
+        "reference path", stage, n_rows,
+    )
 
 
 def dispatch_signature_rows(
@@ -128,73 +158,103 @@ def dispatch_signature_rows(
     device_schemes = _effective_device_schemes(use_device)
     for scheme_id, idxs in buckets.items():
         if scheme_id in device_schemes:
-            keys = [rows[i][0].encoded for i in idxs]
-            sigs = [rows[i][1] for i in idxs]
-            msgs = [rows[i][2] for i in idxs]
-            from corda_tpu.ops._blockpack import start_host_copy
-            from corda_tpu.parallel.mesh import service_mesh_active
-
-            # production fan-out: shard EVERY device-capable bucket over
-            # the device mesh (SURVEY §2.9 P3) — the reference's fan-out
-            # load-balances all verification work across workers
-            # (Verifier.kt:66-84), not one scheme. Single chip degrades
-            # transparently to the plain batched dispatches below.
-            on_mesh = service_mesh_active()
-            if on_mesh:
-                from corda_tpu.parallel.mesh import service_mesh_verifier
-
-                mesh_v = service_mesh_verifier()
-            if scheme_id == EDDSA_ED25519_SHA512:
-                if on_mesh:
-                    mask, _spent, _total = mesh_v.dispatch_rows(
-                        keys, sigs, msgs, min_bucket=min_bucket
-                    )
-                else:
-                    from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
-
-                    mask = ed25519_verify_dispatch(
-                        keys, sigs, msgs, min_bucket=min_bucket
-                    )
-            elif scheme_id == SPHINCS256_SHA256:
-                if on_mesh:
-                    mask = mesh_v.dispatch_sphincs_rows(
-                        keys, sigs, msgs, min_bucket=min_bucket
-                    )
-                else:
-                    from corda_tpu.ops.sphincs_batch import (
-                        sphincs_verify_dispatch,
-                    )
-
-                    mask = sphincs_verify_dispatch(
-                        keys, sigs, msgs, min_bucket=min_bucket
-                    )
-            else:
-                # async like the ed25519 bucket: the ECDSA ladder queues on
-                # device and collects later, so mixed-scheme batches overlap
-                # both ladders instead of serializing on this one (r2
-                # VERDICT weak #2)
-                curve = (
-                    "secp256k1"
-                    if scheme_id == ECDSA_SECP256K1_SHA256
-                    else "secp256r1"
+            try:
+                _dispatch_device_bucket(
+                    pending, rows, scheme_id, idxs, min_bucket
                 )
-                if on_mesh:
-                    mask = mesh_v.dispatch_ecdsa_rows(
-                        curve, keys, sigs, msgs, min_bucket=min_bucket
-                    )
-                else:
-                    from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
-
-                    mask = ecdsa_verify_dispatch(
-                        curve, keys, sigs, msgs, min_bucket=min_bucket
-                    )
-            start_host_copy(mask)
-            pending._deferred.append((idxs, mask))
+            except Exception:
+                # graceful degradation: a device bucket that fails to
+                # DISPATCH (backend gone, kernel error, injected fault)
+                # completes on the host reference path instead of failing
+                # the whole batch — the notary/verifier keeps serving
+                # while the operator reads the failover counters
+                _note_device_failover(len(idxs), "dispatch")
+                _host_verify_bucket(pending, rows, idxs)
         else:
-            for i in idxs:
-                key, sig, msg = rows[i]
-                pending._out[i] = is_valid(key, sig, msg)
+            _host_verify_bucket(pending, rows, idxs)
     return pending
+
+
+def _host_verify_bucket(pending: PendingRows, rows, idxs) -> None:
+    for i in idxs:
+        key, sig, msg = rows[i]
+        pending._out[i] = is_valid(key, sig, msg)
+
+
+def _dispatch_device_bucket(
+    pending: PendingRows, rows, scheme_id: int, idxs, min_bucket
+) -> None:
+    """Enqueue one scheme bucket on device; raises on dispatch failure
+    (the caller degrades to host). The faultinject site lets a seeded
+    chaos plan force exactly this failure."""
+    from corda_tpu.faultinject import check_site
+
+    check_site("verifier.device")
+    keys = [rows[i][0].encoded for i in idxs]
+    sigs = [rows[i][1] for i in idxs]
+    msgs = [rows[i][2] for i in idxs]
+    from corda_tpu.ops._blockpack import start_host_copy
+    from corda_tpu.parallel.mesh import service_mesh_active
+
+    # production fan-out: shard EVERY device-capable bucket over
+    # the device mesh (SURVEY §2.9 P3) — the reference's fan-out
+    # load-balances all verification work across workers
+    # (Verifier.kt:66-84), not one scheme. Single chip degrades
+    # transparently to the plain batched dispatches below.
+    on_mesh = service_mesh_active()
+    if on_mesh:
+        from corda_tpu.parallel.mesh import service_mesh_verifier
+
+        mesh_v = service_mesh_verifier()
+    if scheme_id == EDDSA_ED25519_SHA512:
+        if on_mesh:
+            mask, _spent, _total = mesh_v.dispatch_rows(
+                keys, sigs, msgs, min_bucket=min_bucket
+            )
+        else:
+            from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+
+            mask = ed25519_verify_dispatch(
+                keys, sigs, msgs, min_bucket=min_bucket
+            )
+    elif scheme_id == SPHINCS256_SHA256:
+        if on_mesh:
+            mask = mesh_v.dispatch_sphincs_rows(
+                keys, sigs, msgs, min_bucket=min_bucket
+            )
+        else:
+            from corda_tpu.ops.sphincs_batch import (
+                sphincs_verify_dispatch,
+            )
+
+            mask = sphincs_verify_dispatch(
+                keys, sigs, msgs, min_bucket=min_bucket
+            )
+    else:
+        # async like the ed25519 bucket: the ECDSA ladder queues on
+        # device and collects later, so mixed-scheme batches overlap
+        # both ladders instead of serializing on this one (r2
+        # VERDICT weak #2)
+        curve = (
+            "secp256k1"
+            if scheme_id == ECDSA_SECP256K1_SHA256
+            else "secp256r1"
+        )
+        if on_mesh:
+            mask = mesh_v.dispatch_ecdsa_rows(
+                curve, keys, sigs, msgs, min_bucket=min_bucket
+            )
+        else:
+            from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+
+            mask = ecdsa_verify_dispatch(
+                curve, keys, sigs, msgs, min_bucket=min_bucket
+            )
+    start_host_copy(mask)
+    pending._deferred.append(
+        (idxs, mask, lambda: _host_verify_bucket(pending, rows, idxs))
+    )
+    pending.device_rows += len(idxs)
 
 
 def verify_signature_rows(
@@ -270,8 +330,11 @@ class PendingTxCheck:
             } - set(self._allowed[t])
             if missing:
                 results[t] = SignaturesMissingException(missing, stx.id)
+        # a collect-time failover shrinks the pending's device count; the
+        # report reflects where the rows actually settled
         return BatchVerifyReport(
-            results, n_sigs=len(self._row_tx), n_device=self._n_device
+            results, n_sigs=len(self._row_tx),
+            n_device=min(self._n_device, self._pending.device_rows),
         )
 
 
@@ -301,12 +364,8 @@ def dispatch_transactions(
     pending = dispatch_signature_rows(
         rows, use_device=use_device, min_bucket=min_bucket
     )
-    device_schemes = _effective_device_schemes(use_device)
-    n_device = sum(
-        1 for key, _s, _m in rows if key.scheme_id in device_schemes
-    )
     return PendingTxCheck(
-        stxs, allowed_missing, pending, row_tx, row_sig, n_device
+        stxs, allowed_missing, pending, row_tx, row_sig, pending.device_rows
     )
 
 

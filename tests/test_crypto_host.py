@@ -26,6 +26,24 @@ ALL_SIGNING_SCHEMES = [
     crypto.SPHINCS256_SHA256,
 ]
 
+# ECDSA/RSA have no portable fallback engine: on containers without the
+# 'cryptography' package they raise CryptoError by design (fail loudly,
+# schemes._require_openssl) — skip rather than fail their tests there.
+_OPENSSL_ONLY = {
+    crypto.RSA_SHA256,
+    crypto.ECDSA_SECP256K1_SHA256,
+    crypto.ECDSA_SECP256R1_SHA256,
+}
+requires_openssl = pytest.mark.skipif(
+    not crypto.schemes._HAVE_OPENSSL,
+    reason="needs the 'cryptography' package (no portable engine)",
+)
+
+
+def _skip_without_openssl(scheme_id):
+    if scheme_id in _OPENSSL_ONLY and not crypto.schemes._HAVE_OPENSSL:
+        pytest.skip("scheme needs the 'cryptography' package")
+
 
 # ------------------------------------------------------------ hashing
 
@@ -97,6 +115,7 @@ def test_partial_merkle_tampered_leaf_fails():
 
 @pytest.mark.parametrize("scheme_id", ALL_SIGNING_SCHEMES)
 def test_sign_verify_roundtrip(scheme_id):
+    _skip_without_openssl(scheme_id)
     kp = crypto.generate_keypair(scheme_id)
     msg = b"the quick brown fox"
     sig = crypto.sign(kp.private, msg)
@@ -114,6 +133,7 @@ def test_sign_verify_roundtrip(scheme_id):
      crypto.EDDSA_ED25519_SHA512, crypto.SPHINCS256_SHA256],
 )
 def test_deterministic_derivation(scheme_id):
+    _skip_without_openssl(scheme_id)
     a = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-1")
     b = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-1")
     c = crypto.derive_keypair_from_entropy(scheme_id, b"entropy-2")
@@ -130,6 +150,7 @@ def test_child_key_derivation():
     assert crypto.is_valid(child1.public, sig, b"m")
 
 
+@requires_openssl
 def test_ecdsa_signatures_are_low_s():
     kp = crypto.derive_keypair_from_entropy(crypto.ECDSA_SECP256K1_SHA256, b"e")
     from corda_tpu.crypto.schemes import SECP256K1_N
@@ -147,6 +168,7 @@ def test_unknown_scheme_rejected():
         crypto.generate_keypair(99)
 
 
+@requires_openssl
 def test_public_key_on_curve():
     kp = crypto.generate_keypair(crypto.ECDSA_SECP256R1_SHA256)
     assert crypto.public_key_on_curve(kp.public)
@@ -297,6 +319,7 @@ def test_malformed_composite_key_is_crypto_error_not_crash():
     "scheme_id", [crypto.ECDSA_SECP256K1_SHA256, crypto.ECDSA_SECP256R1_SHA256]
 )
 def test_ecdsa_high_s_twin_rejected(scheme_id):
+    _skip_without_openssl(scheme_id)
     from corda_tpu.crypto.schemes import _order
 
     kp = crypto.derive_keypair_from_entropy(scheme_id, b"malleability")

@@ -12,6 +12,9 @@ from corda_tpu.ops import host_ref
 
 @pytest.fixture(scope="module")
 def batch():
+    pytest.importorskip(
+        "cryptography", reason="the baseline oracle IS OpenSSL"
+    )
     from cryptography.hazmat.primitives.asymmetric import ed25519 as oed
 
     pks, sigs, msgs = [], [], []

@@ -25,16 +25,20 @@ from corda_tpu.parallel import (
 
 
 def _sigs(n, tag=b"mesh"):
-    from cryptography.hazmat.primitives.asymmetric import ed25519 as hostlib
+    # signatures come from the repo's own host signer (OpenSSL when
+    # installed, the portable engine otherwise) — the kernels under test
+    # only care that the (pk, sig, msg) triples are valid RFC 8032
+    from corda_tpu.crypto import EDDSA_ED25519_SHA512, derive_keypair_from_entropy
+    from corda_tpu.crypto import sign as host_sign
 
     pks, sigs, msgs = [], [], []
-    seed = hashlib.sha256(tag).digest()
-    sk = hostlib.Ed25519PrivateKey.from_private_bytes(seed)
-    pk = sk.public_key().public_bytes_raw()
+    kp = derive_keypair_from_entropy(
+        EDDSA_ED25519_SHA512, hashlib.sha256(tag).digest()
+    )
     for i in range(n):
         m = b"CTSG" + hashlib.sha256(tag + i.to_bytes(4, "little")).digest() + bytes(8)
-        pks.append(pk)
-        sigs.append(sk.sign(m))
+        pks.append(kp.public.encoded)
+        sigs.append(host_sign(kp.private, m))
         msgs.append(m)
     return pks, sigs, msgs
 
@@ -107,8 +111,14 @@ class TestMeshVerifier:
 
 
 def _ecdsa_rows(n, scheme_id, tag=b"mesh-ecdsa"):
-    from corda_tpu.crypto.schemes import derive_keypair_from_entropy, sign
+    from corda_tpu.crypto.schemes import (
+        _HAVE_OPENSSL,
+        derive_keypair_from_entropy,
+        sign,
+    )
 
+    if not _HAVE_OPENSSL:
+        pytest.skip("ECDSA signing needs the 'cryptography' package")
     pks, sigs, msgs = [], [], []
     for i in range(n):
         ent = hashlib.sha256(tag + i.to_bytes(4, "little")).digest()

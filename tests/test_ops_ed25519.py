@@ -12,6 +12,7 @@ import random
 
 import numpy as np
 import pytest
+pytest.importorskip("cryptography")  # differential oracle IS OpenSSL
 from cryptography.hazmat.primitives.asymmetric import ed25519 as hostlib
 
 from corda_tpu.ops.ed25519 import L, P, ed25519_verify_batch

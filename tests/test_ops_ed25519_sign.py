@@ -45,6 +45,7 @@ class TestCombTables:
                 assert t2d == 2 * _D * x % P * y % P
 
     def test_expand_seed_matches_openssl_pub(self):
+        pytest.importorskip("cryptography")
         from cryptography.hazmat.primitives.asymmetric import ed25519 as oed
 
         seed = hashlib.sha256(b"seed").digest()
@@ -69,6 +70,7 @@ class TestSignBatch:
     def test_differential_vs_openssl(self, signed_batch):
         """Device signatures are bit-identical to OpenSSL's (deterministic
         RFC 8032) across multiple keys and message lengths."""
+        pytest.importorskip("cryptography")
         from cryptography.hazmat.primitives.asymmetric import ed25519 as oed
 
         seeds, msgs, sigs = signed_batch

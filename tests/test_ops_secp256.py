@@ -8,6 +8,7 @@ import random
 
 import numpy as np
 import pytest
+pytest.importorskip("cryptography")  # differential oracle IS OpenSSL
 from cryptography.hazmat.primitives import hashes, serialization
 from cryptography.hazmat.primitives.asymmetric import ec
 from cryptography.hazmat.primitives.asymmetric.utils import (

@@ -33,6 +33,13 @@ from corda_tpu.node.certificates import (
 )
 from corda_tpu.testing import driver
 
+from corda_tpu.messaging import SECURE_TRANSPORT_AVAILABLE
+
+pytestmark = pytest.mark.skipif(
+    not SECURE_TRANSPORT_AVAILABLE,
+    reason="secure transport needs the 'cryptography' package",
+)
+
 
 class TestCertificates:
     def test_issue_save_load_round_trip(self, tmp_path):

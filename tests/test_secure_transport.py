@@ -23,6 +23,13 @@ from corda_tpu.messaging import (
     SecureChannel,
 )
 
+from corda_tpu.messaging import SECURE_TRANSPORT_AVAILABLE
+
+pytestmark = pytest.mark.skipif(
+    not SECURE_TRANSPORT_AVAILABLE,
+    reason="secure transport needs the 'cryptography' package",
+)
+
 
 def _name(org):
     return CordaX500Name(org, "London", "GB")

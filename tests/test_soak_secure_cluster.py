@@ -14,6 +14,7 @@ raft-notary demo's cluster.
 """
 
 import time
+from concurrent.futures import TimeoutError as FutureTimeoutError
 
 import pytest
 
@@ -135,3 +136,138 @@ class TestSecureClusterSoak:
             assert sum(
                 sr.state.data.amount.quantity for sr in apage.states
             ) == 0, "alice kept cash that was spent"
+
+
+@pytest.mark.slow
+class TestSeededChaosSoak:
+    """Seeded chaos soak (ISSUE 1 tentpole acceptance): a FaultPlan drives
+    drop + delay + duplicate + one scheduled replica crash/restart against
+    a durable 3-replica Raft notary cluster while a commit storm (with
+    deliberate client re-submissions and double-spend attempts) runs.
+    The run must end with every honest commit applied exactly once, every
+    double-spend rejected, and bit-identical uniqueness state on all
+    replicas — and the plan must actually have injected faults."""
+
+    def test_chaos_storm_converges_to_identical_state(self, tmp_path):
+        from corda_tpu.crypto import SecureHash
+        from corda_tpu.faultinject import (
+            ChaosOrchestrator,
+            CrashEvent,
+            FaultInjector,
+            FaultPlan,
+        )
+        from corda_tpu.ledger import StateRef
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.notary import NotaryError, RaftUniquenessProvider
+
+        def ref(n):
+            return StateRef(SecureHash(n.to_bytes(2, "big") * 16), 0)
+
+        def tx(n):
+            return SecureHash((10_000 + n).to_bytes(2, "big") * 16)
+
+        plan = FaultPlan(
+            seed=2026, drop_p=0.08, delay_p=0.12, duplicate_p=0.1,
+            crashes=(CrashEvent(at_round=500, node="s1", down_rounds=2500),),
+        )
+        inj = FaultInjector(plan)
+        net = InMemoryMessagingNetwork(fault_injector=inj)
+        orch = ChaosOrchestrator(net, inj)
+        names = ["s0", "s1", "s2"]
+        storage = str(tmp_path)
+        providers = {
+            n: RaftUniquenessProvider.make_node(n, names, net, storage)
+            for n in names
+        }
+        for p in providers.values():
+            p.node.start()
+
+        def stop_s1():
+            providers["s1"].close()
+            net.stop_node("s1")
+
+        def restart_s1():
+            endpoint = net.restart_node("s1")
+            providers["s1"] = RaftUniquenessProvider.make_node_on_endpoint(
+                "s1", names, endpoint, storage_path=f"{storage}/s1.db",
+                election_timeout_s=(0.15, 0.3), heartbeat_s=0.05,
+            )
+            providers["s1"].node.start()
+
+        orch.register("s1", stop_s1, restart_s1)
+        net.start_pumping()
+        n_tx = 40
+        try:
+            def commit_retrying(provider, refs, tx_id):
+                deadline = time.monotonic() + 60
+                while True:
+                    try:
+                        provider.commit(refs, tx_id, "chaos-soak")
+                        return None
+                    except NotaryError as e:
+                        if "already consumed" in str(e):
+                            return e
+                        if time.monotonic() > deadline:
+                            raise
+                    except (TimeoutError, FutureTimeoutError):
+                        if time.monotonic() > deadline:
+                            raise
+                    time.sleep(0.05)
+
+            for i in range(n_tx):
+                assert commit_retrying(
+                    providers["s0"], [ref(i)], tx(i)
+                ) is None
+                if i % 5 == 0:
+                    # client retry of the SAME tx (lost-response replay):
+                    # must return the original success, not double-spend
+                    assert commit_retrying(
+                        providers["s0"], [ref(i)], tx(i)
+                    ) is None
+                if i % 7 == 0:
+                    # a DIFFERENT tx spending the same input must conflict
+                    assert commit_retrying(
+                        providers["s0"], [ref(i)], tx(1000 + i)
+                    ) is not None
+                time.sleep(0.01)
+
+            # the scheduled crash must have fired during (or right after)
+            # the storm; then wait out the restart
+            deadline = time.monotonic() + 90
+            while not any(e.kind == "crash" for e in inj.trace):
+                assert time.monotonic() < deadline, "crash never fired"
+                time.sleep(0.1)
+            while "s1" in orch.down:
+                assert time.monotonic() < deadline, "s1 never restarted"
+                time.sleep(0.1)
+
+            def rows(name):
+                return sorted(
+                    tuple(
+                        bytes(c) if isinstance(c, (bytes, bytearray)) else c
+                        for c in row
+                    )
+                    for row in providers[name].node._storage.dump_map()
+                )
+
+            deadline = time.monotonic() + 90
+            while True:
+                state = [rows(n) for n in names]
+                if len(state[0]) == n_tx and state[0] == state[1] == state[2]:
+                    break
+                assert time.monotonic() < deadline, (
+                    "replicas did not converge: "
+                    f"{[len(s) for s in state]}"
+                )
+                time.sleep(0.25)
+            # the plan actually exercised the cluster
+            kinds = {e.kind for e in inj.trace}
+            assert "crash" in kinds and "restart" in kinds
+            assert kinds & {"drop", "delay", "duplicate"}
+        finally:
+            for p in providers.values():
+                try:
+                    p.close()
+                except Exception:
+                    pass
+            net.stop_pumping()

@@ -137,6 +137,8 @@ class TestBatchCheck:
         scheme-bucketed device dispatch on CPU-backed kernels."""
         from corda_tpu.crypto import schemes as cs
 
+        if not cs._HAVE_OPENSSL:
+            pytest.skip("ECDSA signing needs the 'cryptography' package")
         rows, want = [], []
         for sid in (
             cs.EDDSA_ED25519_SHA512,
