@@ -970,6 +970,105 @@ def _apply_cached(p: _Partial) -> None:
         p.data["value_is_cached"] = True
 
 
+def run_smoke() -> int:
+    """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
+    serving scheduler's end-to-end paths — immediate dispatch on an idle
+    scheduler, cross-client coalescing, the notary window and verifier
+    service routed through the scheduler, and a wavefront resolve — so a
+    scheduler regression fails tier-1 tests (tests/test_serving.py runs
+    this as a subprocess), not just the TPU bench. Prints ONE JSON line
+    with ``ok`` plus the observed occupancy/latency; exit code 0 iff ok.
+    No device init: every dispatch routes use_device=False."""
+    from corda_tpu.crypto import TransactionSignature, generate_keypair, sign
+    from corda_tpu.parallel.wavefront import verify_transaction_dag
+    from corda_tpu.serving import INTERACTIVE, DeviceScheduler
+    from corda_tpu.verifier import BatchedVerifierService
+
+    out: dict = {"metric": "serving_smoke", "unit": "checks", "ok": False}
+    t_all = time.perf_counter()
+    try:
+        sched = DeviceScheduler(
+            use_device_default=False
+        )
+        kp = generate_keypair()
+        rows = []
+        for i in range(32):
+            msg = b"smoke-%d" % i
+            rows.append((kp.public, sign(kp.private, msg), msg))
+        # 1. idle scheduler: a single request must dispatch immediately
+        # (no batching window to wait out)
+        t0 = time.perf_counter()
+        rr = sched.submit_rows(
+            rows[:1], priority=INTERACTIVE, use_device=False
+        ).result(timeout=30)
+        out["idle_dispatch_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        assert rr.mask.tolist() == [True]
+        assert out["idle_dispatch_ms"] < 1000, "idle dispatch waited a window"
+        # 2. cross-client coalescing: concurrent singleton submits form
+        # one multi-request batch (deterministic via the pause hook)
+        sched.pause()
+        futs = [
+            sched.submit_rows([r], use_device=False) for r in rows
+        ]
+        sched.resume()
+        results = [f.result(timeout=30) for f in futs]
+        assert all(r.mask.tolist() == [True] for r in results)
+        seqs = {r.batch_seq for r in results}
+        out["coalesced_requests"] = len(results)
+        out["device_batches"] = len(seqs)
+        out["max_batch_occupancy"] = max(
+            sum(1 for r in results if r.batch_seq == s) for s in seqs
+        )
+        assert out["max_batch_occupancy"] > 1, "no cross-request coalescing"
+        sched.shutdown()
+
+        # 3. notary window through the process-global scheduler
+        moves, resolve, notary_id = make_notary_stream(24)
+        from corda_tpu.notary import (
+            BatchedNotaryService, PersistentUniquenessProvider,
+        )
+
+        svc = BatchedNotaryService(
+            notary_id[0], notary_id[1], PersistentUniquenessProvider(),
+            use_device=False, validating=True, max_batch=32,
+        )
+        t0 = time.perf_counter()
+        res = svc.process_batch([(stx, resolve, "smoke") for stx in moves])
+        out["notary_txs"] = sum(
+            1 for r in res if isinstance(r, TransactionSignature)
+        )
+        out["notary_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        assert out["notary_txs"] == len(moves), res
+        svc.shutdown()
+
+        # 4. verifier service routed through the scheduler
+        vsvc = BatchedVerifierService(use_device=False)
+        futs = [
+            vsvc.verify_signed(stx, None, {notary_id[0].owning_key})
+            for stx in moves[:8]
+        ]
+        for f in futs:
+            assert f.result(timeout=30) is None
+        out["verifier_txs"] = len(futs)
+        vsvc.shutdown()
+
+        # 5. wavefront resolve through the scheduler
+        chain, chain_notary = make_back_chain(24)
+        dag = verify_transaction_dag(
+            {s.id: s for s in chain},
+            allowed_missing_fn=lambda s: {chain_notary.owning_key},
+            use_device=False,
+        )
+        out["dag_txs"] = len(dag.order)
+        assert out["dag_txs"] == len(chain)
+        out["ok"] = True
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    out["total_s"] = round(time.perf_counter() - t_all, 2)
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
 def main() -> int:
     p = _Partial()
 
@@ -1141,4 +1240,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
+    if "--smoke" in sys.argv[1:]:
+        sys.exit(run_smoke())
     sys.exit(main())

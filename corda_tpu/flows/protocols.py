@@ -67,6 +67,19 @@ class FetchRequest:
     hashes: tuple = ()
 
 
+def _verify_sigs(flow: FlowLogic, stx: SignedTransaction, allowed: set) -> None:
+    """Flow-side signature verification: routed through the serving
+    scheduler (INTERACTIVE class) when the node's ServiceHub runs the
+    device-batched verifier tier — concurrent flows' singleton verifies
+    then coalesce into one device batch — and the plain host check
+    otherwise (identical verdicts either way)."""
+    services = getattr(flow, "services", None)
+    if services is not None and hasattr(services, "verify_stx_signatures"):
+        services.verify_stx_signatures(stx, allowed)
+    else:
+        stx.verify_signatures_except(allowed)
+
+
 # --------------------------------------------------------------- vending
 
 def vend_data(flow: FlowLogic, session: FlowSession,
@@ -293,7 +306,7 @@ class ReceiveTransactionFlow(FlowLogic):
                 # completeness is relaxed by the caller's allowed set + notary
                 if stx.notary is not None:
                     allowed.add(stx.notary.owning_key)
-            stx.verify_signatures_except(allowed)
+            _verify_sigs(self, stx, allowed)
         if self.check_contracts:
             ltx = self.services.resolve_to_ledger_transaction(stx)
             ltx.verify()
@@ -323,7 +336,7 @@ class NotaryFlowClient(FlowLogic):
         notary = stx.notary
         if notary is None:
             raise NotaryException("transaction names no notary")
-        stx.verify_signatures_except({notary.owning_key})
+        _verify_sigs(self, stx, {notary.owning_key})
         session = self.initiate_flow(notary)
         validating = self.services.network_map_cache.is_validating_notary(notary)
         if validating:
@@ -471,7 +484,7 @@ class FinalityFlow(FlowLogic):
         stx = self.stx
         notary = stx.notary
         allowed = {notary.owning_key} if notary is not None else set()
-        stx.verify_signatures_except(allowed)
+        _verify_sigs(self, stx, allowed)
         ltx = self.services.resolve_to_ledger_transaction(stx)
         ltx.verify()
 
@@ -543,7 +556,7 @@ class CollectSignaturesFlow(FlowLogic):
                     )
             stx = stx.plus(sigs)
         allowed = {notary_key} if notary_key is not None else set()
-        stx.verify_signatures_except(allowed)
+        _verify_sigs(self, stx, allowed)
         return stx
 
 

@@ -170,6 +170,15 @@ class MetricRegistry:
         with self._lock:
             return {k: v.snapshot() for k, v in sorted(self._metrics.items())}
 
+    def section(self, prefix: str) -> dict:
+        """Snapshot of the metrics under one dotted prefix, keys
+        relativized (``section("serving.")`` → ``{"batches": ...}``)."""
+        snap = self.snapshot()
+        return {
+            k[len(prefix):]: v for k, v in snap.items()
+            if k.startswith(prefix)
+        }
+
 
 # Process-global registry for cross-cutting health events that happen
 # below any service object holding its own registry — currently the
@@ -182,3 +191,19 @@ _process_registry = MetricRegistry()
 
 def node_metrics() -> MetricRegistry:
     return _process_registry
+
+
+def monitoring_snapshot() -> dict:
+    """The process-wide observability snapshot, sectioned for the RPC/shell
+    surface: ``serving`` holds the device scheduler's queue/batch/shed
+    counters and gauges (corda_tpu/serving — queue depth & rows, wait
+    time, batch occupancy & latency, shed/rejected counts, failovers),
+    ``process`` the remaining cross-cutting metrics (e.g. the verifier's
+    ``device_failover`` counters)."""
+    return {
+        "serving": _process_registry.section("serving."),
+        "process": {
+            k: v for k, v in _process_registry.snapshot().items()
+            if not k.startswith("serving.")
+        },
+    }

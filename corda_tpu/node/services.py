@@ -109,6 +109,45 @@ class ServiceHub:
                 for fn in list(self._commit_listeners):
                     fn(stx)
 
+    # -- signature verification routing ---------------------------------------
+
+    def verify_stx_signatures(self, stx, allowed_missing=frozenset()) -> None:
+        """Signature-set + signer-set validation of one transaction for the
+        flow hot path. When this node runs the device-batched verifier
+        tier, the check routes through the process-global serving
+        scheduler (INTERACTIVE class) so concurrent flows' singleton
+        verifies coalesce with verifier/notary batches into one device
+        dispatch instead of paying a host loop each. Verdicts match
+        ``stx.verify_signatures_except`` exactly (pass/fail per tx);
+        invalid signatures surface as the batch tier's
+        ``InvalidSignatureError``. Overload or a shut-down scheduler sheds
+        to the direct host path."""
+        allowed = set(allowed_missing)
+        svc = self.transaction_verifier_service
+        if getattr(svc, "routes_via_scheduler", False):
+            from concurrent.futures import TimeoutError as _FutTimeout
+
+            from corda_tpu.serving import (
+                INTERACTIVE,
+                ServingError,
+                device_scheduler,
+            )
+
+            try:
+                report = device_scheduler().submit_transactions(
+                    [stx], [allowed], priority=INTERACTIVE,
+                    use_device=getattr(svc, "use_device", False),
+                ).result(timeout=120)
+            except (ServingError, _FutTimeout):
+                # explicit shed (admission reject / shutdown race) or a
+                # wedged scheduler: the flow must not fail on overload —
+                # fall through to the direct host check (idempotent)
+                pass
+            else:
+                report.raise_first()
+                return
+        stx.verify_signatures_except(allowed)
+
     # -- signing (reference: ServiceHub.signInitialTransaction :187-209) ------
 
     def _keypair_for(self, public_key=None) -> KeyPair:

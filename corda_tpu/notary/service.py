@@ -201,12 +201,14 @@ class BatchedNotaryService(NotaryService):
         self, identity, keypair, uniqueness, *,
         max_batch: int = 1024, window_s: float = 0.005,
         use_device: bool = True, validating: bool = True,
+        use_scheduler: bool = True,
         metrics=None, clock=time.time,
     ):
         super().__init__(identity, keypair, uniqueness, clock)
         self._max_batch = max_batch
         self._window_s = window_s
         self._use_device = use_device
+        self._use_scheduler = use_scheduler
         self._validating = validating
         self._metrics = metrics
         self._pending: list[_PendingRequest] = []
@@ -278,6 +280,30 @@ class BatchedNotaryService(NotaryService):
 
             n_rows = sum(len(r[0].sigs) for r in requests)
             use_device = device_verify_worthwhile(n_rows)
+        if self._use_scheduler:
+            # route the window through the process-global serving
+            # scheduler (BULK class): its continuous-batching loop
+            # coalesces this window with concurrent verifier/flow traffic
+            # and keeps up to its pipeline depth in flight — the same
+            # round-trip overlap process_stream arranged privately. The
+            # routing verdict (device vs host after the break-even gate)
+            # travels with the request; host windows coalesce too.
+            from corda_tpu.serving import (
+                BULK,
+                FuturePending,
+                ServingError,
+                device_scheduler,
+            )
+
+            try:
+                return FuturePending(device_scheduler().submit_transactions(
+                    [r[0] for r in requests],
+                    [{self.identity.owning_key}] * len(requests),
+                    priority=BULK, use_device=use_device,
+                    min_bucket=self._max_batch if use_device else None,
+                ))
+            except ServingError:
+                pass  # saturated/closed: degrade to the direct dispatch
         return dispatch_transactions(
             [r[0] for r in requests],
             [{self.identity.owning_key}] * len(requests),

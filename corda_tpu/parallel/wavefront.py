@@ -85,6 +85,7 @@ def verify_transaction_dag(
     recompute_ids: bool = True,
     window: int = 256,
     depth: int = 3,
+    use_scheduler: bool = True,
 ) -> DagVerifyResult:
     """Verify a set of interdependent SignedTransactions wavefront-parallel.
 
@@ -192,16 +193,35 @@ def verify_transaction_dag(
 
     def dispatch_window(win_levels):
         """Order-free work for one window: id recompute-and-check, then
-        the scheme-bucketed signature batch (enqueued, not collected)."""
+        the scheme-bucketed signature batch (enqueued, not collected).
+        The signature batch rides the process-global serving scheduler
+        (SERVICE class) so resolve sweeps coalesce with concurrent
+        notary/verifier/flow traffic; a saturated or shut-down scheduler
+        degrades to the direct dispatch with identical verdicts."""
         tids = [tid for lvl in win_levels for tid in lvl]
         if check_ids:
             from corda_tpu.ops.txid import check_and_prime_ids
 
             check_and_prime_ids({tid: stxs[tid] for tid in tids})
         win_stxs = [stxs[tid] for tid in tids]
+        allowed = [allowed_for(s) for s in win_stxs]
+        if use_scheduler:
+            from corda_tpu.serving import (
+                SERVICE,
+                FuturePending,
+                ServingError,
+                device_scheduler,
+            )
+
+            try:
+                return FuturePending(device_scheduler().submit_transactions(
+                    win_stxs, allowed, priority=SERVICE,
+                    use_device=use_device,
+                ))
+            except ServingError:
+                pass
         return dispatch_transactions(
-            win_stxs, [allowed_for(s) for s in win_stxs],
-            use_device=use_device,
+            win_stxs, allowed, use_device=use_device,
         )
 
     def walk_window(win_levels, pending):

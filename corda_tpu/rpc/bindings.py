@@ -539,6 +539,38 @@ def sum_amounts(states: ObservableList, token) -> ObservableValue:
     return out
 
 
+class PolledValue(ObservableValue):
+    """A pull-refreshed observable: wraps a read callable; ``refresh()``
+    re-reads and emits on change. The binding shape for RPC surfaces that
+    are snapshots rather than push feeds (metrics, counts) — consumers
+    compose it with the usual combinators (``map``, ``combine``) and a
+    caller-owned refresh cadence."""
+
+    def __init__(self, read: Callable):
+        super().__init__(read())
+        self._read = read
+
+    def refresh(self):
+        value = self._read()
+        self.set(value)
+        return value
+
+
+def serving_metrics_value(proxy) -> PolledValue:
+    """Live read binding over the node's serving-scheduler metrics
+    (``CordaRPCOps.serving_metrics``): queue depth/rows, wait time, batch
+    occupancy/latency, shed + rejected counts — the ``serving`` section
+    of the monitoring snapshot as an ObservableValue the explorer/shell
+    widgets fold into their views."""
+    return PolledValue(lambda: proxy.serving_metrics())
+
+
+def monitoring_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the full sectioned monitoring snapshot
+    (``serving`` / ``process`` / ``node``)."""
+    return PolledValue(lambda: proxy.monitoring_snapshot())
+
+
 # ------------------------------------------------------------- model tier
 
 class NodeMonitorModel:

@@ -161,6 +161,26 @@ class CordaRPCOps:
         self._services.validated_transactions.untrack(callback)
         self._services.network_map_cache.untrack(callback)
 
+    # -------------------------------------------------------- monitoring
+    def monitoring_snapshot(self) -> dict:
+        """Process + node metrics, sectioned (reference: the Codahale
+        registry MonitoringService exposes over JMX). ``serving`` is the
+        device scheduler's queue/batch/shed surface (docs/SERVING.md),
+        ``process`` the remaining process-global counters, ``node`` this
+        node's own registry (notary meters etc.)."""
+        from corda_tpu.node.monitoring import monitoring_snapshot
+
+        snap = monitoring_snapshot()
+        snap["node"] = self._services.metrics.snapshot()
+        return snap
+
+    def serving_metrics(self) -> dict:
+        """Just the ``serving`` section — the operator's first read on a
+        slow hot path (queue depth, wait time, batch occupancy, sheds)."""
+        from corda_tpu.node.monitoring import node_metrics
+
+        return node_metrics().section("serving.")
+
     # -------------------------------------------------------------- misc
     def current_node_time(self) -> float:
         return (

@@ -1,0 +1,48 @@
+"""Serving layer — the continuous-batching device scheduler shared by the
+verifier, notary, and flow hot paths (docs/SERVING.md).
+
+One process-global dispatch queue in front of the signature kernels:
+requests from concurrent clients coalesce into shape-bucketed device
+batches with priority classes, deadlines, backpressure, and adaptive
+batch sizing — the request-coalescing layer the committee-consensus EdDSA
+and FPGA ECDSA verification-engine papers (PAPERS.md) credit for their
+throughput, and the role the reference delegates to the Artemis verifier
+queue in front of OutOfProcessTransactionVerifierService.
+"""
+
+from .scheduler import (
+    BULK,
+    INTERACTIVE,
+    SERVICE,
+    DeadlineExceededError,
+    DeviceScheduler,
+    FuturePending,
+    RowResult,
+    SchedulerClosedError,
+    SchedulerSaturatedError,
+    ServingError,
+    configure_scheduler,
+    device_scheduler,
+    shutdown_scheduler,
+)
+from .shapes import DEFAULT_SHAPES, ShapeTable, load_shape_table, shape_table
+
+__all__ = [
+    "BULK",
+    "INTERACTIVE",
+    "SERVICE",
+    "DeadlineExceededError",
+    "DeviceScheduler",
+    "FuturePending",
+    "RowResult",
+    "SchedulerClosedError",
+    "SchedulerSaturatedError",
+    "ServingError",
+    "configure_scheduler",
+    "device_scheduler",
+    "shutdown_scheduler",
+    "DEFAULT_SHAPES",
+    "ShapeTable",
+    "load_shape_table",
+    "shape_table",
+]

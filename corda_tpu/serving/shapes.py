@@ -1,0 +1,102 @@
+"""Compiled-shape buckets for the device scheduler.
+
+Every device kernel in ``corda_tpu/ops`` compiles once per pad bucket
+(``_blockpack.pow2_at_least`` — power-of-two row counts, floored at the
+pallas block width). The scheduler must never hand XLA a shape it has not
+seen before mid-traffic: a ragged batch size on a tunneled backend costs a
+multi-minute remote compile in the middle of request latency (the r4
+trader capture lost a whole section to exactly one fresh Mosaic shape).
+
+So the shape set is DATA, not code: ``tools_block_sweep.py`` measures the
+kernels on the real chip and emits its chosen block widths + bucket ladder
+to the checked-in ``shapes.json`` next to this module; the scheduler loads
+it at startup. When the file is missing or unreadable the built-in default
+below applies — the same pow-of-two ladder the kernels would derive on
+their own, so behavior degrades to the status quo, never to a crash.
+
+Override precedence: ``CORDA_TPU_SERVING_SHAPES`` (path to a JSON file)
+> checked-in ``shapes.json`` > ``DEFAULT_SHAPES``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+# Safe built-in default: the bucket ladder implied by the production
+# pallas block width (128) up to the bench batch shape (8192). Matches
+# what bucket_floor()/pow2_at_least() would produce today, so loading
+# nothing changes nothing.
+DEFAULT_SHAPES: dict = {
+    "source": "built-in default",
+    "ed25519_block": 128,
+    "ecdsa_block": 128,
+    "buckets": [128, 256, 512, 1024, 2048, 4096, 8192],
+}
+
+_SHAPES_PATH = os.path.join(os.path.dirname(__file__), "shapes.json")
+
+
+class ShapeTable:
+    """The scheduler's pad-bucket chooser: ``bucket_for(n)`` returns the
+    smallest configured bucket ≥ n (None when n exceeds the ladder — the
+    kernels then fall back to their own pow2 padding)."""
+
+    def __init__(self, data: dict):
+        buckets = data.get("buckets") or DEFAULT_SHAPES["buckets"]
+        self.buckets: list[int] = sorted(
+            int(b) for b in buckets if int(b) > 0
+        ) or list(DEFAULT_SHAPES["buckets"])
+        self.source: str = str(data.get("source", "unknown"))
+        self.data = dict(data)
+
+    def bucket_for(self, n_rows: int, floor: int | None = None) -> int | None:
+        """Smallest bucket covering ``n_rows`` (and ``floor``, a caller
+        hint such as the notary's pinned window size)."""
+        want = max(n_rows, floor or 0)
+        for b in self.buckets:
+            if b >= want:
+                return b
+        return None
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if isinstance(data, dict) and data.get("buckets"):
+            return data
+    except Exception:
+        pass
+    return None
+
+
+def load_shape_table() -> ShapeTable:
+    """Resolve the shape table by the documented precedence. Never raises:
+    a corrupt or missing file yields the built-in default."""
+    override = os.environ.get("CORDA_TPU_SERVING_SHAPES", "").strip()
+    for path in ([override] if override else []) + [_SHAPES_PATH]:
+        data = _read_json(path)
+        if data is not None:
+            data.setdefault("source", path)
+            return ShapeTable(data)
+    return ShapeTable(dict(DEFAULT_SHAPES))
+
+
+_cached: ShapeTable | None = None
+_cache_lock = threading.Lock()
+
+
+def shape_table() -> ShapeTable:
+    """Process-cached table (one file read per process)."""
+    global _cached
+    if _cached is None:
+        with _cache_lock:
+            if _cached is None:
+                _cached = load_shape_table()
+    return _cached

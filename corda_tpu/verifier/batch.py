@@ -30,6 +30,7 @@ from corda_tpu.crypto import (
     ECDSA_SECP256R1_SHA256,
     EDDSA_ED25519_SHA512,
     SPHINCS256_SHA256,
+    CryptoError,
     SecureHash,
     TransactionSignature,
     is_fulfilled_by,
@@ -100,13 +101,17 @@ class PendingRows:
     response-sign tiering) track reality rather than intent.
     """
 
-    __slots__ = ("_n", "_deferred", "_out", "device_rows")
+    __slots__ = ("_n", "_deferred", "_out", "device_rows", "device_mask")
 
     def __init__(self, n: int):
         self._n = n
         self._deferred: list[tuple[list[int], object, object]] = []
         self._out = np.zeros(n, dtype=bool)
         self.device_rows = 0
+        # per-row attribution of where the verdict settled (the serving
+        # scheduler slices coalesced multi-client batches back apart and
+        # needs per-request device counts, not just the batch total)
+        self.device_mask = np.zeros(n, dtype=bool)
 
     def collect(self) -> np.ndarray:
         for idxs, mask, fallback in self._deferred:
@@ -115,6 +120,7 @@ class PendingRows:
             except Exception:
                 _note_device_failover(len(idxs), "collect")
                 self.device_rows -= len(idxs)
+                self.device_mask[idxs] = False
                 fallback()
         self._deferred = []
         return self._out
@@ -255,6 +261,7 @@ def _dispatch_device_bucket(
         (idxs, mask, lambda: _host_verify_bucket(pending, rows, idxs))
     )
     pending.device_rows += len(idxs)
+    pending.device_mask[idxs] = True
 
 
 def verify_signature_rows(
@@ -274,6 +281,10 @@ class BatchVerifyReport:
     results: list  # Exception | None per transaction (None = ok)
     n_sigs: int
     n_device: int
+    # device-batch sequence number when the check went through the serving
+    # scheduler (requests coalesced into one device batch share it); None
+    # on the direct dispatch path
+    batch_seq: int | None = None
 
     @property
     def ok(self) -> bool:
@@ -285,7 +296,12 @@ class BatchVerifyReport:
                 raise r
 
 
-class InvalidSignatureError(Exception):
+class InvalidSignatureError(CryptoError):
+    """A signature failed batch verification. A CryptoError subclass so
+    callers catching the direct path's per-signature failure
+    (``TransactionSignature.verify`` → CryptoError) see the same
+    hierarchy whichever verifier tier served the check."""
+
     def __init__(self, tx_id: SecureHash, sig: TransactionSignature):
         self.tx_id = tx_id
         self.sig = sig
@@ -308,34 +324,59 @@ class PendingTxCheck:
         self._n_device = n_device
 
     def collect(self) -> BatchVerifyReport:
-        stxs = self._stxs
         mask = self._pending.collect()
-        results: list = [None] * len(stxs)
-        # first invalid signature per tx wins (matches the sequential
-        # reference loop's first-throw behavior)
-        for i, valid in enumerate(mask):
-            t = self._row_tx[i]
-            if not valid and results[t] is None:
-                results[t] = InvalidSignatureError(
-                    stxs[t].id, stxs[t].sigs[self._row_sig[i]]
-                )
-        for t, stx in enumerate(stxs):
-            if results[t] is not None:
-                continue
-            signed_by = {s.by for s in stx.sigs}
-            missing = {
-                k
-                for k in stx.required_signing_keys
-                if not is_fulfilled_by(k, signed_by)
-            } - set(self._allowed[t])
-            if missing:
-                results[t] = SignaturesMissingException(missing, stx.id)
         # a collect-time failover shrinks the pending's device count; the
         # report reflects where the rows actually settled
-        return BatchVerifyReport(
-            results, n_sigs=len(self._row_tx),
-            n_device=min(self._n_device, self._pending.device_rows),
+        return tx_report_from_mask(
+            self._stxs, self._allowed, mask, self._row_tx, self._row_sig,
+            min(self._n_device, self._pending.device_rows),
         )
+
+
+def flatten_signature_rows(stxs: list[SignedTransaction]):
+    """Flatten many transactions' signature triples into one row list plus
+    the row→(tx, sig) back-maps — the feed shape of every bucketed
+    dispatch (direct or through the serving scheduler)."""
+    rows: list[tuple] = []
+    row_tx: list[int] = []
+    row_sig: list[int] = []
+    for t, stx in enumerate(stxs):
+        for j, (key, sig, msg) in enumerate(stx.signature_triples()):
+            rows.append((key, sig, msg))
+            row_tx.append(t)
+            row_sig.append(j)
+    return rows, row_tx, row_sig
+
+
+def tx_report_from_mask(
+    stxs, allowed, mask, row_tx, row_sig, n_device, batch_seq=None,
+) -> BatchVerifyReport:
+    """The per-transaction signer-set algebra over a row verdict mask —
+    shared by the direct path (``PendingTxCheck``) and the serving
+    scheduler so both produce identical reports by construction."""
+    results: list = [None] * len(stxs)
+    # first invalid signature per tx wins (matches the sequential
+    # reference loop's first-throw behavior)
+    for i, valid in enumerate(mask):
+        t = row_tx[i]
+        if not valid and results[t] is None:
+            results[t] = InvalidSignatureError(
+                stxs[t].id, stxs[t].sigs[row_sig[i]]
+            )
+    for t, stx in enumerate(stxs):
+        if results[t] is not None:
+            continue
+        signed_by = {s.by for s in stx.sigs}
+        missing = {
+            k
+            for k in stx.required_signing_keys
+            if not is_fulfilled_by(k, signed_by)
+        } - set(allowed[t])
+        if missing:
+            results[t] = SignaturesMissingException(missing, stx.id)
+    return BatchVerifyReport(
+        results, n_sigs=len(row_tx), n_device=n_device, batch_seq=batch_seq,
+    )
 
 
 def dispatch_transactions(
@@ -352,14 +393,7 @@ def dispatch_transactions(
     if len(allowed_missing) != len(stxs):
         raise ValueError("allowed_missing length mismatch")
 
-    rows: list[tuple] = []
-    row_tx: list[int] = []
-    row_sig: list[int] = []
-    for t, stx in enumerate(stxs):
-        for j, (key, sig, msg) in enumerate(stx.signature_triples()):
-            rows.append((key, sig, msg))
-            row_tx.append(t)
-            row_sig.append(j)
+    rows, row_tx, row_sig = flatten_signature_rows(stxs)
 
     pending = dispatch_signature_rows(
         rows, use_device=use_device, min_bucket=min_bucket

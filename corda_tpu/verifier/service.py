@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future, ThreadPoolExecutor
 
 from corda_tpu.ledger import LedgerTransaction, SignedTransaction
@@ -65,19 +66,36 @@ class InMemoryVerifierService(TransactionVerifierService):
 
 
 class _Pending:
-    __slots__ = ("stx", "resolve_state", "allowed_missing", "future")
+    __slots__ = ("stx", "resolve_state", "allowed_missing", "future",
+                 "arrived")
 
     def __init__(self, stx, resolve_state, allowed_missing, future):
         self.stx = stx
         self.resolve_state = resolve_state
         self.allowed_missing = allowed_missing
         self.future = future
+        # first-arrival timestamp: the flush window is owed from HERE even
+        # when the item is sliced off beyond max_batch and carried into a
+        # later flush decision (the leftover-aging fix)
+        self.arrived = time.monotonic()
 
 
 class BatchedVerifierService(TransactionVerifierService):
-    """The TPU tier: concurrent verify requests accumulate; a flusher thread
-    drains them into one scheme-bucketed device dispatch for every signature
-    plus host-pool contract verification.
+    """The TPU tier. By default (``use_scheduler=True``) every
+    ``verify_signed`` submits straight into the process-global serving
+    scheduler (corda_tpu/serving): coalescing with OTHER clients (notary
+    windows, flow verifies) happens there with continuous batching, so a
+    lone request on an idle device dispatches immediately instead of
+    waiting out ``window_s``, and sustained load still forms full device
+    batches. Contract semantics run on this service's host pool once the
+    signature verdicts land.
+
+    ``use_scheduler=False`` keeps the self-contained windowed flusher
+    (the pre-serving design): requests accumulate and flush as one
+    scheme-bucketed dispatch when ``max_batch`` fills or ``window_s``
+    elapses since the OLDEST pending request's arrival — the window ages
+    with items carried over past a full batch, it never restarts for
+    leftovers.
 
     ``verify_signed`` is the full-tx entry (signatures on device + contract
     semantics); ``verify`` keeps the reference's LedgerTransaction-only
@@ -91,19 +109,37 @@ class BatchedVerifierService(TransactionVerifierService):
         window_s: float = 0.005,
         workers: int = 8,
         use_device: bool = True,
+        use_scheduler: bool = True,
     ):
         self._max_batch = max_batch
         self._window_s = window_s
         self._use_device = use_device
+        self._use_scheduler = use_scheduler
         self._pool = ThreadPoolExecutor(max_workers=workers)
         self._lock = threading.Condition()
         self._queue: list[_Pending] = []
         self._closed = False
-        self._flusher = threading.Thread(
-            target=self._flush_loop, name="verifier-flusher", daemon=True
-        )
-        self._flusher.start()
+        self._outstanding: set[Future] = set()   # scheduler-routed futures
+        # bounded recent-batch dedupe for stats["batches"]: seqs arrive
+        # (nearly) in order, so a small window suffices; an unbounded set
+        # would grow one int per device batch for the service's lifetime
+        self._batch_seqs: set[int] = set()
+        self._batch_seq_order: "deque[int]" = deque(maxlen=4096)
+        self._flusher: threading.Thread | None = None
+        if not use_scheduler:
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="verifier-flusher", daemon=True
+            )
+            self._flusher.start()
         self.stats = {"batches": 0, "txs": 0, "sigs": 0, "device_sigs": 0}
+
+    @property
+    def use_device(self) -> bool:
+        return self._use_device
+
+    @property
+    def routes_via_scheduler(self) -> bool:
+        return self._use_scheduler
 
     # ------------------------------------------------------------- entries
     def verify(self, ltx: LedgerTransaction) -> Future:
@@ -117,7 +153,12 @@ class BatchedVerifierService(TransactionVerifierService):
     ) -> Future:
         """Queue a full verification (device signature batch + host contract
         run when ``resolve_state`` is given). Completes with None or fails
-        with the verification error."""
+        with the verification error. Admission-control rejects from the
+        serving scheduler (bounded queue) propagate synchronously."""
+        if self._use_scheduler:
+            return self._submit_via_scheduler(
+                stx, resolve_state, allowed_missing or set()
+            )
         fut: Future = Future()
         with self._lock:
             if self._closed:
@@ -128,6 +169,72 @@ class BatchedVerifierService(TransactionVerifierService):
             self._lock.notify()
         return fut
 
+    # -------------------------------------------------- scheduler routing
+    def _submit_via_scheduler(self, stx, resolve_state, allowed) -> Future:
+        from corda_tpu.serving import SERVICE, device_scheduler
+
+        with self._lock:
+            if self._closed:
+                raise VerificationError("verifier service is shut down")
+            fut: Future = Future()
+            self._outstanding.add(fut)
+        try:
+            inner = device_scheduler().submit_transactions(
+                [stx], [allowed], priority=SERVICE,
+                use_device=self._use_device,
+            )
+        except Exception:
+            with self._lock:
+                self._outstanding.discard(fut)
+            raise
+
+        def settle(f: Future):
+            try:
+                report = f.result()
+                with self._lock:
+                    self.stats["txs"] += 1
+                    self.stats["sigs"] += report.n_sigs
+                    self.stats["device_sigs"] += report.n_device
+                    if report.batch_seq is not None:
+                        # distinct device batches this service's requests
+                        # landed in — comparable to the old per-flush count
+                        if report.batch_seq not in self._batch_seqs:
+                            if (len(self._batch_seq_order)
+                                    == self._batch_seq_order.maxlen):
+                                self._batch_seqs.discard(
+                                    self._batch_seq_order[0]
+                                )
+                            self._batch_seq_order.append(report.batch_seq)
+                            self._batch_seqs.add(report.batch_seq)
+                            self.stats["batches"] += 1
+                err = report.results[0]
+            except Exception as e:
+                err = e
+
+            def finish():
+                try:
+                    if err is not None:
+                        _complete(fut, error=err)
+                    elif resolve_state is not None:
+                        ltx = stx.tx.to_ledger_transaction(resolve_state)
+                        ltx.verify()
+                        _complete(fut)
+                    else:
+                        _complete(fut)
+                except Exception as e:
+                    _complete(fut, error=e)
+                finally:
+                    with self._lock:
+                        self._outstanding.discard(fut)
+
+            try:
+                self._pool.submit(finish)
+            except RuntimeError:
+                finish()  # pool already shut down: finish inline
+
+        inner.add_done_callback(settle)
+        return fut
+
     # ------------------------------------------------------------- flusher
     def _flush_loop(self) -> None:
         while True:
@@ -136,9 +243,11 @@ class BatchedVerifierService(TransactionVerifierService):
                     self._lock.wait()
                 if self._closed and not self._queue:
                     return
-                # batch-accumulate: wait out the window from the first
-                # arrival unless the batch is already full
-                deadline = time.monotonic() + self._window_s
+                # batch-accumulate: the window is owed from the OLDEST
+                # pending item's arrival (which may predate this loop
+                # iteration when leftovers were sliced off a full batch),
+                # so no request waits more than window_s beyond a free slot
+                deadline = self._queue[0].arrived + self._window_s
                 while (
                     len(self._queue) < self._max_batch
                     and not self._closed
@@ -192,8 +301,19 @@ class BatchedVerifierService(TransactionVerifierService):
                 finish(p, err)
 
     def shutdown(self) -> None:
+        """Stop accepting work; every queued and in-flight future completes
+        (result or error) before this returns. Idempotent — a second
+        shutdown is a no-op."""
         with self._lock:
+            if self._closed:
+                return
             self._closed = True
+            outstanding = list(self._outstanding)
             self._lock.notify_all()
-        self._flusher.join()
+        if self._flusher is not None:
+            self._flusher.join()
+        if outstanding:
+            import concurrent.futures as _cf
+
+            _cf.wait(outstanding, timeout=60)
         self._pool.shutdown(wait=True)

@@ -1,0 +1,696 @@
+"""Serving-layer tests — the continuous-batching device scheduler
+(corda_tpu/serving) and the verifier/notary/flow refactors that submit
+through it (ISSUE 2 acceptance criteria).
+
+Everything runs the host crypto path (use_device=False, or device
+requests failed over by an injected fault before any kernel is touched),
+so failures localize to the scheduling layer; the kernels have their own
+differential suites.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sign, sign_tx_id
+from corda_tpu.faultinject import FaultInjector, FaultPlan
+from corda_tpu.faultinject import clear as clear_injector
+from corda_tpu.faultinject import install as install_injector
+from corda_tpu.ledger import (
+    CordaX500Name,
+    Party,
+    SignedTransaction,
+    StateRef,
+    TransactionBuilder,
+)
+from corda_tpu.ledger.states import register_contract
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.serialization import register_custom
+from corda_tpu.serving import (
+    BULK,
+    INTERACTIVE,
+    SERVICE,
+    DeadlineExceededError,
+    DeviceScheduler,
+    SchedulerClosedError,
+    SchedulerSaturatedError,
+    device_scheduler,
+    load_shape_table,
+    shape_table,
+)
+from corda_tpu.verifier import BatchedVerifierService, VerificationError
+from corda_tpu.verifier.batch import InvalidSignatureError
+
+
+# ------------------------------------------------------------- test ledger
+
+@dataclasses.dataclass(frozen=True)
+class TokenState:
+    value: int
+
+    @property
+    def participants(self):
+        return []
+
+
+@dataclasses.dataclass(frozen=True)
+class TokenCommand:
+    op: str
+
+
+register_custom(
+    TokenState, "serving.TokenState",
+    to_fields=lambda s: {"value": s.value},
+    from_fields=lambda d: TokenState(d["value"]),
+)
+register_custom(
+    TokenCommand, "serving.TokenCommand",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: TokenCommand(d["op"]),
+)
+
+
+@register_contract("serving.TokenContract")
+class TokenContract:
+    def verify(self, tx):
+        if not tx.commands_of_type(TokenCommand):
+            raise ValueError("no TokenCommand")
+
+
+@pytest.fixture(scope="module")
+def notary():
+    kp = generate_keypair()
+    return Party(CordaX500Name("ServingNotary", "Zurich", "CH"), kp.public), kp
+
+
+@pytest.fixture(scope="module")
+def alice():
+    kp = generate_keypair()
+    return Party(CordaX500Name("ServingAlice", "London", "GB"), kp.public), kp
+
+
+def issue_tx(notary, alice, value=100) -> SignedTransaction:
+    b = TransactionBuilder(notary=notary[0])
+    b.add_output_state(TokenState(value), "serving.TokenContract")
+    b.add_command(TokenCommand("issue"), alice[1].public)
+    return b.sign_initial_transaction(alice[1])
+
+
+def move_tx(notary, alice, parent, idx=0):
+    b = TransactionBuilder(notary=notary[0])
+    b._inputs.append(StateRef(parent.id, idx))
+    b._ensure_attachment(parent.tx.outputs[idx].contract)
+    b.add_output_state(
+        TokenState(parent.tx.outputs[idx].data.value),
+        "serving.TokenContract",
+    )
+    b.add_command(TokenCommand("move"), alice[1].public)
+    wtx = b.to_wire_transaction()
+    return SignedTransaction.create(wtx, [
+        sign_tx_id(alice[1].private, alice[1].public, wtx.id),
+        sign_tx_id(notary[1].private, notary[1].public, wtx.id),
+    ])
+
+
+def make_rows(n, tamper=()):
+    kp = generate_keypair()
+    rows = []
+    for i in range(n):
+        msg = b"serving-row-%d" % i
+        s = sign(kp.private, msg)
+        if i in tamper:
+            s = b"\0" * len(s)
+        rows.append((kp.public, s, msg))
+    return rows
+
+
+# ------------------------------------------------------------ shape table
+
+class TestShapes:
+    def test_checked_in_table_loads(self):
+        t = shape_table()
+        assert t.buckets == sorted(t.buckets)
+        assert t.max_bucket >= 4096
+
+    def test_bucket_for(self):
+        t = shape_table()
+        assert t.bucket_for(1) == t.buckets[0]
+        assert t.bucket_for(t.buckets[0] + 1) == t.buckets[1]
+        # floor hint (the notary's pinned window) dominates a small n
+        assert t.bucket_for(3, floor=1024) == 1024
+        # beyond the ladder: None → kernels fall back to pow2 padding
+        assert t.bucket_for(t.max_bucket + 1) is None
+
+    def test_corrupt_override_falls_back_to_default(self, tmp_path,
+                                                    monkeypatch):
+        bad = tmp_path / "shapes.json"
+        bad.write_text("{not json")
+        monkeypatch.setenv("CORDA_TPU_SERVING_SHAPES", str(bad))
+        t = load_shape_table()
+        assert t.buckets  # never raises, never empty
+
+    def test_env_override_wins(self, tmp_path, monkeypatch):
+        override = tmp_path / "shapes.json"
+        override.write_text(json.dumps({"buckets": [64, 4096]}))
+        monkeypatch.setenv("CORDA_TPU_SERVING_SHAPES", str(override))
+        t = load_shape_table()
+        assert t.buckets == [64, 4096]
+
+    def test_block_sweep_shape_chooser(self):
+        from tools_block_sweep import choose_serving_shapes
+
+        results = {
+            "captured_at": "now", "device": "test",
+            "ed25519_block_128": {"sigs_per_sec_median": 100.0},
+            "ed25519_block_256": {"error": "Mosaic"},
+            "ecdsa_k1_block_64": {"error": "pallas"},
+            "ecdsa_k1_block_128": {"sigs_per_sec_median": 50.0},
+        }
+        shapes = choose_serving_shapes(results)
+        assert shapes["ed25519_block"] == 128
+        assert shapes["ecdsa_block"] == 128
+        assert shapes["buckets"][0] == 128
+        assert shapes["buckets"][-1] == 8192
+        # a fully-failed sweep must not overwrite the checked-in table
+        assert choose_serving_shapes({"captured_at": "now"}) is None
+
+
+# -------------------------------------------------------- scheduler core
+
+class TestSchedulerCore:
+    def test_single_request_idle_dispatches_immediately(self):
+        """Acceptance: a single request on an idle scheduler dispatches
+        without waiting out a batching window."""
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            rows = make_rows(1)
+            t0 = time.monotonic()
+            rr = s.submit_rows(rows, priority=INTERACTIVE).result(timeout=10)
+            elapsed = time.monotonic() - t0
+            assert rr.mask.tolist() == [True]
+            # far below any plausible batching window (the old verifier
+            # default was 5 ms, but services pin up to seconds)
+            assert elapsed < 1.0
+        finally:
+            s.shutdown()
+
+    def test_concurrent_threads_coalesce_into_one_batch(self):
+        """Acceptance: N threads submitting single verifies concurrently
+        produce ≥1 multi-request device batch (occupancy > 1)."""
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            rows = make_rows(8, tamper={3})
+            s.pause()
+            results: dict = {}
+
+            def submit(i):
+                results[i] = s.submit_rows([rows[i]]).result(timeout=30)
+
+            threads = [
+                threading.Thread(target=submit, args=(i,)) for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            # let every thread enqueue before the (paused) loop assembles
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and sum(
+                len(q) for q in s._queues.values()
+            ) < 8:
+                time.sleep(0.005)
+            s.resume()
+            for t in threads:
+                t.join(timeout=30)
+            assert sorted(results) == list(range(8))
+            for i, rr in results.items():
+                assert rr.mask.tolist() == [i != 3]
+            seqs = [rr.batch_seq for rr in results.values()]
+            occupancy = max(seqs.count(q) for q in set(seqs))
+            assert occupancy > 1  # one device batch served many requests
+        finally:
+            s.shutdown()
+
+    def test_verdicts_match_direct_path(self, notary, alice):
+        from corda_tpu.verifier import check_transactions
+
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            good = issue_tx(notary, alice, 1)
+            victim = issue_tx(notary, alice, 2)
+            sig = victim.sigs[0]
+            forged = dataclasses.replace(victim, sigs=(dataclasses.replace(
+                sig, signature=b"\0" * len(sig.signature)
+            ),))
+            stripped = dataclasses.replace(
+                move_tx(notary, alice, good), sigs=(
+                    move_tx(notary, alice, good).sigs[0],
+                ),
+            )
+            stxs = [good, forged, stripped]
+            allowed = [set(), set(), set()]
+            direct = check_transactions(stxs, allowed, use_device=False)
+            routed = s.submit_transactions(
+                stxs, allowed, use_device=False
+            ).result(timeout=30)
+            assert [type(r) for r in routed.results] == [
+                type(r) for r in direct.results
+            ]
+            assert routed.n_sigs == direct.n_sigs
+        finally:
+            s.shutdown()
+
+    def test_class_fairness_interactive_rides_first_batch(self):
+        """A bulk backlog cannot starve interactive work: the reserved
+        interactive share puts a late-arriving interactive singleton into
+        the FIRST batch, ahead of queued bulk rows."""
+        s = DeviceScheduler(
+            use_device_default=False,
+            max_batch_rows=8, min_batch_rows=8,
+        )
+        try:
+            s.pause()
+            bulk1 = s.submit_rows(make_rows(6), priority=BULK)
+            bulk2 = s.submit_rows(make_rows(6), priority=BULK)
+            inter = s.submit_rows(make_rows(1), priority=INTERACTIVE)
+            s.resume()
+            r_b1 = bulk1.result(timeout=30)
+            r_b2 = bulk2.result(timeout=30)
+            r_i = inter.result(timeout=30)
+            assert r_i.batch_seq == r_b1.batch_seq  # rode the first batch
+            assert r_b2.batch_seq > r_b1.batch_seq  # second bulk waited
+            assert r_i.mask.all() and r_b1.mask.all() and r_b2.mask.all()
+        finally:
+            s.shutdown()
+
+    def test_over_deadline_work_is_shed(self):
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            shed0 = node_metrics().counter("serving.shed").count
+            s.pause()
+            doomed = s.submit_rows(make_rows(2), deadline_s=0.01)
+            live = s.submit_rows(make_rows(1))
+            time.sleep(0.05)
+            s.resume()
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=30)
+            assert live.result(timeout=30).mask.tolist() == [True]
+            assert node_metrics().counter("serving.shed").count == shed0 + 1
+        finally:
+            s.shutdown()
+
+    def test_bounded_queue_rejects_at_admission(self):
+        s = DeviceScheduler(
+            use_device_default=False,
+            max_queue_rows=4,
+        )
+        try:
+            s.pause()
+            ok = s.submit_rows(make_rows(4))
+            with pytest.raises(SchedulerSaturatedError):
+                s.submit_rows(make_rows(1))
+            s.resume()
+            assert ok.result(timeout=30).mask.all()
+        finally:
+            s.shutdown()
+
+    def test_adaptive_cap_splits_a_deep_queue(self):
+        """Many queued rows split into several capped batches instead of
+        one giant dispatch (the pipeline-depth shape)."""
+        s = DeviceScheduler(
+            use_device_default=False,
+            max_batch_rows=8, min_batch_rows=8,
+        )
+        try:
+            s.pause()
+            futs = [s.submit_rows(make_rows(4)) for _ in range(6)]  # 24 rows
+            s.resume()
+            seqs = {f.result(timeout=30).batch_seq for f in futs}
+            assert len(seqs) >= 3  # 24 rows over a cap of 8 → ≥3 batches
+        finally:
+            s.shutdown()
+
+
+class TestSchedulerLifecycle:
+    def test_shutdown_completes_queued_and_inflight(self):
+        s = DeviceScheduler(use_device_default=False)
+        s.pause()
+        futs = [s.submit_rows(make_rows(1)) for _ in range(4)]
+        # shutdown overrides pause and DRAINS: every queued future resolves
+        s.shutdown()
+        for f in futs:
+            assert f.result(timeout=5).mask.tolist() == [True]
+        assert not s._dispatcher.is_alive()
+        assert not s._collector.is_alive()
+
+    def test_double_shutdown_is_noop(self):
+        s = DeviceScheduler(use_device_default=False)
+        s.shutdown()
+        s.shutdown()  # second call returns immediately, no error
+        with pytest.raises(SchedulerClosedError):
+            s.submit_rows(make_rows(1))
+
+    def test_global_scheduler_replaced_after_shutdown(self):
+        from corda_tpu.serving import shutdown_scheduler
+
+        a = device_scheduler()
+        shutdown_scheduler()
+        b = device_scheduler()
+        try:
+            assert a is not b and a.closed and not b.closed
+        finally:
+            pass  # leave the (healthy) global for later tests
+
+
+# ------------------------------------------------- verifier service tier
+
+class TestVerifierServiceRouting:
+    def test_single_verify_ignores_window(self, notary, alice):
+        """The scheduler-routed service dispatches a lone request
+        immediately even with a pathological window_s configured — the
+        window is a legacy-path knob, not a latency tax."""
+        svc = BatchedVerifierService(window_s=5.0, use_device=False)
+        try:
+            t0 = time.monotonic()
+            fut = svc.verify_signed(issue_tx(notary, alice))
+            assert fut.result(timeout=10) is None
+            assert time.monotonic() - t0 < 2.0
+            assert svc.stats["txs"] == 1
+        finally:
+            svc.shutdown()
+
+    def test_cross_client_coalescing_through_hub_and_service(self, notary,
+                                                             alice):
+        """Three DIFFERENT client kinds — verifier service futures, flow
+        hot-path checks (ServiceHub helper), and a notary window — land
+        in one device batch while the scheduler is held."""
+        from corda_tpu.node import ServiceHub
+        from corda_tpu.notary import (
+            BatchedNotaryService,
+            PersistentUniquenessProvider,
+        )
+
+        sched = device_scheduler()
+        occupancy = node_metrics().timer("serving.batch_occupancy")
+        svc = BatchedVerifierService(use_device=False)
+        hub = ServiceHub(verifier_service=svc)
+        nkp = generate_keypair()
+        nparty = Party(CordaX500Name("CoalesceNotary", "Oslo", "NO"),
+                       nkp.public)
+        notary_svc = BatchedNotaryService(
+            nparty, nkp, PersistentUniquenessProvider(),
+            use_device=False, validating=False,
+        )
+        stx = issue_tx(notary, alice)
+        sched.pause()
+        try:
+            futs = [
+                svc.verify_signed(issue_tx(notary, alice, v), None, set())
+                for v in (11, 12)
+            ]
+            hub_done: list = []
+
+            def via_hub():
+                hub.verify_stx_signatures(stx, set())
+                hub_done.append(True)
+
+            threads = [threading.Thread(target=via_hub) for _ in range(2)]
+            for t in threads:
+                t.start()
+            pending = notary_svc.dispatch_batch(
+                [(issue_tx(notary, alice, 13), None, "coalesce")],
+                pending_ids=None, pipelined=True,
+            )
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and sum(
+                len(q) for q in sched._queues.values()
+            ) < 5:
+                time.sleep(0.005)
+        finally:
+            sched.resume()
+        for f in futs:
+            assert f.result(timeout=30) is None
+        for t in threads:
+            t.join(timeout=30)
+        assert hub_done == [True, True]
+        report = pending.collect()
+        assert report.ok
+        # the batch that served them recorded multi-request occupancy
+        assert occupancy.count > 0
+        assert occupancy._last >= 5 or occupancy._max >= 5
+        notary_svc.shutdown()
+        svc.shutdown()
+
+    def test_shutdown_completes_inflight_futures(self, notary, alice):
+        svc = BatchedVerifierService(use_device=False)
+        futs = [
+            svc.verify_signed(issue_tx(notary, alice, v)) for v in range(6)
+        ]
+        svc.shutdown()
+        for f in futs:
+            assert f.done()
+            assert f.result(timeout=1) is None
+        # double shutdown is a no-op; new submits are refused
+        svc.shutdown()
+        with pytest.raises(VerificationError):
+            svc.verify_signed(issue_tx(notary, alice))
+
+    def test_legacy_shutdown_drains_queue_no_hung_flusher(self, notary,
+                                                          alice):
+        svc = BatchedVerifierService(
+            use_device=False, use_scheduler=False, window_s=0.5
+        )
+        futs = [
+            svc.verify_signed(issue_tx(notary, alice, v)) for v in range(4)
+        ]
+        svc.shutdown()  # drains the queue without waiting the window out
+        for f in futs:
+            assert f.result(timeout=1) is None
+        assert not svc._flusher.is_alive()
+        svc.shutdown()  # no-op
+        with pytest.raises(VerificationError):
+            svc.verify_signed(issue_tx(notary, alice))
+
+    def test_legacy_leftover_window_ages_from_first_arrival(self, notary,
+                                                            alice):
+        """The aging fix: items sliced off beyond max_batch must NOT
+        restart the window — with max_batch=1 and window_s=0.3, four
+        queued requests flush in ~one window, not four."""
+        svc = BatchedVerifierService(
+            use_device=False, use_scheduler=False,
+            max_batch=1, window_s=0.3,
+        )
+        try:
+            t0 = time.monotonic()
+            futs = [
+                svc.verify_signed(issue_tx(notary, alice, v))
+                for v in (21, 22, 23, 24)
+            ]
+            for f in futs:
+                assert f.result(timeout=10) is None
+            elapsed = time.monotonic() - t0
+            # buggy leftover handling waited a fresh window per item
+            # (≥ 0.9 s); the aged window clears all four in ~0.3 s
+            assert elapsed < 0.8, f"leftover window restarted: {elapsed:.2f}s"
+            assert svc.stats["batches"] == 4
+        finally:
+            svc.shutdown()
+
+
+# ----------------------------------------------------- fault-plan failover
+
+class TestServingFaultPlan:
+    def test_injected_dispatch_failure_fails_over_to_host(self):
+        """A seeded FaultPlan failing the serving.dispatch site forces the
+        whole batch onto the host reference path: identical verdicts, a
+        counted failover, a recorded trace event."""
+        s = DeviceScheduler(use_device_default=True)
+        failover0 = node_metrics().counter("serving.device_failover").count
+        inj = install_injector(FaultInjector(FaultPlan(
+            seed=77, fail_sites=(("serving.dispatch", 1),),
+        )))
+        try:
+            rows = make_rows(4, tamper={2})
+            rr = s.submit_rows(rows, use_device=True).result(timeout=30)
+        finally:
+            clear_injector()
+            s.shutdown()
+        assert rr.mask.tolist() == [True, True, False, True]
+        assert rr.n_device == 0  # nothing settled on device
+        assert node_metrics().counter(
+            "serving.device_failover"
+        ).count == failover0 + 1
+        assert any(
+            e.kind == "op-fail" and e.site == "serving.dispatch"
+            for e in inj.trace
+        )
+
+    def test_trader_demo_via_scheduler_under_faultplan(self, request):
+        """Acceptance: the trader-demo path runs through the scheduler
+        with results identical to the direct path, including under a
+        seeded FaultPlan whose device-op failures fail every dispatch
+        over to host."""
+        from corda_tpu.finance import CashIssueFlow, CashState
+        from corda_tpu.samples import trader_demo
+        from corda_tpu.testing import MockNetworkNodes
+
+        n = 3
+        inj = install_injector(FaultInjector(FaultPlan(seed=9, op_fail_p=1.0)))
+        request.addfinalizer(clear_injector)
+        with MockNetworkNodes() as net:
+            bank = net.create_node("Bank A")
+            buyer = net.create_node("Bank B")
+            notary = net.create_notary_node("Notary", validating=True)
+            # device-batched verifier tier on every node: flow verifies
+            # route INTERACTIVE through the process-global scheduler with
+            # use_device=True, and the plan fails each device dispatch —
+            # the host failover must keep results identical
+            for node in (bank, buyer, notary):
+                node.services.transaction_verifier_service = (
+                    BatchedVerifierService(use_device=True)
+                )
+            papers = []
+            for _ in range(n):
+                buyer.run_flow(
+                    CashIssueFlow(1500, "GBP", b"\x01", notary.party)
+                )
+                issued = trader_demo.issue_paper(bank, notary.party)
+                papers.append(
+                    bank.services.to_state_and_ref(StateRef(issued.id, 0))
+                )
+            handles = [
+                bank.smm.start_flow(
+                    trader_demo.SellerFlow(buyer.party, sar, 900, "GBP")
+                )
+                for sar in papers
+            ]
+            for h in handles:
+                assert h.result.result(timeout=120) is not None
+            seller_cash = sum(
+                sr.state.data.amount.quantity
+                for sr in bank.services.vault_service.unconsumed_states(
+                    CashState
+                )
+            )
+            assert seller_cash == 900 * n  # identical to the direct path
+        assert any(e.site == "serving.dispatch" for e in inj.trace)
+
+    def test_dag_via_scheduler_matches_direct_under_faultplan(self, notary,
+                                                              alice):
+        """Acceptance: the 1k-hop-DAG shape (scaled down) through the
+        scheduler equals the direct path, with and without injected
+        device-op failures."""
+        from corda_tpu.parallel import verify_transaction_dag
+
+        chain = [issue_tx(notary, alice, 64)]
+        for _ in range(12):
+            chain.append(move_tx(notary, alice, chain[-1]))
+        dag = {s.id: s for s in chain}
+        allowed = lambda s: {notary[0].owning_key}  # noqa: E731
+
+        direct = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=False,
+            use_scheduler=False,
+        )
+        routed = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=False,
+        )
+        assert routed.order == direct.order
+        assert routed.n_sigs == direct.n_sigs
+        assert routed.consumed == direct.consumed
+
+        install_injector(FaultInjector(FaultPlan(seed=5, op_fail_p=1.0)))
+        try:
+            faulted = verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=True,
+                recompute_ids=False,
+            )
+        finally:
+            clear_injector()
+        assert faulted.order == direct.order
+        assert faulted.n_sigs == direct.n_sigs
+
+
+# ------------------------------------------------ monitoring + RPC surface
+
+class TestServingObservability:
+    def test_monitoring_snapshot_has_serving_section(self):
+        s = DeviceScheduler(use_device_default=False)  # registers gauges
+        try:
+            s.submit_rows(make_rows(2)).result(timeout=30)
+            snap = monitoring_snapshot()
+            assert "serving" in snap and "process" in snap
+            assert "queue_depth" in snap["serving"]
+            assert "batches" in snap["serving"]
+            assert snap["serving"]["rows"]["count"] >= 2
+            assert not any(
+                k.startswith("serving.") for k in snap["process"]
+            )
+        finally:
+            s.shutdown()
+
+    def test_rpc_op_and_read_binding(self, notary, alice):
+        from corda_tpu.node import ServiceHub
+        from corda_tpu.rpc.bindings import (
+            monitoring_snapshot_value,
+            serving_metrics_value,
+        )
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        hub = ServiceHub(
+            verifier_service=BatchedVerifierService(use_device=False)
+        )
+        ops = CordaRPCOps(hub, smm=None)
+        live = serving_metrics_value(ops)
+        before = live.get().get("requests", {}).get("count", 0)
+        hub.verify_stx_signatures(issue_tx(notary, alice), set())
+        after = live.refresh().get("requests", {}).get("count", 0)
+        assert after >= before + 1
+        full = monitoring_snapshot_value(ops).get()
+        assert set(full) >= {"serving", "process", "node"}
+        hub.transaction_verifier_service.shutdown()
+
+    def test_hub_helper_rejects_bad_signature(self, notary, alice):
+        from corda_tpu.node import ServiceHub
+
+        hub = ServiceHub(
+            verifier_service=BatchedVerifierService(use_device=False)
+        )
+        stx = issue_tx(notary, alice)
+        sig = stx.sigs[0]
+        forged = dataclasses.replace(stx, sigs=(dataclasses.replace(
+            sig, signature=b"\0" * len(sig.signature)
+        ),))
+        with pytest.raises(InvalidSignatureError):
+            hub.verify_stx_signatures(forged, set())
+        hub.verify_stx_signatures(stx, set())  # the good one passes
+        hub.transaction_verifier_service.shutdown()
+
+
+# --------------------------------------------------------- bench --smoke
+
+class TestBenchSmoke:
+    def test_bench_smoke_exercises_scheduler(self):
+        """tier-1 guard: `bench.py --smoke` (the fast scheduler path) must
+        pass on CPU so scheduler regressions fail tests, not just the TPU
+        bench."""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.dirname(
+                 os.path.abspath(__file__))), "bench.py"),
+             "--smoke"],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        line = proc.stdout.strip().splitlines()[-1]
+        out = json.loads(line)
+        assert out["ok"] is True
+        assert out["max_batch_occupancy"] > 1
+        assert out["notary_txs"] == 24

@@ -7,10 +7,19 @@ with data (r2 VERDICT weak #7: the block-256 Mosaic crash was routed
 around, not diagnosed).
 
     python tools_block_sweep.py            # writes BLOCK_SWEEP.json
+                                           # + corda_tpu/serving/shapes.json
 
 Each config compiles fresh (blocks are static args), runs a warm-up, then
 times DEVICE_REPS enqueues with one deferred readback — the same
 methodology as bench.py's device sections.
+
+Besides the raw sweep record, the run emits its CHOSEN shapes — best
+measured block width per kernel family plus the pad-bucket ladder — to
+the checked-in ``corda_tpu/serving/shapes.json`` that the serving
+scheduler loads at startup (corda_tpu/serving/shapes.py), so a re-sweep
+on new hardware retunes the scheduler without a code change. The file is
+only rewritten when at least one configuration measured successfully;
+the scheduler's built-in default covers its absence entirely.
 """
 
 from __future__ import annotations
@@ -140,9 +149,63 @@ def sweep() -> dict:
     return out
 
 
+MAX_BUCKET = 8192  # bench batch shape ceiling (bench.py SIG_BATCH)
+
+
+def choose_serving_shapes(results: dict) -> dict | None:
+    """Distill a sweep record into the scheduler's shape table: the best
+    measured block per kernel family and the power-of-two bucket ladder
+    from the smallest winning block up to the bench batch shape. Returns
+    None when nothing measured (sweep fully failed) — never downgrade the
+    checked-in shapes on a broken run."""
+    def best_block(prefix: str) -> int | None:
+        rates = {}
+        for key, val in results.items():
+            if key.startswith(prefix) and isinstance(val, dict) \
+                    and "sigs_per_sec_median" in val:
+                rates[int(key[len(prefix):])] = val["sigs_per_sec_median"]
+        return max(rates, key=rates.get) if rates else None
+
+    ed = best_block("ed25519_block_")
+    ec = best_block("ecdsa_k1_block_")
+    if ed is None and ec is None:
+        return None
+    floor = min(b for b in (ed, ec) if b is not None)
+    buckets, b = [], floor
+    while b <= MAX_BUCKET:
+        buckets.append(b)
+        b <<= 1
+    return {
+        "source": "tools_block_sweep",
+        "captured_at": results.get("captured_at"),
+        "device": results.get("device"),
+        "ed25519_block": ed,
+        "ecdsa_block": ec,
+        "buckets": buckets,
+    }
+
+
+def emit_serving_shapes(results: dict) -> None:
+    import os
+
+    shapes = choose_serving_shapes(results)
+    if shapes is None:
+        print("block sweep measured nothing; serving/shapes.json unchanged")
+        return
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "corda_tpu", "serving", "shapes.json",
+    )
+    with open(path, "w") as f:
+        json.dump(shapes, f, indent=1, sort_keys=True)
+        f.write("\n")
+    print("wrote", path, json.dumps(shapes))
+
+
 if __name__ == "__main__":
     results = sweep()
     with open("BLOCK_SWEEP.json", "w") as f:
         json.dump(results, f, indent=1, sort_keys=True)
         f.write("\n")
+    emit_serving_shapes(results)
     print(json.dumps(results))
