@@ -970,6 +970,107 @@ def _apply_cached(p: _Partial) -> None:
         p.data["value_is_cached"] = True
 
 
+def wait_for_complete_trace(trc, flow_id: str, required: set,
+                            timeout_s: float = 15.0) -> list:
+    """Poll the tracer ring until ``flow_id``'s trace covers ``required``
+    stages with intact parent links (spans land at FINISH time, and
+    responder flows outlive the initiator's result future), then return
+    the spans. Asserts on timeout with the best diagnosis available.
+
+    Trace views include LINK-joined foreign spans (a serving.batch span
+    coalescing this flow with another sampled flow lives in the other
+    flow's trace — docs/OBSERVABILITY.md), so the parent-link and
+    single-trace invariants are asserted over the flow's OWN spans while
+    stage coverage counts linked foreign spans too."""
+    deadline = time.monotonic() + timeout_s
+    spans: list = []
+    while True:
+        spans = trc.trace_for_attr("flow.id", flow_id)
+        own_tid = next(
+            (s["trace_id"] for s in spans
+             if s["attrs"].get("flow.id") == flow_id),
+            None,
+        )
+        own = [s for s in spans if s["trace_id"] == own_tid]
+        names = {s["name"] for s in spans}
+        own_ids = {s["span_id"] for s in own}
+        orphans = [
+            s["name"] for s in own
+            if s["parent_id"] and s["parent_id"] not in own_ids
+        ]
+        if own and not orphans and required <= names:
+            return spans
+        if time.monotonic() >= deadline:
+            assert required <= names, (
+                f"trace missing stages: {sorted(required - names)}"
+            )
+            assert not orphans, f"broken parent links: {orphans}"
+            assert own, f"no spans recorded for flow {flow_id}"
+            return spans
+        time.sleep(0.05)
+
+
+def run_smoke_tracing() -> dict:
+    """The smoke's tracing leg: CashIssue + CashPayment on a 3-node mock
+    network with the flow verify path routed through the serving
+    scheduler, sampling at 1.0 — assert the payment flow's trace is one
+    connected flow→scheduler→batch→notary tree, and report the serving
+    stage quantiles (p50/p99 from the reservoir timers) alongside."""
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+    from corda_tpu.node.monitoring import node_metrics
+    from corda_tpu.observability import configure_tracing, tracer
+    from corda_tpu.testing import MockNetworkNodes
+    from corda_tpu.verifier import BatchedVerifierService
+
+    configure_tracing(sample_rate=1.0)
+    try:
+        with MockNetworkNodes() as net:
+            alice = net.create_node("TraceAlice")
+            bob = net.create_node("TraceBob")
+            notary = net.create_notary_node("TraceNotary")
+            vsvc = BatchedVerifierService(use_device=False)
+            alice.services.transaction_verifier_service = vsvc
+            alice.run_flow(CashIssueFlow(1000, "GBP", b"\x01", notary.party))
+            handle = alice.smm.start_flow(
+                CashPaymentFlow(250, "GBP", bob.party)
+            )
+            handle.result.result(timeout=120)
+            # responder flows (notary, broadcast recipients) finish — and
+            # record their spans — shortly AFTER the initiator's result
+            # resolves; wait for the trace to become complete
+            spans = wait_for_complete_trace(
+                tracer(), handle.flow_id,
+                {"flow", "flow.verify_stx", "serving.queue",
+                 "serving.batch", "notary.attest"},
+            )
+            vsvc.shutdown()
+    finally:
+        configure_tracing(sample_rate=0.0)
+
+    # per-stage p50/p99: from the trace's own span durations (covers
+    # every stage incl. host-settled batches), plus the reservoir-backed
+    # queue-wait timer as the registry-side cross-check
+    by_stage: dict = {}
+    for s in spans:
+        if s["duration_s"] is not None:
+            by_stage.setdefault(s["name"], []).append(s["duration_s"])
+    stage_quantiles = {}
+    for name, ds in sorted(by_stage.items()):
+        ds.sort()
+        stage_quantiles[name] = {
+            "p50_ms": round(ds[min(len(ds) - 1, int(0.5 * len(ds)))] * 1e3, 3),
+            "p99_ms": round(ds[min(len(ds) - 1, int(0.99 * len(ds)))] * 1e3, 3),
+        }
+    wait = node_metrics().timer("serving.wait_s").snapshot()
+    return {
+        "trace_spans": len(spans),
+        "trace_connected": True,
+        "stage_quantiles": stage_quantiles,
+        "serving_wait_p50_ms": round(wait["p50_s"] * 1e3, 3),
+        "serving_wait_p99_ms": round(wait["p99_s"] * 1e3, 3),
+    }
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1061,6 +1162,13 @@ def run_smoke() -> int:
         )
         out["dag_txs"] = len(dag.order)
         assert out["dag_txs"] == len(chain)
+
+        # 6. tracing pass (docs/OBSERVABILITY.md): sampling forced on,
+        # one mock-network payment flow must yield a SINGLE connected
+        # trace — flow → scheduler queue → device batch → notary attest —
+        # with intact parent links. Runs LAST so steps 1-5 measure the
+        # tracing-disabled (default) scheduler numbers.
+        out.update(run_smoke_tracing())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -100,6 +100,12 @@ class InjectedEvent:
     site: str      # "sender->recipient" edge, queue name, or op site
     key: str       # msg id / call ordinal the decision was keyed on
     round: int     # pump round (or -1 where rounds don't apply)
+    # the trace active on the injecting thread ("" when untraced): joins a
+    # chaos event against the request traces it disturbed
+    # (docs/OBSERVABILITY.md). Excluded from trace_digest — trace ids are
+    # random per run, and the digest's bit-for-bit replay contract is over
+    # the plan's own deterministic decisions.
+    trace_id: str = ""
 
 
 @dataclasses.dataclass
@@ -130,8 +136,11 @@ class FaultInjector:
         return int.from_bytes(h[:8], "big") / 2.0**64
 
     def _record(self, kind: str, site: str, key: str, rnd: int = -1) -> None:
+        from corda_tpu.observability import current_trace_id
+
+        event = InjectedEvent(kind, site, key, rnd, current_trace_id())
         with self._lock:
-            self.trace.append(InjectedEvent(kind, site, key, rnd))
+            self.trace.append(event)
 
     def trace_digest(self) -> str:
         """One hash over the whole trace — the bit-for-bit reproducibility

@@ -46,6 +46,13 @@ from concurrent.futures import Future
 from corda_tpu.ledger import Party
 from corda_tpu.messaging.queue import Message
 from corda_tpu.messaging.retry import RetryPolicy
+from corda_tpu.observability import (
+    NOOP_SPAN,
+    SPAN_FLOW,
+    SPAN_FLOW_RESPONDER,
+    TraceContext,
+    tracer,
+)
 from corda_tpu.serialization import deserialize, serialize
 
 from .api import (
@@ -167,6 +174,10 @@ class _FlowExecutor:
         self.result: Future = result if result is not None else Future()
         self.sessions: list[int] = []         # local sids owned
         self.killed = False                   # set by SMM.kill_flow
+        # the flow's trace span (or NOOP when unsampled): spans the whole
+        # flow lifetime across park/replay — a resumed flow's fresh
+        # executor rebinds the SAME span from the SMM's span table
+        self.trace_span = smm.span_of(flow_id)
 
     # ------------------------------------------------------------ op core
     def _do_op(self, effect, replay=None):
@@ -280,7 +291,8 @@ class _FlowExecutor:
             sess = self.smm.register_session(sid, party, self)
             self.smm.send_to(
                 party,
-                SessionInit(sid, class_path(type(flow)), b""),
+                SessionInit(sid, class_path(type(flow)), b"",
+                            trace=self.trace_span.wire()),
                 msg_id=f"{self.flow_id}:op{idx}",
                 track_kind="init", track_sid=sid,
                 deadline_s=self._retry_deadline_s(),
@@ -358,6 +370,16 @@ class _FlowExecutor:
     def run_once(self) -> str:
         """Execute on the calling worker thread until the flow finishes,
         parks, or dies → "finished" | "parked"."""
+        span = self.trace_span
+        if not span.sampled:
+            return self._run_body()
+        # activate for the duration of this execution segment: every span
+        # the flow body opens on this thread (verify, scheduler submit,
+        # notary attest) parents under the flow span via tracer.current()
+        with tracer().activate(span):
+            return self._run_body()
+
+    def _run_body(self) -> str:
         try:
             if self.responder_cls is not None:
                 session = self.op_accept_session()
@@ -484,14 +506,60 @@ class StateMachineManager:
         self._rewake: set[str] = set()        # woken while still running
         self._sleepers: dict[str, float] = {} # flow_id -> deadline
         self._results: dict[str, Future] = {} # persistent per-flow futures
+        # flow id -> open trace span (sampled flows only): outlives the
+        # executor across park/replay like the result future does; finished
+        # (and pruned) in flow_finished / _fail_unrunnable
+        self._flow_spans: dict[str, object] = {}
         self._killed_ids: set[str] = set()
         self._workers: list[threading.Thread] = []
         self._timer: threading.Thread | None = None
         messaging.add_handler(SESSION_TOPIC, self._on_message)
 
+    # ------------------------------------------------------------ tracing
+    def span_of(self, flow_id: str):
+        """The flow's open trace span, or the shared no-op."""
+        with self._lock:
+            return self._flow_spans.get(flow_id, NOOP_SPAN)
+
+    def _open_flow_span(self, flow_id: str, flow_cls: str, *,
+                        responder: bool = False,
+                        parent_wire: str = "") -> None:
+        """Root (initiator) or wire-parented (responder) flow span; only
+        sampled spans enter the table — unsampled flows cost one lookup
+        miss. A responder NEVER roots its own trace: the sampling
+        decision is the initiator's, carried (or withheld) on the wire —
+        an empty parent context means "not sampled", not "re-roll"
+        (re-rolling would leak orphan fragment traces at the configured
+        rate per responder and overshoot the sampling knob)."""
+        trc = tracer()
+        if responder:
+            span = trc.start(
+                SPAN_FLOW_RESPONDER, TraceContext.from_wire(parent_wire),
+                attrs={"flow.id": flow_id, "flow.class": flow_cls,
+                       "node": str(self.our_identity.name)},
+            )
+        else:
+            span = trc.root(
+                SPAN_FLOW,
+                attrs={"flow.id": flow_id, "flow.class": flow_cls,
+                       "node": str(self.our_identity.name)},
+            )
+        if span.sampled:
+            with self._lock:
+                self._flow_spans[flow_id] = span
+
+    def _close_flow_span(self, flow_id: str, error=None) -> None:
+        with self._lock:
+            span = self._flow_spans.pop(flow_id, None)
+        if span is not None:
+            if error is not None:
+                span.set_error(error)
+            span.finish()
+
     # ------------------------------------------------------------ public
     def start_flow(self, flow: FlowLogic, flow_id: str | None = None) -> FlowHandle:
         flow_id = flow_id or secrets.token_hex(16)
+        self._open_flow_span(flow_id, class_path(type(flow)))
         blob = serialize({
             "cls": class_path(type(flow)),
             "fields": flow.flow_fields(),
@@ -615,6 +683,7 @@ class StateMachineManager:
                             self._unpark_locked(flow_id)
 
     def _fail_unrunnable(self, flow_id: str, error: Exception) -> None:
+        self._close_flow_span(flow_id, error=error)
         with self._lock:
             fut = self._results.pop(flow_id, None)
             self._flows.pop(flow_id, None)
@@ -975,6 +1044,7 @@ class StateMachineManager:
                 self._lock.wait(timeout=max(0.001, min(waits)))
 
     def flow_finished(self, ex: _FlowExecutor) -> None:
+        self._close_flow_span(ex.flow_id)
         self.checkpoints.remove_flow(ex.flow_id)
         with self._lock:
             self._flows.pop(ex.flow_id, None)
@@ -1164,6 +1234,8 @@ class StateMachineManager:
             if ack:
                 ack()
             return
+        self._open_flow_span(flow_id, class_path(responder),
+                             responder=True, parent_wire=init.trace)
         blob = serialize({
             "cls": class_path(responder),
             "fields": {},

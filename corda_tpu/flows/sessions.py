@@ -21,6 +21,13 @@ class SessionInit:
     initiator_session_id: int
     flow_name: str            # registered initiating flow name
     first_payload: bytes      # optional piggybacked first send (b"" if none)
+    # trace propagation (docs/OBSERVABILITY.md): "<trace_id>:<span_id>" of
+    # the initiating flow's active span, "" when the flow is unsampled —
+    # the responder parents its own flow span under this context, so one
+    # trace spans initiator, notary, and broadcast recipients. Carried on
+    # Init only: Data/End ride an established session whose responder
+    # already joined the trace.
+    trace: str = ""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -64,9 +71,12 @@ register_custom(
     SessionInit, "flows.SessionInit",
     to_fields=lambda m: {
         "sid": m.initiator_session_id, "flow": m.flow_name,
-        "first": m.first_payload,
+        "first": m.first_payload, "trace": m.trace,
     },
-    from_fields=lambda d: SessionInit(d["sid"], d["flow"], d["first"]),
+    # .get: Inits serialized before the trace field existed decode fine
+    from_fields=lambda d: SessionInit(
+        d["sid"], d["flow"], d["first"], d.get("trace", "")
+    ),
 )
 register_custom(
     SessionConfirm, "flows.SessionConfirm",

@@ -10,8 +10,45 @@ thread-safe, snapshot-able for the RPC/shell observability surface.
 from __future__ import annotations
 
 import math
+import random
 import threading
 import time
+
+
+class QuantileReservoir:
+    """Fixed-size uniform sample (Vitter's algorithm R) feeding the
+    p50/p95/p99 fields of Timer/Meter snapshots. 512 slots bounds memory
+    per metric while keeping the p99 estimate useful at the batch counts
+    the serving scheduler sees; the RNG is private and seeded so snapshot
+    quantiles are reproducible for a deterministic driven sequence.
+
+    NOT thread-safe on its own — the owning metric's lock guards it."""
+
+    __slots__ = ("_size", "_values", "_seen", "_rng")
+
+    def __init__(self, size: int = 512, seed: int = 0x0B5E):
+        self._size = size
+        self._values: list[float] = []
+        self._seen = 0
+        self._rng = random.Random(seed)
+
+    def update(self, value: float) -> None:
+        self._seen += 1
+        if len(self._values) < self._size:
+            self._values.append(value)
+        else:
+            j = self._rng.randrange(self._seen)
+            if j < self._size:
+                self._values[j] = value
+
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
+        """Nearest-rank quantiles over the current sample (0.0 each when
+        empty — snapshots stay numeric for the exposition layer)."""
+        if not self._values:
+            return [0.0] * len(qs)
+        ordered = sorted(self._values)
+        n = len(ordered)
+        return [ordered[min(n - 1, int(q * n))] for q in qs]
 
 
 class Counter:
@@ -49,7 +86,15 @@ class Gauge:
 
 
 class Meter:
-    """Event rate: total count + exponentially-weighted 1-minute rate."""
+    """Event rate: total count + exponentially-weighted 1-minute rate,
+    plus a reservoir over per-mark sizes (``mark(n)`` records ``n``) —
+    p50/p95/p99 of e.g. rows-per-request for the ``serving.rows`` meter.
+
+    Burst accounting: marks arriving with ``dt == 0`` (several requests
+    inside one clock tick) fold into ``_pending`` and count toward the
+    NEXT nonzero-dt rate sample — previously only the final mark's ``n``
+    was treated as the interval's events, understating ``m1_rate`` under
+    bursts by up to the burst size."""
 
     def __init__(self, clock=time.monotonic):
         self._clock = clock
@@ -57,17 +102,22 @@ class Meter:
         self._count = 0
         self._rate = 0.0
         self._last = clock()
+        self._pending = 0
+        self._reservoir = QuantileReservoir()
 
     def mark(self, n: int = 1) -> None:
         with self._lock:
             now = self._clock()
             dt = now - self._last
+            self._count += n
+            self._pending += n
+            self._reservoir.update(float(n))
             if dt > 0:
                 alpha = 1.0 - math.exp(-dt / 60.0)
-                inst = n / dt
+                inst = self._pending / dt
                 self._rate += alpha * (inst - self._rate)
                 self._last = now
-            self._count += n
+                self._pending = 0
 
     @property
     def count(self) -> int:
@@ -78,11 +128,19 @@ class Meter:
         return self._rate
 
     def snapshot(self) -> dict:
-        return {"type": "meter", "count": self._count, "m1_rate": self._rate}
+        with self._lock:
+            p50, p95, p99 = self._reservoir.quantiles()
+            return {
+                "type": "meter", "count": self._count, "m1_rate": self._rate,
+                "p50": p50, "p95": p95, "p99": p99,
+            }
 
 
 class Timer:
-    """Duration histogram (count / mean / min / max / last)."""
+    """Duration histogram (count / mean / min / max / last) with a
+    fixed-size reservoir exposing p50/p95/p99 — the tail-attribution
+    fields the serving/verifier/notary timers report (a mean hides
+    exactly the queueing effects the cross-layer traces exist to find)."""
 
     def __init__(self, clock=time.perf_counter):
         self._clock = clock
@@ -92,6 +150,7 @@ class Timer:
         self._min = math.inf
         self._max = 0.0
         self._last = 0.0
+        self._reservoir = QuantileReservoir()
 
     class _Ctx:
         def __init__(self, timer):
@@ -115,6 +174,7 @@ class Timer:
             self._min = min(self._min, seconds)
             self._max = max(self._max, seconds)
             self._last = seconds
+            self._reservoir.update(seconds)
 
     @property
     def count(self) -> int:
@@ -124,15 +184,27 @@ class Timer:
     def mean(self) -> float:
         return self._total / self._count if self._count else 0.0
 
+    def quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
+        with self._lock:
+            return self._reservoir.quantiles(qs)
+
     def snapshot(self) -> dict:
-        return {
-            "type": "timer",
-            "count": self._count,
-            "mean_s": self.mean,
-            "min_s": 0.0 if math.isinf(self._min) else self._min,
-            "max_s": self._max,
-            "last_s": self._last,
-        }
+        with self._lock:
+            p50, p95, p99 = self._reservoir.quantiles()
+            return {
+                "type": "timer",
+                "count": self._count,
+                "mean_s": (
+                    self._total / self._count if self._count else 0.0
+                ),
+                "min_s": 0.0 if math.isinf(self._min) else self._min,
+                "max_s": self._max,
+                "last_s": self._last,
+                "total_s": self._total,
+                "p50_s": p50,
+                "p95_s": p95,
+                "p99_s": p99,
+            }
 
 
 class MetricRegistry:
@@ -161,10 +233,26 @@ class MetricRegistry:
         return self._get(name, Timer)
 
     def gauge(self, name: str, fn=None) -> Gauge:
-        if fn is not None:
-            with self._lock:
+        """Register (``fn`` given) or read a gauge. A read before any
+        registration returns a TRANSIENT placeholder gauge reading None —
+        never a bare KeyError from registry internals — and the read holds
+        the lock like every other accessor (an unlocked dict read raced
+        concurrent registrations). The placeholder is deliberately NOT
+        stored: registering it would poison the name for a later
+        ``counter(name)``/``meter(name)``/``timer(name)`` writer, whose
+        ``_get`` would hand back the Gauge and crash the writing thread
+        (the serving dispatcher, for one) on ``.inc()``."""
+        with self._lock:
+            if fn is not None:
                 self._metrics[name] = Gauge(fn)
-        return self._metrics[name]
+            m = self._metrics.get(name)
+            if m is None:
+                return Gauge(lambda: None)
+            if not isinstance(m, Gauge):
+                raise TypeError(
+                    f"metric {name!r} is a {type(m).__name__}, not a Gauge"
+                )
+            return m
 
     def snapshot(self) -> dict:
         with self._lock:

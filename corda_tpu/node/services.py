@@ -121,32 +121,43 @@ class ServiceHub:
         ``stx.verify_signatures_except`` exactly (pass/fail per tx);
         invalid signatures surface as the batch tier's
         ``InvalidSignatureError``. Overload or a shut-down scheduler sheds
-        to the direct host path."""
-        allowed = set(allowed_missing)
-        svc = self.transaction_verifier_service
-        if getattr(svc, "routes_via_scheduler", False):
-            from concurrent.futures import TimeoutError as _FutTimeout
+        to the direct host path.
 
-            from corda_tpu.serving import (
-                INTERACTIVE,
-                ServingError,
-                device_scheduler,
-            )
+        Traced as ``flow.verify_stx`` under the calling flow's span
+        (docs/OBSERVABILITY.md); the scheduler's queue-wait and batch
+        spans hang off it, which is how a slow flow p99 is attributed to
+        queue wait vs device time."""
+        from corda_tpu.observability import SPAN_FLOW_VERIFY, tracer
 
-            try:
-                report = device_scheduler().submit_transactions(
-                    [stx], [allowed], priority=INTERACTIVE,
-                    use_device=getattr(svc, "use_device", False),
-                ).result(timeout=120)
-            except (ServingError, _FutTimeout):
-                # explicit shed (admission reject / shutdown race) or a
-                # wedged scheduler: the flow must not fail on overload —
-                # fall through to the direct host check (idempotent)
-                pass
-            else:
-                report.raise_first()
-                return
-        stx.verify_signatures_except(allowed)
+        trc = tracer()
+        span = trc.start(SPAN_FLOW_VERIFY, trc.current(),
+                         attrs={"tx.id": str(stx.id)})
+        with span, trc.activate(span):
+            allowed = set(allowed_missing)
+            svc = self.transaction_verifier_service
+            if getattr(svc, "routes_via_scheduler", False):
+                from concurrent.futures import TimeoutError as _FutTimeout
+
+                from corda_tpu.serving import (
+                    INTERACTIVE,
+                    ServingError,
+                    device_scheduler,
+                )
+
+                try:
+                    report = device_scheduler().submit_transactions(
+                        [stx], [allowed], priority=INTERACTIVE,
+                        use_device=getattr(svc, "use_device", False),
+                    ).result(timeout=120)
+                except (ServingError, _FutTimeout):
+                    # explicit shed (admission reject / shutdown race) or a
+                    # wedged scheduler: the flow must not fail on overload —
+                    # fall through to the direct host check (idempotent)
+                    span.set_attr("degraded", "host-fallback")
+                else:
+                    report.raise_first()
+                    return
+            stx.verify_signatures_except(allowed)
 
     # -- signing (reference: ServiceHub.signInitialTransaction :187-209) ------
 

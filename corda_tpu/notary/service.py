@@ -34,6 +34,11 @@ from corda_tpu.ledger import (
     SignedTransaction,
     TimeWindow,
 )
+from corda_tpu.observability import (
+    SPAN_NOTARY_ATTEST,
+    SPAN_NOTARY_SUBMIT,
+    tracer,
+)
 
 from .uniqueness import NotaryError, UniquenessProvider
 
@@ -126,6 +131,12 @@ class SimpleNotaryService(NotaryService):
     NonValidatingNotaryFlow provides)."""
 
     def process(self, ftx: FilteredTransaction, caller_name: str) -> TransactionSignature:
+        trc = tracer()
+        with trc.start(SPAN_NOTARY_ATTEST, trc.current(),
+                       attrs={"tx.id": str(ftx.id), "service": "simple"}):
+            return self._process_inner(ftx, caller_name)
+
+    def _process_inner(self, ftx, caller_name):
         cached = self.cached_signature(ftx.id)
         if cached is not None:
             return cached  # duplicate resubmission: original attestation
@@ -154,6 +165,12 @@ class ValidatingNotaryService(NotaryService):
     def process(
         self, stx: SignedTransaction, resolve_state, caller_name: str
     ) -> TransactionSignature:
+        trc = tracer()
+        with trc.start(SPAN_NOTARY_ATTEST, trc.current(),
+                       attrs={"tx.id": str(stx.id), "service": "validating"}):
+            return self._process_inner(stx, resolve_state, caller_name)
+
+    def _process_inner(self, stx, resolve_state, caller_name):
         cached = self.cached_signature(stx.id)
         if cached is not None:
             return cached  # duplicate resubmission: original attestation
@@ -170,13 +187,17 @@ class ValidatingNotaryService(NotaryService):
 
 
 class _PendingRequest:
-    __slots__ = ("stx", "resolve_state", "caller", "future")
+    __slots__ = ("stx", "resolve_state", "caller", "future", "span")
 
-    def __init__(self, stx, resolve_state, caller):
+    def __init__(self, stx, resolve_state, caller, span=None):
         self.stx = stx
         self.resolve_state = resolve_state
         self.caller = caller
         self.future: Future = Future()
+        # notary.submit span (request → response), captured on the
+        # CALLER's thread — the flusher pipeline threads that settle the
+        # future have no ambient trace context of their own
+        self.span = span
 
 
 class BatchedNotaryService(NotaryService):
@@ -250,7 +271,8 @@ class BatchedNotaryService(NotaryService):
 
         return dispatch_prime_ids([r[0] for r in requests])
 
-    def dispatch_batch(self, requests, pending_ids=None, pipelined=True):
+    def dispatch_batch(self, requests, pending_ids=None, pipelined=True,
+                       trace=None):
         """Enqueue the device half (signature ladders) of a batch; the
         returned pending check settles in ``settle_batch``. Splitting the
         two is what hides the interconnect round trip: while batch k's
@@ -301,6 +323,11 @@ class BatchedNotaryService(NotaryService):
                     [{self.identity.owning_key}] * len(requests),
                     priority=BULK, use_device=use_device,
                     min_bucket=self._max_batch if use_device else None,
+                    # explicit propagation: the flusher thread dispatching
+                    # this window is not the traced caller's thread
+                    trace=(
+                        trace if trace is not None else tracer().current()
+                    ),
                 ))
             except ServingError:
                 pass  # saturated/closed: degrade to the direct dispatch
@@ -532,10 +559,26 @@ class BatchedNotaryService(NotaryService):
             fut: Future = Future()
             fut.set_result(cached)
             return fut
-        req = _PendingRequest(stx, resolve_state, caller)
+        trc = tracer()
+        span = trc.start(SPAN_NOTARY_SUBMIT, trc.current(),
+                         attrs={"tx.id": str(stx.id), "caller": caller})
+        req = _PendingRequest(stx, resolve_state, caller, span=span)
+        if span.sampled:
+            def close_span(f: Future):
+                err = f.exception() if not f.cancelled() else None
+                if err is not None:
+                    span.set_error(err)
+                span.finish()
+
+            req.future.add_done_callback(close_span)
         with self._lock:
             if self._stopped:
-                raise NotaryInternalException("notary service stopped")
+                # the future never settles on this path, so the span's
+                # done-callback close never fires — close it here
+                err = NotaryInternalException("notary service stopped")
+                span.set_error(err)
+                span.finish()
+                raise err
             self._pending.append(req)
             if self._flusher is None:
                 self._flusher = threading.Thread(
@@ -663,6 +706,13 @@ class BatchedNotaryService(NotaryService):
                         nxt = None
                     if ahead is not None:
                         a_batch, a_reqs, a_ids = ahead
+                        # first traced request parents the window's
+                        # scheduler spans (members link via the batch span)
+                        a_trace = next(
+                            (r.span for r in a_batch
+                             if r.span is not None and r.span.sampled),
+                            None,
+                        )
                         try:
                             # sustained load is what fills windows: a
                             # half-full-or-better window rides the device
@@ -677,6 +727,7 @@ class BatchedNotaryService(NotaryService):
                                 pipelined=(
                                     len(a_batch) >= self._max_batch // 2
                                 ),
+                                trace=a_trace,
                             )))
                         except Exception as e:
                             for req in a_batch:

@@ -1,0 +1,55 @@
+"""Observability — end-to-end request tracing + metric exposition.
+
+The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
+
+- ``trace`` — ``Span``/``Tracer`` with process-unique trace/span ids, a
+  thread-local + explicitly-propagated context, wire propagation through
+  session messages, a bounded in-memory ring, and an off-by-default JSONL
+  sink. A flow's trace id travels flow → serving scheduler → device batch
+  → notary, and injected chaos events are stamped with it.
+- ``exposition`` — Prometheus-text rendering of the metric registries,
+  including the p50/p95/p99 quantiles the reservoir upgrade added to
+  ``Timer``/``Meter``.
+"""
+
+from .exposition import metrics_text, parse_prometheus, render_prometheus
+from .trace import (
+    NOOP_SPAN,
+    SPAN_FLOW,
+    SPAN_FLOW_RESPONDER,
+    SPAN_FLOW_VERIFY,
+    SPAN_NOTARY_ATTEST,
+    SPAN_NOTARY_SUBMIT,
+    SPAN_SERVING_BATCH,
+    SPAN_SERVING_QUEUE,
+    SPAN_VERIFIER_REQUEST,
+    SPAN_WAVEFRONT_WINDOW,
+    Span,
+    TraceContext,
+    Tracer,
+    configure_tracing,
+    current_trace_id,
+    tracer,
+)
+
+__all__ = [
+    "NOOP_SPAN",
+    "SPAN_FLOW",
+    "SPAN_FLOW_RESPONDER",
+    "SPAN_FLOW_VERIFY",
+    "SPAN_NOTARY_ATTEST",
+    "SPAN_NOTARY_SUBMIT",
+    "SPAN_SERVING_BATCH",
+    "SPAN_SERVING_QUEUE",
+    "SPAN_VERIFIER_REQUEST",
+    "SPAN_WAVEFRONT_WINDOW",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "configure_tracing",
+    "current_trace_id",
+    "metrics_text",
+    "parse_prometheus",
+    "render_prometheus",
+    "tracer",
+]

@@ -120,7 +120,14 @@ def verify_transaction_dag(
     + consumed-set report.
     """
     del max_workers  # kept for API compat; the walk batches per window now
+    from corda_tpu.observability import SPAN_WAVEFRONT_WINDOW, tracer
     from corda_tpu.verifier import dispatch_transactions
+
+    # the resolve runs on the calling flow's thread: capture its context
+    # once — window spans are created here but collected in walk order,
+    # possibly after other windows' dispatches interleaved
+    _trc = tracer()
+    _resolve_ctx = _trc.current()
 
     deps: dict = {}
     for tid, stx in stxs.items():
@@ -199,6 +206,21 @@ def verify_transaction_dag(
         notary/verifier/flow traffic; a saturated or shut-down scheduler
         degrades to the direct dispatch with identical verdicts."""
         tids = [tid for lvl in win_levels for tid in lvl]
+        span = _trc.start(
+            SPAN_WAVEFRONT_WINDOW, _resolve_ctx,
+            attrs={"txs": len(tids), "levels": len(win_levels)},
+        )
+        try:
+            return span, _dispatch_window_inner(win_levels, tids, span)
+        except Exception as e:
+            # a dispatch-time failure (forged chain link in the id sweep,
+            # dispatch error) must still land the window span in the ring
+            # — failing resolves are exactly the traces worth reading
+            span.set_error(e)
+            span.finish()
+            raise
+
+    def _dispatch_window_inner(win_levels, tids, span):
         if check_ids:
             from corda_tpu.ops.txid import check_and_prime_ids
 
@@ -214,19 +236,28 @@ def verify_transaction_dag(
             )
 
             try:
-                return FuturePending(device_scheduler().submit_transactions(
-                    win_stxs, allowed, priority=SERVICE,
-                    use_device=use_device,
-                ))
+                return FuturePending(
+                    device_scheduler().submit_transactions(
+                        win_stxs, allowed, priority=SERVICE,
+                        use_device=use_device, trace=span,
+                    )
+                )
             except ServingError:
                 pass
         return dispatch_transactions(
             win_stxs, allowed, use_device=use_device,
         )
 
-    def walk_window(win_levels, pending):
+    def walk_window(win_levels, staged):
         """Collect the window's signature verdicts, then the
-        order-dependent walk over its levels."""
+        order-dependent walk over its levels. The window span opened at
+        dispatch closes here — it covers enqueue→device→walk, the
+        per-window latency the resolve pipeline tries to hide."""
+        span, pending = staged
+        with span:
+            _walk_window_inner(win_levels, pending)
+
+    def _walk_window_inner(win_levels, pending):
         nonlocal n_sigs
         report = pending.collect()
         report.raise_first()
@@ -267,13 +298,22 @@ def verify_transaction_dag(
 
     from collections import deque
 
-    in_flight: deque = deque()  # (win_levels, pending sig-check)
+    in_flight: deque = deque()  # (win_levels, (span, pending sig-check))
     live_depth = depth if pipelined else 1
-    for win_levels in windows:
-        in_flight.append((win_levels, dispatch_window(win_levels)))
-        if len(in_flight) >= live_depth:
+    try:
+        for win_levels in windows:
+            in_flight.append((win_levels, dispatch_window(win_levels)))
+            if len(in_flight) >= live_depth:
+                walk_window(*in_flight.popleft())
+        while in_flight:
             walk_window(*in_flight.popleft())
-    while in_flight:
-        walk_window(*in_flight.popleft())
+    except BaseException as e:
+        # a failed walk abandons the still-dispatched windows: close their
+        # spans (status from the failure that aborted the resolve) so the
+        # trace shows the whole pipeline, not a truncated prefix
+        for _lv, (span, _pending) in in_flight:
+            span.set_error(e)
+            span.finish()
+        raise
 
     return DagVerifyResult(order, levels, n_sigs, consumed)

@@ -571,6 +571,26 @@ def monitoring_snapshot_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.monitoring_snapshot())
 
 
+def metrics_text_value(proxy) -> PolledValue:
+    """Read binding over the Prometheus text exposition
+    (``CordaRPCOps.metrics_text``) — the scrape body as a live value the
+    shell/explorer surfaces render or re-export."""
+    return PolledValue(lambda: proxy.metrics_text())
+
+
+def trace_dump_value(proxy, limit: int = 200) -> PolledValue:
+    """Read binding over the tracer's recent-span ring
+    (``CordaRPCOps.trace_dump``): each refresh pulls the latest finished
+    spans, for live trace-waterfall widgets."""
+    return PolledValue(lambda: proxy.trace_dump(limit=limit))
+
+
+def trace_for_value(proxy, flow_id: str) -> PolledValue:
+    """Read binding over one flow's trace (``CordaRPCOps.trace_for``) —
+    refresh while the flow runs to watch its spans land."""
+    return PolledValue(lambda: proxy.trace_for(flow_id))
+
+
 # ------------------------------------------------------------- model tier
 
 class NodeMonitorModel:

@@ -181,6 +181,31 @@ class CordaRPCOps:
 
         return node_metrics().section("serving.")
 
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the process-global AND node-local
+        registries (docs/OBSERVABILITY.md §exposition) — counters as
+        ``_total``, timers/meters as summaries with p50/p95/p99
+        ``quantile`` labels from the reservoirs. The scrape endpoint body."""
+        from corda_tpu.observability import metrics_text
+
+        return metrics_text(self._services.metrics)
+
+    # ------------------------------------------------------------ tracing
+    def trace_dump(self, limit: int = 200) -> list:
+        """The most recent finished spans from the process tracer's ring
+        (span dicts, oldest first) — the raw feed behind trace tooling."""
+        from corda_tpu.observability import tracer
+
+        return tracer().dump(limit=limit)
+
+    def trace_for(self, flow_id: str) -> list:
+        """Every span of the trace that contains ``flow_id`` (the
+        flow→scheduler→batch→notary chain of one request), start-ordered;
+        empty when the flow was unsampled or has aged out of the ring."""
+        from corda_tpu.observability import tracer
+
+        return tracer().trace_for_attr("flow.id", flow_id)
+
     # -------------------------------------------------------------- misc
     def current_node_time(self) -> float:
         return (

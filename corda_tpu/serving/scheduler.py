@@ -56,6 +56,13 @@ from concurrent.futures import TimeoutError as _FutTimeout
 
 import numpy as np
 
+from corda_tpu.observability import (
+    NOOP_SPAN,
+    SPAN_SERVING_BATCH,
+    SPAN_SERVING_QUEUE,
+    tracer,
+)
+
 from .shapes import shape_table
 
 # ------------------------------------------------------------ priorities
@@ -107,10 +114,10 @@ class RowResult:
 
 class _Request:
     __slots__ = ("rows", "future", "priority", "use_device", "min_bucket",
-                 "enqueued_at", "deadline")
+                 "enqueued_at", "deadline", "queue_span")
 
     def __init__(self, rows, future, priority, use_device, min_bucket,
-                 enqueued_at, deadline):
+                 enqueued_at, deadline, queue_span=NOOP_SPAN):
         self.rows = rows
         self.future = future
         self.priority = priority
@@ -118,6 +125,10 @@ class _Request:
         self.min_bucket = min_bucket
         self.enqueued_at = enqueued_at
         self.deadline = deadline
+        # open serving.queue span (NOOP for unsampled callers): starts at
+        # admission on the submitting thread, finishes on the dispatcher
+        # thread when the request leaves the queue for a batch
+        self.queue_span = queue_span
 
 
 class _InFlight:
@@ -126,15 +137,18 @@ class _InFlight:
     time. Host-routed requests never enter the in-flight pipeline — they
     settle on the scheduler's host pool straight from dispatch."""
 
-    __slots__ = ("requests", "pending", "n_rows", "dev_map", "seq", "t0")
+    __slots__ = ("requests", "pending", "n_rows", "dev_map", "seq", "t0",
+                 "span")
 
-    def __init__(self, requests, pending, n_rows, dev_map, seq, t0):
+    def __init__(self, requests, pending, n_rows, dev_map, seq, t0,
+                 span=NOOP_SPAN):
         self.requests = requests
         self.pending = pending
         self.n_rows = n_rows
         self.dev_map = dev_map      # (request index, row offset) per dev row
         self.seq = seq
         self.t0 = t0
+        self.span = span            # serving.batch span, finished at settle
 
 
 def _metrics():
@@ -216,11 +230,18 @@ class DeviceScheduler:
         deadline_s: float | None = None,
         use_device: bool | None = None,
         min_bucket: int | None = None,
+        trace=None,
     ) -> Future:
         """Enqueue (PublicKey, signature, message) rows; the Future
         resolves to a ``RowResult``. Raises ``SchedulerClosedError`` /
         ``SchedulerSaturatedError`` synchronously (admission control
-        rejects at the door, it never queues doomed work)."""
+        rejects at the door, it never queues doomed work).
+
+        ``trace`` is an explicit parent ``TraceContext``/``Span`` for
+        callers submitting from a thread that is not the traced request's
+        (the notary flusher); same-thread callers inherit the activated
+        context automatically. Sampled requests get a ``serving.queue``
+        span covering admission→dispatch."""
         if priority not in _CLASSES:
             raise ValueError(f"unknown priority class {priority!r}")
         rows = list(rows)
@@ -228,22 +249,35 @@ class DeviceScheduler:
         if not rows:
             fut.set_result(RowResult(np.zeros(0, dtype=bool), 0, -1))
             return fut
+        trc = tracer()
+        queue_span = trc.start(
+            SPAN_SERVING_QUEUE,
+            trace if trace is not None else trc.current(),
+            attrs={"priority": priority, "rows": len(rows)},
+        )
         now = time.monotonic()
         req = _Request(
             rows, fut, priority,
             self._use_device_default if use_device is None else use_device,
             min_bucket, now,
             None if deadline_s is None else now + deadline_s,
+            queue_span=queue_span,
         )
         with self._lock:
             if self._closed:
-                raise SchedulerClosedError("device scheduler is shut down")
+                err = SchedulerClosedError("device scheduler is shut down")
+                queue_span.set_error(err)
+                queue_span.finish()
+                raise err
             if self._queued_rows + len(rows) > self._max_queue_rows:
                 _metrics().counter("serving.rejected").inc()
-                raise SchedulerSaturatedError(
+                err = SchedulerSaturatedError(
                     f"serving queue full ({self._queued_rows} rows queued, "
                     f"bound {self._max_queue_rows})"
                 )
+                queue_span.set_error(err)
+                queue_span.finish()
+                raise err
             self._queues[priority].append(req)
             self._queued_rows += len(rows)
             dt = now - self._arrival_last
@@ -266,6 +300,7 @@ class DeviceScheduler:
         deadline_s: float | None = None,
         use_device: bool | None = None,
         min_bucket: int | None = None,
+        trace=None,
     ) -> Future:
         """Enqueue the signature half of a batched transaction check; the
         Future resolves to a ``BatchVerifyReport`` with verdicts identical
@@ -283,7 +318,7 @@ class DeviceScheduler:
         rows, row_tx, row_sig = flatten_signature_rows(stxs)
         inner = self.submit_rows(
             rows, priority=priority, deadline_s=deadline_s,
-            use_device=use_device, min_bucket=min_bucket,
+            use_device=use_device, min_bucket=min_bucket, trace=trace,
         )
         out: Future = Future()
 
@@ -329,9 +364,12 @@ class DeviceScheduler:
             if shed:
                 _metrics().counter("serving.shed").inc(len(shed))
                 for r in shed:
-                    _complete(r.future, error=DeadlineExceededError(
+                    err = DeadlineExceededError(
                         "request shed: deadline passed while queued"
-                    ))
+                    )
+                    r.queue_span.set_error(err)
+                    r.queue_span.finish()
+                    _complete(r.future, error=err)
             if not batch:
                 continue
             try:
@@ -423,6 +461,23 @@ class DeviceScheduler:
         # occupancy histogram: requests coalesced per batch (the Timer is
         # a generic histogram; values are counts, not seconds)
         m.timer("serving.batch_occupancy").update(float(len(batch)))
+        # one serving.batch span per dispatched batch: parented under the
+        # FIRST sampled member's queue span (which makes a lone flow's
+        # trace a clean chain) and LINKED to every sampled member — the
+        # fan-in of cross-client coalescing that a parent tree alone
+        # cannot express. Queue-wait spans close here: the wait is over.
+        batch_span = NOOP_SPAN
+        for r in batch:
+            qs = r.queue_span
+            if qs.sampled:
+                qs.set_attr("batch_seq", seq)
+                if not batch_span.sampled:
+                    batch_span = tracer().start(
+                        SPAN_SERVING_BATCH, qs,
+                        attrs={"batch_seq": seq, "n_requests": len(batch)},
+                    )
+                batch_span.add_link(qs)
+            qs.finish()
         dev_reqs = [r for r in batch if r.use_device]
         host_reqs = [r for r in batch if not r.use_device]
         pending = None
@@ -442,28 +497,43 @@ class DeviceScheduler:
             bucket = self._shapes.bucket_for(len(dev_rows), floor=floor)
             try:
                 # the scheduler-level fail site: a FaultPlan can force the
-                # WHOLE batch onto the host reference path deterministically
-                check_site("serving.dispatch")
-                pending = dispatch_signature_rows(
-                    dev_rows, use_device=True, min_bucket=bucket
-                )
+                # WHOLE batch onto the host reference path deterministically.
+                # The batch span is ACTIVATED around the dispatch so a fault
+                # injected here (or at the nested verifier.device site)
+                # stamps this batch's trace id onto its chaos event —
+                # without it the dispatcher thread has no ambient context
+                with tracer().activate(batch_span):
+                    check_site("serving.dispatch")
+                    pending = dispatch_signature_rows(
+                        dev_rows, use_device=True, min_bucket=bucket
+                    )
             except Exception:
                 m.counter("serving.device_failover").inc()
+                batch_span.set_attr("device_failover", True)
                 host_reqs = host_reqs + dev_reqs
                 dev_reqs, pending = [], None
+        device_entry = bool(dev_reqs and pending is not None)
+        batch_span.set_attr(
+            "routing", "device" if device_entry else "host"
+        )
         if host_reqs:
+            # a host-only batch's span closes when the host pool settles
+            # it; a mixed batch's span rides the device entry instead
+            host_span = batch_span if not device_entry else NOOP_SPAN
             try:
-                self._host_pool.submit(self._settle_host, host_reqs, seq)
+                self._host_pool.submit(
+                    self._settle_host, host_reqs, seq, host_span
+                )
             except RuntimeError:
-                self._settle_host(host_reqs, seq)  # pool closed: inline
-        if dev_reqs and pending is not None:
+                self._settle_host(host_reqs, seq, host_span)  # pool closed
+        if device_entry:
             return _InFlight(dev_reqs, pending, len(dev_rows), dev_map,
-                             seq, t0)
+                             seq, t0, span=batch_span)
         return None
 
     # ------------------------------------------------------------ collect
     @staticmethod
-    def _settle_host(requests: list, seq: int) -> None:
+    def _settle_host(requests: list, seq: int, span=NOOP_SPAN) -> None:
         """Host reference path for host-routed (or failed-over) requests;
         runs on the host pool so a bulk host window never delays an
         unrelated batch's settlement."""
@@ -476,7 +546,9 @@ class DeviceScheduler:
                 )
                 _complete(r.future, result=RowResult(mask, 0, seq))
             except Exception as e:
+                span.set_error(e)
                 _complete(r.future, error=e)
+        span.finish()
 
     def _collect_loop(self) -> None:
         while True:
@@ -486,6 +558,8 @@ class DeviceScheduler:
             try:
                 self._settle(entry)
             except Exception as e:
+                entry.span.set_error(e)
+                entry.span.finish()
                 for r in entry.requests:
                     _complete(r.future, error=e)
             finally:
@@ -507,6 +581,9 @@ class DeviceScheduler:
         latency = time.monotonic() - entry.t0
         m = _metrics()
         m.timer("serving.batch_latency_s").update(latency)
+        entry.span.set_attr("n_rows", entry.n_rows)
+        entry.span.set_attr("device_rows", int(sum(n_device)))
+        entry.span.finish()
         with self._lock:
             self._latency_ewma = (
                 latency if self._latency_ewma == 0.0

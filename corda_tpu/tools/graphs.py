@@ -81,7 +81,10 @@ def layering_violations(edges: dict[str, set[str]]) -> list[tuple[str, str]]:
     # wire types at import; ops loads C++ engines), unlike the JVM stack
     # where serialization sits above the data model
     layer = {
-        "native_build": 0, "serialization": 0,
+        # observability is foundational on purpose: every layer opens
+        # spans / records metrics, so the tracer must sit below them all
+        # (its only corda_tpu imports are function-level)
+        "native_build": 0, "serialization": 0, "observability": 0,
         "ops": 1, "crypto": 1,  # mutually layered: ops hashes crypto's
                                 # types, crypto dispatches to ops kernels
         "ledger": 2,

@@ -171,6 +171,7 @@ class BatchedVerifierService(TransactionVerifierService):
 
     # -------------------------------------------------- scheduler routing
     def _submit_via_scheduler(self, stx, resolve_state, allowed) -> Future:
+        from corda_tpu.observability import SPAN_VERIFIER_REQUEST, tracer
         from corda_tpu.serving import SERVICE, device_scheduler
 
         with self._lock:
@@ -178,12 +179,21 @@ class BatchedVerifierService(TransactionVerifierService):
                 raise VerificationError("verifier service is shut down")
             fut: Future = Future()
             self._outstanding.add(fut)
+        # verifier.request spans the whole round-trip (submit → scheduler
+        # queue → batch → contract run); the caller's context is captured
+        # HERE because settle/finish run on scheduler and pool threads
+        trc = tracer()
+        span = trc.start(SPAN_VERIFIER_REQUEST, trc.current(),
+                         attrs={"tx.id": str(stx.id)})
+        t0 = time.monotonic()
         try:
             inner = device_scheduler().submit_transactions(
                 [stx], [allowed], priority=SERVICE,
-                use_device=self._use_device,
+                use_device=self._use_device, trace=span,
             )
-        except Exception:
+        except Exception as e:
+            span.set_error(e)
+            span.finish()
             with self._lock:
                 self._outstanding.discard(fut)
             raise
@@ -214,6 +224,7 @@ class BatchedVerifierService(TransactionVerifierService):
             def finish():
                 try:
                     if err is not None:
+                        span.set_error(err)
                         _complete(fut, error=err)
                     elif resolve_state is not None:
                         ltx = stx.tx.to_ledger_transaction(resolve_state)
@@ -222,8 +233,18 @@ class BatchedVerifierService(TransactionVerifierService):
                     else:
                         _complete(fut)
                 except Exception as e:
+                    span.set_error(e)
                     _complete(fut, error=e)
                 finally:
+                    span.finish()
+                    # verify_signed round-trip (queue + batch + contract
+                    # run) — the verifier-tier latency distribution the
+                    # exposition reports p50/p95/p99 for
+                    from corda_tpu.node.monitoring import node_metrics
+
+                    node_metrics().timer("verifier.request_s").update(
+                        time.monotonic() - t0
+                    )
                     with self._lock:
                         self._outstanding.discard(fut)
 

@@ -1,0 +1,684 @@
+"""Observability tier tests: tracing (span model, propagation, wire
+travel, chaos stamping), the quantile upgrade to the metric registry,
+the Prometheus exposition, the RPC/string-call surface, and the
+metrics-name lint — docs/OBSERVABILITY.md is the spec."""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.node.monitoring import (
+    Meter,
+    MetricRegistry,
+    QuantileReservoir,
+    Timer,
+    monitoring_snapshot,
+    node_metrics,
+)
+from corda_tpu.observability import (
+    NOOP_SPAN,
+    TraceContext,
+    Tracer,
+    configure_tracing,
+    metrics_text,
+    parse_prometheus,
+    render_prometheus,
+    tracer,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def traced():
+    """Sampling on for the test, off (the default) afterwards; ring
+    cleared both ways so traces never leak between tests."""
+    configure_tracing(sample_rate=1.0)
+    tracer().clear()
+    yield tracer()
+    configure_tracing(sample_rate=0.0)
+    tracer().clear()
+
+
+# ---------------------------------------------------------------- tracer
+
+class TestTracer:
+    def test_off_by_default_returns_noop(self):
+        t = Tracer(sample_rate=0.0)
+        span = t.root("flow")
+        assert span is NOOP_SPAN and not span.sampled
+        assert t.start("child", span) is NOOP_SPAN
+        # activating a no-op must not mask an outer context
+        with t.activate(span):
+            assert t.current() is None
+        assert span.wire() == ""
+
+    def test_sampled_trace_parents_and_ring(self):
+        t = Tracer(sample_rate=1.0)
+        root = t.root("flow", attrs={"flow.id": "f-1"})
+        assert root.sampled
+        with t.activate(root):
+            child = t.start("flow.verify_stx", t.current())
+            child.finish()
+        root.finish()
+        spans = t.trace(root.trace_id)
+        assert [s["name"] for s in spans] == ["flow", "flow.verify_stx"]
+        assert spans[1]["parent_id"] == root.span_id
+        assert spans[0]["parent_id"] is None
+        assert t.trace_for_attr("flow.id", "f-1") == spans
+        assert t.trace_for_attr("flow.id", "nope") == []
+
+    def test_explicit_context_and_links(self):
+        t = Tracer(sample_rate=1.0)
+        root = t.root("flow")
+        # explicit propagation: a different thread parents via the ctx
+        out = {}
+
+        def other_thread():
+            span = t.start("serving.batch", root.ctx)
+            span.add_link(root)
+            span.finish()
+            out["span"] = span
+
+        th = threading.Thread(target=other_thread)
+        th.start()
+        th.join()
+        s = out["span"]
+        assert s.trace_id == root.trace_id
+        assert s.parent_id == root.span_id
+        assert s.to_dict()["links"] == [root.ctx.to_wire()]
+
+    def test_wire_roundtrip(self):
+        ctx = TraceContext("abc123", "def456")
+        assert TraceContext.from_wire(ctx.to_wire()) == ctx
+        assert TraceContext.from_wire("") is None
+        assert TraceContext.from_wire("garbage") is None
+        assert TraceContext.from_wire(":") is None
+
+    def test_ring_is_bounded(self):
+        t = Tracer(sample_rate=1.0, ring_size=16)
+        for i in range(100):
+            t.root(f"s{i}").finish()
+        dump = t.dump()
+        assert len(dump) == 16
+        assert dump[-1]["name"] == "s99"
+
+    def test_jsonl_sink(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        t = Tracer(sample_rate=1.0, jsonl_path=path)
+        for i in range(3):
+            t.root("flow", attrs={"i": i}).finish()
+        lines = [json.loads(ln) for ln in open(path)]
+        assert [ln["attrs"]["i"] for ln in lines] == [0, 1, 2]
+        assert all(ln["duration_s"] >= 0 for ln in lines)
+
+    def test_span_context_manager_records_errors(self):
+        t = Tracer(sample_rate=1.0)
+        with pytest.raises(ValueError):
+            with t.root("flow"):
+                raise ValueError("boom")
+        (span,) = t.dump()
+        assert span["status"].startswith("error: ValueError")
+
+    def test_activation_nests_and_unwinds(self):
+        t = Tracer(sample_rate=1.0)
+        a = t.root("flow")
+        b = t.start("flow.verify_stx", a)
+        with t.activate(a):
+            assert t.current() == a.ctx
+            with t.activate(b):
+                assert t.current() == b.ctx
+            assert t.current() == a.ctx
+        assert t.current() is None
+
+
+# ------------------------------------------------------------- quantiles
+
+class TestQuantiles:
+    def test_reservoir_exact_when_under_capacity(self):
+        r = QuantileReservoir(size=512)
+        for i in range(100):
+            r.update(float(i))
+        p50, p95, p99 = r.quantiles()
+        assert p50 == 50.0 and p95 == 95.0 and p99 == 99.0
+
+    def test_reservoir_bounded_and_sane_over_capacity(self):
+        r = QuantileReservoir(size=64)
+        for i in range(10_000):
+            r.update(float(i))
+        assert len(r._values) == 64
+        p50, _p95, p99 = r.quantiles()
+        # a uniform sample of 0..9999: the median estimate must land
+        # mid-range and the ordering invariant must hold
+        assert 2000 < p50 < 8000
+        assert p99 >= p50
+
+    def test_empty_reservoir_reads_zero(self):
+        assert QuantileReservoir().quantiles() == [0.0, 0.0, 0.0]
+
+    def test_timer_snapshot_has_quantiles(self):
+        t = Timer()
+        for i in range(1, 101):
+            t.update(i / 1000.0)
+        snap = t.snapshot()
+        assert snap["p50_s"] == pytest.approx(0.051)
+        assert snap["p95_s"] == pytest.approx(0.096)
+        assert snap["p99_s"] == pytest.approx(0.1)
+        assert snap["total_s"] == pytest.approx(sum(
+            i / 1000.0 for i in range(1, 101)
+        ))
+        assert snap["p50_s"] <= snap["p95_s"] <= snap["p99_s"] <= snap["max_s"]
+
+    def test_meter_snapshot_has_mark_size_quantiles(self):
+        m = Meter()
+        for n in (1, 1, 1, 1, 1, 1, 1, 1, 1, 100):
+            m.mark(n)
+        snap = m.snapshot()
+        assert snap["count"] == 109
+        assert snap["p50"] == 1.0
+        assert snap["p99"] == 100.0
+
+
+# ------------------------------------------------- satellite: metric fixes
+
+class TestMeterBurstAccounting:
+    def test_same_tick_marks_fold_into_next_sample(self):
+        """10 marks inside one clock tick + 1 mark a second later must
+        rate-account all 11 events, not just the final 1 (the burst
+        understatement bug)."""
+        clock = {"t": 0.0}
+        m = Meter(clock=lambda: clock["t"])
+        for _ in range(10):
+            m.mark()
+        assert m.one_minute_rate == 0.0  # no nonzero interval yet
+        clock["t"] = 1.0
+        m.mark()
+        expected = (1.0 - math.exp(-1 / 60.0)) * 11.0
+        assert m.one_minute_rate == pytest.approx(expected)
+        # pending drained: the next interval starts clean
+        clock["t"] = 2.0
+        m.mark()
+        assert m.count == 12
+
+    def test_rate_still_ewma_under_steady_marks(self):
+        clock = {"t": 0.0}
+        m = Meter(clock=lambda: clock["t"])
+        for i in range(1, 61):
+            clock["t"] = float(i)
+            m.mark()
+        assert m.one_minute_rate == pytest.approx(1.0, rel=0.4)
+
+
+class TestGaugeReadBeforeRegistration:
+    def test_read_before_registration_returns_placeholder(self):
+        r = MetricRegistry()
+        g = r.gauge("serving.not_yet")
+        assert g.value is None
+        assert g.snapshot() == {"type": "gauge", "value": None}
+        # a later registration replaces the placeholder
+        r.gauge("serving.not_yet", lambda: 7)
+        assert r.gauge("serving.not_yet").value == 7
+
+    def test_placeholder_does_not_poison_writers(self):
+        """An early gauge READ of a name that later becomes a counter must
+        not wedge the counter's writer (the placeholder is transient)."""
+        r = MetricRegistry()
+        assert r.gauge("serving.shed").value is None
+        r.counter("serving.shed").inc(2)  # would AttributeError if poisoned
+        assert r.counter("serving.shed").count == 2
+        assert "serving.shed" in r.snapshot()
+
+    def test_read_of_non_gauge_is_a_clear_error(self):
+        r = MetricRegistry()
+        r.counter("x").inc()
+        with pytest.raises(TypeError, match="not a Gauge"):
+            r.gauge("x")
+
+    def test_concurrent_reads_and_registrations_race_free(self):
+        r = MetricRegistry()
+        errors = []
+
+        def reader():
+            try:
+                for _ in range(300):
+                    r.gauge("racy").snapshot()
+            except Exception as e:  # pragma: no cover - failure capture
+                errors.append(e)
+
+        def writer():
+            try:
+                for i in range(300):
+                    r.gauge("racy", lambda i=i: i)
+            except Exception as e:  # pragma: no cover - failure capture
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=f)
+            for f in (reader, writer, reader, writer)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+
+# ----------------------------------------------------------- exposition
+
+class TestExposition:
+    def _populated_registry(self):
+        r = MetricRegistry()
+        r.counter("serving.shed").inc(3)
+        t = r.timer("serving.wait_s")
+        for i in range(50):
+            t.update(i / 100.0)
+        r.meter("serving.rows").mark(8)
+        r.gauge("serving.queue_depth", lambda: 2)
+        return r
+
+    def test_render_parses_and_has_quantiles(self):
+        text = render_prometheus(self._populated_registry().snapshot())
+        samples = parse_prometheus(text)
+        assert samples["cordatpu_serving_shed_total"] == "3"
+        assert samples["cordatpu_serving_queue_depth"] == "2"
+        for q in ("0.5", "0.95", "0.99"):
+            assert (
+                f'cordatpu_serving_wait_s_seconds{{quantile="{q}"}}'
+                in samples
+            )
+        assert samples["__types__"]["cordatpu_serving_shed"] == "counter"
+        assert (
+            samples["__types__"]["cordatpu_serving_wait_s_seconds"]
+            == "summary"
+        )
+
+    def test_every_line_well_formed(self):
+        text = render_prometheus(self._populated_registry().snapshot())
+        for line in text.splitlines():
+            assert line.startswith("#") or " " in line
+        parse_prometheus(text)  # raises on any malformed line
+
+    def test_parser_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            parse_prometheus("cordatpu_bad_value 12notanumber")
+
+    def test_non_numeric_gauge_skipped(self):
+        r = MetricRegistry()
+        r.gauge("weird", lambda: {"a": 1})
+        assert render_prometheus(r.snapshot()) == ""
+
+    def test_process_and_node_registries_namespaced(self):
+        node_metrics().counter("serving.shed").inc()
+        node_reg = MetricRegistry()
+        node_reg.meter("notary.requests").mark(4)
+        text = metrics_text(node_reg)
+        samples = parse_prometheus(text)
+        assert "cordatpu_serving_shed_total" in samples
+        assert samples["cordatpu_node_notary_requests_total"] == "4"
+
+
+# -------------------------------------------- monitoring snapshot + RPC
+
+class TestMonitoringSurface:
+    def test_snapshot_sectioning(self):
+        node_metrics().counter("serving.shed").inc()
+        node_metrics().counter("verifier.device_failover").inc()
+        snap = monitoring_snapshot()
+        assert set(snap) == {"serving", "process"}
+        assert "shed" in snap["serving"]
+        assert "device_failover" not in snap["serving"]
+        assert "verifier.device_failover" in snap["process"]
+        assert not any(k.startswith("serving.") for k in snap["process"])
+
+    def _ops(self):
+        from corda_tpu.node import ServiceHub
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        hub = ServiceHub()
+        hub.metrics.meter("notary.requests").mark(2)
+        return CordaRPCOps(hub, smm=None)
+
+    def test_string_call_rpc_path(self, traced):
+        """The shell's text dispatch must reach every observability op:
+        monitoring_snapshot / serving_metrics / metrics_text /
+        trace_dump / trace_for."""
+        from corda_tpu.rpc.string_calls import StringToMethodCallParser
+
+        span = traced.root("flow", attrs={"flow.id": "flow-abc"})
+        traced.start("flow.verify_stx", span).finish()
+        span.finish()
+
+        parser = StringToMethodCallParser(self._ops())
+        snap = parser.invoke("monitoring_snapshot")
+        assert set(snap) >= {"serving", "process", "node"}
+        serving = parser.invoke("serving_metrics")
+        assert isinstance(serving, dict)
+        text = parser.invoke("metrics_text")
+        samples = parse_prometheus(text)
+        assert samples["cordatpu_node_notary_requests_total"] == "2"
+        dump = parser.invoke("trace_dump limit: 10")
+        assert any(s["name"] == "flow" for s in dump)
+        trace = parser.invoke("trace_for flow_id: flow-abc")
+        assert [s["name"] for s in trace] == ["flow", "flow.verify_stx"]
+        assert parser.invoke("trace_for flow_id: unknown") == []
+
+    def test_metrics_text_includes_serving_and_verifier_quantiles(
+        self, traced
+    ):
+        """Acceptance: the exposition includes p50/p95/p99 for the
+        serving and verifier timers after real traffic through both."""
+        from corda_tpu.crypto import generate_keypair
+        from corda_tpu.finance import CashState
+        from corda_tpu.finance.contracts import CASH_PROGRAM_ID, Issue
+        from corda_tpu.ledger import (
+            Amount,
+            CordaX500Name,
+            Issued,
+            Party,
+            PartyAndReference,
+            TransactionBuilder,
+        )
+        from corda_tpu.verifier import BatchedVerifierService
+
+        akp = generate_keypair()
+        alice = Party(CordaX500Name("ExpoAlice", "London", "GB"), akp.public)
+        nkp = generate_keypair()
+        notary = Party(
+            CordaX500Name("ExpoNotary", "London", "GB"), nkp.public
+        )
+        token = Issued(PartyAndReference(alice, b"\x01"), "GBP")
+        b = TransactionBuilder(notary=notary)
+        b.add_output_state(
+            CashState(Amount(100, token), alice), CASH_PROGRAM_ID
+        )
+        b.add_command(Issue(), alice.owning_key)
+        stx = b.sign_initial_transaction(akp)
+
+        svc = BatchedVerifierService(use_device=False)
+        try:
+            fut = svc.verify_signed(stx, None, {notary.owning_key})
+            assert fut.result(timeout=30) is None
+        finally:
+            svc.shutdown()
+        samples = parse_prometheus(self._ops().metrics_text())
+        for fam in ("serving_wait_s", "verifier_request_s"):
+            for q in ("0.5", "0.95", "0.99"):
+                key = f'cordatpu_{fam}_seconds{{quantile="{q}"}}'
+                assert key in samples, (fam, q)
+        assert float(
+            samples['cordatpu_verifier_request_s_seconds{quantile="0.99"}']
+        ) > 0.0
+
+    def test_read_bindings(self, traced):
+        from corda_tpu.rpc.bindings import (
+            metrics_text_value,
+            trace_dump_value,
+            trace_for_value,
+        )
+
+        ops = self._ops()
+        live_text = metrics_text_value(ops)
+        assert "cordatpu_" in live_text.get()
+        traced.root("flow", attrs={"flow.id": "bind-1"}).finish()
+        dump = trace_dump_value(ops)
+        assert any(s["name"] == "flow" for s in dump.refresh())
+        one = trace_for_value(ops, "bind-1")
+        assert [s["name"] for s in one.refresh()] == ["flow"]
+
+
+# ----------------------------------------------------- wire propagation
+
+class TestWirePropagation:
+    def test_session_init_roundtrips_trace(self):
+        from corda_tpu.flows.sessions import SessionInit
+        from corda_tpu.serialization import deserialize, serialize
+
+        init = SessionInit(7, "a.b.Flow", b"", trace="abc:def")
+        assert deserialize(serialize(init)) == init
+
+    def test_session_init_decodes_without_trace_field(self):
+        """Inits from before the trace field (old checkpoints / mixed
+        clusters) decode with an empty trace."""
+        from corda_tpu.flows.sessions import SessionInit
+        from corda_tpu.serialization.cbe import _REGISTRY
+
+        _cls, from_fields = _REGISTRY["flows.SessionInit"]
+        init = from_fields({"sid": 3, "flow": "x.Y", "first": b""})
+        assert init == SessionInit(3, "x.Y", b"", "")
+
+
+# --------------------------------------------------- chaos trace stamping
+
+class TestFaultTraceStamping:
+    def test_injected_event_carries_active_trace(self, traced):
+        from corda_tpu.faultinject import (
+            FaultInjector,
+            FaultPlan,
+            InjectedFault,
+        )
+
+        inj = FaultInjector(FaultPlan(seed=9, fail_sites=(("site.x", 1),)))
+        span = traced.root("flow")
+        with traced.activate(span):
+            with pytest.raises(InjectedFault):
+                inj.check_site("site.x")
+        span.finish()
+        (event,) = inj.trace
+        assert event.trace_id == span.trace_id
+
+    def test_scheduler_dispatch_fault_stamped_cross_thread(self, traced):
+        """The serving.dispatch fault site fires on the DISPATCHER thread;
+        the batch span activation must carry the submitting request's
+        trace onto the chaos event (regression: it stamped "")."""
+        from corda_tpu.crypto import generate_keypair, sign
+        from corda_tpu.faultinject import FaultInjector, FaultPlan
+        from corda_tpu.faultinject import clear as clear_injector
+        from corda_tpu.faultinject import install as install_injector
+        from corda_tpu.serving import DeviceScheduler
+
+        inj = install_injector(FaultInjector(
+            FaultPlan(seed=3, fail_sites=(("serving.dispatch", 1),))
+        ))
+        sched = DeviceScheduler(use_device_default=True)
+        root = traced.root("flow")
+        try:
+            with traced.activate(root):
+                kp = generate_keypair()
+                rows = [
+                    (kp.public, sign(kp.private, b"m%d" % i), b"m%d" % i)
+                    for i in range(4)
+                ]
+                rr = sched.submit_rows(rows).result(timeout=30)
+            assert rr.mask.all()  # failover verdicts stay correct
+        finally:
+            root.finish()
+            sched.shutdown()
+            clear_injector()
+        (event,) = [e for e in inj.trace if e.kind == "op-fail"]
+        assert event.site == "serving.dispatch"
+        assert event.trace_id == root.trace_id
+
+    def test_trace_digest_excludes_stamp(self, traced):
+        """Bit-for-bit replay determinism: the digest must not depend on
+        the (random) trace ids stamped onto events."""
+        from corda_tpu.faultinject import (
+            FaultInjector,
+            FaultPlan,
+            InjectedFault,
+        )
+
+        def run(inside_trace: bool) -> str:
+            inj = FaultInjector(
+                FaultPlan(seed=9, fail_sites=(("site.x", 1),))
+            )
+            if inside_trace:
+                span = traced.root("flow")
+                with traced.activate(span):
+                    with pytest.raises(InjectedFault):
+                        inj.check_site("site.x")
+                span.finish()
+            else:
+                with pytest.raises(InjectedFault):
+                    inj.check_site("site.x")
+            return inj.trace_digest()
+
+        assert run(True) == run(False)
+
+
+# ------------------------------------------------------ end-to-end trace
+
+class TestEndToEndTrace:
+    def test_run_flow_yields_single_connected_trace(self, traced):
+        """Acceptance: one run_flow under the mock network yields ONE
+        trace id whose spans cover flow execution, scheduler queue wait,
+        device batch dispatch, and notary attestation, with parent/child
+        links intact."""
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+        from corda_tpu.testing import MockNetworkNodes
+        from corda_tpu.verifier import BatchedVerifierService
+
+        with MockNetworkNodes() as net:
+            alice = net.create_node("TraceAlice")
+            bob = net.create_node("TraceBob")
+            notary = net.create_notary_node("TraceNotary")
+            vsvc = BatchedVerifierService(use_device=False)
+            alice.services.transaction_verifier_service = vsvc
+            alice.run_flow(
+                CashIssueFlow(1000, "GBP", b"\x01", notary.party)
+            )
+            handle = alice.smm.start_flow(
+                CashPaymentFlow(250, "GBP", bob.party)
+            )
+            handle.result.result(timeout=60)
+            # responder flows record their spans shortly AFTER the
+            # initiator's result future resolves: poll until complete
+            required = {"flow", "flow.verify_stx", "serving.queue",
+                        "serving.batch", "notary.attest", "flow.responder"}
+            deadline = time.monotonic() + 15
+            while True:
+                spans = traced.trace_for_attr("flow.id", handle.flow_id)
+                span_ids = {s["span_id"] for s in spans}
+                orphans = [
+                    s for s in spans
+                    if s["parent_id"] and s["parent_id"] not in span_ids
+                ]
+                names = {s["name"] for s in spans}
+                if (spans and not orphans and required <= names) or (
+                    time.monotonic() >= deadline
+                ):
+                    break
+                time.sleep(0.05)
+            vsvc.shutdown()
+
+        assert required <= names, names
+        assert len({s["trace_id"] for s in spans}) == 1
+        roots = [s for s in spans if s["parent_id"] is None]
+        assert [s["name"] for s in roots] == ["flow"]
+        for s in spans:
+            assert s["parent_id"] is None or s["parent_id"] in span_ids, s
+            assert s["end_s"] is not None and s["duration_s"] >= 0
+        # the batch span links the queue spans it coalesced
+        batch = next(s for s in spans if s["name"] == "serving.batch")
+        assert batch["links"], "batch span must link member requests"
+
+    def test_unsampled_flow_produces_no_spans(self):
+        """Default-off tracing: the same flow machinery emits nothing and
+        pays only no-op spans."""
+        configure_tracing(sample_rate=0.0)
+        tracer().clear()
+        from corda_tpu.finance import CashIssueFlow
+        from corda_tpu.testing import MockNetworkNodes
+
+        with MockNetworkNodes() as net:
+            alice = net.create_node("QuietAlice")
+            notary = net.create_notary_node("QuietNotary")
+            alice.run_flow(
+                CashIssueFlow(100, "GBP", b"\x01", notary.party)
+            )
+        assert tracer().dump() == []
+
+    def test_responder_inherits_not_sampled_decision(self):
+        """An UNSAMPLED initiator sends trace="" on the wire; responders
+        must inherit that decision, never re-roll a root trace of their
+        own (regression: fragment root traces at the sampling rate per
+        responder). Sampling is decided once per trace, at the flow
+        root."""
+        # start_flow rolls the root synchronously, so dropping the rate to
+        # 0 just for that call pins the initiator unsampled; raising it
+        # back to 1.0 before the responders spawn means a buggy re-roll
+        # would root a trace with certainty
+        try:
+            from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+            from corda_tpu.testing import MockNetworkNodes
+
+            with MockNetworkNodes() as net:
+                alice = net.create_node("RerollAlice")
+                bob = net.create_node("RerollBob")
+                notary = net.create_notary_node("RerollNotary")
+                configure_tracing(sample_rate=0.0)
+                alice.run_flow(
+                    CashIssueFlow(100, "GBP", b"\x01", notary.party)
+                )
+                h = alice.smm.start_flow(
+                    CashPaymentFlow(40, "GBP", bob.party)
+                )
+                configure_tracing(sample_rate=1.0)
+                tracer().clear()
+                h.result.result(timeout=60)
+                time.sleep(0.5)
+            # initiator unsampled → every responder (bob, notary) must
+            # stay unsampled too: no spans at all
+            assert tracer().dump() == []
+        finally:
+            configure_tracing(sample_rate=0.0)
+            tracer().clear()
+
+
+# ------------------------------------------------------------- tooling
+
+class TestMetricsLint:
+    def test_lint_passes_on_tree(self):
+        """tier-1 guard: every metric/span name in code is documented in
+        docs/OBSERVABILITY.md (the lint is the registry's enforcement)."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "tools_metrics_lint.py")],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "all documented" in proc.stdout
+
+    def test_lint_catches_undocumented_name(self, tmp_path):
+        """The lint must actually FAIL on an undocumented metric — run it
+        against a scratch tree with one rogue counter."""
+        import shutil
+
+        scratch = tmp_path / "repo"
+        (scratch / "corda_tpu" / "observability").mkdir(parents=True)
+        (scratch / "docs").mkdir()
+        shutil.copy(
+            os.path.join(REPO_ROOT, "tools_metrics_lint.py"),
+            scratch / "tools_metrics_lint.py",
+        )
+        (scratch / "docs" / "OBSERVABILITY.md").write_text(
+            "| `serving.documented` | counter | fine |\n"
+        )
+        (scratch / "corda_tpu" / "observability" / "trace.py").write_text(
+            'SPAN_FLOW = "flow"\n'
+        )
+        (scratch / "corda_tpu" / "rogue.py").write_text(
+            'm.counter("serving.documented").inc()\n'
+            'm.counter("serving.rogue_name").inc()\n'
+        )
+        proc = subprocess.run(
+            [sys.executable, str(scratch / "tools_metrics_lint.py")],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 1
+        assert "serving.rogue_name" in proc.stdout
+        assert "flow" in proc.stdout  # the undocumented span too
