@@ -948,10 +948,16 @@ def _load_cached() -> dict | None:
 
 
 def _save_cached(data: dict) -> None:
+    """Atomic BENCH_LOCAL commit (write temp + rename): a crash or
+    watchdog ``os._exit`` mid-write must never leave a truncated JSON
+    where the next run's ``_apply_cached`` (or the perf gate) expects the
+    last good capture."""
     try:
-        with open(BENCH_LOCAL, "w") as f:
+        tmp = BENCH_LOCAL + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(data, f, indent=1, sort_keys=True)
             f.write("\n")
+        os.replace(tmp, BENCH_LOCAL)
     except Exception:
         pass
 
@@ -1008,6 +1014,63 @@ def wait_for_complete_trace(trc, flow_id: str, required: set,
             assert own, f"no spans recorded for flow {flow_id}"
             return spans
         time.sleep(0.05)
+
+
+def run_profile_pass(reps: int = 3, rows: int = 6) -> dict:
+    """The per-stage PROFILE leg (docs/OBSERVABILITY.md §Profiling): a few
+    small dispatches through the ed25519 verify kernel and the Merkle-id
+    sweep with the kernel profiler ON, condensed into the machine-readable
+    ``profile`` section of the JSON line — compile/execute wall split
+    (keyed first-dispatch latch), batch-efficiency ratios, and achieved
+    rows/sec per kernel. Runs AFTER the measured sections so the
+    profiler's block-until-ready syncs never distort their numbers; the
+    perf gate (tools_perf_gate.py) consumes this section by path
+    (``profile/<kernel>/<field>``)."""
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.observability.profiler import configure_profiler, profiler
+    from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+    from corda_tpu.ops.txid import compute_tx_ids
+
+    configure_profiler(enabled=True, reset=True)
+    try:
+        kp = generate_keypair()
+        msgs = [b"profile%d" % i for i in range(rows)]
+        pks = [kp.public.encoded] * rows
+        sigs = [sign(kp.private, m) for m in msgs]
+        for _ in range(reps):
+            mask = np.asarray(ed25519_verify_dispatch(pks, sigs, msgs))[:rows]
+            assert mask.all(), "profiled ed25519 pass rejected valid sigs"
+        moves, _resolve, _notary_id = make_notary_stream(3)
+        wtxs = [stx.tx for stx in moves]
+        ids = None
+        for _ in range(reps):
+            ids = compute_tx_ids(wtxs)
+        assert ids == [stx.id for stx in moves], "profiled id sweep diverged"
+        snap = profiler().snapshot()
+    finally:
+        configure_profiler(enabled=False)
+
+    profile: dict = {}
+    for kernel, agg in snap["kernels"].items():
+        entry = {
+            "compile_s": agg["compile_s"],
+            "compile_count": agg["compile_count"],
+            "execute_total_s": agg["execute_total_s"],
+            "execute_count": agg["execute_count"],
+            "rows": agg["rows"],
+            "padded_lanes": agg["padded_lanes"],
+            "batch_efficiency": agg["batch_efficiency"],
+            "buckets": sorted(int(b) for b in agg["buckets"]),
+        }
+        for opt in ("rows_per_sec", "roofline_rows_per_sec", "roofline_frac"):
+            if opt in agg:
+                entry[opt] = agg[opt]
+        profile[kernel] = entry
+    for required in ("ed25519.verify", "txid"):
+        assert required in profile, f"profile pass missed {required}"
+        assert profile[required]["execute_count"] >= 1, profile[required]
+        assert 0 < profile[required]["batch_efficiency"] <= 1.0
+    return profile
 
 
 def run_smoke_tracing() -> dict:
@@ -1166,9 +1229,16 @@ def run_smoke() -> int:
         # 6. tracing pass (docs/OBSERVABILITY.md): sampling forced on,
         # one mock-network payment flow must yield a SINGLE connected
         # trace — flow → scheduler queue → device batch → notary attest —
-        # with intact parent links. Runs LAST so steps 1-5 measure the
-        # tracing-disabled (default) scheduler numbers.
+        # with intact parent links. Runs after steps 1-5 so those measure
+        # the tracing-disabled (default) scheduler numbers.
         out.update(run_smoke_tracing())
+
+        # 7. profile pass (docs/OBSERVABILITY.md §Profiling): kernel
+        # profiler forced on, small ed25519-verify + Merkle-id dispatches;
+        # emits the per-stage compile/execute split and batch-efficiency
+        # ratios the perf gate consumes. Runs LAST — the profiler's
+        # blocking syncs must not touch any measured number above.
+        out["profile"] = run_profile_pass()
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]
@@ -1324,6 +1394,13 @@ def main() -> int:
         p.data["dag_1k_chain_best_tx_per_sec"] = round(dag_best, 1)
         if dag_host_rate:
             p.data["dag_vs_host"] = round(dag_median / dag_host_rate, 3)
+
+    # per-stage profile LAST: the profiler's block-until-ready syncs
+    # serialize the pipeline, so it must never run inside a measured
+    # section — this is the accounting capture, not a rate capture
+    prof = p.run("profile_pass", run_profile_pass)
+    if prof:
+        p.data["profile"] = prof
 
     _mfu_analysis(p.data)
     p.data["sig_batch"] = SIG_BATCH

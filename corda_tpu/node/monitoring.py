@@ -285,13 +285,19 @@ def monitoring_snapshot() -> dict:
     """The process-wide observability snapshot, sectioned for the RPC/shell
     surface: ``serving`` holds the device scheduler's queue/batch/shed
     counters and gauges (corda_tpu/serving — queue depth & rows, wait
-    time, batch occupancy & latency, shed/rejected counts, failovers),
-    ``process`` the remaining cross-cutting metrics (e.g. the verifier's
-    ``device_failover`` counters)."""
+    time, batch occupancy & latency, pad waste & fill ratio, shed/rejected
+    counts, failovers), ``profiler`` the kernel profiler's registry
+    mirror (compile/execute timers, row/pad counters — empty until the
+    first profiled dispatch, and retaining the last profiled run's
+    numbers after the profiler is disabled; the per-kernel detail is
+    ``CordaRPCOps.profiler_snapshot()``), ``process`` the remaining
+    cross-cutting metrics (e.g. the verifier's ``device_failover``
+    counters)."""
     return {
         "serving": _process_registry.section("serving."),
+        "profiler": _process_registry.section("profiler."),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
-            if not k.startswith("serving.")
+            if not (k.startswith("serving.") or k.startswith("profiler."))
         },
     }

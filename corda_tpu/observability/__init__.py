@@ -10,9 +10,21 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
 - ``exposition`` — Prometheus-text rendering of the metric registries,
   including the p50/p95/p99 quantiles the reservoir upgrade added to
   ``Timer``/``Meter``.
+- ``profiler`` — the off-by-default kernel profiler: per kernel × shape
+  bucket compile/execute wall split (keyed first-dispatch latch), batch
+  efficiency (real vs padded lanes), bytes in/out, and the roofline join
+  against BASELINE.json. Snapshots ride the registry/exposition above
+  and ``CordaRPCOps.profiler_snapshot()``.
 """
 
 from .exposition import metrics_text, parse_prometheus, render_prometheus
+from .profiler import (
+    DeviceProfiler,
+    active_profiler,
+    configure_profiler,
+    profiler,
+    stamp_span,
+)
 from .trace import (
     NOOP_SPAN,
     SPAN_FLOW,
@@ -33,6 +45,7 @@ from .trace import (
 )
 
 __all__ = [
+    "DeviceProfiler",
     "NOOP_SPAN",
     "SPAN_FLOW",
     "SPAN_FLOW_RESPONDER",
@@ -46,10 +59,14 @@ __all__ = [
     "Span",
     "TraceContext",
     "Tracer",
+    "active_profiler",
+    "configure_profiler",
     "configure_tracing",
     "current_trace_id",
     "metrics_text",
     "parse_prometheus",
+    "profiler",
     "render_prometheus",
+    "stamp_span",
     "tracer",
 ]

@@ -65,9 +65,15 @@ def _render_gauge(lines, name, snap):
 def _render_summary(lines, name, snap, *, quantile_keys, sum_key, unit=""):
     base = name + unit
     lines.append(f"# TYPE {base} summary")
-    for q, key in quantile_keys:
-        if key in snap:
-            lines.append(f'{base}{{quantile="{q}"}} {_fmt(snap[key])}')
+    # an EMPTY reservoir (no samples yet) has no quantiles: omit the
+    # quantile lines entirely — a 0.0 (or NaN) p99 on a never-updated
+    # timer would read as "this path is instant", the worst possible lie
+    # for a latency surface. `_sum`/`_count` still render (count 0 is the
+    # honest signal).
+    if snap.get("count", 0):
+        for q, key in quantile_keys:
+            if key in snap and snap[key] is not None:
+                lines.append(f'{base}{{quantile="{q}"}} {_fmt(snap[key])}')
     if sum_key is not None and sum_key in snap:
         lines.append(f"{base}_sum {_fmt(snap[sum_key])}")
     lines.append(f"{base}_count {_fmt(snap.get('count', 0))}")

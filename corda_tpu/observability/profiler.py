@@ -1,0 +1,422 @@
+"""Kernel-level device profiler: compile/execute accounting per shape bucket.
+
+The serving scheduler (docs/SERVING.md) made every device dispatch go
+through a handful of entry points, and the tracing layer (PR 3) can say
+*which* request a `serving.batch` span served — but the span itself is a
+black box: it cannot split first-call compile time from steady-state
+execute time, say how many padded lanes a shape bucket wasted, or relate
+achieved sigs-per-sec to the device roofline. The FPGA ECDSA engine
+(arxiv 2112.02229) and the EdDSA/BLS committee study (arxiv 2302.00418)
+both attribute throughput to per-kernel batch efficiency before touching
+the kernels; this module is that accounting substrate.
+
+Design contract, in order:
+
+1. **Off by default, near-free when off.** Every instrumented entry point
+   calls ``active_profiler()`` — two attribute reads returning None —
+   and takes its un-instrumented path. No metric is created, no span
+   attr is written, no lock is touched (pinned by a test).
+2. **Keyed first-dispatch latch.** The first profiled dispatch of each
+   ``kernel × bucket`` key is counted (exactly once, thread-safe) as the
+   COMPILE observation: its wall time — measured around the dispatch
+   plus a ``block_until_ready`` on the result — includes the XLA/Mosaic
+   compile when the process is cold. Every later dispatch of that key is
+   a steady-state EXECUTE observation. (On a warm compilation cache the
+   "compile" sample degrades to one more execute sample; the split is
+   first-call wall vs steady-state wall, which is exactly the latency a
+   caller experiences.)
+3. **Batch efficiency is data.** Each record carries real rows vs padded
+   bucket lanes (``efficiency = rows / bucket``) and bytes in/out, per
+   kernel × bucket, so bucket-ladder decisions (serving/shapes.py) can
+   be audited from a snapshot instead of re-benchmarked.
+4. **Snapshots join the existing surfaces.** Aggregates mirror into the
+   process ``MetricRegistry`` (``profiler.*`` — Prometheus exposition
+   comes for free), the full per-kernel/per-bucket detail is
+   ``profiler().snapshot()`` behind ``CordaRPCOps.profiler_snapshot()``,
+   and profiled dispatches stamp their kernel/bucket onto the active
+   ``serving.batch`` span (``stamp_span``) so traces and profiles join.
+
+Profiling ALTERS the measured system: the ``block_until_ready`` sync
+serializes the async dispatch pipeline it measures. That is the point —
+it is a diagnostic mode for attributing device time, not a production
+default; the continuous perf gate (``tools_perf_gate.py``) runs it in a
+dedicated pass after the un-profiled measurement sections.
+
+Roofline join: ``BASELINE.json``'s ``roofline`` table maps kernel names
+to the best measured device rows/sec; snapshots report achieved rows/sec
+(execute-only) and the fraction of roofline reached.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+# Canonical kernel names. Instrumented entry points profile through these
+# constants so the metrics lint (tools_metrics_lint.py) can enumerate
+# every kernel the profiler may report and check each against the
+# docs/OBSERVABILITY.md "Profiling" registry.
+KERNEL_ED25519_VERIFY = "ed25519.verify"    # ops/ed25519.ed25519_verify_dispatch
+KERNEL_ED25519_SIGN = "ed25519.sign"        # ops/ed25519_sign.ed25519_sign_dispatch
+KERNEL_ECDSA_VERIFY = "ecdsa.verify"        # ops/secp256.ecdsa_verify_dispatch (both curves)
+KERNEL_SHA256 = "sha256"                    # ops/sha256.sha256_batch_words
+KERNEL_SHA512 = "sha512"                    # ops/sha512.sha512_batch
+KERNEL_TXID = "txid"                        # ops/txid Merkle-id sweep (leaves = rows)
+KERNEL_SPHINCS = "sphincs.verify"           # ops/sphincs_batch.sphincs_verify_dispatch
+KERNEL_HOST_REF = "host_ref"                # ops/host_ref.verify_loop (C loop)
+KERNEL_SERVING_DISPATCH = "serving.batch"   # scheduler device dispatch (whole batch)
+
+
+def _sync(result) -> None:
+    """Force a device result to finish so the measured wall time covers
+    execution, not just enqueue. Handles jax arrays (block_until_ready),
+    tuples/lists of them, and plain host values (no-op)."""
+    if result is None:
+        return
+    if isinstance(result, (tuple, list)):
+        for item in result:
+            _sync(item)
+        return
+    block = getattr(result, "block_until_ready", None)
+    if block is not None:
+        try:
+            block()
+        except Exception:
+            pass  # a failing readback is the caller's error to surface
+
+
+class _KernelStats:
+    """Accumulated observations for one kernel × bucket key. Mutated only
+    under the owning profiler's lock."""
+
+    __slots__ = ("compile_count", "compile_s", "exec_count", "exec_total_s",
+                 "exec_min_s", "exec_max_s", "rows", "exec_rows", "lanes",
+                 "bytes_in", "bytes_out")
+
+    def __init__(self):
+        self.compile_count = 0
+        self.compile_s = 0.0
+        self.exec_count = 0
+        self.exec_total_s = 0.0
+        self.exec_min_s = float("inf")
+        self.exec_max_s = 0.0
+        self.rows = 0        # real rows, all observations
+        self.exec_rows = 0   # real rows, execute observations only
+        self.lanes = 0       # padded bucket lanes, all observations
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+
+# thread-local stack of spans that profiled dispatches should stamp
+# (the scheduler pushes its serving.batch span around the batch dispatch)
+_span_stack = threading.local()
+
+
+class _NoStamp:
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NO_STAMP = _NoStamp()
+
+
+class _SpanStamp:
+    __slots__ = ("_span",)
+
+    def __init__(self, span):
+        self._span = span
+
+    def __enter__(self):
+        stack = getattr(_span_stack, "stack", None)
+        if stack is None:
+            stack = _span_stack.stack = []
+        stack.append(self._span)
+        return self._span
+
+    def __exit__(self, *exc):
+        stack = getattr(_span_stack, "stack", None)
+        if stack and stack[-1] is self._span:
+            stack.pop()
+        return False
+
+
+def stamp_span(span):
+    """``with stamp_span(batch_span):`` — profiled dispatches inside the
+    block stamp their kernel/bucket onto ``span`` (``profiler.kernel`` /
+    ``profiler.bucket`` attrs plus a cumulative ``profiler.kernels``
+    list). A shared no-op when the profiler is disabled or the span is
+    unsampled, so the scheduler's hot path pays two reads."""
+    p = _global
+    if p is None or not p._enabled or not getattr(span, "sampled", False):
+        return _NO_STAMP
+    return _SpanStamp(span)
+
+
+class DeviceProfiler:
+    """Process-global kernel profiler (construct directly only in tests;
+    production code shares ``profiler()``)."""
+
+    def __init__(self, *, enabled: bool | None = None):
+        if enabled is None:
+            enabled = os.environ.get(
+                "CORDA_TPU_PROFILE", ""
+            ).strip().lower() in ("1", "true", "on", "yes")
+        self._enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._stats: dict[tuple[str, int], _KernelStats] = {}
+        self._compiled: set[tuple[str, int]] = set()
+
+    # ------------------------------------------------------------- config
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    def reset(self) -> None:
+        """Drop accumulated stats AND the compile latch (the next dispatch
+        of every key counts as a fresh first call)."""
+        with self._lock:
+            self._stats.clear()
+            self._compiled.clear()
+
+    # ------------------------------------------------------------ profile
+    def profile(self, kernel: str, fn, *, rows: int, bucket: int,
+                bytes_in: int = 0, bytes_out=None, sync=None):
+        """Run ``fn`` (a zero-arg dispatch closure), block its result to
+        ready, and record one observation for ``kernel × bucket``.
+        Returns ``fn``'s result unchanged.
+
+        ``rows`` is the real (caller-visible) row count, ``bucket`` the
+        padded lane count the kernel actually ran — pass a CALLABLE over
+        the result (evaluated after the sync) when the true lane count is
+        only known post-dispatch (e.g. the returned mask's padded shape);
+        deriving it from the result keeps the profiler keyed to what the
+        kernel really ran instead of a re-derivation of its padding rule.
+        ``bytes_out`` may likewise be an int or a callable over the
+        result. ``sync`` overrides the default readiness wait for results
+        that wrap their device arrays (pending-style objects). Zero-row
+        dispatches are passed through unrecorded."""
+        if not self._enabled or rows <= 0:
+            return fn()
+        t0 = time.perf_counter()
+        result = fn()
+        if sync is not None:
+            try:
+                sync(result)
+            except Exception:
+                pass
+        else:
+            _sync(result)
+        dt = time.perf_counter() - t0
+        if callable(bucket):
+            try:
+                bucket = int(bucket(result) or 0)
+            except Exception:
+                bucket = 0
+        bucket = max(int(bucket), int(rows), 1)
+        if callable(bytes_out):
+            try:
+                bytes_out = int(bytes_out(result) or 0)
+            except Exception:
+                bytes_out = 0
+        self._record(kernel, bucket, int(rows), dt, int(bytes_in),
+                     int(bytes_out or 0))
+        return result
+
+    def _record(self, kernel: str, bucket: int, rows: int, dt: float,
+                bytes_in: int, bytes_out: int) -> None:
+        key = (kernel, bucket)
+        with self._lock:
+            st = self._stats.get(key)
+            if st is None:
+                st = self._stats[key] = _KernelStats()
+            # the keyed first-dispatch latch: exactly one observation per
+            # key is the compile sample, decided under this lock so two
+            # threads racing a fresh key cannot both claim it
+            first = key not in self._compiled
+            if first:
+                self._compiled.add(key)
+                st.compile_count += 1
+                st.compile_s += dt
+            else:
+                st.exec_count += 1
+                st.exec_total_s += dt
+                st.exec_min_s = min(st.exec_min_s, dt)
+                st.exec_max_s = max(st.exec_max_s, dt)
+                st.exec_rows += rows
+            st.rows += rows
+            st.lanes += bucket
+            st.bytes_in += bytes_in
+            st.bytes_out += bytes_out
+        # registry mirror outside the lock: the MetricRegistry has its own
+        from corda_tpu.node.monitoring import node_metrics
+
+        m = node_metrics()
+        m.meter("profiler.dispatches").mark()
+        m.meter("profiler.rows").mark(rows)
+        m.counter("profiler.pad_rows").inc(bucket - rows)
+        (m.timer("profiler.compile_s") if first
+         else m.timer("profiler.execute_s")).update(dt)
+        if bytes_in:
+            m.counter("profiler.bytes_in").inc(bytes_in)
+        if bytes_out:
+            m.counter("profiler.bytes_out").inc(bytes_out)
+        stack = getattr(_span_stack, "stack", None)
+        if stack:
+            span = stack[-1]
+            span.set_attr("profiler.kernel", kernel)
+            span.set_attr("profiler.bucket", bucket)
+            kernels = span.attrs.setdefault("profiler.kernels", [])
+            kernels.append(f"{kernel}/{bucket}")
+
+    # ----------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """The full per-kernel / per-bucket accounting, JSON-shaped:
+        compile vs execute wall, batch efficiency, bytes, achieved
+        rows/sec (execute-only) and the roofline fraction where
+        ``BASELINE.json`` has a number for the kernel."""
+        with self._lock:
+            items = [
+                (kernel, bucket, st) for (kernel, bucket), st
+                in sorted(self._stats.items())
+            ]
+        roofline = _roofline_table()
+        kernels: dict = {}
+        for kernel, bucket, st in items:
+            agg = kernels.setdefault(kernel, {
+                "compile_count": 0, "compile_s": 0.0,
+                "execute_count": 0, "execute_total_s": 0.0,
+                "rows": 0, "exec_rows": 0, "padded_lanes": 0,
+                "bytes_in": 0, "bytes_out": 0, "buckets": {},
+            })
+            b = {
+                "compile_count": st.compile_count,
+                "compile_s": round(st.compile_s, 6),
+                "execute_count": st.exec_count,
+                "execute_total_s": round(st.exec_total_s, 6),
+                "execute_mean_s": round(
+                    st.exec_total_s / st.exec_count, 6
+                ) if st.exec_count else 0.0,
+                "execute_min_s": (
+                    0.0 if st.exec_count == 0 else round(st.exec_min_s, 6)
+                ),
+                "execute_max_s": round(st.exec_max_s, 6),
+                "rows": st.rows,
+                "padded_lanes": st.lanes,
+                "batch_efficiency": round(st.rows / st.lanes, 4),
+                "bytes_in": st.bytes_in,
+                "bytes_out": st.bytes_out,
+            }
+            if st.exec_total_s > 0:
+                b["rows_per_sec"] = round(st.exec_rows / st.exec_total_s, 1)
+            agg["buckets"][str(bucket)] = b
+            agg["compile_count"] += st.compile_count
+            agg["compile_s"] += st.compile_s
+            agg["execute_count"] += st.exec_count
+            agg["execute_total_s"] += st.exec_total_s
+            agg["rows"] += st.rows
+            agg["exec_rows"] += st.exec_rows
+            agg["padded_lanes"] += st.lanes
+            agg["bytes_in"] += st.bytes_in
+            agg["bytes_out"] += st.bytes_out
+        for kernel, agg in kernels.items():
+            # rate math on the RAW total — rounding first would zero out
+            # sub-microsecond executes and drop the roofline join
+            raw_exec_total = agg["execute_total_s"]
+            agg["compile_s"] = round(agg["compile_s"], 6)
+            agg["execute_total_s"] = round(raw_exec_total, 6)
+            agg["batch_efficiency"] = round(
+                agg["rows"] / agg["padded_lanes"], 4
+            ) if agg["padded_lanes"] else 0.0
+            if raw_exec_total > 0:
+                agg["rows_per_sec"] = round(
+                    agg["exec_rows"] / raw_exec_total, 1
+                )
+                peak = roofline.get(kernel)
+                if isinstance(peak, (int, float)) and peak > 0:
+                    agg["roofline_rows_per_sec"] = peak
+                    agg["roofline_frac"] = round(
+                        agg["rows_per_sec"] / peak, 4
+                    )
+        return {"enabled": self._enabled, "kernels": kernels}
+
+
+# --------------------------------------------------------------- roofline
+
+_roofline_cache: dict | None = None
+_roofline_lock = threading.Lock()
+
+
+def _roofline_table() -> dict:
+    """The measured device peak rows/sec per kernel, from the ``roofline``
+    key of the checked-in BASELINE.json (best-of device captures). Missing
+    file/key degrades to an empty table — snapshots simply omit the
+    roofline fields."""
+    global _roofline_cache
+    if _roofline_cache is not None:
+        return _roofline_cache
+    with _roofline_lock:
+        if _roofline_cache is not None:
+            return _roofline_cache
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)
+            ))),
+            "BASELINE.json",
+        )
+        table: dict = {}
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            raw = data.get("roofline") or {}
+            table = {
+                k: v for k, v in raw.items()
+                if isinstance(v, (int, float))
+            }
+        except Exception:
+            table = {}
+        _roofline_cache = table
+        return table
+
+
+# ------------------------------------------------- process-global instance
+
+_global = DeviceProfiler()
+
+
+def profiler() -> DeviceProfiler:
+    return _global
+
+
+def active_profiler() -> DeviceProfiler | None:
+    """The hot-path check every instrumented dispatch performs: returns
+    the process profiler when profiling is ON, else None. Two attribute
+    reads — the disabled-by-default overhead contract."""
+    p = _global
+    return p if p._enabled else None
+
+
+def configure_profiler(*, enabled: bool | None = None,
+                       reset: bool = False) -> DeviceProfiler:
+    """The on/off + reset knob (docs/OBSERVABILITY.md §Profiling). Also
+    settable at process start via ``CORDA_TPU_PROFILE=1``."""
+    if reset:
+        _global.reset()
+    if enabled is not None:
+        if enabled:
+            _global.enable()
+        else:
+            _global.disable()
+    return _global

@@ -33,6 +33,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corda_tpu.observability.profiler import (
+    KERNEL_ED25519_VERIFY,
+    active_profiler,
+)
+
 from ._blockpack import bucket_floor, pow2_at_least
 from .fe25519 import (
     P,
@@ -392,8 +397,24 @@ def ed25519_verify_dispatch(
     reuses one compiled kernel shape — a ragged batch hitting a fresh
     power-of-two bucket would otherwise stall its pipeline thread behind a
     multi-second compile."""
-    return _verify_prep_enqueue(
-        pubkeys, signatures, messages, min_bucket=min_bucket
+    prof = active_profiler()
+    if prof is None or not pubkeys:
+        return _verify_prep_enqueue(
+            pubkeys, signatures, messages, min_bucket=min_bucket
+        )
+    # bucket/bytes_out come from the RETURNED mask's padded shape — the
+    # lanes the kernel actually ran, not a re-derivation of its pad rule
+    return prof.profile(
+        KERNEL_ED25519_VERIFY,
+        lambda: _verify_prep_enqueue(
+            pubkeys, signatures, messages, min_bucket=min_bucket
+        ),
+        rows=len(pubkeys),
+        bucket=lambda mask: int(mask.shape[0]),
+        bytes_in=sum(
+            len(x) for seq in (pubkeys, signatures, messages) for x in seq
+        ),
+        bytes_out=lambda mask: int(mask.shape[0]),  # one verdict lane each
     )
 
 

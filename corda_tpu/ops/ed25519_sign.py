@@ -276,11 +276,41 @@ def ed25519_sign_dispatch(
     ``min_bucket`` pins the pad bucket's floor (see
     ``ed25519_verify_dispatch``): services with ragged batch sizes pass
     their max batch so every dispatch reuses one compiled kernel shape."""
+    from corda_tpu.observability.profiler import (
+        KERNEL_ED25519_SIGN,
+        active_profiler,
+    )
+
     n = len(seeds)
     if len(messages) != n:
         raise ValueError("batch length mismatch")
     if n == 0:
         return PendingSignatures([], [], [], [], None, 0)
+    prof = active_profiler()
+    if prof is not None:
+        b = pow2_at_least(
+            n, bucket_floor(min_bucket, jax.default_backend() == "tpu")
+        )
+        return prof.profile(
+            KERNEL_ED25519_SIGN,
+            lambda: _sign_enqueue(seeds, messages, min_bucket),
+            rows=n, bucket=b,
+            bytes_in=sum(len(s) + len(m) for s, m in zip(seeds, messages)),
+            bytes_out=n * 64,
+            # the pending wraps its device array; block the R points so the
+            # sample covers the comb ladder, not just the enqueue
+            sync=lambda p: getattr(
+                p._r_enc, "block_until_ready", lambda: None
+            )(),
+        )
+    return _sign_enqueue(seeds, messages, min_bucket)
+
+
+def _sign_enqueue(
+    seeds: list[bytes], messages: list[bytes],
+    min_bucket: int | None = None,
+) -> PendingSignatures:
+    n = len(seeds)
     on_tpu = jax.default_backend() == "tpu"
     b = pow2_at_least(n, bucket_floor(min_bucket, on_tpu))
 

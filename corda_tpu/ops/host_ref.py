@@ -79,6 +79,28 @@ def verify_one(pk: bytes, sig: bytes, msg: bytes) -> bool:
 def verify_loop(pubkeys: list, sigs: list, msgs: list) -> np.ndarray:
     """Sequential one-at-a-time verify over the batch — the timing shape of
     the reference's per-signature loop. Returns the (N,) validity mask."""
+    from corda_tpu.observability.profiler import (
+        KERNEL_HOST_REF,
+        active_profiler,
+    )
+
+    prof = active_profiler()
+    if prof is not None and pubkeys:
+        # host loop: no padding (bucket == rows, efficiency 1.0) and the
+        # result is already materialized, so the wall IS the execute time
+        return prof.profile(
+            KERNEL_HOST_REF,
+            lambda: _verify_loop(pubkeys, sigs, msgs),
+            rows=len(pubkeys), bucket=len(pubkeys),
+            bytes_in=sum(
+                len(x) for seq in (pubkeys, sigs, msgs) for x in seq
+            ),
+            bytes_out=len(pubkeys),
+        )
+    return _verify_loop(pubkeys, sigs, msgs)
+
+
+def _verify_loop(pubkeys: list, sigs: list, msgs: list) -> np.ndarray:
     n = len(pubkeys)
     out = np.zeros(n, dtype=np.uint8)
     pre = np.ones(n, dtype=bool)

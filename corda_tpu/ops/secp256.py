@@ -50,6 +50,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corda_tpu.observability.profiler import (
+    KERNEL_ECDSA_VERIFY,
+    active_profiler,
+)
+
 from ._blockpack import pow2_at_least
 
 LIMBS = 32
@@ -609,22 +614,36 @@ def ecdsa_verify_dispatch(
 
     floor = max(min_bucket or 0, ECDSA_BLOCK if on_tpu else 8)
     b = pow2_at_least(n_real, floor)
-    qx, qy, u1b, u2b, ra, rb, rb_ok, pre = _prep_byte_planes(
-        curve_name, pubkeys, signatures, messages, b
-    )
-    if on_tpu:
-        from .secp256_pallas import ecdsa_verify_pallas
 
-        return ecdsa_verify_pallas(
-            curve_name, qx, qy, u1b, u2b, ra, rb,
+    def enqueue():
+        qx, qy, u1b, u2b, ra, rb, rb_ok, pre = _prep_byte_planes(
+            curve_name, pubkeys, signatures, messages, b
+        )
+        if on_tpu:
+            from .secp256_pallas import ecdsa_verify_pallas
+
+            return ecdsa_verify_pallas(
+                curve_name, qx, qy, u1b, u2b, ra, rb,
+                jnp.asarray(rb_ok), jnp.asarray(pre),
+            )
+        return ecdsa_verify_core(
+            curve_name,
+            qx.astype(np.int32), qy.astype(np.int32),
+            _bits_le(u1b), _bits_le(u2b),
+            ra.astype(np.int32), rb.astype(np.int32),
             jnp.asarray(rb_ok), jnp.asarray(pre),
         )
-    return ecdsa_verify_core(
-        curve_name,
-        qx.astype(np.int32), qy.astype(np.int32),
-        _bits_le(u1b), _bits_le(u2b),
-        ra.astype(np.int32), rb.astype(np.int32),
-        jnp.asarray(rb_ok), jnp.asarray(pre),
+
+    prof = active_profiler()
+    if prof is None:
+        return enqueue()
+    return prof.profile(
+        KERNEL_ECDSA_VERIFY, enqueue, rows=n_real,
+        bucket=lambda mask: int(mask.shape[0]),  # actual padded lanes
+        bytes_in=sum(
+            len(x) for seq in (pubkeys, signatures, messages) for x in seq
+        ),
+        bytes_out=lambda mask: int(mask.shape[0]),
     )
 
 

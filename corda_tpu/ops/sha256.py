@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from corda_tpu.observability.profiler import KERNEL_SHA256, active_profiler
+
 from ._blockpack import bucket_batch, pad_md_blocks, words_to_bytes
 
 # fmt: off
@@ -255,6 +257,19 @@ def sha256_batch_words(messages: list[bytes]) -> jax.Array:
     DEVICE with no readback — for consumers that feed the digests straight
     into further device hashing (the Merkle id sweep), where a bytes
     round trip would cost a full interconnect round trip and re-upload."""
-    padded, nblocks = bucket_batch(messages, 64)
-    blocks, counts = pad_sha256(padded, nblocks=nblocks)
-    return sha256_blocks(blocks, counts)[: len(messages)]
+    lanes = {}
+
+    def enqueue():
+        padded, nblocks = bucket_batch(messages, 64)
+        lanes["n"] = len(padded)  # the ACTUAL padded batch the kernel ran
+        blocks, counts = pad_sha256(padded, nblocks=nblocks)
+        return sha256_blocks(blocks, counts)[: len(messages)]
+
+    prof = active_profiler()
+    if prof is None or not messages:
+        return enqueue()
+    n = len(messages)
+    return prof.profile(
+        KERNEL_SHA256, enqueue, rows=n, bucket=lambda _r: lanes["n"],
+        bytes_in=sum(len(m) for m in messages), bytes_out=n * 32,
+    )

@@ -199,7 +199,23 @@ def sha512_batch(messages: list[bytes]) -> list[bytes]:
     and are sliced off."""
     if not messages:
         return []
-    padded, nblocks = bucket_batch(messages, 128)
-    blocks, counts = pad_sha512(padded, nblocks=nblocks)
-    out = digest_words_to_bytes(np.asarray(sha512_blocks(blocks, counts)))
-    return out[: len(messages)]
+
+    lanes = {}
+
+    def run():
+        padded, nblocks = bucket_batch(messages, 128)
+        lanes["n"] = len(padded)  # the ACTUAL padded batch the kernel ran
+        blocks, counts = pad_sha512(padded, nblocks=nblocks)
+        out = digest_words_to_bytes(np.asarray(sha512_blocks(blocks, counts)))
+        return out[: len(messages)]
+
+    from corda_tpu.observability.profiler import KERNEL_SHA512, active_profiler
+
+    prof = active_profiler()
+    if prof is None:
+        return run()
+    n = len(messages)
+    return prof.profile(
+        KERNEL_SHA512, run, rows=n, bucket=lambda _r: lanes["n"],
+        bytes_in=sum(len(m) for m in messages), bytes_out=n * 64,
+    )

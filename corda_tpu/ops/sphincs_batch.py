@@ -104,6 +104,34 @@ def sphincs_verify_dispatch(
     the bucket-padded device verdict mask; slice ``[:len(pubkeys)]`` after
     ``np.asarray``. Pad lanes fail the precheck and compute garbage
     harmlessly."""
+    from corda_tpu.observability.profiler import (
+        KERNEL_SPHINCS,
+        active_profiler,
+    )
+
+    prof = active_profiler()
+    if prof is None or not pubkeys:
+        return _sphincs_verify_enqueue(
+            pubkeys, signatures, messages, min_bucket
+        )
+    return prof.profile(
+        KERNEL_SPHINCS,
+        lambda: _sphincs_verify_enqueue(
+            pubkeys, signatures, messages, min_bucket
+        ),
+        rows=len(pubkeys),
+        bucket=lambda mask: int(mask.shape[0]),  # actual padded lanes
+        bytes_in=sum(
+            len(x) for seq in (pubkeys, signatures, messages) for x in seq
+        ),
+        bytes_out=lambda mask: int(mask.shape[0]),
+    )
+
+
+def _sphincs_verify_enqueue(
+    pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
+    min_bucket: int | None = None,
+) -> jnp.ndarray:
     from ._blockpack import pow2_at_least
 
     n_real = len(pubkeys)

@@ -131,6 +131,27 @@ def _tx_id_roots(wtxs: list):
             cursor += len(raws)
         spans.append(tx_spans)
 
+    from corda_tpu.observability.profiler import KERNEL_TXID, active_profiler
+
+    prof = active_profiler()
+    if prof is None:
+        return _tx_id_roots_device(wtxs, nonce_msgs, comp_bytes, spans)
+    # rows = component leaves (the real hash lanes); the pad bucket mirrors
+    # the sha256 leaf sweep's power-of-two batch padding
+    return prof.profile(
+        KERNEL_TXID,
+        lambda: _tx_id_roots_device(wtxs, nonce_msgs, comp_bytes, spans),
+        rows=max(len(comp_bytes), 1),
+        bucket=max(8, _pow2(max(len(comp_bytes), 1))),
+        bytes_in=sum(len(c) for c in comp_bytes)
+        + sum(len(m) for m in nonce_msgs),
+        bytes_out=len(wtxs) * 32,
+    )
+
+
+def _tx_id_roots_device(wtxs: list, nonce_msgs, comp_bytes, spans):
+    """The device half of the id sweep: nonce digests (host hashlib),
+    leaf hashing, and the level-by-level Merkle reduction."""
     import hashlib
 
     import jax.numpy as jnp

@@ -571,6 +571,14 @@ def monitoring_snapshot_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.monitoring_snapshot())
 
 
+def profiler_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the kernel profiler's accounting
+    (``CordaRPCOps.profiler_snapshot``): per-kernel/per-bucket compile vs
+    execute split, batch efficiency, and roofline fractions — refresh
+    while a profiled run executes to watch the split evolve."""
+    return PolledValue(lambda: proxy.profiler_snapshot())
+
+
 def metrics_text_value(proxy) -> PolledValue:
     """Read binding over the Prometheus text exposition
     (``CordaRPCOps.metrics_text``) — the scrape body as a live value the

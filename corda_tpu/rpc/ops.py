@@ -166,8 +166,11 @@ class CordaRPCOps:
         """Process + node metrics, sectioned (reference: the Codahale
         registry MonitoringService exposes over JMX). ``serving`` is the
         device scheduler's queue/batch/shed surface (docs/SERVING.md),
-        ``process`` the remaining process-global counters, ``node`` this
-        node's own registry (notary meters etc.)."""
+        ``profiler`` the kernel profiler's registry mirror (empty until
+        the first profiled dispatch; retains the last profiled run after
+        disable — the snapshot's ``enabled`` flag says whether numbers
+        are live), ``process`` the remaining process-global counters,
+        ``node`` this node's own registry (notary meters etc.)."""
         from corda_tpu.node.monitoring import monitoring_snapshot
 
         snap = monitoring_snapshot()
@@ -180,6 +183,16 @@ class CordaRPCOps:
         from corda_tpu.node.monitoring import node_metrics
 
         return node_metrics().section("serving.")
+
+    def profiler_snapshot(self) -> dict:
+        """The kernel profiler's per-kernel / per-shape-bucket accounting
+        (docs/OBSERVABILITY.md §Profiling): compile vs execute wall split,
+        batch-efficiency ratios, bytes in/out, achieved rows/sec and the
+        roofline fraction. ``{"enabled": false, "kernels": {}}`` while the
+        profiler is off (the default)."""
+        from corda_tpu.observability import profiler
+
+        return profiler().snapshot()
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the process-global AND node-local

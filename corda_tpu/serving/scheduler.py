@@ -62,6 +62,11 @@ from corda_tpu.observability import (
     SPAN_SERVING_QUEUE,
     tracer,
 )
+from corda_tpu.observability.profiler import (
+    KERNEL_SERVING_DISPATCH,
+    active_profiler,
+    stamp_span,
+)
 
 from .shapes import shape_table
 
@@ -202,6 +207,10 @@ class DeviceScheduler:
         self._host_pool = ThreadPoolExecutor(
             max_workers=host_workers, thread_name_prefix="serving-host"
         )
+        # cumulative real-vs-padded device lanes: the fill-ratio gauge
+        # (dispatcher-thread-only writes; read racily by the gauge)
+        self._real_rows = 0
+        self._padded_rows = 0
         # EWMA state: arrival rate (rows/s, ~5 s horizon) and per-batch
         # device latency — their product is the expected arrivals during
         # one round trip, i.e. the natural adaptive batch size
@@ -495,18 +504,48 @@ class DeviceScheduler:
             from corda_tpu.verifier.batch import dispatch_signature_rows
 
             bucket = self._shapes.bucket_for(len(dev_rows), floor=floor)
+
+            def lanes_of(pending):
+                # ground truth from the dispatch itself: each scheme
+                # bucket pads independently, and PendingRows sums the
+                # lanes the kernels REALLY ran (a shape-table estimate
+                # would under-count mixed-scheme batches)
+                return getattr(pending, "padded_lanes", 0) or len(dev_rows)
+
             try:
                 # the scheduler-level fail site: a FaultPlan can force the
                 # WHOLE batch onto the host reference path deterministically.
                 # The batch span is ACTIVATED around the dispatch so a fault
                 # injected here (or at the nested verifier.device site)
                 # stamps this batch's trace id onto its chaos event —
-                # without it the dispatcher thread has no ambient context
-                with tracer().activate(batch_span):
+                # without it the dispatcher thread has no ambient context.
+                # stamp_span lets profiled kernels inside the dispatch tag
+                # this batch's span with their kernel/bucket (no-op unless
+                # the profiler is on AND the span is sampled)
+                with tracer().activate(batch_span), stamp_span(batch_span):
                     check_site("serving.dispatch")
-                    pending = dispatch_signature_rows(
-                        dev_rows, use_device=True, min_bucket=bucket
-                    )
+                    prof = active_profiler()
+                    if prof is None:
+                        pending = dispatch_signature_rows(
+                            dev_rows, use_device=True, min_bucket=bucket
+                        )
+                    else:
+                        pending = prof.profile(
+                            KERNEL_SERVING_DISPATCH,
+                            lambda: dispatch_signature_rows(
+                                dev_rows, use_device=True, min_bucket=bucket
+                            ),
+                            rows=len(dev_rows), bucket=lanes_of,
+                        )
+                # bucket-induced waste, visible with the profiler OFF:
+                # wasted lanes per dispatch (histogram) + the cumulative
+                # fill-ratio gauge registered in _register_process_gauges
+                padded = lanes_of(pending)
+                m.timer("serving.batch_pad_waste").update(
+                    float(padded - len(dev_rows))
+                )
+                self._real_rows += len(dev_rows)
+                self._padded_rows += padded
             except Exception:
                 m.counter("serving.device_failover").inc()
                 batch_span.set_attr("device_failover", True)
@@ -700,6 +739,14 @@ def _register_process_gauges() -> None:
         len(q) for q in s._queues.values()
     )))
     m.gauge("serving.inflight", live(lambda s: s._inflight))
+    # cumulative device-batch fill ratio (real rows / padded lanes): the
+    # bucket-waste health read next to batch_occupancy — 1.0 before any
+    # device dispatch (nothing padded means nothing wasted)
+    m.gauge("serving.batch_fill_ratio", live(
+        lambda s: (
+            s._real_rows / s._padded_rows if s._padded_rows else 1.0
+        )
+    ))
 
 
 _register_process_gauges()

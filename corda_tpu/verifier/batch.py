@@ -101,7 +101,8 @@ class PendingRows:
     response-sign tiering) track reality rather than intent.
     """
 
-    __slots__ = ("_n", "_deferred", "_out", "device_rows", "device_mask")
+    __slots__ = ("_n", "_deferred", "_out", "device_rows", "device_mask",
+                 "padded_lanes")
 
     def __init__(self, n: int):
         self._n = n
@@ -112,6 +113,10 @@ class PendingRows:
         # scheduler slices coalesced multi-client batches back apart and
         # needs per-request device counts, not just the batch total)
         self.device_mask = np.zeros(n, dtype=bool)
+        # total padded lanes the device ACTUALLY ran across scheme buckets
+        # (each bucket pads independently) — the ground truth behind the
+        # scheduler's pad-waste/fill-ratio accounting; 0 for host-only
+        self.padded_lanes = 0
 
     def collect(self) -> np.ndarray:
         for idxs, mask, fallback in self._deferred:
@@ -262,6 +267,10 @@ def _dispatch_device_bucket(
     )
     pending.device_rows += len(idxs)
     pending.device_mask[idxs] = True
+    # the returned mask is bucket-padded: its leading dim is the lane
+    # count this scheme bucket really occupied on device
+    shape = getattr(mask, "shape", None)
+    pending.padded_lanes += int(shape[0]) if shape else len(idxs)
 
 
 def verify_signature_rows(

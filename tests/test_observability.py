@@ -330,11 +330,12 @@ class TestMonitoringSurface:
         node_metrics().counter("serving.shed").inc()
         node_metrics().counter("verifier.device_failover").inc()
         snap = monitoring_snapshot()
-        assert set(snap) == {"serving", "process"}
+        assert set(snap) == {"serving", "profiler", "process"}
         assert "shed" in snap["serving"]
         assert "device_failover" not in snap["serving"]
         assert "verifier.device_failover" in snap["process"]
         assert not any(k.startswith("serving.") for k in snap["process"])
+        assert not any(k.startswith("profiler.") for k in snap["process"])
 
     def _ops(self):
         from corda_tpu.node import ServiceHub
@@ -671,6 +672,9 @@ class TestMetricsLint:
         (scratch / "corda_tpu" / "observability" / "trace.py").write_text(
             'SPAN_FLOW = "flow"\n'
         )
+        (scratch / "corda_tpu" / "observability" / "profiler.py").write_text(
+            'KERNEL_ROGUE = "rogue.kernel"\n'
+        )
         (scratch / "corda_tpu" / "rogue.py").write_text(
             'm.counter("serving.documented").inc()\n'
             'm.counter("serving.rogue_name").inc()\n'
@@ -682,3 +686,4 @@ class TestMetricsLint:
         assert proc.returncode == 1
         assert "serving.rogue_name" in proc.stdout
         assert "flow" in proc.stdout  # the undocumented span too
+        assert "rogue.kernel" in proc.stdout  # the undocumented kernel too

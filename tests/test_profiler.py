@@ -1,0 +1,367 @@
+"""Kernel-profiler tier tests: the first-dispatch latch (compile counted
+exactly once per kernel×bucket, thread-safe), batch-efficiency math at
+bucket boundaries, the disabled-by-default zero-footprint contract, span
+stamping, the roofline join against BASELINE.json, the RPC/string-call
+surface, the scheduler's pad-waste telemetry, and the empty-reservoir
+exposition fix — docs/OBSERVABILITY.md §Profiling is the spec."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from corda_tpu.node.monitoring import MetricRegistry, node_metrics
+from corda_tpu.observability import (
+    configure_profiler,
+    configure_tracing,
+    parse_prometheus,
+    render_prometheus,
+    tracer,
+)
+from corda_tpu.observability.profiler import (
+    KERNEL_ED25519_VERIFY,
+    KERNEL_SHA256,
+    DeviceProfiler,
+    active_profiler,
+    profiler,
+    stamp_span,
+)
+
+
+@pytest.fixture(autouse=True)
+def profiler_off_after():
+    """Every test leaves the process profiler in its default (off, empty)
+    state so profiling can never leak into other test files' timings."""
+    yield
+    configure_profiler(enabled=False, reset=True)
+
+
+# ------------------------------------------------------------ core model
+
+class TestProfilerCore:
+    def test_disabled_by_default(self):
+        assert active_profiler() is None
+        snap = profiler().snapshot()
+        assert snap["enabled"] is False
+
+    def test_off_creates_no_metrics_and_no_span_attrs(self):
+        """The disabled-overhead pin: with the profiler OFF, a profiled
+        entry point takes its plain path — the registry gains no
+        profiler.* names, the tracer ring gains no spans, and a sampled
+        span inside stamp_span gets no profiler attrs."""
+        from corda_tpu.ops.sha256 import sha256_batch
+
+        before_keys = set(node_metrics().snapshot())
+        configure_tracing(sample_rate=1.0)
+        tracer().clear()
+        try:
+            span = tracer().root("flow")
+            with stamp_span(span):
+                digests = sha256_batch([b"a", b"bb", b"ccc"])
+            span.finish()
+        finally:
+            configure_tracing(sample_rate=0.0)
+            tracer().clear()
+        assert len(digests) == 3
+        after_keys = set(node_metrics().snapshot())
+        assert not {
+            k for k in after_keys - before_keys if k.startswith("profiler.")
+        }
+        assert not any(k.startswith("profiler.") for k in span.attrs)
+
+    def test_latch_compile_counted_once_per_key_thread_safe(self):
+        """Satellite: N threads racing the same fresh kernel×bucket key
+        must produce EXACTLY one compile observation; the rest are
+        executes. A second bucket of the same kernel latches separately."""
+        prof = DeviceProfiler(enabled=True)
+        n_threads = 8
+        barrier = threading.Barrier(n_threads)
+        errors = []
+
+        def dispatch():
+            try:
+                barrier.wait(timeout=10)
+                prof.profile("test.kernel", lambda: None, rows=4, bucket=8)
+            except Exception as e:  # pragma: no cover - diagnostic
+                errors.append(e)
+
+        threads = [threading.Thread(target=dispatch) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors
+        b = prof.snapshot()["kernels"]["test.kernel"]["buckets"]["8"]
+        assert b["compile_count"] == 1
+        assert b["execute_count"] == n_threads - 1
+        # a NEW bucket of the same kernel gets its own latch
+        prof.profile("test.kernel", lambda: None, rows=10, bucket=16)
+        prof.profile("test.kernel", lambda: None, rows=10, bucket=16)
+        b16 = prof.snapshot()["kernels"]["test.kernel"]["buckets"]["16"]
+        assert b16["compile_count"] == 1 and b16["execute_count"] == 1
+        # reset drops the latch: the next dispatch is a compile again
+        prof.reset()
+        prof.profile("test.kernel", lambda: None, rows=4, bucket=8)
+        b = prof.snapshot()["kernels"]["test.kernel"]["buckets"]["8"]
+        assert b["compile_count"] == 1 and b["execute_count"] == 0
+
+    def test_batch_efficiency_at_bucket_boundaries(self):
+        """rows == bucket → 1.0; one row over a bucket boundary would pad
+        a full fresh bucket; a bucket below rows is normalized up (the
+        profiler never reports efficiency > 1)."""
+        prof = DeviceProfiler(enabled=True)
+        prof.profile("k", lambda: None, rows=8, bucket=8)      # exact fit
+        prof.profile("k", lambda: None, rows=9, bucket=16)     # boundary+1
+        prof.profile("k", lambda: None, rows=16, bucket=4)     # bad caller
+        snap = prof.snapshot()["kernels"]["k"]
+        assert snap["buckets"]["8"]["batch_efficiency"] == 1.0
+        assert snap["buckets"]["16"]["batch_efficiency"] == round(
+            (9 + 16) / 32, 4
+        )
+        # aggregate pools every lane: (8 + 9 + 16) / (8 + 16 + 16)
+        assert snap["batch_efficiency"] == pytest.approx(33 / 40)
+        # zero-row dispatches pass through unrecorded
+        prof.profile("empty", lambda: None, rows=0, bucket=8)
+        assert "empty" not in prof.snapshot()["kernels"]
+
+    def test_compile_vs_execute_split_and_bytes(self):
+        prof = DeviceProfiler(enabled=True)
+        for _ in range(3):
+            prof.profile("k", lambda: None, rows=2, bucket=4,
+                         bytes_in=100, bytes_out=10)
+        b = prof.snapshot()["kernels"]["k"]["buckets"]["4"]
+        assert b["compile_count"] == 1 and b["execute_count"] == 2
+        assert b["compile_s"] >= 0.0
+        assert b["execute_total_s"] >= b["execute_max_s"] >= b["execute_min_s"]
+        assert b["bytes_in"] == 300 and b["bytes_out"] == 30
+        # bytes_out may be a callable over the (synced) result
+        prof.profile("k2", lambda: [1, 2, 3], rows=3, bucket=4,
+                     bytes_out=lambda r: len(r) * 7)
+        assert prof.snapshot()["kernels"]["k2"]["bytes_out"] == 21
+
+    def test_roofline_join_from_baseline_json(self):
+        """BASELINE.json's roofline table feeds roofline_rows_per_sec /
+        roofline_frac for kernels it names (ed25519.verify is checked
+        in); unnamed kernels simply omit the fields."""
+        prof = DeviceProfiler(enabled=True)
+        prof.profile(KERNEL_ED25519_VERIFY, lambda: None, rows=8, bucket=8)
+        prof.profile(KERNEL_ED25519_VERIFY, lambda: None, rows=8, bucket=8)
+        prof.profile("no.such.kernel", lambda: None, rows=8, bucket=8)
+        prof.profile("no.such.kernel", lambda: None, rows=8, bucket=8)
+        snap = prof.snapshot()["kernels"]
+        ed = snap[KERNEL_ED25519_VERIFY]
+        assert ed["roofline_rows_per_sec"] == 106104.5
+        assert ed["roofline_frac"] > 0
+        assert "roofline_frac" not in snap["no.such.kernel"]
+
+    def test_span_stamping_when_enabled(self):
+        configure_profiler(enabled=True, reset=True)
+        configure_tracing(sample_rate=1.0)
+        tracer().clear()
+        try:
+            span = tracer().root("serving.batch")
+            with stamp_span(span):
+                profiler().profile("k", lambda: None, rows=2, bucket=8)
+                profiler().profile("k2", lambda: None, rows=2, bucket=4)
+            span.finish()
+        finally:
+            configure_tracing(sample_rate=0.0)
+            tracer().clear()
+        assert span.attrs["profiler.kernel"] == "k2"  # last dispatch wins
+        assert span.attrs["profiler.bucket"] == 4
+        assert span.attrs["profiler.kernels"] == ["k/8", "k2/4"]
+
+    def test_registry_mirror_flows_to_exposition(self):
+        """Enabled profiling mirrors into profiler.* metrics, which the
+        Prometheus exposition renders like any other family."""
+        configure_profiler(enabled=True, reset=True)
+        profiler().profile("k", lambda: None, rows=6, bucket=8)
+        profiler().profile("k", lambda: None, rows=6, bucket=8)
+        configure_profiler(enabled=False)
+        snap = node_metrics().snapshot()
+        assert snap["profiler.dispatches"]["count"] >= 2
+        assert snap["profiler.pad_rows"]["count"] >= 4
+        from corda_tpu.observability import metrics_text
+
+        samples = parse_prometheus(metrics_text())
+        assert int(samples["cordatpu_profiler_dispatches_total"]) >= 2
+        assert any(
+            k.startswith("cordatpu_profiler_execute_s_seconds")
+            for k in samples
+        )
+
+
+# ---------------------------------------------------- instrumented kernels
+
+class TestInstrumentedDispatch:
+    def test_sha256_batch_words_profiles_compile_execute(self):
+        """End-to-end through a real jitted kernel on the CPU tier: the
+        first dispatch of the bucket latches as compile, repeats count as
+        execute, and efficiency reflects the pow2 pad."""
+        from corda_tpu.ops.sha256 import sha256_batch_words
+
+        configure_profiler(enabled=True, reset=True)
+        try:
+            msgs = [b"x%d" % i for i in range(5)]
+            for _ in range(3):
+                words = np.asarray(sha256_batch_words(msgs))
+            assert words.shape == (5, 8)
+        finally:
+            configure_profiler(enabled=False)
+        snap = profiler().snapshot()["kernels"][KERNEL_SHA256]
+        b = snap["buckets"]["8"]
+        assert b["compile_count"] == 1 and b["execute_count"] == 2
+        assert b["batch_efficiency"] == pytest.approx(5 / 8)
+        assert b["bytes_out"] == 3 * 5 * 32
+
+    def test_host_ref_loop_profiles_with_full_efficiency(self):
+        host_ref = pytest.importorskip("corda_tpu.ops.host_ref")
+        try:
+            host_ref._load()
+        except Exception:
+            pytest.skip("portable C engine unavailable")
+        from corda_tpu.crypto import generate_keypair, sign
+
+        kp = generate_keypair()
+        msgs = [b"hr%d" % i for i in range(3)]
+        rows = [(kp.public.encoded, sign(kp.private, m), m) for m in msgs]
+        configure_profiler(enabled=True, reset=True)
+        try:
+            for _ in range(2):  # latch once, then a real execute sample
+                mask = host_ref.verify_loop(
+                    [r[0] for r in rows], [r[1] for r in rows],
+                    [r[2] for r in rows],
+                )
+        finally:
+            configure_profiler(enabled=False)
+        assert mask.all()
+        snap = profiler().snapshot()["kernels"]["host_ref"]
+        assert snap["batch_efficiency"] == 1.0  # host loop never pads
+        assert snap["roofline_rows_per_sec"] == pytest.approx(901.8)
+
+
+# ------------------------------------------------------ serving pad waste
+
+class TestServingPadWaste:
+    def test_pad_waste_timer_and_fill_ratio_gauge(self, monkeypatch):
+        """Satellite: a device dispatch records its wasted padded lanes
+        (serving.batch_pad_waste) and moves the cumulative fill-ratio
+        gauge — with the profiler OFF. The device kernel itself is
+        stubbed: this is scheduler accounting, not kernel math."""
+        import corda_tpu.serving.scheduler as sched_mod
+        import corda_tpu.verifier.batch as vbatch
+        from corda_tpu.serving import device_scheduler
+
+        class FakePending:
+            def __init__(self, n, lanes):
+                self._n = n
+                self.device_mask = np.ones(n, dtype=bool)
+                # what a real PendingRows reports: the lanes the kernels
+                # actually padded to (per scheme bucket)
+                self.padded_lanes = lanes
+
+            def collect(self):
+                return np.ones(self._n, dtype=bool)
+
+        def fake_dispatch(rows, use_device=True, min_bucket=None):
+            return FakePending(len(rows), max(min_bucket or 0, 128))
+
+        monkeypatch.setattr(vbatch, "dispatch_signature_rows", fake_dispatch)
+        sched = device_scheduler()
+        m = node_metrics()
+        waste_before = m.timer("serving.batch_pad_waste").count
+        fut = sched.submit_rows(
+            [(None, b"", b"")] * 3, use_device=True
+        )
+        rr = fut.result(timeout=30)
+        assert rr.mask.all() and rr.n_device == 3
+        waste_t = m.timer("serving.batch_pad_waste")
+        assert waste_t.count == waste_before + 1
+        # 3 rows pad to the ladder's smallest bucket (128): 125 wasted
+        assert waste_t.snapshot()["last_s"] == 125.0
+        ratio = m.gauge("serving.batch_fill_ratio").value
+        assert 0 < ratio <= 1.0
+        assert sched._padded_rows >= 128 and sched._real_rows >= 3
+
+    def test_pending_rows_reports_actual_padded_lanes(self):
+        """PendingRows.padded_lanes is the ground truth the scheduler's
+        accounting consumes: the returned device mask's padded shape, not
+        a re-derivation of the kernels' pad rules."""
+        from corda_tpu.crypto import generate_keypair, sign
+        from corda_tpu.verifier.batch import dispatch_signature_rows
+
+        kp = generate_keypair()
+        msgs = [b"pl%d" % i for i in range(5)]
+        rows = [(kp.public, sign(kp.private, m), m) for m in msgs]
+        pending = dispatch_signature_rows(rows, use_device=True)
+        assert pending.collect().all()
+        # 5 ed25519 rows pad to the CPU tier's pow2 bucket of 8
+        assert pending.padded_lanes == 8
+        host = dispatch_signature_rows(rows, use_device=False)
+        assert host.collect().all()
+        assert host.padded_lanes == 0  # host loop never pads
+
+    def test_fill_ratio_gauge_in_serving_section(self):
+        from corda_tpu.node.monitoring import monitoring_snapshot
+
+        snap = monitoring_snapshot()
+        assert "batch_fill_ratio" in snap["serving"]
+        assert "profiler" in snap  # the new sectioned mirror
+
+
+# ------------------------------------------------------------ RPC surface
+
+class TestProfilerRPC:
+    def _ops(self):
+        from corda_tpu.node import ServiceHub
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        return CordaRPCOps(ServiceHub(), smm=None)
+
+    def test_profiler_snapshot_over_string_call_shell_path(self):
+        """Satellite: the shell's text dispatch reaches profiler_snapshot
+        and the result reflects recorded kernels."""
+        from corda_tpu.rpc.string_calls import StringToMethodCallParser
+
+        configure_profiler(enabled=True, reset=True)
+        profiler().profile("rpc.kernel", lambda: None, rows=2, bucket=8)
+        configure_profiler(enabled=False)
+        parser = StringToMethodCallParser(self._ops())
+        snap = parser.invoke("profiler_snapshot")
+        assert snap["enabled"] is False
+        assert snap["kernels"]["rpc.kernel"]["rows"] == 2
+        assert json.dumps(snap)  # JSON-shaped end to end
+
+    def test_profiler_snapshot_read_binding(self):
+        from corda_tpu.rpc.bindings import profiler_snapshot_value
+
+        ops = self._ops()
+        configure_profiler(enabled=False, reset=True)
+        live = profiler_snapshot_value(ops)
+        assert live.get()["kernels"] == {}
+        configure_profiler(enabled=True)
+        profiler().profile("bind.kernel", lambda: None, rows=1, bucket=8)
+        configure_profiler(enabled=False)
+        assert "bind.kernel" in live.refresh()["kernels"]
+
+
+# --------------------------------------------------- exposition edge case
+
+class TestEmptyReservoirExposition:
+    def test_empty_timer_omits_quantile_lines(self):
+        """Satellite pin: a registered-but-never-updated Timer (and Meter)
+        renders _sum/_count only — no quantile samples, no NaN."""
+        reg = MetricRegistry()
+        reg.timer("cold.timer")
+        reg.meter("cold.meter")
+        text = render_prometheus(reg.snapshot())
+        assert "quantile" not in text
+        assert "NaN" not in text
+        assert "cordatpu_cold_timer_seconds_count 0" in text
+        samples = parse_prometheus(text)  # still a well-formed exposition
+        assert samples["cordatpu_cold_timer_seconds_sum"] == "0.0"
+        # one update later the quantiles appear
+        reg.timer("cold.timer").update(0.25)
+        text = render_prometheus(reg.snapshot())
+        assert 'cordatpu_cold_timer_seconds{quantile="0.99"} 0.25' in text

@@ -675,17 +675,15 @@ class TestServingObservability:
 # --------------------------------------------------------- bench --smoke
 
 class TestBenchSmoke:
-    def test_bench_smoke_exercises_scheduler(self):
+    def test_bench_smoke_exercises_scheduler(self, tmp_path):
         """tier-1 guard: `bench.py --smoke` (the fast scheduler path) must
         pass on CPU so scheduler regressions fail tests, not just the TPU
-        bench."""
+        bench — and its JSON must round-trip through the perf gate."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.run(
-            [sys.executable,
-             os.path.join(os.path.dirname(os.path.dirname(
-                 os.path.abspath(__file__))), "bench.py"),
-             "--smoke"],
+            [sys.executable, os.path.join(repo, "bench.py"), "--smoke"],
             capture_output=True, text=True, timeout=300, env=env,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
@@ -694,3 +692,38 @@ class TestBenchSmoke:
         assert out["ok"] is True
         assert out["max_batch_occupancy"] > 1
         assert out["notary_txs"] == 24
+        # acceptance: the per-stage profile section carries a
+        # compile/execute split and batch-efficiency ratios for at least
+        # the ed25519 and txid paths (docs/OBSERVABILITY.md §Profiling)
+        for kernel in ("ed25519.verify", "txid"):
+            prof = out["profile"][kernel]
+            assert prof["compile_count"] >= 1
+            assert prof["execute_count"] >= 1
+            assert 0 < prof["batch_efficiency"] <= 1.0
+
+        # acceptance: a baseline generated from this same output gates
+        # green; an injected profile regression gates red
+        result = tmp_path / "smoke.json"
+        result.write_text(line)
+        baseline = tmp_path / "PERF_BASELINE.json"
+        gate = os.path.join(repo, "tools_perf_gate.py")
+
+        def run_gate(*args):
+            return subprocess.run(
+                [sys.executable, gate, *args],
+                capture_output=True, text=True, timeout=60,
+            )
+
+        wrote = run_gate("--result", str(result), "--write-baseline",
+                         "--baseline", str(baseline))
+        assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+        ok = run_gate("--result", str(result), "--baseline", str(baseline))
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        doctored = dict(out)
+        doctored["profile"] = json.loads(json.dumps(out["profile"]))
+        doctored["profile"]["ed25519.verify"]["rows_per_sec"] *= 0.4
+        bad_path = tmp_path / "smoke_bad.json"
+        bad_path.write_text(json.dumps(doctored))
+        bad = run_gate("--result", str(bad_path), "--baseline", str(baseline))
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        assert "ed25519.verify" in bad.stdout

@@ -1,9 +1,13 @@
 """Tooling tier tests — loadtest harness (generate/interpret/execute/gather
-+ disruption), interactive shell, REST webserver; mirrors the reference's
-tools/loadtest tests + webserver integration tests."""
++ disruption), interactive shell, REST webserver, and the continuous
+perf-regression gate; mirrors the reference's tools/loadtest tests +
+webserver integration tests."""
 
 import io
 import json
+import os
+import subprocess
+import sys
 import urllib.request
 
 import pytest
@@ -165,6 +169,142 @@ class TestWebServer:
             assert e.value.code == 404
         finally:
             server.stop()
+
+
+class TestPerfGate:
+    """CI/tooling satellite: tools_perf_gate.py runs deviceless against a
+    synthetic bench result — schema mode validates shape, the gate passes
+    within tolerance and fails on a doctored 20% ed25519 regression."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    GATE = os.path.join(REPO, "tools_perf_gate.py")
+
+    SYNTHETIC = {
+        "metric": "notarised_tx_per_sec",
+        "value": 8000.0,
+        "ed25519_sigs_per_sec": 100000.0,
+        "ecdsa_sigs_per_sec": 50000.0,
+        "profile": {
+            "ed25519.verify": {
+                "compile_s": 5.2, "compile_count": 1,
+                "execute_total_s": 0.4, "execute_count": 2,
+                "batch_efficiency": 0.75, "rows_per_sec": 30.0,
+            },
+            "txid": {
+                "compile_s": 1.3, "compile_count": 1,
+                "execute_total_s": 0.01, "execute_count": 2,
+                "batch_efficiency": 0.5625, "rows_per_sec": 5000.0,
+            },
+        },
+    }
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, self.GATE, *args],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def test_check_schema_passes_synthetic_and_rejects_garbage(self, tmp_path):
+        good = tmp_path / "bench.json"
+        good.write_text(json.dumps(self.SYNTHETIC))
+        proc = self._run("--result", str(good), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        # no gated metric at all → schema failure
+        bad = tmp_path / "nothing.json"
+        bad.write_text(json.dumps({"unrelated": 1}))
+        assert self._run(
+            "--result", str(bad), "--check-schema"
+        ).returncode == 1
+
+        # malformed profile entry → schema failure
+        broken = dict(self.SYNTHETIC)
+        broken["profile"] = {"ed25519.verify": {"compile_s": "not-a-number"}}
+        bad2 = tmp_path / "broken.json"
+        bad2.write_text(json.dumps(broken))
+        assert self._run(
+            "--result", str(bad2), "--check-schema"
+        ).returncode == 1
+
+    def test_gate_passes_in_tolerance_fails_on_20pct_regression(
+        self, tmp_path
+    ):
+        result = tmp_path / "bench.json"
+        result.write_text(json.dumps(self.SYNTHETIC))
+        baseline = tmp_path / "PERF_BASELINE.json"
+        wrote = self._run("--result", str(result), "--write-baseline",
+                          "--baseline", str(baseline))
+        assert wrote.returncode == 0, wrote.stdout + wrote.stderr
+        doc = json.loads(baseline.read_text())
+        assert doc["schema"] == 1
+        assert doc["metrics"]["ed25519_sigs_per_sec"]["baseline"] == 100000.0
+
+        # identical result → green
+        ok = self._run("--result", str(result), "--baseline", str(baseline))
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+
+        # a wobble within tolerance (-10% vs 15% tol) → still green
+        wobble = dict(self.SYNTHETIC)
+        wobble["ed25519_sigs_per_sec"] = 90000.0
+        w = tmp_path / "wobble.json"
+        w.write_text(json.dumps(wobble))
+        assert self._run(
+            "--result", str(w), "--baseline", str(baseline)
+        ).returncode == 0
+
+        # the doctored 20% ed25519_sigs_per_sec regression → red
+        regressed = dict(self.SYNTHETIC)
+        regressed["ed25519_sigs_per_sec"] = 80000.0
+        r = tmp_path / "regressed.json"
+        r.write_text(json.dumps(regressed))
+        proc = self._run("--result", str(r), "--baseline", str(baseline))
+        assert proc.returncode == 1
+        assert "ed25519_sigs_per_sec" in proc.stdout
+        assert "FAIL" in proc.stdout
+
+    def test_gate_skips_missing_sections_but_not_everything(self, tmp_path):
+        """A partially-errored bench (dead device section) must not read
+        as a regression; a result sharing NO metric with the baseline
+        must fail (it gates nothing)."""
+        result = tmp_path / "bench.json"
+        result.write_text(json.dumps(self.SYNTHETIC))
+        baseline = tmp_path / "PERF_BASELINE.json"
+        self._run("--result", str(result), "--write-baseline",
+                  "--baseline", str(baseline))
+
+        partial = {"value": 8000.0}  # headline survived, sections died
+        p = tmp_path / "partial.json"
+        p.write_text(json.dumps(partial))
+        ok = self._run("--result", str(p), "--baseline", str(baseline))
+        assert ok.returncode == 0, ok.stdout
+        assert "SKIP" in ok.stdout
+
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"unrelated": 3.0}))
+        assert self._run(
+            "--result", str(empty), "--baseline", str(baseline)
+        ).returncode == 1
+
+    def test_checked_in_baseline_gates_checked_in_capture(self, tmp_path):
+        """The committed PERF_BASELINE.json must stay consistent with the
+        COMMITTED BENCH_LOCAL.json capture it was generated from — the
+        invariant the TPU driver relies on when it reruns the gate. Gate
+        the HEAD version, not the working tree: bench.py overwrites the
+        working-tree file by design, and a slow local dev capture must
+        not turn this consistency check red."""
+        head = subprocess.run(
+            ["git", "-C", self.REPO, "show", "HEAD:BENCH_LOCAL.json"],
+            capture_output=True, text=True, timeout=60,
+        )
+        if head.returncode != 0:
+            pytest.skip("no git HEAD capture available")
+        committed = tmp_path / "BENCH_LOCAL.head.json"
+        committed.write_text(head.stdout)
+        proc = self._run("--result", str(committed))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert self._run(
+            "--result", str(committed), "--check-schema",
+        ).returncode == 0
 
 
 class TestGraphs:

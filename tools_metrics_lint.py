@@ -1,13 +1,15 @@
-"""Metric/span name lint: code vs the docs/OBSERVABILITY.md registry.
+"""Metric/span/kernel name lint: code vs the docs/OBSERVABILITY.md registry.
 
 Greps the tree for every name created against a MetricRegistry
-(``.counter("…")`` / ``.meter(`` / ``.timer(`` / ``.gauge(``) and every
+(``.counter("…")`` / ``.meter(`` / ``.timer(`` / ``.gauge(``), every
 canonical span name (the ``SPAN_*`` constants in
 ``corda_tpu/observability/trace.py``, which all span creation goes
-through), then fails if any name is missing from the registry/taxonomy
-tables in ``docs/OBSERVABILITY.md``. A metric that is not in the table
-is a metric no operator will ever find — the doc IS the registry, and
-this lint is what keeps it true. Run from tier-1 by
+through), and every profiler kernel name (the ``KERNEL_*`` constants in
+``corda_tpu/observability/profiler.py``, which all profiled dispatch
+goes through), then fails if any name is missing from the
+registry/taxonomy tables in ``docs/OBSERVABILITY.md``. A metric that is
+not in the table is a metric no operator will ever find — the doc IS
+the registry, and this lint is what keeps it true. Run from tier-1 by
 ``tests/test_observability.py``.
 
     python tools_metrics_lint.py            # rc 0 clean, rc 1 violations
@@ -26,6 +28,7 @@ _METRIC_CALL = re.compile(
     r"\.(?:counter|meter|timer|gauge)\(\s*\n?\s*[\"']([A-Za-z0-9_.]+)[\"']"
 )
 _SPAN_CONST = re.compile(r"^SPAN_[A-Z_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
+_KERNEL_CONST = re.compile(r"^KERNEL_[A-Z0-9_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
 
 
 def collect_metric_names() -> dict[str, list[str]]:
@@ -57,6 +60,19 @@ def collect_span_names() -> dict[str, list[str]]:
     }
 
 
+def collect_kernel_names() -> dict[str, list[str]]:
+    """Profiler kernel names — every instrumented dispatch profiles
+    through a KERNEL_* constant, so this enumerates what
+    ``profiler_snapshot()`` (and the bench's ``profile`` section) can
+    ever report."""
+    prof_py = ROOT / "corda_tpu" / "observability" / "profiler.py"
+    src = prof_py.read_text()
+    return {
+        m.group(1): [str(prof_py.relative_to(ROOT))]
+        for m in _KERNEL_CONST.finditer(src)
+    }
+
+
 def documented_names() -> set[str]:
     """Names appearing in backticks inside docs/OBSERVABILITY.md tables
     (any backticked token qualifies — the lint checks presence, the
@@ -74,19 +90,21 @@ def run() -> int:
     for kind, found in (
         ("metric", collect_metric_names()),
         ("span", collect_span_names()),
+        ("kernel", collect_kernel_names()),
     ):
         for name, files in sorted(found.items()):
             if name not in documented:
                 missing.append((kind, name, files))
     if missing:
-        print("metric/span names missing from docs/OBSERVABILITY.md:")
+        print("metric/span/kernel names missing from docs/OBSERVABILITY.md:")
         for kind, name, files in missing:
             print(f"  {kind} {name!r}  (used in {', '.join(sorted(set(files)))})")
         return 1
     n_metrics = len(collect_metric_names())
     n_spans = len(collect_span_names())
-    print(f"metrics-lint ok: {n_metrics} metric names, {n_spans} span names "
-          f"all documented")
+    n_kernels = len(collect_kernel_names())
+    print(f"metrics-lint ok: {n_metrics} metric names, {n_spans} span names, "
+          f"{n_kernels} kernel names all documented")
     return 0
 
 

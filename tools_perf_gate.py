@@ -1,0 +1,276 @@
+"""Continuous perf-regression gate: bench JSON vs PERF_BASELINE.json.
+
+The BENCH_*.json trajectory records every capture, but until this tool
+nothing GATED on it — a PR could halve ``ed25519_sigs_per_sec`` and only
+a human reading the numbers would notice. This gate compares one bench
+result (``bench.py``'s JSON line, ``bench.py --smoke``'s JSON line, or a
+saved ``BENCH_LOCAL.json``) against a checked-in baseline with
+per-metric RELATIVE tolerances, and exits nonzero on any regression
+beyond tolerance.
+
+No device is needed for any mode: the gate is pure JSON arithmetic, so
+it runs in tier-1 CI against a synthetic result, and on real hardware
+against a fresh capture.
+
+Modes::
+
+    python tools_perf_gate.py --result BENCH_LOCAL.json          # gate (rc 0/1)
+    python tools_perf_gate.py --result out.json --write-baseline # (re)base
+    python tools_perf_gate.py --result out.json --check-schema   # shape only
+
+``--baseline`` overrides the baseline path (default: PERF_BASELINE.json
+beside this file). ``--write-baseline`` records every known gated metric
+present in the result, with the default tolerance table below (edit the
+JSON to tighten/loosen per metric — the file, not this table, is the
+contract once written).
+
+Baseline schema (``PERF_BASELINE.json``)::
+
+    {
+      "schema": 1,
+      "source": "<result file the baseline was generated from>",
+      "metrics": {
+        "<path>": {"baseline": <number>,
+                    "rel_tol": <fraction>,
+                    "direction": "higher" | "lower"}
+      }
+    }
+
+Metric paths address the result JSON with ``/`` separators (profiler
+kernel names contain dots): ``ed25519_sigs_per_sec`` is a top-level key,
+``profile/ed25519.verify/rows_per_sec`` walks the per-stage profile
+section bench.py emits. A ``higher`` metric fails when
+``value < baseline * (1 - rel_tol)``; a ``lower`` metric (latencies)
+fails when ``value > baseline * (1 + rel_tol)``. Metrics missing from
+the result are reported but do NOT fail the gate (bench sections degrade
+independently — a dead device must not read as a regression); a result
+that is missing EVERY gated metric fails, since that gates nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+BASELINE_DEFAULT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "PERF_BASELINE.json"
+)
+
+# Known gated metrics: path -> (direction, default relative tolerance).
+# Device sig rates gate tight (they are the north-star axis and the chip
+# is dedicated); end-to-end rates looser (they fold host scheduling
+# noise); wall-clock latencies loosest (shared-host CI jitter).
+GATED_METRICS: dict[str, tuple[str, float]] = {
+    # full bench (BENCH_LOCAL.json / bench.py main JSON)
+    "ed25519_sigs_per_sec": ("higher", 0.15),
+    "ed25519_best_sigs_per_sec": ("higher", 0.15),
+    "ecdsa_sigs_per_sec": ("higher", 0.15),
+    "mixed_scheme_sigs_per_sec": ("higher", 0.25),
+    "value": ("higher", 0.20),                    # notarised tx/sec headline
+    "notary_best_tx_per_sec": ("higher", 0.20),
+    "notary_loadtest_tx_per_sec": ("higher", 0.30),
+    "notary_raft_cluster_tx_per_sec": ("higher", 0.30),
+    "notary_bft_cluster_tx_per_sec": ("higher", 0.30),
+    "dag_1k_chain_tx_per_sec": ("higher", 0.25),
+    "trader_demo_trades_per_sec": ("higher", 0.30),
+    "empty_flows_per_sec": ("higher", 0.35),
+    # smoke (bench.py --smoke JSON)
+    "idle_dispatch_ms": ("lower", 1.00),
+    "notary_ms": ("lower", 1.00),
+    "total_s": ("lower", 1.00),
+    # per-stage profile section (both modes): achieved steady-state rates
+    "profile/ed25519.verify/rows_per_sec": ("higher", 0.50),
+    "profile/ecdsa.verify/rows_per_sec": ("higher", 0.50),
+    "profile/txid/rows_per_sec": ("higher", 0.50),
+    "profile/sha256/rows_per_sec": ("higher", 0.50),
+}
+
+# keys every per-kernel profile entry must carry for --check-schema
+PROFILE_REQUIRED_KEYS = (
+    "compile_s", "execute_total_s", "batch_efficiency",
+)
+
+
+def resolve_path(data: dict, path: str):
+    """Walk a ``/``-separated path; None when any hop is missing or the
+    leaf is not a number."""
+    node = data
+    for part in path.split("/"):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def load_json(path: str) -> dict:
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: expected a JSON object")
+    return data
+
+
+def check_schema(result: dict) -> list[str]:
+    """Structural validation of a bench result — the no-device CI mode.
+    Returns problem strings (empty = ok)."""
+    problems: list[str] = []
+    present = [p for p in GATED_METRICS if resolve_path(result, p) is not None]
+    if not present:
+        problems.append(
+            "no known gated metric present (expected at least one of: "
+            + ", ".join(sorted(GATED_METRICS)) + ")"
+        )
+    for path in present:
+        v = resolve_path(result, path)
+        if v is not None and v < 0:
+            problems.append(f"{path}: negative value {v}")
+    profile = result.get("profile")
+    if profile is not None:
+        if not isinstance(profile, dict):
+            problems.append("profile: expected an object of kernel entries")
+        else:
+            for kernel, entry in profile.items():
+                if not isinstance(entry, dict):
+                    problems.append(f"profile/{kernel}: expected an object")
+                    continue
+                for key in PROFILE_REQUIRED_KEYS:
+                    if not isinstance(entry.get(key), (int, float)):
+                        problems.append(
+                            f"profile/{kernel}: missing numeric {key!r}"
+                        )
+                eff = entry.get("batch_efficiency")
+                if isinstance(eff, (int, float)) and not (0 < eff <= 1.0):
+                    problems.append(
+                        f"profile/{kernel}: batch_efficiency {eff} "
+                        "outside (0, 1]"
+                    )
+    return problems
+
+
+def write_baseline(result: dict, result_path: str, baseline_path: str) -> int:
+    metrics = {}
+    for path, (direction, tol) in sorted(GATED_METRICS.items()):
+        v = resolve_path(result, path)
+        if v is None:
+            continue
+        metrics[path] = {
+            "baseline": v, "rel_tol": tol, "direction": direction,
+        }
+    if not metrics:
+        print("perf-gate: refusing to write an empty baseline "
+              "(no gated metric found in the result)")
+        return 1
+    doc = {
+        "schema": 1,
+        "source": os.path.basename(result_path),
+        "captured_at": result.get("captured_at"),
+        "device": result.get("device"),
+        "metrics": metrics,
+    }
+    tmp = baseline_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, baseline_path)
+    print(f"perf-gate: wrote {baseline_path} ({len(metrics)} metrics)")
+    return 0
+
+
+def run_gate(result: dict, baseline: dict) -> int:
+    metrics = baseline.get("metrics")
+    if not isinstance(metrics, dict) or not metrics:
+        print("perf-gate: baseline has no metrics table")
+        return 1
+    failures, missing, passed = [], [], 0
+    for path, spec in sorted(metrics.items()):
+        base = spec.get("baseline")
+        tol = float(spec.get("rel_tol", 0.2))
+        direction = spec.get("direction", "higher")
+        value = resolve_path(result, path)
+        if value is None:
+            missing.append(path)
+            continue
+        if not isinstance(base, (int, float)):
+            failures.append(f"{path}: baseline entry is not numeric")
+            continue
+        if direction == "higher":
+            bound = base * (1.0 - tol)
+            ok = value >= bound
+            verdict = f"value {value:g} >= floor {bound:g}"
+        else:
+            bound = base * (1.0 + tol)
+            ok = value <= bound
+            verdict = f"value {value:g} <= ceiling {bound:g}"
+        status = "PASS" if ok else "FAIL"
+        print(f"perf-gate: {status} {path}: {verdict} "
+              f"(baseline {base:g}, tol {tol:.0%}, {direction} is better)")
+        if ok:
+            passed += 1
+        else:
+            failures.append(
+                f"{path}: {value:g} vs baseline {base:g} "
+                f"(allowed {'-' if direction == 'higher' else '+'}{tol:.0%})"
+            )
+    for path in missing:
+        print(f"perf-gate: SKIP {path}: not present in result")
+    if passed == 0 and not failures:
+        print("perf-gate: result contains none of the baseline's metrics")
+        return 1
+    if failures:
+        print(f"perf-gate: {len(failures)} regression(s):")
+        for f in failures:
+            print(f"  {f}")
+        return 1
+    print(f"perf-gate: ok ({passed} metrics within tolerance, "
+          f"{len(missing)} skipped)")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--result", required=True,
+                    help="bench JSON to gate (bench.py / --smoke output "
+                         "or BENCH_LOCAL.json)")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT,
+                    help="baseline path (default: PERF_BASELINE.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record the result as the new baseline and exit")
+    ap.add_argument("--check-schema", action="store_true",
+                    help="validate the result's structure only (no "
+                         "baseline, no device)")
+    args = ap.parse_args(argv)
+
+    try:
+        result = load_json(args.result)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf-gate: cannot read result {args.result}: {e}")
+        return 2
+
+    if args.check_schema:
+        problems = check_schema(result)
+        if problems:
+            print(f"perf-gate: schema problems in {args.result}:")
+            for p in problems:
+                print(f"  {p}")
+            return 1
+        print(f"perf-gate: schema ok ({args.result})")
+        return 0
+
+    if args.write_baseline:
+        return write_baseline(result, args.result, args.baseline)
+
+    try:
+        baseline = load_json(args.baseline)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf-gate: cannot read baseline {args.baseline}: {e} "
+              "(generate one with --write-baseline)")
+        return 2
+    return run_gate(result, baseline)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
