@@ -1073,6 +1073,57 @@ def run_profile_pass(reps: int = 3, rows: int = 6) -> dict:
     return profile
 
 
+def run_smoke_dag_pipeline() -> dict:
+    """The smoke's DAG-pipeline leg: a back-chain resolved through the
+    double-buffered wavefront pipeline (small windows so several are in
+    flight), asserting (a) verdict parity with the synchronous
+    one-window path and (b) that the pipeline really overlaps — window
+    N+1's ``wavefront.window`` span (opened at dispatch) starts before
+    window N's closes (it closes after N's walk). Host crypto only; the
+    on-chip variant of this overlap is what moves ``dag_vs_host``."""
+    from corda_tpu.observability import tracer
+    from corda_tpu.parallel.wavefront import verify_transaction_dag
+
+    chain, chain_notary = make_back_chain(95)  # 96 txs → 6 windows of 16
+    allowed = lambda s: {chain_notary.owning_key}  # noqa: E731
+    dag = {s.id: s for s in chain}
+    sync = verify_transaction_dag(
+        dag, allowed_missing_fn=allowed, use_device=False,
+        window=len(chain) + 1, use_scheduler=False,
+    )
+    trc = tracer()
+    root = trc.root("bench.dag_pipeline", force=True)
+    with trc.activate(root):
+        t0 = time.perf_counter()
+        piped = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=False,
+            window=16, depth=3,
+        )
+        dt = time.perf_counter() - t0
+    root.finish()
+    spans = [
+        s for s in trc.dump(limit=500)
+        if s["name"] == "wavefront.window"
+        and s["trace_id"] == root.trace_id
+    ]
+    assert piped.order == sync.order, "pipelined order diverged"
+    assert piped.n_sigs == sync.n_sigs, "pipelined sig count diverged"
+    assert piped.consumed == sync.consumed, "pipelined consumed diverged"
+    assert len(spans) == 6, f"expected 6 window spans, got {len(spans)}"
+    spans.sort(key=lambda s: s["start_s"])
+    overlaps = sum(
+        1 for a, b in zip(spans, spans[1:])
+        if a["end_s"] is not None and b["start_s"] < a["end_s"]
+    )
+    assert overlaps > 0, "no window overlap: pipeline ran synchronously"
+    return {
+        "dag_pipeline_txs": len(piped.order),
+        "dag_pipeline_windows": len(spans),
+        "dag_pipeline_overlaps": overlaps,
+        "dag_pipeline_ms": round(dt * 1e3, 1),
+    }
+
+
 def run_smoke_tracing() -> dict:
     """The smoke's tracing leg: CashIssue + CashPayment on a 3-node mock
     network with the flow verify path routed through the serving
@@ -1225,6 +1276,10 @@ def run_smoke() -> int:
         )
         out["dag_txs"] = len(dag.order)
         assert out["dag_txs"] == len(chain)
+
+        # 5b. DAG pipeline pass: double-buffered windows — parity with
+        # the synchronous path plus a real dispatch/walk overlap witness
+        out.update(run_smoke_dag_pipeline())
 
         # 6. tracing pass (docs/OBSERVABILITY.md): sampling forced on,
         # one mock-network payment flow must yield a SINGLE connected

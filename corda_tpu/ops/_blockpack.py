@@ -60,6 +60,22 @@ def start_host_copy(arr) -> None:
         pass
 
 
+def result_ready(arr) -> bool:
+    """Non-blocking readiness probe on a dispatched result handle: True
+    when the device computation behind ``arr`` has finished (or ``arr``
+    is plain host memory). The completion-order collectors (PendingRows,
+    the serving scheduler) use this to harvest whichever in-flight batch
+    lands first instead of blocking in dispatch order; an unknown handle
+    type reads as ready so callers degrade to the blocking FIFO path."""
+    probe = getattr(arr, "is_ready", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:
+        return True
+
+
 def bucket_batch(
     messages: list[bytes], block_bytes: int, min_batch: int = 8
 ) -> tuple[list[bytes], int]:

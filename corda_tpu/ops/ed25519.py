@@ -27,7 +27,9 @@ against R is exact (canonical limbs).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import hashlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -328,7 +330,7 @@ def _bits_le(x: np.ndarray) -> np.ndarray:
     )
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0,))
 def _tpu_verify_fixedlen(packed: jax.Array) -> jax.Array:
     """Fully fused fixed-length verify: SHA-512 compress, Barrett mod-L,
     and the pallas ladder in ONE device program fed by ONE upload.
@@ -341,7 +343,11 @@ def _tpu_verify_fixedlen(packed: jax.Array) -> jax.Array:
     are re-extracted on device rather than shipped twice), then s, then
     the precheck flag. One array per batch matters: the tunneled
     interconnect charges ~50 ms latency PER TRANSFER, so three separate
-    uploads cost more than the ladder itself."""
+    uploads cost more than the ladder itself. The input buffer is DONATED
+    (always freshly device_put here, never aliased by a caller): XLA may
+    recycle its device memory for the dispatch's own temporaries, so
+    back-to-back dispatches of the same shape bucket reuse one allocation
+    instead of growing the arena per in-flight batch."""
     from .ed25519_pallas import verify_pallas_windows
     from .scalar25519 import challenge_windows
     from .sha512 import sha512_blocks
@@ -364,7 +370,7 @@ def _tpu_verify_fixedlen(packed: jax.Array) -> jax.Array:
     )
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
 def _tpu_verify_from_bytes(
     y_bytes: jax.Array, r_bytes: jax.Array, s_bytes: jax.Array,
     h_bytes: jax.Array, sign: jax.Array, precheck: jax.Array,
@@ -372,7 +378,9 @@ def _tpu_verify_from_bytes(
     """Device-side prep + pallas ladder: the radix-4096 limb repack, 4-bit
     window extraction, and transposes happen ON DEVICE (jnp ops fused into
     this jit) so the host ships 4 compact uint8 planes — the transfer was
-    the bottleneck over the tunneled PCIe path."""
+    the bottleneck over the tunneled PCIe path. All six planes are donated
+    (freshly device_put per call): same-bucket dispatches recycle the
+    upload buffers instead of allocating per in-flight batch."""
     from .ed25519_pallas import ed25519_verify_pallas
 
     return ed25519_verify_pallas(
@@ -438,6 +446,75 @@ def ed25519_verify_batch(
     return np.asarray(mask)[:n_real]
 
 
+# ---------------------------------------------------- host staging buffers
+#
+# The fixed-length path packs each dispatch into one (B, 161) uint8 plane.
+# Under the pipelined services the SAME shape bucket dispatches
+# back-to-back, so the pack buffer is pooled per bucket instead of being
+# re-allocated (and page-faulted) for every batch. A pooled buffer is
+# handed out again only once the dispatch that consumed it has FINISHED
+# computing (``result_ready`` on its verdict mask): on the TPU backend the
+# host→device copy of an enqueued dispatch can still be in flight after
+# dispatch returns, so "compute done" is the earliest point the host may
+# scribble on that staging memory. The CPU/test tier never reaches this
+# path (``on_tpu`` gate) — there ``jnp.asarray`` may alias the numpy
+# buffer outright, which would make reuse corrupting.
+
+_IN_USE = object()
+_staging_lock = threading.Lock()
+_staging: dict[int, list] = {}   # bucket -> [[buffer, last_mask], ...]
+_STAGING_SLOTS_PER_BUCKET = 4    # > any service pipeline depth (3)
+
+
+def _transfer_done(mask) -> bool:
+    """STRICT readiness probe for staging reuse: unlike the collectors'
+    ``result_ready`` (which fails OPEN so unknown handles degrade to a
+    blocking FIFO collect), an unknown or raising handle here must read
+    as NOT done — "ready" licenses the host to scribble on memory the
+    device may still be copying, so the safe default is the opposite."""
+    probe = getattr(mask, "is_ready", None)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:
+        return False
+
+
+def _acquire_packed(b: int):
+    """A zeroed (b, 161) staging buffer + its pool slot (None when the
+    pool is saturated and a throwaway buffer is handed out)."""
+    reuse = None
+    with _staging_lock:
+        slots = _staging.setdefault(b, [])
+        for slot in slots:
+            last = slot[1]
+            if last is None or (last is not _IN_USE and _transfer_done(last)):
+                slot[1] = _IN_USE
+                reuse = slot
+                break
+        else:
+            if len(slots) < _STAGING_SLOTS_PER_BUCKET:
+                reuse = [np.zeros((b, 161), np.uint8), _IN_USE]
+                slots.append(reuse)
+                return reuse[0], reuse
+    if reuse is None:
+        return np.zeros((b, 161), np.uint8), None
+    # the memset runs OUTSIDE the global lock — the slot is exclusively
+    # owned once tagged _IN_USE, and a ~1 MB fill must not serialize
+    # unrelated buckets' concurrent acquires
+    reuse[0].fill(0)
+    return reuse[0], reuse
+
+
+def _retire_packed(slot, mask) -> None:
+    """Return a staging buffer to the pool, tagged with the dispatch's
+    mask handle; it frees for reuse when that mask reads back ready."""
+    if slot is not None:
+        with _staging_lock:
+            slot[1] = mask
+
+
 def _verify_prep_enqueue(
     pubkeys: list[bytes], signatures: list[bytes], messages: list[bytes],
     min_bucket: int | None = None,
@@ -470,21 +547,27 @@ def _verify_prep_enqueue(
         and mlen <= 47
         and all(len(m) == mlen for m in messages)
     ):
-        packed = np.zeros((b, 161), np.uint8)
-        packed[:n_real, :32] = sig_arr[:n_real, :32]
-        packed[:n_real, 32:64] = pk_arr[:n_real]
-        if mlen:
-            packed[:n_real, 64 : 64 + mlen] = np.frombuffer(
-                b"".join(messages), np.uint8
-            ).reshape(n_real, mlen)
-        total = 64 + mlen
-        packed[:, total] = 0x80
-        bitlen = total * 8
-        packed[:, 126] = (bitlen >> 8) & 0xFF
-        packed[:, 127] = bitlen & 0xFF
-        packed[:, 128:160] = s_arr
-        packed[:, 160] = precheck
-        return _tpu_verify_fixedlen(jnp.asarray(packed))
+        packed, slot = _acquire_packed(b)
+        try:
+            packed[:n_real, :32] = sig_arr[:n_real, :32]
+            packed[:n_real, 32:64] = pk_arr[:n_real]
+            if mlen:
+                packed[:n_real, 64 : 64 + mlen] = np.frombuffer(
+                    b"".join(messages), np.uint8
+                ).reshape(n_real, mlen)
+            total = 64 + mlen
+            packed[:, total] = 0x80
+            bitlen = total * 8
+            packed[:, 126] = (bitlen >> 8) & 0xFF
+            packed[:, 127] = bitlen & 0xFF
+            packed[:, 128:160] = s_arr
+            packed[:, 160] = precheck
+            mask = _tpu_verify_fixedlen(jnp.asarray(packed))
+        except BaseException:
+            _retire_packed(slot, None)
+            raise
+        _retire_packed(slot, mask)
+        return mask
 
     # challenge scalars: SHA-512(R‖A‖M) mod L on host — hashlib is C-speed
     # and this generic path only serves variable-length message batches

@@ -592,6 +592,29 @@ def _prep_byte_planes(
     return qx, qy, u1b, u2b, ra, rb, rb_ok, pre
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("curve_name",),
+    donate_argnums=(1, 2, 3, 4, 5, 6, 7, 8),
+)
+def _ecdsa_pallas_donated(
+    curve_name, qx, qy, u1b, u2b, ra, rb, rb_ok, pre
+):
+    """The dispatch path's TPU entry: same ladder as
+    ``ecdsa_verify_pallas`` but with every input plane DONATED. The
+    planes are freshly built per dispatch here (``_prep_byte_planes``),
+    so XLA may recycle their device memory across back-to-back
+    dispatches of the same shape bucket instead of holding one upload
+    arena per in-flight batch. Callers that REUSE plane arrays across
+    calls (the bench's rep loop) must keep using ``ecdsa_verify_pallas``
+    directly — donation would invalidate their buffers."""
+    from .secp256_pallas import ecdsa_verify_pallas
+
+    return ecdsa_verify_pallas(
+        curve_name, qx, qy, u1b, u2b, ra, rb, rb_ok, pre
+    )
+
+
 def ecdsa_verify_dispatch(
     curve_name: str,
     pubkeys: list[bytes],
@@ -620,9 +643,7 @@ def ecdsa_verify_dispatch(
             curve_name, pubkeys, signatures, messages, b
         )
         if on_tpu:
-            from .secp256_pallas import ecdsa_verify_pallas
-
-            return ecdsa_verify_pallas(
+            return _ecdsa_pallas_donated(
                 curve_name, qx, qy, u1b, u2b, ra, rb,
                 jnp.asarray(rb_ok), jnp.asarray(pre),
             )

@@ -458,28 +458,105 @@ def _host_prime_ids(cold_stxs: list) -> None:
         )
 
 
-def check_and_prime_ids(stxs: dict) -> None:
-    """Recompute the id of every SignedTransaction in
-    ``{claimed_id: stx}``; raise on any mismatch (forged chain link),
-    otherwise PRIME each WireTransaction's id cache so downstream host
-    code never re-hashes. Same host/device routing as
-    ``dispatch_prime_ids`` (``ids_tier()``)."""
-    items = list(stxs.items())
-    if ids_tier() == "host":
-        for _tid, stx in items:
-            # drop any pre-set cache: the check must hash the bytes
-            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
-        _host_prime_ids([stx for _tid, stx in items])
-        ids = [stx.tx.id for _tid, stx in items]
-    else:
-        ids = compute_tx_ids([stx.tx for _tid, stx in items])
-    for (claimed, stx), computed in zip(items, ids):
-        if computed != claimed:
-            from corda_tpu.ledger.states import TransactionVerificationException
+class PendingIdCheck:
+    """An ENQUEUED recompute-and-check id sweep over ``{claimed_id: stx}``
+    items: on the device tier the Merkle reduction and root gather queue
+    with NO readback at dispatch time (the async half the wavefront
+    pipeline rides); ``collect()`` pays the one readback, raises on any
+    claimed≠recomputed mismatch (forged chain link), and primes the wire
+    tx id caches with the recomputed truth. The host tier defers its
+    hashing to ``collect()`` too — it is host work, and the pipelined
+    caller wants the dispatch stage back immediately so in-flight device
+    batches keep the chip busy while the host hashes."""
 
+    __slots__ = ("_items", "_id_words")
+
+    def __init__(self, items, id_words):
+        self._items = items
+        self._id_words = id_words  # device handle, or None for host tier
+
+    def ready(self) -> bool:
+        from ._blockpack import result_ready
+
+        return self._id_words is None or result_ready(self._id_words)
+
+    def collect(self) -> None:
+        items, self._items = self._items, []
+        if not items:
+            return
+        if self._id_words is None:
+            for _tid, stx in items:
+                # drop any pre-set cache: the check must hash the bytes
+                object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+            _host_prime_ids([stx for _tid, stx in items])
+            ids = [stx.tx.id for _tid, stx in items]
+        else:
+            try:
+                id_bytes = digest_words_to_bytes(np.asarray(self._id_words))
+            except BaseException:
+                # readback failure: nothing was checked — drop any
+                # optimistically primed claimed ids rather than leave
+                # unverified claims cached on shared tx objects
+                self.drop_unchecked(items)
+                raise
+            self._id_words = None
+            ids = [SecureHash(raw) for raw in id_bytes]
+        # prime EVERY recomputed id (the truth derived from the bytes)
+        # before raising the first mismatch: a caller that optimistically
+        # cached claimed ids must never keep a forged one after the sweep
+        # ran — including claims BEYOND the first mismatch in this batch
+        mismatch = None
+        for (claimed, stx), computed in zip(items, ids):
+            object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+            if mismatch is None and computed != claimed:
+                mismatch = (claimed, computed)
+        if mismatch is not None:
+            from corda_tpu.ledger.states import (
+                TransactionVerificationException,
+            )
+
+            claimed, computed = mismatch
             raise TransactionVerificationException(
                 claimed,
                 f"transaction id mismatch: claimed {claimed}, "
                 f"recomputed {computed}",
             )
-        object.__getattribute__(stx.tx, "__dict__")["_id"] = computed
+
+    def abort(self) -> None:
+        """Roll back without checking: drop any still-cached id for the
+        uncollected items (a pipelined caller primes CLAIMED ids at
+        dispatch; an aborted window must not leave those unverified
+        claims behind). Idempotent; a no-op after ``collect()``."""
+        items, self._items = self._items, []
+        self._id_words = None
+        self.drop_unchecked(items)
+
+    @staticmethod
+    def drop_unchecked(items) -> None:
+        for _tid, stx in items:
+            object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+
+
+def dispatch_check_ids(stxs: dict) -> PendingIdCheck:
+    """Enqueue the recompute-and-check id sweep for ``{claimed_id: stx}``;
+    ``collect()`` raises the first mismatch and primes the caches. Same
+    host/device routing as ``dispatch_prime_ids`` (``ids_tier()``)."""
+    items = list(stxs.items())
+    if not items or ids_tier() == "host":
+        return PendingIdCheck(items, None)
+    import jax.numpy as jnp
+
+    from ._blockpack import start_host_copy
+
+    roots, pool = _tx_id_roots([stx.tx for _tid, stx in items])
+    id_words = jnp.take(pool, jnp.asarray(np.array(roots)), axis=0)
+    start_host_copy(id_words)
+    return PendingIdCheck(items, id_words)
+
+
+def check_and_prime_ids(stxs: dict) -> None:
+    """Synchronous wrapper over ``dispatch_check_ids``: recompute the id
+    of every SignedTransaction in ``{claimed_id: stx}``; raise on any
+    mismatch (forged chain link), otherwise PRIME each WireTransaction's
+    id cache so downstream host code never re-hashes."""
+    dispatch_check_ids(stxs).collect()

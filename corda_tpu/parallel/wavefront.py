@@ -102,19 +102,36 @@ def verify_transaction_dag(
     computation is the reference's per-tx cost in
     ResolveTransactionsFlow.kt:91-99.)
 
-    Pipelining (the notary ``process_stream`` shape, applied to resolve):
-    the topological levels are grouped into level-aligned windows of
-    ≥ ``window`` transactions, and up to ``depth`` windows' signature
-    batches ride the device concurrently while earlier windows run the
-    order-DEPENDENT host walk (double-spend set, input resolution,
-    contract semantics). A one-shot whole-DAG dispatch (the r4 shape)
-    paid one un-overlapped link round trip before the walk could start —
-    exactly what sank config #4 to 0.9× host; windows hide the round
-    trips under the walk. The walk itself batches contract semantics per
-    window through ``verify_ledger_batch`` (once per contract class, the
-    fungible fast path) instead of per-tx ``ltx.verify`` calls — sound
-    because a window's outputs feed later resolution only if nothing in
-    the window raised, and ANY contract failure in the window raises.
+    Pipelining — a two-stage async double-buffered pipeline over
+    level-aligned windows of ≥ ``window`` transactions, up to ``depth``
+    windows deep:
+
+    - **Stage A (dispatch)** holds everything ORDER-FREE and enqueues it
+      with no device readback: the Merkle-id recompute-and-check sweep
+      (``ops/txid.dispatch_check_ids`` — an async result handle, with
+      claimed ids optimistically primed so row flattening costs no host
+      hashing) and the scheme-bucketed signature batch, pre-packed into
+      a PINNED pad bucket (``min_bucket`` grows to the largest window
+      seen, so every window reuses one compiled kernel shape and its
+      donated input buffers).
+    - **Stage B (walk)** consumes a window only when it reaches the
+      front of the in-flight deque: collect the id sweep (a forged
+      chain link raises HERE, at its own window), collect the signature
+      verdicts, then run the order-DEPENDENT remainder — double-spend
+      set, input resolution, and contract semantics batched per window
+      through ``verify_ledger_batch`` (once per contract class, the
+      fungible fast path) instead of per-tx ``ltx.verify`` calls.
+
+    While the device verifies window N's buckets, the host walks window
+    N−1 and pre-packs window N+1 — device round-trip latency hides
+    behind host work instead of adding to it. The r4 one-shot dispatch
+    paid one un-overlapped link round trip before the walk could start
+    (config #4 at 0.9× host); the r5 windowed shape still BLOCKED each
+    window's dispatch on the id sweep's readback, serializing the walk
+    behind per-window round trips — the async handles remove that last
+    synchronous boundary. Contract batching is sound because a window's
+    outputs feed later resolution only if nothing in the window raised,
+    and ANY contract failure in the window raises.
 
     Raises the first verification failure; on success returns the ordering
     + consumed-set report.
@@ -154,7 +171,10 @@ def verify_transaction_dag(
     # caller's use_device before any perf downgrade below — the break-even
     # gate must never silently drop the forged-chain-link check
     check_ids = recompute_ids and use_device
-    pipelined = use_device and len(windows) > 1
+    # host-routed resolves pipeline too: through the serving scheduler a
+    # host window settles on the scheduler's host pool, so the walk of
+    # window N overlaps the settle of window N+1 even with no device
+    pipelined = len(windows) > 1
     if use_device:
         # Routing economics differ from the notary stream: a resolve's
         # host walk per window is tiny (contract semantics on a thin
@@ -198,35 +218,67 @@ def verify_transaction_dag(
                 return st
         raise UnresolvedStateError(ref, tid)
 
+    # pinned pad bucket: grows to the largest window's row count, so every
+    # window (including the ragged last one) pads to ONE compiled kernel
+    # shape — repeat dispatches then also recycle the kernels' donated
+    # input buffers instead of compiling/allocating per ragged size
+    pin_bucket = 0
+
     def dispatch_window(win_levels):
-        """Order-free work for one window: id recompute-and-check, then
-        the scheme-bucketed signature batch (enqueued, not collected).
-        The signature batch rides the process-global serving scheduler
-        (SERVICE class) so resolve sweeps coalesce with concurrent
-        notary/verifier/flow traffic; a saturated or shut-down scheduler
-        degrades to the direct dispatch with identical verdicts."""
+        """Stage A — all order-free work for one window, ENQUEUED with no
+        device readback: the async id recompute-and-check sweep, then the
+        scheme-bucketed signature batch. The signature batch rides the
+        process-global serving scheduler (SERVICE class) so resolve
+        sweeps coalesce with concurrent notary/verifier/flow traffic; a
+        saturated or shut-down scheduler degrades to the direct dispatch
+        with identical verdicts."""
         tids = [tid for lvl in win_levels for tid in lvl]
         span = _trc.start(
             SPAN_WAVEFRONT_WINDOW, _resolve_ctx,
             attrs={"txs": len(tids), "levels": len(win_levels)},
         )
+        pending_ids = None
         try:
-            return span, _dispatch_window_inner(win_levels, tids, span)
-        except Exception as e:
-            # a dispatch-time failure (forged chain link in the id sweep,
-            # dispatch error) must still land the window span in the ring
-            # — failing resolves are exactly the traces worth reading
+            if check_ids:
+                from corda_tpu.ops.txid import dispatch_check_ids
+
+                # optimistically prime each tx's id cache with its
+                # CLAIMED id so the row flatten below (signable payloads
+                # bind the tx id) costs no host hashing; the enqueued
+                # sweep recomputes every id from the component bytes,
+                # and walk_window raises the mismatch before any verdict
+                # depends on the claim
+                for tid in tids:
+                    object.__getattribute__(
+                        stxs[tid].tx, "__dict__"
+                    )["_id"] = tid
+                pending_ids = dispatch_check_ids(
+                    {tid: stxs[tid] for tid in tids}
+                )
+            return span, pending_ids, _dispatch_sigs(tids, span)
+        except BaseException as e:
+            # a dispatch-time failure must still land the window span in
+            # the ring — failing resolves are the traces worth reading —
+            # and must not leave THIS window's unchecked claimed ids
+            # cached on the shared tx objects
+            if pending_ids is not None:
+                pending_ids.abort()
+            elif check_ids:
+                for tid in tids:
+                    object.__getattribute__(
+                        stxs[tid].tx, "__dict__"
+                    ).pop("_id", None)
             span.set_error(e)
             span.finish()
             raise
 
-    def _dispatch_window_inner(win_levels, tids, span):
-        if check_ids:
-            from corda_tpu.ops.txid import check_and_prime_ids
-
-            check_and_prime_ids({tid: stxs[tid] for tid in tids})
+    def _dispatch_sigs(tids, span):
+        nonlocal pin_bucket
         win_stxs = [stxs[tid] for tid in tids]
         allowed = [allowed_for(s) for s in win_stxs]
+        pin_bucket = max(
+            pin_bucket, sum(len(s.sigs) for s in win_stxs)
+        )
         if use_scheduler:
             from corda_tpu.serving import (
                 SERVICE,
@@ -239,26 +291,32 @@ def verify_transaction_dag(
                 return FuturePending(
                     device_scheduler().submit_transactions(
                         win_stxs, allowed, priority=SERVICE,
-                        use_device=use_device, trace=span,
+                        use_device=use_device, min_bucket=pin_bucket,
+                        trace=span,
                     )
                 )
             except ServingError:
                 pass
         return dispatch_transactions(
             win_stxs, allowed, use_device=use_device,
+            min_bucket=pin_bucket if use_device else None,
         )
 
     def walk_window(win_levels, staged):
-        """Collect the window's signature verdicts, then the
-        order-dependent walk over its levels. The window span opened at
-        dispatch closes here — it covers enqueue→device→walk, the
-        per-window latency the resolve pipeline tries to hide."""
-        span, pending = staged
+        """Stage B — collect the window's id check and signature
+        verdicts, then the order-dependent walk over its levels. The
+        window span opened at dispatch closes here — it covers
+        enqueue→device→walk, the per-window latency the pipeline hides."""
+        span, pending_ids, pending = staged
         with span:
-            _walk_window_inner(win_levels, pending)
+            _walk_window_inner(win_levels, pending_ids, pending)
 
-    def _walk_window_inner(win_levels, pending):
+    def _walk_window_inner(win_levels, pending_ids, pending):
         nonlocal n_sigs
+        if pending_ids is not None:
+            # the forged-chain-link check lands at ITS window, before any
+            # verdict derived from the claimed id is consumed
+            pending_ids.collect()
         report = pending.collect()
         report.raise_first()
         n_sigs += report.n_sigs
@@ -298,7 +356,8 @@ def verify_transaction_dag(
 
     from collections import deque
 
-    in_flight: deque = deque()  # (win_levels, (span, pending sig-check))
+    # (win_levels, (span, pending id-check, pending sig-check)) per window
+    in_flight: deque = deque()
     live_depth = depth if pipelined else 1
     try:
         for win_levels in windows:
@@ -310,8 +369,12 @@ def verify_transaction_dag(
     except BaseException as e:
         # a failed walk abandons the still-dispatched windows: close their
         # spans (status from the failure that aborted the resolve) so the
-        # trace shows the whole pipeline, not a truncated prefix
-        for _lv, (span, _pending) in in_flight:
+        # trace shows the whole pipeline, not a truncated prefix — and
+        # roll back their optimistically primed CLAIMED ids, which the
+        # abandoned sweeps never got to check against the bytes
+        for _win_levels, (span, pids, _pending) in in_flight:
+            if pids is not None:
+                pids.abort()
             span.set_error(e)
             span.finish()
         raise

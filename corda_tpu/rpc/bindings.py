@@ -506,18 +506,26 @@ def fold_feed(feed, initial, folder: Callable) -> ObservableValue:
     return out
 
 
-def accumulate_feed(feed, extract: Callable = lambda u: [u]) -> ObservableList:
+def accumulate_feed(
+    feed, extract: Callable = lambda u: [u], seed=(),
+) -> ObservableList:
     """reference: ObservableFold.kt foldToObservableList — feed updates
     appended into a live list (``extract`` maps one update to zero or
     more elements, e.g. produced states out of a vault update). Snapshot
     seeding follows ``fold_feed``'s rule: only sequence snapshots are
-    update-shaped."""
+    update-shaped; non-update-shaped snapshot elements (a vault Page's
+    pre-existing states) go in via ``seed``. All seeding happens BEFORE
+    the subscription so updates pushed during construction can neither
+    land ahead of the snapshot nor duplicate into it — the reference's
+    snapshot-then-updates ordering."""
     out = ObservableList()
 
     def on_update(update):
         for el in extract(update):
             out.append(el)
 
+    for el in seed:
+        out.append(el)
     snap = getattr(feed, "snapshot", None)
     if isinstance(snap, (list, tuple)):
         for item in snap:
@@ -612,15 +620,16 @@ class NodeMonitorModel:
         # the vault feed's snapshot is a Page (not update-shaped):
         # vault_updates carries the pushed Update stream; produced_states
         # is the FLAT live list of states — pre-existing page states
-        # seeded explicitly, then each update's produced set appended
+        # seeded BEFORE the subscription (an update pushed while this
+        # model is constructed must append after, never ahead of or
+        # duplicated with, the snapshot it is already part of)
+        page = getattr(vault_feed, "snapshot", None)
         self.vault_updates = accumulate_feed(vault_feed)
         self.produced_states = accumulate_feed(
             vault_feed,
             extract=lambda u: list(getattr(u, "produced", ())),
+            seed=list(getattr(page, "states", ()) or ()),
         )
-        page = getattr(vault_feed, "snapshot", None)
-        for sar in list(getattr(page, "states", ()) or ()):
-            self.produced_states.append(sar)
         self.transactions = accumulate_feed(
             proxy.validated_transactions_track()
         )

@@ -33,8 +33,9 @@ Core loop (``_dispatch_loop``):
   serial batch;
 - up to ``depth`` batches ride the device concurrently (dispatch is the
   async half of ``dispatch_signature_rows``; a separate collector thread
-  blocks on readbacks), preserving the round-trip overlap the notary and
-  wavefront pipelines rely on.
+  harvests readbacks in COMPLETION order — ``serving.settle_reorder``
+  counts out-of-order settles), preserving the round-trip overlap the
+  notary and wavefront pipelines rely on.
 
 Degradation contract: the ``serving.dispatch`` faultinject site sits in
 front of every device dispatch; an injected (or real) dispatch failure
@@ -162,6 +163,19 @@ def _metrics():
     return node_metrics()
 
 
+def _pending_ready(pending) -> bool:
+    """Non-blocking probe: has this in-flight batch's device work
+    finished? Unknown pending types read as not-ready so the collector
+    falls back to the FIFO blocking path for them."""
+    probe = getattr(pending, "ready", None)
+    if probe is None:
+        return False
+    try:
+        return bool(probe())
+    except Exception:
+        return False
+
+
 def _complete(future: Future, result=None, error: Exception | None = None):
     """Complete tolerating caller-side cancellation."""
     try:
@@ -199,7 +213,12 @@ class DeviceScheduler:
         self._closed = False
         self._paused = False            # test hook: hold assembly
         self._seq = 0
-        self._inflight_q: _queue.Queue = _queue.Queue(maxsize=max(1, depth))
+        # dispatcher→collector handoff; the depth bound lives on the
+        # _inflight counter (waited on BEFORE device enqueue), not on the
+        # queue, so the collector may hold several batches and settle
+        # them in COMPLETION order without widening the device pipeline
+        self._depth = max(1, depth)
+        self._inflight_q: _queue.Queue = _queue.Queue()
         self._inflight = 0
         # host-routed rows settle here, off the device collector thread —
         # a bulk host window must not delay an unrelated device batch's
@@ -371,16 +390,38 @@ class DeviceScheduler:
                     break
                 batch, shed = self._assemble_locked()
             if shed:
-                _metrics().counter("serving.shed").inc(len(shed))
-                for r in shed:
-                    err = DeadlineExceededError(
-                        "request shed: deadline passed while queued"
-                    )
-                    r.queue_span.set_error(err)
-                    r.queue_span.finish()
-                    _complete(r.future, error=err)
+                self._fail_shed(shed)
             if not batch:
                 continue
+            # bounded in-flight pipeline: wait for a free device slot
+            # BEFORE enqueueing — the natural dispatch-rate brake (the
+            # collector frees slots as batches settle, in whatever order
+            # they complete). Host-only batches skip the wait: they
+            # settle on the host pool and must not queue behind slow
+            # device kernels.
+            if any(r.use_device for r in batch):
+                late: list = []
+                with self._lock:
+                    while self._inflight >= self._depth:
+                        self._lock.wait(timeout=0.5)
+                        # deadlines keep ticking while the batch parks
+                        # at the slot wait: shed expired members on
+                        # every wake-up rather than dispatching late
+                        # with device time nobody waits for; a
+                        # no-longer-device remainder abandons the wait
+                        now = time.monotonic()
+                        expired = [r for r in batch if (
+                            r.deadline is not None and now > r.deadline
+                        )]
+                        if expired:
+                            late += expired
+                            batch = [r for r in batch if r not in expired]
+                            if not any(r.use_device for r in batch):
+                                break
+                if late:
+                    self._fail_shed(late)
+                if not batch:
+                    continue
             try:
                 entry = self._dispatch(batch)
             except Exception as e:  # defensive: never lose futures
@@ -391,10 +432,21 @@ class DeviceScheduler:
                 continue  # host-only batch: settling on the host pool
             with self._lock:
                 self._inflight += 1
-            # bounded in-flight pipeline: blocks when `depth` batches are
-            # already riding the device — the natural dispatch-rate brake
             self._inflight_q.put(entry)
         self._inflight_q.put(None)
+
+    @staticmethod
+    def _fail_shed(requests: list) -> None:
+        """Complete shed requests with DeadlineExceededError (counted,
+        spans landed) — shared by assembly-time and slot-wait shedding."""
+        _metrics().counter("serving.shed").inc(len(requests))
+        for r in requests:
+            err = DeadlineExceededError(
+                "request shed: deadline passed while queued"
+            )
+            r.queue_span.set_error(err)
+            r.queue_span.finish()
+            _complete(r.future, error=err)
 
     def _assemble_locked(self) -> tuple[list, list]:
         """Shed over-deadline work, then assemble one batch under the
@@ -590,20 +642,50 @@ class DeviceScheduler:
         span.finish()
 
     def _collect_loop(self) -> None:
+        # Settle in COMPLETION order, not dispatch order: with several
+        # batches in flight (possibly different shape buckets), the one
+        # that lands first should resolve its futures first — blocking on
+        # the oldest dispatch would stack every later batch's settlement
+        # behind the slowest kernel. When nothing is ready, block on the
+        # oldest (the FIFO degenerate case, identical to the old loop).
+        live: list[_InFlight] = []
+        draining = False
         while True:
-            entry = self._inflight_q.get()
+            while not draining:
+                try:
+                    entry = self._inflight_q.get(block=not live)
+                except _queue.Empty:
+                    break
+                if entry is None:
+                    draining = True
+                else:
+                    live.append(entry)
+            if not live:
+                if draining:
+                    return
+                continue
+            entry = next(
+                (e for e in live if _pending_ready(e.pending)), None
+            )
             if entry is None:
-                return
-            try:
-                self._settle(entry)
-            except Exception as e:
-                entry.span.set_error(e)
-                entry.span.finish()
-                for r in entry.requests:
-                    _complete(r.future, error=e)
-            finally:
-                with self._lock:
-                    self._inflight -= 1
+                entry = live[0]
+            elif entry is not live[0]:
+                _metrics().counter("serving.settle_reorder").inc()
+            live.remove(entry)
+            self._settle_entry(entry)
+
+    def _settle_entry(self, entry: "_InFlight") -> None:
+        try:
+            self._settle(entry)
+        except Exception as e:
+            entry.span.set_error(e)
+            entry.span.finish()
+            for r in entry.requests:
+                _complete(r.future, error=e)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+                self._lock.notify_all()
 
     def _settle(self, entry: _InFlight) -> None:
         masks = [np.zeros(len(r.rows), dtype=bool) for r in entry.requests]

@@ -64,12 +64,15 @@ def _effective_device_schemes(use_device: bool) -> set:
         return set()
     schemes = set(_DEVICE_SCHEMES)
     forced = _sphincs_override()
-    import jax
-
-    if forced == "device" or (
-        forced != "host" and jax.default_backend() == "tpu"
-    ):
+    if forced == "device":
+        # the override outranks (and never consults) the backend gate
         schemes.add(SPHINCS256_SHA256)
+        return schemes
+    if forced != "host":
+        import jax
+
+        if jax.default_backend() == "tpu":
+            schemes.add(SPHINCS256_SHA256)
     return schemes
 
 
@@ -118,8 +121,33 @@ class PendingRows:
         # scheduler's pad-waste/fill-ratio accounting; 0 for host-only
         self.padded_lanes = 0
 
+    def ready(self) -> bool:
+        """Non-blocking: True when every enqueued device bucket has
+        finished computing, i.e. ``collect()`` would not block on the
+        device. Completion-order collectors (the serving scheduler's
+        settle loop) poll this to harvest whichever in-flight batch lands
+        first."""
+        from corda_tpu.ops._blockpack import result_ready
+
+        return all(result_ready(mask) for _idxs, mask, _fb in self._deferred)
+
     def collect(self) -> np.ndarray:
-        for idxs, mask, fallback in self._deferred:
+        # settle scheme buckets in COMPLETION order, not dispatch order: a
+        # mixed batch enqueues e.g. the ed25519 bucket before the slower
+        # ECDSA ladder, but whichever bucket finishes first should pay its
+        # host copy-out while the others are still computing — blocking on
+        # the first-dispatched bucket would stack the readbacks serially
+        # behind the slowest one. When nothing is ready yet, block on the
+        # oldest dispatch (the FIFO degenerate case).
+        from corda_tpu.ops._blockpack import result_ready
+
+        deferred, self._deferred = self._deferred, []
+        while deferred:
+            entry = next(
+                (e for e in deferred if result_ready(e[1])), deferred[0]
+            )
+            deferred.remove(entry)
+            idxs, mask, fallback = entry
             try:
                 self._out[idxs] = np.asarray(mask)[: len(idxs)]
             except Exception:
@@ -127,7 +155,6 @@ class PendingRows:
                 self.device_rows -= len(idxs)
                 self.device_mask[idxs] = False
                 fallback()
-        self._deferred = []
         return self._out
 
 

@@ -416,6 +416,60 @@ class TestBFT:
         finally:
             net.stop_pumping()
 
+    def test_pending_state_cleanup_is_lifecycle_tied(self):
+        """_futures/_replies cleanup must not depend on collect() being
+        called: the quorum resolution pops the digest state, and a
+        pending abandoned WITHOUT collect() (a pipelined window unwound
+        by an earlier failure) drops it via its finalizer — no per-digest
+        state may survive for the process lifetime."""
+        import gc
+
+        from corda_tpu.notary.bft import BFTClusterClient
+        from corda_tpu.serialization import serialize
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            _replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix="cleanup-replica"
+            )
+            provider = make_client("cleanup-client")
+            client = provider.client
+            # normal path: collect ran, everything popped
+            provider.commit(_refs("p"), sha256(b"txP"), "alice")
+            assert not client._futures and not client._replies
+            # quorum resolves an UNCOLLECTED pending: cleanup rides the
+            # resolution, not the collect that never comes
+            pending = client._submit_command_async(
+                serialize((_refs("q"), sha256(b"txQ"), "bob"))
+            )
+            deadline = time.monotonic() + 10
+            while client._futures and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not client._futures, "quorum did not pop the future"
+            assert not client._replies
+            del pending
+        finally:
+            net.stop_pumping()
+
+        # a pending that never reaches quorum (unreachable replicas) and
+        # is abandoned without collect(): the finalizer drops its state
+        class _NullMessaging:
+            def add_handler(self, _t, _fn):
+                pass
+
+            def send(self, _to, _t, _payload):
+                pass
+
+        lonely = BFTClusterClient(
+            "lonely", _NullMessaging(), ["r0", "r1", "r2", "r3"], {}
+        )
+        abandoned = lonely._submit_command_async(b"never-quorate")
+        assert lonely._futures and len(lonely._futures) == 1
+        del abandoned
+        gc.collect()
+        assert not lonely._futures and not lonely._replies
+
     def test_equivocating_primary_cannot_split_quorum(self):
         """Votes for different digests at one sequence must not conflate:
         inject a forged commit vote for a digest that was never

@@ -190,3 +190,51 @@ class TestFeedBridge:
         assert gbp.get() == 15
         amounts.remove(_Amt(5, "GBP"))
         assert gbp.get() == 10
+
+    def test_accumulate_feed_seed_precedes_construction_updates(self):
+        """``seed`` elements land BEFORE the subscription, so an update
+        pushed during construction appends after the snapshot instead of
+        ahead of (or duplicated with) it."""
+        feed = _FakeFeed(snapshot=object())  # page-shaped: not a sequence
+        original_subscribe = feed.subscribe
+
+        def subscribe_and_push(cb):
+            original_subscribe(cb)
+            feed.push({"produced": ["during-construction"]})
+
+        feed.subscribe = subscribe_and_push
+        out = accumulate_feed(
+            feed, extract=lambda u: u["produced"], seed=["page-1", "page-2"],
+        )
+        assert out.snapshot() == ["page-1", "page-2", "during-construction"]
+
+    def test_node_monitor_model_seeds_page_before_updates(self):
+        """NodeMonitorModel's produced_states: the vault Page's snapshot
+        states precede any update pushed while the model is constructed
+        (the reference's snapshot-then-updates ordering)."""
+        import types
+
+        from corda_tpu.rpc.bindings import NodeMonitorModel
+
+        page = types.SimpleNamespace(states=["sar-page-a", "sar-page-b"])
+        vault_feed = _FakeFeed(snapshot=page)
+        original_subscribe = vault_feed.subscribe
+        pushed = types.SimpleNamespace(produced=["sar-live"])
+
+        def subscribe_and_push(cb):
+            # an update races model construction: delivered the moment
+            # anything subscribes
+            original_subscribe(cb)
+            cb(pushed)
+
+        vault_feed.subscribe = subscribe_and_push
+        proxy = types.SimpleNamespace(
+            vault_track=lambda: vault_feed,
+            validated_transactions_track=lambda: _FakeFeed(snapshot=[]),
+            network_map_feed=lambda: _FakeFeed(snapshot=[]),
+        )
+        model = NodeMonitorModel(proxy)
+        produced = model.produced_states.snapshot()
+        assert produced[:2] == ["sar-page-a", "sar-page-b"]
+        assert produced.count("sar-live") == 1
+        assert produced.index("sar-live") >= 2

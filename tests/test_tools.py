@@ -184,6 +184,9 @@ class TestPerfGate:
         "value": 8000.0,
         "ed25519_sigs_per_sec": 100000.0,
         "ecdsa_sigs_per_sec": 50000.0,
+        # the host-relative pipeline ratios (ISSUE 5 acceptance axes)
+        "dag_vs_host": 1.1,
+        "mixed_vs_host": 5.5,
         "profile": {
             "ed25519.verify": {
                 "compile_s": 5.2, "compile_count": 1,
@@ -238,6 +241,9 @@ class TestPerfGate:
         doc = json.loads(baseline.read_text())
         assert doc["schema"] == 1
         assert doc["metrics"]["ed25519_sigs_per_sec"]["baseline"] == 100000.0
+        # the pipeline ratio metrics are gated (written with the rest)
+        assert doc["metrics"]["dag_vs_host"]["baseline"] == 1.1
+        assert doc["metrics"]["mixed_vs_host"]["baseline"] == 5.5
 
         # identical result → green
         ok = self._run("--result", str(result), "--baseline", str(baseline))
@@ -261,6 +267,16 @@ class TestPerfGate:
         assert proc.returncode == 1
         assert "ed25519_sigs_per_sec" in proc.stdout
         assert "FAIL" in proc.stdout
+
+        # a dag_vs_host slide back under host (1.1 → 0.85, past the 20%
+        # tolerance) → red: the pipeline win cannot silently regress
+        slid = dict(self.SYNTHETIC)
+        slid["dag_vs_host"] = 0.85
+        s = tmp_path / "slid.json"
+        s.write_text(json.dumps(slid))
+        proc = self._run("--result", str(s), "--baseline", str(baseline))
+        assert proc.returncode == 1
+        assert "dag_vs_host" in proc.stdout
 
     def test_gate_skips_missing_sections_but_not_everything(self, tmp_path):
         """A partially-errored bench (dead device section) must not read

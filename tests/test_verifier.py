@@ -330,3 +330,42 @@ class TestWavefront:
             verify_transaction_dag(
                 {s.id: s for s in (root, bad)}, use_device=False
             )
+
+
+# ------------------------------------------------- SPHINCS routing override
+
+class TestSphincsRoutingOverride:
+    def test_forced_device_outranks_backend_gate(self, monkeypatch):
+        """CORDA_TPU_SPHINCS=device must route scheme 5 to the device
+        tier on ANY accelerator backend — without even consulting the
+        backend gate (the override exists precisely to pin routing on
+        non-TPU backends)."""
+        import jax
+
+        from corda_tpu.crypto import SPHINCS256_SHA256
+        from corda_tpu.verifier.batch import _effective_device_schemes
+
+        monkeypatch.setenv("CORDA_TPU_SPHINCS", "device")
+
+        def boom():
+            raise AssertionError("backend gate consulted under override")
+
+        monkeypatch.setattr(jax, "default_backend", boom)
+        assert SPHINCS256_SHA256 in _effective_device_schemes(True)
+
+    def test_forced_host_and_backend_default(self, monkeypatch):
+        import jax
+
+        from corda_tpu.crypto import SPHINCS256_SHA256
+        from corda_tpu.verifier.batch import _effective_device_schemes
+
+        monkeypatch.setenv("CORDA_TPU_SPHINCS", "host")
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        assert SPHINCS256_SHA256 not in _effective_device_schemes(True)
+        # no override: route by backend — TPU on, anything else off
+        monkeypatch.delenv("CORDA_TPU_SPHINCS")
+        assert SPHINCS256_SHA256 in _effective_device_schemes(True)
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert SPHINCS256_SHA256 not in _effective_device_schemes(True)
+        # host-only dispatch never routes any scheme to device
+        assert _effective_device_schemes(False) == set()

@@ -1,0 +1,405 @@
+"""The async double-buffered wavefront pipeline (parallel/wavefront.py).
+
+Three properties of the two-stage dispatch/walk design:
+
+1. **Verdict parity** with the synchronous one-window path on generated
+   ledgers — including double-spend and unresolved-state failures, which
+   must surface with the same exception and offender whichever shape ran.
+2. **Overlap is real**: a window's ``wavefront.window`` span opens at
+   dispatch and closes after its walk, so with the pipeline live,
+   window N+1's span must START before window N's CLOSES.
+3. **Failure hygiene**: a failure in an in-flight window closes the
+   queued windows' spans and drops their optimistically primed claimed
+   ids — no poisoned id caches, no truncated traces.
+
+Everything runs host-crypto (or the CPU device tier for the id-sweep
+paths) so failures localize; the on-chip throughput claim lives in
+bench.py / PERF_BASELINE.json (``dag_vs_host``).
+"""
+
+import hashlib
+
+import pytest
+
+from corda_tpu.crypto import derive_keypair_from_entropy
+from corda_tpu.finance import CashState
+from corda_tpu.finance.contracts import CASH_PROGRAM_ID, Issue, Move
+from corda_tpu.ledger import (
+    Amount,
+    CordaX500Name,
+    Issued,
+    Party,
+    PartyAndReference,
+    TransactionBuilder,
+)
+from corda_tpu.parallel.wavefront import (
+    DoubleSpendInDagError,
+    UnresolvedStateError,
+    verify_transaction_dag,
+)
+
+
+def _party(tag: bytes):
+    kp = derive_keypair_from_entropy(4, hashlib.sha256(tag).digest())
+    return Party(CordaX500Name(tag.decode(), "London", "GB"), kp.public), kp
+
+
+def make_chain(hops: int):
+    """Issue + ``hops`` sequential self-moves (the bench back-chain)."""
+    (alice, akp) = _party(b"Pipeline Owner")
+    (notary, _) = _party(b"Pipeline Notary")
+    token = Issued(PartyAndReference(alice, b"\x07"), "GBP")
+    b = TransactionBuilder(notary=notary)
+    b.add_output_state(CashState(Amount(500, token), alice), CASH_PROGRAM_ID)
+    b.add_command(Issue(), alice.owning_key)
+    chain = [b.sign_initial_transaction(akp)]
+    for _ in range(hops):
+        mb = TransactionBuilder(notary=notary)
+        mb.add_input_state(chain[-1].tx.out_ref(0))
+        mb.add_output_state(
+            CashState(Amount(500, token), alice), CASH_PROGRAM_ID
+        )
+        mb.add_command(Move(), alice.owning_key)
+        chain.append(mb.sign_initial_transaction(akp))
+    return chain, notary, alice, akp
+
+
+def _clear_ids(chain):
+    for stx in chain:
+        object.__getattribute__(stx.tx, "__dict__").pop("_id", None)
+
+
+def _result_tuple(res):
+    return (res.order, res.levels, res.n_sigs, res.consumed)
+
+
+def _drain_scheduler():
+    """Drain in-flight batches an aborted pipeline abandoned on the
+    process-global scheduler (a replacement spins up on next access) —
+    interpreter teardown mid-device-dispatch aborts the process."""
+    from corda_tpu.serving import shutdown_scheduler
+
+    shutdown_scheduler()
+
+
+@pytest.fixture(scope="module")
+def chain():
+    return make_chain(39)  # 40 txs → 5 windows of 8
+
+
+class TestVerdictParity:
+    def test_pipelined_matches_sync_host_path(self, chain):
+        stxs, notary, _alice, _akp = chain
+        dag = {s.id: s for s in stxs}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        sync = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=False,
+            window=len(stxs) + 1, use_scheduler=False,
+        )
+        piped = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=False,
+            window=8, depth=3,
+        )
+        assert _result_tuple(piped) == _result_tuple(sync)
+
+    def test_pipelined_matches_sync_device_tier(self, chain):
+        """use_device=True on the CPU backend exercises the async id
+        sweep (dispatch_check_ids) + scheme-bucket dispatch end to end."""
+        stxs, notary, _alice, _akp = chain
+        sub = stxs[:16]
+        dag = {s.id: s for s in sub}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        _clear_ids(sub)
+        sync = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=True,
+            window=len(sub) + 1, use_scheduler=False,
+        )
+        _clear_ids(sub)
+        piped = verify_transaction_dag(
+            dag, allowed_missing_fn=allowed, use_device=True,
+            window=4, depth=3,
+        )
+        assert _result_tuple(piped) == _result_tuple(sync)
+        # the sweep primed every id cache with the recomputed truth
+        for stx in sub:
+            cached = object.__getattribute__(stx.tx, "__dict__")["_id"]
+            assert cached == stx.id
+
+    def test_double_spend_same_offender_both_shapes(self, chain):
+        stxs, notary, alice, akp = chain
+        # a second spend of window-3 territory: tx 20's output re-spent
+        parent = stxs[20]
+        db = TransactionBuilder(notary=notary)
+        db.add_input_state(parent.tx.out_ref(0))
+        db.add_output_state(
+            CashState(parent.tx.outputs[0].data.amount, alice),
+            CASH_PROGRAM_ID,
+        )
+        db.add_command(Move(), alice.owning_key)
+        dup = db.sign_initial_transaction(akp)
+        dag = {s.id: s for s in stxs}
+        dag[dup.id] = dup
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        with pytest.raises(DoubleSpendInDagError) as sync_err:
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=len(dag) + 1, use_scheduler=False,
+            )
+        with pytest.raises(DoubleSpendInDagError) as piped_err:
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=8, depth=3,
+            )
+        assert piped_err.value.ref == sync_err.value.ref
+
+    def test_unresolved_state_same_offender_both_shapes(self, chain):
+        stxs, notary, _alice, _akp = chain
+        # drop a mid-chain parent: its child (in a later window) must
+        # fail resolution at that window in both shapes
+        dag = {s.id: s for s in stxs if s is not stxs[25]}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        with pytest.raises(UnresolvedStateError) as sync_err:
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=len(dag) + 1, use_scheduler=False,
+            )
+        with pytest.raises(UnresolvedStateError) as piped_err:
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=8, depth=3,
+            )
+        assert piped_err.value.ref == sync_err.value.ref
+        assert piped_err.value.tx_id == sync_err.value.tx_id
+
+    def test_all_claims_checked_past_first_mismatch(self, chain):
+        """The device-tier id sweep primes EVERY recomputed id before
+        raising the first mismatch — a batch with two forged claims must
+        not leave the second one's unchecked claim cached."""
+        from corda_tpu.crypto import SecureHash
+        from corda_tpu.ledger.states import TransactionVerificationException
+        from corda_tpu.ops.txid import dispatch_check_ids, ids_tier
+
+        stxs, _notary, _alice, _akp = chain
+        a, b = stxs[30], stxs[31]
+        true_ids = (a.id, b.id)
+        fake_a = SecureHash(hashlib.sha256(b"forge-a").digest())
+        fake_b = SecureHash(hashlib.sha256(b"forge-b").digest())
+        assert ids_tier() == "device"  # CPU backend routes device here
+        for stx, fake in ((a, fake_a), (b, fake_b)):
+            object.__getattribute__(stx.tx, "__dict__")["_id"] = fake
+        with pytest.raises(TransactionVerificationException):
+            dispatch_check_ids({fake_a: a, fake_b: b}).collect()
+        cached = tuple(
+            object.__getattribute__(s.tx, "__dict__").get("_id")
+            for s in (a, b)
+        )
+        assert cached == true_ids, "a forged claim survived the sweep"
+
+    def test_dispatch_failure_rolls_back_window_claims(self, chain,
+                                                       monkeypatch):
+        """A window whose SIGNATURE dispatch fails (after the claimed-id
+        priming ran) must drop its unchecked claims — the abort path for
+        the window being dispatched, not just the in-flight ones."""
+        from corda_tpu.serving.scheduler import DeviceScheduler
+
+        stxs, notary, _alice, _akp = chain
+        sub = stxs[:12]
+        dag = {s.id: s for s in sub}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+
+        def boom(self, *a, **k):
+            raise RuntimeError("injected dispatch failure")
+
+        monkeypatch.setattr(DeviceScheduler, "submit_transactions", boom)
+        # the direct-dispatch fallback only catches ServingError, so the
+        # RuntimeError escapes the first window's dispatch
+        _clear_ids(sub)
+        with pytest.raises(RuntimeError, match="injected"):
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=True,
+                window=4, depth=3,
+            )
+        dangling = [
+            s for s in sub
+            if "_id" in object.__getattribute__(s.tx, "__dict__")
+        ]
+        assert not dangling, "dispatch failure left unchecked claimed ids"
+
+    def test_forged_chain_link_raises_at_its_window(self, chain):
+        """A claimed id that does not hash to the content fails the id
+        sweep when ITS window walks — and the poisoned claimed id must
+        not survive in the tx's cache afterwards."""
+        from corda_tpu.crypto import SecureHash
+        from corda_tpu.ledger.states import TransactionVerificationException
+
+        stxs, notary, _alice, _akp = chain
+        sub = stxs[:12]
+        fake = SecureHash(hashlib.sha256(b"forged-link").digest())
+        dag = {s.id: s for s in sub[:-1]}
+        dag[fake] = sub[-1]  # claimed id != recomputed id
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        _clear_ids(sub)
+        with pytest.raises(TransactionVerificationException):
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=True,
+                window=4, depth=3,
+            )
+        cached = object.__getattribute__(sub[-1].tx, "__dict__").get("_id")
+        assert cached != fake, "forged claimed id survived in the cache"
+        _clear_ids(sub)
+        _drain_scheduler()
+
+
+class TestOverlap:
+    def _window_spans(self, trc, root):
+        return sorted(
+            (
+                s for s in trc.dump(limit=500)
+                if s["name"] == "wavefront.window"
+                and s["trace_id"] == root.trace_id
+            ),
+            key=lambda s: s["start_s"],
+        )
+
+    def test_window_spans_overlap_when_pipelined(self, chain):
+        from corda_tpu.observability import tracer
+
+        stxs, notary, _alice, _akp = chain
+        dag = {s.id: s for s in stxs}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        trc = tracer()
+        root = trc.root("test.dag_pipeline", force=True)
+        with trc.activate(root):
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=8, depth=3,
+            )
+        root.finish()
+        spans = self._window_spans(trc, root)
+        assert len(spans) == 5
+        # window N+1 dispatches (span opens) before window N's walk
+        # finishes (span closes): the double-buffer overlap witness
+        overlaps = sum(
+            1 for a, b in zip(spans, spans[1:])
+            if b["start_s"] < a["end_s"]
+        )
+        assert overlaps >= 1, "pipeline ran synchronously"
+        assert all(s["status"] == "ok" for s in spans)
+
+    def test_single_window_runs_unpipelined(self, chain):
+        from corda_tpu.observability import tracer
+
+        stxs, notary, _alice, _akp = chain
+        dag = {s.id: s for s in stxs}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        trc = tracer()
+        root = trc.root("test.dag_oneshot", force=True)
+        with trc.activate(root):
+            verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=False,
+                window=len(stxs) + 1,
+            )
+        root.finish()
+        spans = self._window_spans(trc, root)
+        assert len(spans) == 1
+
+
+class TestFailureCancellation:
+    def test_failure_closes_queued_windows_and_drops_claimed_ids(self):
+        """A double-spend in an early window aborts the resolve while
+        later windows are still in flight: every dispatched window's
+        span must land in the ring (error status on the abandoned ones)
+        and the abandoned windows' optimistically primed CLAIMED ids
+        must be dropped — they were never checked against the bytes."""
+        from corda_tpu.observability import tracer
+
+        stxs, notary, alice, akp = make_chain(23)  # 24 txs → 6 windows
+        parent = stxs[2]
+        db = TransactionBuilder(notary=notary)
+        db.add_input_state(parent.tx.out_ref(0))
+        db.add_output_state(
+            CashState(parent.tx.outputs[0].data.amount, alice),
+            CASH_PROGRAM_ID,
+        )
+        db.add_command(Move(), alice.owning_key)
+        dup = db.sign_initial_transaction(akp)
+        dag = {s.id: s for s in stxs}
+        dag[dup.id] = dup
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        _clear_ids(stxs)
+        trc = tracer()
+        root = trc.root("test.dag_cancel", force=True)
+        try:
+            with trc.activate(root):
+                with pytest.raises(DoubleSpendInDagError):
+                    verify_transaction_dag(
+                        dag, allowed_missing_fn=allowed, use_device=True,
+                        window=4, depth=3,
+                    )
+        finally:
+            root.finish()
+        spans = [
+            s for s in trc.dump(limit=500)
+            if s["name"] == "wavefront.window"
+            and s["trace_id"] == root.trace_id
+        ]
+        # every DISPATCHED window span finished — the failing one plus
+        # the abandoned in-flight ones, all with error status
+        assert spans, "no window spans recorded"
+        assert all(s["end_s"] is not None for s in spans)
+        erred = [s for s in spans if s["status"] != "ok"]
+        assert len(erred) >= 2, "abandoned windows left open/ok spans"
+        # abandoned (never-walked) windows' txs: claimed-id caches popped
+        walked = 4 * (len(spans) - len(erred))
+        abandoned_tail = stxs[walked + 4 * 3:]
+        dangling = [
+            stx for stx in abandoned_tail
+            if "_id" in object.__getattribute__(stx.tx, "__dict__")
+        ]
+        # txs beyond the dispatch horizon never primed; txs inside it
+        # must have been cleaned — nothing past the walked prefix plus
+        # the pipeline depth may keep an unchecked claimed id... except
+        # the failing window itself, whose sweep DID check its ids
+        assert not dangling, (
+            f"{len(dangling)} abandoned txs kept unchecked claimed ids"
+        )
+        _clear_ids(stxs)
+        _drain_scheduler()
+
+
+class TestPendingRowsCompletionOrder:
+    def test_collect_settles_ready_buckets_first(self):
+        """PendingRows.collect harvests whichever scheme bucket's device
+        work finished first, falling back to dispatch order only when
+        nothing is ready."""
+        import numpy as np
+
+        from corda_tpu.verifier.batch import PendingRows
+
+        settle_order = []
+
+        class FakeMask:
+            def __init__(self, tag, ready):
+                self.tag = tag
+                self._ready = ready
+                self.shape = (4,)
+
+            def is_ready(self):
+                return self._ready
+
+            def __array__(self, dtype=None, copy=None):
+                settle_order.append(self.tag)
+                return np.ones(4, dtype=bool)
+
+        pending = PendingRows(4)
+        slow = FakeMask("slow", ready=False)
+        fast = FakeMask("fast", ready=True)
+        # dispatch order: slow first, fast second
+        pending._deferred.append(([0, 1], slow, lambda: None))
+        pending._deferred.append(([2, 3], fast, lambda: None))
+        pending.device_rows = 4
+        pending.device_mask[:] = True
+        mask = pending.collect()
+        assert mask.all()
+        assert settle_order == ["fast", "slow"]
+        assert pending.ready()  # drained: nothing deferred

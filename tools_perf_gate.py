@@ -68,6 +68,15 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     "ed25519_best_sigs_per_sec": ("higher", 0.15),
     "ecdsa_sigs_per_sec": ("higher", 0.15),
     "mixed_scheme_sigs_per_sec": ("higher", 0.25),
+    # host-relative ratios: the "device beats host" acceptance axes for
+    # the DAG-resolve and mixed-scheme pipelines — gating the RATIO means
+    # a host-baseline speedup cannot mask a device-path regression. Tight
+    # tolerances on purpose: the checked-in baseline tracks the last
+    # committed chip capture, and every on-chip improvement should be
+    # locked in promptly with --write-baseline (the baseline, not this
+    # table, is the contract once written).
+    "dag_vs_host": ("higher", 0.10),
+    "mixed_vs_host": ("higher", 0.15),
     "value": ("higher", 0.20),                    # notarised tx/sec headline
     "notary_best_tx_per_sec": ("higher", 0.20),
     "notary_loadtest_tx_per_sec": ("higher", 0.30),
