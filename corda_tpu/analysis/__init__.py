@@ -1,0 +1,39 @@
+"""tpu-lint — the project's concurrency & device-invariant analyzer.
+
+A deviceless, AST-based static-analysis suite encoding the invariants
+the multi-threaded refactors keep re-litigating in review (ISSUE 6 /
+docs/STATIC_ANALYSIS.md): lock discipline around ``self._lock`` owners,
+no reads of donated device buffers, no blocking readback on the serving
+hot paths, every thread daemonized or joined, and rollback handlers that
+survive ``KeyboardInterrupt``. The registry passes folded in from
+``tools_metrics_lint.py`` keep the metric/span/kernel and fault-site
+name registries true to the docs.
+
+Entry point: ``tools_analyze.py`` at the repo root (wired into tier-1 by
+``tests/test_tools.py``). Pure stdlib — importing this package must
+never touch jax, so the analyzer runs on a bare container in seconds.
+
+The runtime half of the story — the lock-order sanitizer that watches
+*actual* acquisition order — lives in ``corda_tpu.observability
+.lockwatch`` (the passes here are static; cycles between locks only
+exist at runtime).
+"""
+
+from .core import (
+    BaselineError,
+    Finding,
+    Project,
+    load_baseline,
+    run_passes,
+)
+from .registry import ALL_PASSES, get_passes
+
+__all__ = [
+    "ALL_PASSES",
+    "BaselineError",
+    "Finding",
+    "Project",
+    "get_passes",
+    "load_baseline",
+    "run_passes",
+]

@@ -1,0 +1,245 @@
+"""Analyzer core: the project model, findings, suppressions, baseline.
+
+Design notes (shared by every pass):
+
+- **Stable keys, not line numbers.** A ``Finding`` carries both the
+  line (for the human reading the report) and a ``key`` built from
+  file + enclosing scope + the offending symbol (for the suppression
+  machinery) — so a checked-in baseline entry survives unrelated edits
+  that shift line numbers, and goes STALE the moment the code it
+  excused is gone.
+
+- **Two suppression channels.** An inline ``# tpu-lint: allow=<pass>``
+  comment (on the offending line, or on a comment line directly above
+  it) is the self-documenting channel for invariants that are
+  deliberate — the reason lives next to the code. The baseline file
+  (``ANALYSIS_BASELINE.json``) is the bulk channel for grandfathered
+  findings; the driver FAILS on stale entries so it can only shrink.
+
+- **Deviceless.** Everything here is stdlib ``ast`` + regex. No pass
+  may import jax or any corda_tpu runtime module at analysis time.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+
+ALLOW_RE = re.compile(r"#\s*tpu-lint:\s*allow=([A-Za-z0-9_,\-]+)")
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One violation: where it is, which pass, and the stable key the
+    suppression machinery matches on."""
+
+    pass_id: str
+    file: str       # repo-relative posix path
+    line: int       # 1-based, for the report
+    message: str
+    key: str        # stable: file::scope::symbol — no line numbers
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+class BaselineError(Exception):
+    """The baseline file is malformed (distinct from stale entries,
+    which are reported as ordinary failures)."""
+
+
+class SourceFile:
+    """One parsed source file plus its inline-suppression map."""
+
+    __slots__ = ("rel", "path", "text", "lines", "tree", "_allow")
+
+    def __init__(self, path: Path, rel: str):
+        self.path = path
+        self.rel = rel
+        self.text = path.read_text()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=rel)
+        self._allow = self._scan_allows()
+
+    def _scan_allows(self) -> dict[int, set[str]]:
+        """line (1-based) → pass ids allowed there. A comment-only line
+        carrying the marker also covers the next non-blank line, so long
+        statements can hold their suppression on the line above."""
+        allow: dict[int, set[str]] = {}
+        pending: set[str] = set()
+        for i, raw in enumerate(self.lines, start=1):
+            stripped = raw.strip()
+            m = ALLOW_RE.search(raw)
+            ids = set(m.group(1).split(",")) if m else set()
+            if stripped.startswith("#"):
+                # pure comment: marker (if any) carries down to the
+                # statement below, accumulating across a comment block
+                pending |= ids
+                continue
+            if not stripped:
+                continue
+            here = ids | pending
+            pending = set()
+            if here:
+                allow[i] = here
+        return allow
+
+    def allowed(self, line: int, pass_id: str) -> bool:
+        return pass_id in self._allow.get(line, ())
+
+
+class Project:
+    """The analyzed tree: parsed sources under the scan paths plus the
+    repo root (passes that cross-check docs resolve them from here)."""
+
+    def __init__(self, root: Path, paths: list[str] | None = None):
+        self.root = Path(root)
+        self.files: list[SourceFile] = []
+        self.parse_errors: list[str] = []
+        for path in self._expand(paths):
+            rel = path.relative_to(self.root).as_posix()
+            try:
+                self.files.append(SourceFile(path, rel))
+            except (SyntaxError, OSError, UnicodeDecodeError) as e:
+                self.parse_errors.append(f"{rel}: {e}")
+
+    def _expand(self, paths: list[str] | None) -> list[Path]:
+        if not paths:
+            # default scan set: the package tree + the top-level entry
+            # points (bench, tools_*) — the same surface the metrics
+            # lint always covered
+            out = sorted((self.root / "corda_tpu").rglob("*.py"))
+            out += sorted(self.root.glob("*.py"))
+            return out
+        out = []
+        for p in paths:
+            cand = (self.root / p).resolve()
+            if cand.is_dir():
+                out += sorted(cand.rglob("*.py"))
+            elif cand.is_file():
+                out.append(cand)
+        return out
+
+    def file(self, rel: str) -> SourceFile | None:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+    def doc_text(self, rel: str) -> str | None:
+        p = self.root / rel
+        return p.read_text() if p.exists() else None
+
+
+# --------------------------------------------------------------- baseline
+
+def load_baseline(path: Path) -> dict[tuple[str, str], str]:
+    """(pass_id, key) → reason. Missing file = empty baseline."""
+    if not path.exists():
+        return {}
+    try:
+        doc = json.loads(path.read_text())
+        entries = doc["suppress"]
+        out = {}
+        for e in entries:
+            out[(e["pass"], e["key"])] = e.get("reason", "")
+        return out
+    except (json.JSONDecodeError, KeyError, TypeError) as e:
+        raise BaselineError(f"malformed baseline {path}: {e}") from None
+
+
+def run_passes(project: Project, passes) -> list[Finding]:
+    findings: list[Finding] = []
+    for p in passes:
+        findings.extend(p.run(project))
+    findings.sort(key=lambda f: (f.file, f.line, f.pass_id))
+    return findings
+
+
+def split_suppressed(
+    project: Project,
+    findings: list[Finding],
+    baseline: dict[tuple[str, str], str],
+):
+    """→ (unsuppressed, inline-suppressed, baselined, stale baseline
+    entries). A baseline entry is stale when no current finding matches
+    it — the code it excused changed, so the excuse must go too."""
+    live: list[Finding] = []
+    inline: list[Finding] = []
+    baselined: list[Finding] = []
+    hit: set[tuple[str, str]] = set()
+    for f in findings:
+        sf = project.file(f.file)
+        if sf is not None and sf.allowed(f.line, f.pass_id):
+            inline.append(f)
+        elif (f.pass_id, f.key) in baseline:
+            hit.add((f.pass_id, f.key))
+            baselined.append(f)
+        else:
+            live.append(f)
+    stale = sorted(k for k in baseline if k not in hit)
+    return live, inline, baselined, stale
+
+
+# ------------------------------------------------------------ AST helpers
+
+def qualname_map(tree: ast.AST) -> dict[ast.AST, str]:
+    """node → dotted scope name ("Class.method", "func.<locals>.inner")
+    for every function/class def, so findings name the scope a human
+    greps for."""
+    out: dict[ast.AST, str] = {}
+
+    def walk(node: ast.AST, prefix: str):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                name = f"{prefix}{child.name}"
+                out[child] = name
+                walk(child, f"{name}.<locals>.")
+            elif isinstance(child, ast.ClassDef):
+                name = f"{prefix}{child.name}"
+                out[child] = name
+                walk(child, f"{name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def call_name(func: ast.AST) -> str:
+    """Rightmost dotted name of a call target: ``a.b.c(...)`` → "c",
+    ``f(...)`` → "f"."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Full dotted path for Name/Attribute chains ("threading.Thread");
+    "" for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_self_attr(node: ast.AST) -> str | None:
+    """'x' when node is exactly ``self.x``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None

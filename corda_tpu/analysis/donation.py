@@ -1,0 +1,234 @@
+"""donation-safety: a donated buffer is dead after dispatch.
+
+PR 5 made the device entry points donate their freshly-uploaded input
+planes (``jax.jit(..., donate_argnums=...)``): XLA may recycle that
+device memory for the kernel's outputs, so the Python-side array object
+is INVALID the moment the call returns — reading it raises
+``RuntimeError: Array has been deleted``, and re-passing it to another
+dispatch corrupts whatever now lives in those bytes. The failure only
+reproduces on a real device (CPU jax tolerates more), which is exactly
+why it must be caught statically.
+
+The pass taints every bare-name argument sitting at a donated position
+of a donated callee, then flags any later read of that name in the same
+function scope (line order; a rebind between call and read clears the
+taint — ``x = f(x)`` self-donation included).
+
+Donated callees come from two sources:
+
+- functions DEFINED in the scanned tree whose decorators carry
+  ``donate_argnums`` (``@functools.partial(jax.jit, donate_argnums=…)``
+  or ``@jax.jit(..., donate_argnums=…)``) — positions read from the
+  literal;
+- the known cross-module wrappers (``_ecdsa_pallas_donated``,
+  ``_tpu_verify_*``) with their hardcoded donated positions, so a
+  caller in another module is still covered.
+
+Known blind spots: loops (a textually-earlier read that executes after
+the call), aliasing, attribute/subscript arguments. Keep donated
+dispatches straight-line and the pass sees everything.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, qualname_map
+
+PASS_ID = "donation-safety"
+
+# cross-module wrappers and the argument positions they donate; *_tpu
+# wrappers are matched by prefix below with ALL positions donated
+# (their real donate_argnums cover every array argument)
+_KNOWN = {
+    "_ecdsa_pallas_donated": frozenset(range(1, 9)),
+}
+_KNOWN_PREFIXES = ("_tpu_verify_",)
+
+
+def _donated_positions(deco: ast.expr) -> frozenset | None:
+    """donate_argnums positions from a decorator expression, or None."""
+    if not isinstance(deco, ast.Call):
+        return None
+    for kw in deco.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Tuple):
+                pos = [
+                    e.value for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int)
+                ]
+                return frozenset(pos)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return frozenset((v.value,))
+    return None
+
+
+def _tree_donated(project: Project) -> dict[str, frozenset]:
+    """name → donated positions for decorated defs across the tree."""
+    out: dict[str, frozenset] = {}
+    for sf in project.files:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for deco in node.decorator_list:
+                pos = _donated_positions(deco)
+                if pos is not None:
+                    out[node.name] = pos
+    return out
+
+
+def _terminates(body: list[ast.stmt]) -> bool:
+    """Does this statement list never fall through? (Last statement is
+    a return/raise/continue/break — the early-return idiom.)"""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break)
+    )
+
+
+def _callee_positions(name: str, donated: dict) -> frozenset | None:
+    if name in donated:
+        return donated[name]
+    if name in _KNOWN:
+        return _KNOWN[name]
+    for p in _KNOWN_PREFIXES:
+        if name.startswith(p):
+            return frozenset(range(0, 16))
+    return None
+
+
+class _ScopeCheck(ast.NodeVisitor):
+    """Within one function body: taint names at donated call sites,
+    flag later loads. Nested defs are separate scopes (handled by the
+    outer loop), so they are skipped here."""
+
+    def __init__(self, donated: dict):
+        self.donated = donated
+        # name → line tainted at
+        self.taints: dict[str, int] = {}
+        self.hits: list[tuple[str, int, int]] = []  # (name, line, taint line)
+        self._root = True
+
+    def visit_FunctionDef(self, node):
+        if self._root:
+            self._root = False
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_If(self, node: ast.If):
+        # branch-aware: a taint created in one arm must not flag a read
+        # in the sibling arm (`if on_tpu: return donated(x)` … `return
+        # core(x)` is the idiomatic routing split). After the If, the
+        # join unions both arms' taints — except an arm ending in
+        # return/raise never falls through, so its taints die with it.
+        self.visit(node.test)
+        snapshot = dict(self.taints)
+        for stmt in node.body:
+            self.visit(stmt)
+        after_body = self.taints
+        self.taints = dict(snapshot)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        after_else = self.taints
+        body_falls = not _terminates(node.body)
+        else_falls = not node.orelse or not _terminates(node.orelse)
+        if body_falls and else_falls:
+            self.taints = {**after_body, **after_else}
+        elif body_falls:
+            self.taints = after_body
+        else:
+            self.taints = after_else
+
+    def visit_Try(self, node: ast.Try):
+        # handlers run with the try body partially executed: keep body
+        # taints live in them (conservative), same for finally/else
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # evaluation order: the value runs (and may donate/flag) BEFORE
+        # the targets rebind — `x = f(x)` donates x, then rebinding x to
+        # the result clears the taint
+        self.visit(node.value)
+        for t in node.targets:
+            self.visit(t)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self.visit(node.value)
+        self.visit(node.target)
+
+    def visit_Call(self, node: ast.Call):
+        # arguments are evaluated (read) before the call donates them:
+        # visit children first so `g(x)` after taint still flags x, and
+        # `f(x)` at the taint site itself doesn't self-flag
+        self.generic_visit(node)
+        name = (
+            node.func.attr if isinstance(node.func, ast.Attribute)
+            else node.func.id if isinstance(node.func, ast.Name) else ""
+        )
+        pos = _callee_positions(name, self.donated)
+        if pos is None:
+            return
+        for i, arg in enumerate(node.args):
+            if i in pos and isinstance(arg, ast.Name):
+                self.taints[arg.id] = node.lineno
+
+    def visit_IfExp(self, node: ast.IfExp):
+        # ternaries get the same branch split as ast.If: `donated(x) if
+        # fast else x` must not flag the mutually-exclusive else arm
+        self.visit(node.test)
+        snapshot = dict(self.taints)
+        self.visit(node.body)
+        after_body = self.taints
+        self.taints = dict(snapshot)
+        self.visit(node.orelse)
+        self.taints = {**after_body, **self.taints}
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Store):
+            # rebind clears the taint — the name no longer aliases the
+            # donated buffer
+            self.taints.pop(node.id, None)
+        elif isinstance(node.ctx, ast.Load):
+            t = self.taints.get(node.id)
+            # visitation order IS evaluation order here (taints are set
+            # only after the donating call's own arguments were visited),
+            # so ANY tainted load is a post-donation read — including one
+            # on the same source line, `g(donated(buf), buf)`
+            if t is not None:
+                self.hits.append((node.id, node.lineno, t))
+                del self.taints[node.id]  # one report per taint
+
+
+class DonationSafetyPass:
+    id = PASS_ID
+    doc = (
+        "a variable passed to a donate_argnums dispatch must not be "
+        "read or re-passed afterwards"
+    )
+
+    def run(self, project: Project):
+        donated = _tree_donated(project)
+        for sf in project.files:
+            qnames = qualname_map(sf.tree)
+            for node in ast.walk(sf.tree):
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if node.name in donated or any(
+                    node.name.startswith(p) for p in _KNOWN_PREFIXES
+                ):
+                    continue  # the wrapper itself forwards its args
+                chk = _ScopeCheck(donated)
+                chk.visit(node)
+                for name, line, tline in chk.hits:
+                    qn = qnames.get(node, node.name)
+                    yield Finding(
+                        PASS_ID, sf.rel, line,
+                        f"`{name}` was donated to a device dispatch at "
+                        f"line {tline} and is read again here — the "
+                        "buffer may already be recycled on device",
+                        key=f"{sf.rel}::{qn}::{name}",
+                    )

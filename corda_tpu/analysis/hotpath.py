@@ -1,0 +1,116 @@
+"""hot-path-blocking: no synchronous readback on the async hot paths.
+
+PR 5's whole win was removing every ``block_until_ready`` boundary
+between host walks and device compute — the dispatch half of the
+pipeline must stay enqueue-only, with readback confined to the
+designated collect points. A stray ``np.asarray`` on a device handle
+(or ``.item()``, ``float()``, an explicit ``block_until_ready()``)
+silently re-serializes the pipeline: verdicts stay right, the overlap
+the perf gate measures quietly dies.
+
+The pass is scoped to the files where that contract holds
+(``_HOT_FILES``) and allowlists the designated readback scopes
+(``PendingRows.collect`` — the ONE place a batch is supposed to
+materialize; the profiler lives outside these files and is the only
+legal ``block_until_ready`` caller in the tree).
+
+Flagged forms:
+
+- ``<x>.block_until_ready()`` — always
+- ``<x>.item()`` — always (device scalar readback)
+- ``np.asarray(...)`` / ``numpy.asarray(...)`` — device→host copy
+- ``np.array(x)`` / ``float(x)`` where ``x`` is a bare name, attribute
+  or subscript (literals and computed host expressions like
+  ``float(len(batch))`` pass — those never hold a device handle)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted_name, qualname_map
+
+PASS_ID = "hot-path-blocking"
+
+_HOT_FILES = {
+    "corda_tpu/parallel/wavefront.py",
+    "corda_tpu/serving/scheduler.py",
+    "corda_tpu/verifier/batch.py",
+}
+
+# (file, scope qualname) pairs where readback is the scope's JOB
+_ALLOWED_SCOPES = {
+    ("corda_tpu/verifier/batch.py", "PendingRows.collect"),
+}
+
+_HANDLE_ARG = (ast.Name, ast.Attribute, ast.Subscript)
+
+
+def _scope_of(qnames: dict, stack: list) -> str:
+    for node in reversed(stack):
+        if node in qnames:
+            return qnames[node]
+    return "<module>"
+
+
+class HotPathBlockingPass:
+    id = PASS_ID
+    doc = (
+        "no block_until_ready / implicit device readback inside the "
+        "async hot-path files outside the designated collect points"
+    )
+
+    def run(self, project: Project):
+        for sf in project.files:
+            if sf.rel not in _HOT_FILES:
+                continue
+            qnames = qualname_map(sf.tree)
+            yield from self._scan(sf, qnames)
+
+    def _scan(self, sf, qnames):
+        stack: list = []
+
+        def walk(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child)
+            if isinstance(node, ast.Call):
+                f = self._flag(node)
+                if f is not None:
+                    scope = _scope_of(qnames, stack)
+                    if (sf.rel, scope) not in _ALLOWED_SCOPES:
+                        yield Finding(
+                            PASS_ID, sf.rel, node.lineno,
+                            f"{f} in {scope}: this file's dispatch "
+                            "paths must not block on (or read back "
+                            "from) the device — move the readback to "
+                            "a collect point or allowlist it",
+                            key=f"{sf.rel}::{scope}::{f}",
+                        )
+            if is_scope:
+                stack.pop()
+
+        yield from walk(sf.tree)
+
+    @staticmethod
+    def _flag(node: ast.Call) -> str | None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return "block_until_ready()"
+            if func.attr == "item" and not node.args:
+                return ".item()"
+        name = dotted_name(func)
+        if name in ("np.asarray", "numpy.asarray"):
+            return "np.asarray()"
+        if name in ("np.array", "numpy.array"):
+            if node.args and isinstance(node.args[0], _HANDLE_ARG):
+                return "np.array(<handle>)"
+        if isinstance(func, ast.Name) and func.id == "float":
+            if node.args and isinstance(node.args[0], _HANDLE_ARG):
+                return "float(<handle>)"
+        return None

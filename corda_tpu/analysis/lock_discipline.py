@@ -1,0 +1,191 @@
+"""lock-discipline: guarded attributes stay guarded.
+
+The invariant (ISSUE 6 tentpole (a)): in a class that owns a lock
+(``self._lock = threading.Lock()`` / ``RLock`` / ``Condition``), any
+``self._x`` attribute that is EVER mutated under ``with self._lock``
+is part of that lock's protected state — mutating it anywhere else in
+the class is a data race waiting for a refactor to expose it.
+
+What counts as a mutation:
+
+- rebinding: ``self.x = …``, ``self.x += …``
+- keyed writes: ``self.x[k] = …``, ``del self.x[k]``
+- in-place mutator calls: ``self.x.append(…)``, ``.pop()``, ``.update``
+  … (the ``_MUTATORS`` set)
+
+Scope rules tuned to this codebase's idiom:
+
+- ``__init__`` (and ``__new__``) are construction — the object is not
+  published yet, so writes there neither claim an attribute for a lock
+  nor violate one.
+- methods named ``*_locked`` run with the lock already held by their
+  caller (``_assemble_locked``, ``_park_locked`` …): writes inside
+  them count as guarded.
+- a nested closure inherits the lock state of its definition site —
+  the ``loop()`` bodies the engine threads run are analyzed with
+  whatever ``with self._lock`` wraps their *call*... which is not
+  statically known, so closures start OUTSIDE the lock unless the
+  ``def`` itself sits in a ``with self._lock`` block. ``*_locked``
+  closures get the same held-by-convention treatment as methods.
+
+Aliasing (``q = self._queues[c]; q.append(…)``) is invisible to this
+pass — it checks the direct ``self.x`` spellings only. That is the
+precision/recall trade every practical linter makes; the runtime
+lockwatch sanitizer covers the dynamic side.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted_name, is_self_attr
+
+PASS_ID = "lock-discipline"
+
+_LOCK_FACTORIES = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+}
+
+_MUTATORS = {
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "clear", "update",
+    "setdefault", "sort", "reverse",
+}
+
+_CONSTRUCTORS = {"__init__", "__new__", "__post_init__"}
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Attributes assigned from a threading lock factory anywhere in
+    the class (idiomatically in __init__)."""
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if dotted_name(node.value.func) in _LOCK_FACTORIES:
+                for t in node.targets:
+                    attr = is_self_attr(t)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _with_locks(node: ast.With, locks: set[str]) -> set[str]:
+    """Lock attrs acquired by this ``with``'s items (``with self._lock:``)."""
+    held = set()
+    for item in node.items:
+        attr = is_self_attr(item.context_expr)
+        if attr and attr in locks:
+            held.add(attr)
+    return held
+
+
+class _Write:
+    __slots__ = ("attr", "method", "line", "held")
+
+    def __init__(self, attr: str, method: str, line: int, held: frozenset):
+        self.attr = attr
+        self.method = method
+        self.line = line
+        self.held = held
+
+
+def _collect_writes(cls: ast.ClassDef, locks: set[str]) -> list[_Write]:
+    writes: list[_Write] = []
+
+    def visit(node: ast.AST, method: str, held: frozenset):
+        for child in ast.iter_child_nodes(node):
+            child_method, child_held = method, held
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if method is None:
+                    # a method of the class
+                    child_method = child.name
+                    child_held = (
+                        frozenset(locks)
+                        if child.name.endswith("_locked")
+                        else frozenset()
+                    )
+                else:
+                    # nested closure: *_locked closures are
+                    # held-by-convention, others inherit the definition
+                    # site's lock state
+                    child_method = f"{method}.<locals>.{child.name}"
+                    if child.name.endswith("_locked"):
+                        child_held = held | frozenset(locks)
+            elif isinstance(child, ast.ClassDef):
+                continue  # nested class: its methods are its own story
+            elif isinstance(child, ast.With) and method is not None:
+                child_held = held | _with_locks(child, locks)
+            if method is not None:
+                _record(child, child_method, child_held)
+            visit(child, child_method, child_held)
+
+    def _record(node: ast.AST, method: str, held: frozenset):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                _record_target(t, method, node.lineno, held)
+        elif isinstance(node, ast.AugAssign):
+            _record_target(node.target, method, node.lineno, held)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                _record_target(t, method, node.lineno, held)
+        elif isinstance(node, ast.Call):
+            # self.x.append(...) and friends
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _MUTATORS
+            ):
+                attr = is_self_attr(f.value)
+                if attr:
+                    writes.append(_Write(attr, method, node.lineno, held))
+
+    def _record_target(t: ast.AST, method: str, line: int, held: frozenset):
+        attr = is_self_attr(t)
+        if attr:
+            writes.append(_Write(attr, method, line, held))
+            return
+        # self.x[k] = ... / del self.x[k]
+        if isinstance(t, ast.Subscript):
+            attr = is_self_attr(t.value)
+            if attr:
+                writes.append(_Write(attr, method, line, held))
+
+    visit(cls, None, frozenset())
+    return writes
+
+
+class LockDisciplinePass:
+    id = PASS_ID
+    doc = (
+        "in a class owning a threading lock, attributes mutated under "
+        "`with self._lock` must not be mutated outside it"
+    )
+
+    def run(self, project: Project):
+        for sf in project.files:
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                locks = _lock_attrs(node)
+                if not locks:
+                    continue
+                writes = [
+                    w for w in _collect_writes(node, locks)
+                    if w.method.split(".")[0] not in _CONSTRUCTORS
+                    and w.attr not in locks
+                ]
+                for lock in sorted(locks):
+                    guarded = {w.attr for w in writes if lock in w.held}
+                    for w in writes:
+                        if w.attr in guarded and lock not in w.held:
+                            yield Finding(
+                                PASS_ID, sf.rel, w.line,
+                                f"{node.name}.{w.attr} is mutated under "
+                                f"`with self.{lock}` elsewhere but mutated "
+                                f"here ({w.method}) without it",
+                                key=(
+                                    f"{sf.rel}::{node.name}.{w.method}"
+                                    f"::{w.attr}"
+                                ),
+                            )

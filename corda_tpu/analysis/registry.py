@@ -1,0 +1,36 @@
+"""The pass registry — one place that knows every pass.
+
+Order is report order; ids are the suppression vocabulary
+(``# tpu-lint: allow=<id>`` and the baseline's ``"pass"`` field).
+"""
+
+from __future__ import annotations
+
+from .donation import DonationSafetyPass
+from .hotpath import HotPathBlockingPass
+from .lock_discipline import LockDisciplinePass
+from .registry_docs import FaultSitesPass, MetricsDocPass
+from .rollback import SwallowedRollbackPass
+from .threads import ThreadLifecyclePass
+
+ALL_PASSES = (
+    LockDisciplinePass(),
+    DonationSafetyPass(),
+    HotPathBlockingPass(),
+    ThreadLifecyclePass(),
+    SwallowedRollbackPass(),
+    MetricsDocPass(),
+    FaultSitesPass(),
+)
+
+
+def get_passes(ids: list[str] | None = None):
+    if not ids:
+        return list(ALL_PASSES)
+    by_id = {p.id: p for p in ALL_PASSES}
+    unknown = [i for i in ids if i not in by_id]
+    if unknown:
+        raise KeyError(
+            f"unknown pass id(s) {unknown}; known: {sorted(by_id)}"
+        )
+    return [by_id[i] for i in ids]

@@ -1,0 +1,196 @@
+"""Registry passes: the docs ARE the registries, these keep them true.
+
+- ``metrics-doc`` — the former ``tools_metrics_lint.py``, folded in as
+  a pass: every metric name created against a MetricRegistry
+  (``.counter("…")``/``.meter``/``.timer``/``.gauge``), every canonical
+  span name (``SPAN_*`` in observability/trace.py) and every profiler
+  kernel name (``KERNEL_*`` in observability/profiler.py) must appear
+  backticked in docs/OBSERVABILITY.md. A metric missing from the table
+  is a metric no operator will ever find.
+
+- ``fault-sites`` — the ISSUE 6 extension: every fault-site name
+  literal the tree passes to ``check_site("…")`` / ``fail_op("…")``
+  (the corda_tpu/faultinject hook surface) must appear backticked in
+  docs/FAULT_INJECTION.md, and every site documented in that file's
+  "Fault sites" table must still exist in code — a chaos plan written
+  against a renamed site silently injects nothing, which is worse than
+  failing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, call_name
+
+METRICS_PASS_ID = "metrics-doc"
+SITES_PASS_ID = "fault-sites"
+
+OBS_DOC = "docs/OBSERVABILITY.md"
+FAULT_DOC = "docs/FAULT_INJECTION.md"
+
+_METRIC_CALL = re.compile(
+    r"\.(?:counter|meter|timer|gauge)\(\s*\n?\s*[\"']([A-Za-z0-9_.]+)[\"']"
+)
+_SPAN_CONST = re.compile(r"^SPAN_[A-Z_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
+_KERNEL_CONST = re.compile(r"^KERNEL_[A-Z0-9_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
+
+_TRACE_PY = "corda_tpu/observability/trace.py"
+_PROFILER_PY = "corda_tpu/observability/profiler.py"
+
+_SITE_CALLS = {"check_site", "fail_op"}
+
+
+def _backticked(text: str) -> set[str]:
+    """Backticked tokens in a doc (any placement qualifies — the lint
+    checks presence, the human reviewer checks placement)."""
+    return set(re.findall(r"`([A-Za-z0-9_.]+)`", text))
+
+
+def _line_of(text: str, offset: int) -> int:
+    return text.count("\n", 0, offset) + 1
+
+
+def collect_metric_names(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """metric name → [(file, line)] of each creation site."""
+    names: dict[str, list[tuple[str, int]]] = {}
+    for sf in project.files:
+        if sf.rel.startswith("corda_tpu/analysis/"):
+            continue  # the lint's own pattern strings are not metrics
+        for m in _METRIC_CALL.finditer(sf.text):
+            names.setdefault(m.group(1), []).append(
+                (sf.rel, _line_of(sf.text, m.start()))
+            )
+    return names
+
+
+def collect_span_names(project: Project) -> dict[str, list[tuple[str, int]]]:
+    sf = project.file(_TRACE_PY)
+    if sf is None:
+        return {}
+    return {
+        m.group(1): [(sf.rel, _line_of(sf.text, m.start()))]
+        for m in _SPAN_CONST.finditer(sf.text)
+    }
+
+
+def collect_kernel_names(project: Project) -> dict[str, list[tuple[str, int]]]:
+    sf = project.file(_PROFILER_PY)
+    if sf is None:
+        return {}
+    return {
+        m.group(1): [(sf.rel, _line_of(sf.text, m.start()))]
+        for m in _KERNEL_CONST.finditer(sf.text)
+    }
+
+
+class MetricsDocPass:
+    id = METRICS_PASS_ID
+    doc = (
+        "every metric/span/kernel name in code appears in "
+        "docs/OBSERVABILITY.md (the doc is the registry)"
+    )
+
+    def run(self, project: Project):
+        text = project.doc_text(OBS_DOC)
+        if text is None:
+            yield Finding(
+                METRICS_PASS_ID, OBS_DOC, 1,
+                f"{OBS_DOC} does not exist", key="doc::missing",
+            )
+            return
+        documented = _backticked(text)
+        for kind, found in (
+            ("metric", collect_metric_names(project)),
+            ("span", collect_span_names(project)),
+            ("kernel", collect_kernel_names(project)),
+        ):
+            for name, uses in sorted(found.items()):
+                if name not in documented:
+                    # anchor at the first creation site so the report
+                    # points at real code and an inline allow can match
+                    f, line = sorted(uses)[0]
+                    yield Finding(
+                        METRICS_PASS_ID, f, line,
+                        f"{kind} {name!r} is missing from "
+                        f"{OBS_DOC} (used in "
+                        f"{', '.join(sorted({u[0] for u in uses}))})",
+                        key=f"{kind}::{name}",
+                    )
+
+    @staticmethod
+    def counts(project: Project) -> tuple[int, int, int]:
+        """(metrics, spans, kernels) — the shim's summary line."""
+        return (
+            len(collect_metric_names(project)),
+            len(collect_span_names(project)),
+            len(collect_kernel_names(project)),
+        )
+
+
+def collect_fault_sites(project: Project) -> dict[str, list[tuple[str, int]]]:
+    """site literal → [(file, line)] across every check_site/fail_op
+    call in the tree (the faultinject hook surface)."""
+    sites: dict[str, list[tuple[str, int]]] = {}
+    for sf in project.files:
+        if sf.rel.startswith(("corda_tpu/analysis/", "tests/")):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node.func) not in _SITE_CALLS:
+                continue
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                sites.setdefault(node.args[0].value, []).append(
+                    (sf.rel, node.lineno)
+                )
+    return sites
+
+
+def documented_fault_sites(text: str) -> set[str]:
+    """Sites named in the doc's "Fault sites" table: the backticked
+    FIRST cell of each row under that heading (prose around the table
+    mentions plenty of other backticked tokens that are not sites)."""
+    m = re.search(r"^##+\s*Fault sites\b(.*?)(?=^##|\Z)", text,
+                  re.M | re.S)
+    if not m:
+        return set()
+    return set(re.findall(r"^\|\s*`([A-Za-z0-9_.]+)`\s*\|", m.group(1), re.M))
+
+
+class FaultSitesPass:
+    id = SITES_PASS_ID
+    doc = (
+        "fault-site literals (check_site/fail_op) and the Fault-sites "
+        "table in docs/FAULT_INJECTION.md agree both ways"
+    )
+
+    def run(self, project: Project):
+        text = project.doc_text(FAULT_DOC)
+        if text is None:
+            yield Finding(
+                SITES_PASS_ID, FAULT_DOC, 1,
+                f"{FAULT_DOC} does not exist", key="doc::missing",
+            )
+            return
+        in_code = collect_fault_sites(project)
+        in_doc = documented_fault_sites(text)
+        for site, uses in sorted(in_code.items()):
+            if site not in in_doc:
+                f, line = uses[0]
+                yield Finding(
+                    SITES_PASS_ID, f, line,
+                    f"fault site {site!r} is not in the Fault-sites "
+                    f"table of {FAULT_DOC} — a chaos plan author "
+                    "cannot discover it",
+                    key=f"site::{site}",
+                )
+        for site in sorted(in_doc - set(in_code)):
+            yield Finding(
+                SITES_PASS_ID, FAULT_DOC, 1,
+                f"documented fault site {site!r} no longer exists in "
+                "code — a plan naming it injects nothing",
+                key=f"stale-site::{site}",
+            )

@@ -1,0 +1,97 @@
+"""swallowed-rollback: rollback handlers must catch BaseException.
+
+The wavefront pipeline's hard-won lesson (PR 5 post-review rounds): a
+``try`` whose handler UNDOES shared state — dropping optimistically
+primed id-cache claims, aborting an in-flight sweep — must catch
+``BaseException``, not ``Exception``. A ``KeyboardInterrupt`` (test
+timeout machinery), ``SystemExit`` or generator ``GeneratorExit``
+arriving mid-window otherwise skips the rollback and leaves poisoned
+shared state behind for the NEXT caller, which is how a Ctrl-C turns
+into an unrelated forged-link failure minutes later.
+
+Heuristic: an ``except`` handler whose body calls something named like
+a rollback (``abort``, ``rollback`` / ``roll_back``, or any
+``*_rollback``/``rollback_*`` spelling) is a rollback path; its caught
+type must be ``BaseException`` (bare ``except:`` also qualifies —
+it catches everything). Handlers that merely log / count / re-wrap are
+not rollback paths and stay free to catch narrowly.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, Project, call_name, qualname_map
+
+PASS_ID = "swallowed-rollback"
+
+_ROLLBACK_NAME = re.compile(r"(^|_)(abort|rollback|roll_back)(_|$)")
+
+
+def _rollback_calls(handler: ast.ExceptHandler) -> list[str]:
+    out = []
+    for n in ast.walk(handler):
+        if isinstance(n, ast.Call):
+            name = call_name(n.func)
+            if name and _ROLLBACK_NAME.search(name):
+                out.append(name)
+    return out
+
+
+def _catches_base(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except catches BaseException
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "id", getattr(e, "attr", "")) for e in t.elts]
+    else:
+        names = [getattr(t, "id", getattr(t, "attr", ""))]
+    return "BaseException" in names
+
+
+class SwallowedRollbackPass:
+    id = PASS_ID
+    doc = (
+        "except handlers that roll back shared state must catch "
+        "BaseException (KeyboardInterrupt must not skip the rollback)"
+    )
+
+    def run(self, project: Project):
+        for sf in project.files:
+            qnames = qualname_map(sf.tree)
+            yield from self._scan(sf, qnames)
+
+    def _scan(self, sf, qnames):
+        stack: list = []
+
+        def walk(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node)
+            if isinstance(node, ast.ExceptHandler):
+                calls = _rollback_calls(node)
+                if calls and not _catches_base(node):
+                    scope = next(
+                        (qnames[s] for s in reversed(stack) if s in qnames),
+                        "<module>",
+                    )
+                    caught = ast.unparse(node.type) if node.type else ""
+                    yield Finding(
+                        PASS_ID, sf.rel, node.lineno,
+                        f"rollback handler in {scope} calls "
+                        f"{', '.join(sorted(set(calls)))}() but catches "
+                        f"only `{caught}` — a KeyboardInterrupt/"
+                        "SystemExit here skips the rollback; catch "
+                        "BaseException and re-raise",
+                        key=f"{sf.rel}::{scope}::{'|'.join(sorted(set(calls)))}",
+                    )
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child)
+            if is_scope:
+                stack.pop()
+
+        yield from walk(sf.tree)

@@ -1,0 +1,159 @@
+"""thread-lifecycle: every thread is daemonized or joined.
+
+A non-daemon thread with no ``join`` on any shutdown path keeps the
+interpreter alive after main exits — the classic wedged-test /
+wedged-node failure, invisible until a teardown hangs in CI. The
+invariant (ISSUE 6 tentpole (d)): every ``threading.Thread(...)``
+constructed in the tree is either
+
+- ``daemon=True`` at construction (the idiom everywhere in this
+  codebase: workers, flushers, pump loops), or
+- stored and ``join()``-ed somewhere in the same class (``self._t =
+  Thread(...)`` … ``self._t.join()``), or marked ``.daemon = True``
+  before start, or
+- a local that is joined (or daemonized) in the same function.
+
+A fire-and-forget ``threading.Thread(...).start()`` with no binding and
+no ``daemon=True`` is always a finding — nobody can ever join it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, dotted_name, is_self_attr, qualname_map
+
+PASS_ID = "thread-lifecycle"
+
+_THREAD_FACTORIES = {"threading.Thread", "Thread", "threading.Timer", "Timer"}
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    return dotted_name(node.func) in _THREAD_FACTORIES
+
+
+def _daemon_true(node: ast.Call) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            )
+    return False
+
+
+def _attr_joined_or_daemonized(scope: ast.AST, attr_of, name: str) -> bool:
+    """Does ``scope`` contain ``<target>.join(...)``, ``<target>.daemon
+    = True`` or ``<target>.setDaemon(True)``? The assigned/passed value
+    must be the constant True — ``t.daemon = False`` is an explicit
+    NON-daemon declaration, not a pass. ``attr_of(node) -> str|None``
+    extracts the candidate target name from an expression node."""
+
+    def _is_true(v: ast.AST) -> bool:
+        return isinstance(v, ast.Constant) and v.value is True
+
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+            if n.func.attr == "join" and attr_of(n.func.value) == name:
+                return True
+            if (
+                n.func.attr == "setDaemon"
+                and attr_of(n.func.value) == name
+                and n.args and _is_true(n.args[0])
+            ):
+                return True
+        if isinstance(n, ast.Assign) and _is_true(n.value):
+            for t in n.targets:
+                if (
+                    isinstance(t, ast.Attribute)
+                    and t.attr == "daemon"
+                    and attr_of(t.value) == name
+                ):
+                    return True
+    return False
+
+
+def _local_name(node: ast.AST) -> str | None:
+    return node.id if isinstance(node, ast.Name) else None
+
+
+class ThreadLifecyclePass:
+    id = PASS_ID
+    doc = (
+        "every threading.Thread started must be daemon=True or joined "
+        "on a shutdown/close path"
+    )
+
+    def run(self, project: Project):
+        for sf in project.files:
+            qnames = qualname_map(sf.tree)
+            # enclosing class / function for each constructor site
+            yield from self._scan(sf, qnames)
+
+    def _scan(self, sf, qnames):
+        stack: list = []
+
+        def walk(node):
+            is_scope = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            )
+            if is_scope:
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                yield from walk(child)
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                yield from self._check(sf, qnames, stack, node)
+            if is_scope:
+                stack.pop()
+
+        yield from walk(sf.tree)
+
+    def _check(self, sf, qnames, stack, ctor: ast.Call):
+        if _daemon_true(ctor):
+            return
+        scope = next(
+            (s for s in reversed(stack)
+             if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))),
+            None,
+        )
+        cls = next(
+            (s for s in reversed(stack) if isinstance(s, ast.ClassDef)),
+            None,
+        )
+        # how is the constructed thread bound?
+        target_self, target_local = self._binding(scope, ctor)
+        if target_self and cls is not None:
+            if _attr_joined_or_daemonized(cls, is_self_attr, target_self):
+                return
+        if target_local and scope is not None:
+            if _attr_joined_or_daemonized(scope, _local_name, target_local):
+                return
+        where = qnames.get(scope, "<module>") if scope else "<module>"
+        bound = (
+            f"self.{target_self}" if target_self
+            else target_local if target_local
+            else "<unbound>"
+        )
+        yield Finding(
+            PASS_ID, sf.rel, ctor.lineno,
+            f"thread {bound} in {where} is neither daemon=True nor "
+            "joined on any shutdown path — it can outlive the process "
+            "teardown",
+            key=f"{sf.rel}::{where}::{bound}",
+        )
+
+    @staticmethod
+    def _binding(scope, ctor: ast.Call):
+        """(self-attr name, local name) the ctor result is assigned to,
+        scanning the enclosing function for `x = Thread(...)`."""
+        if scope is None:
+            return None, None
+        for n in ast.walk(scope):
+            if isinstance(n, ast.Assign) and n.value is ctor:
+                for t in n.targets:
+                    attr = is_self_attr(t)
+                    if attr:
+                        return attr, None
+                    if isinstance(t, ast.Name):
+                        return None, t.id
+        return None, None

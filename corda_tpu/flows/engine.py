@@ -595,10 +595,13 @@ class StateMachineManager:
         restore path and the park/resume path)."""
         meta = deserialize(blob)
         oplog = self.checkpoints.load_oplog(flow_id)
-        # reconstruct consumed-message dedupe set from receive records
-        for rec in oplog:
-            if isinstance(rec, dict) and "msg_id" in rec:
-                self._consumed_msg_ids.add(rec["msg_id"])
+        # reconstruct consumed-message dedupe set from receive records —
+        # under the lock: _rebuild also runs on the park/resume path while
+        # worker threads consume ids concurrently (consume_inbound)
+        with self._lock:
+            for rec in oplog:
+                if isinstance(rec, dict) and "msg_id" in rec:
+                    self._consumed_msg_ids.add(rec["msg_id"])
         cls = load_class(meta["cls"])
         with self._lock:
             fut = self._results.setdefault(flow_id, Future())

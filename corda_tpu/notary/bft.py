@@ -153,7 +153,7 @@ class BFTReplica:
 
     MAX_PENDING_COMMANDS = 10_000
 
-    def _bound_pending(self) -> None:
+    def _bound_pending_locked(self) -> None:
         """Cap _commands/_client_of (caller holds the lock): requests the
         primary never orders (primary down, client gave up) must not grow
         memory forever. Evicts oldest-inserted first; a legitimately
@@ -190,7 +190,7 @@ class BFTReplica:
             self._commands[d] = command
             self._client_of[d] = req["client"]
             self._pending_since.setdefault(d, time.monotonic())
-            self._bound_pending()
+            self._bound_pending_locked()
             if not self.is_primary:
                 return
             view = self.view
@@ -324,7 +324,11 @@ class BFTReplica:
             reply = serialize({"digest": d, "replica": self.name,
                                "outcome": outcome, "sig": sig,
                                "key": self._keypair.public})
-            self._executed_replies[d] = reply
+            # _execute runs OUTSIDE _check_committed's locked region (it
+            # does slow work: commit + sign + send); the reply cache it
+            # feeds is read/evicted under the lock, so the write takes it
+            with self._lock:
+                self._executed_replies[d] = reply
             self._messaging.send(client, T_REPLY, reply)
             return
         states, tx_id, caller = cmd
@@ -339,7 +343,8 @@ class BFTReplica:
         reply = serialize({"digest": d, "replica": self.name,
                            "outcome": outcome, "sig": sig,
                            "key": self._keypair.public})
-        self._executed_replies[d] = reply
+        with self._lock:
+            self._executed_replies[d] = reply
         self._messaging.send(client, T_REPLY, reply)
 
     # ------------------------------------------------------- view change
@@ -566,7 +571,7 @@ class BFTClusterClient:
         self._futures: dict[bytes, Future] = {}
         messaging.add_handler(T_REPLY, auto_ack(self._on_reply))
 
-    def _settle(self, d: bytes, fut: Future | None = None) -> None:
+    def _settle_locked(self, d: bytes, fut: Future | None = None) -> None:
         """Drop all per-digest state. Runs when the quorum resolves the
         future (the normal path), from collect()'s finally, and from the
         pending object's finalizer — so an abandoned pending (a pipelined
@@ -605,7 +610,7 @@ class BFTClusterClient:
                 fut.set_result((outcome, dict(bucket)))
                 # quorum reached: state cleanup rides the resolution, not
                 # a collect() that may never come
-                self._settle(d)
+                self._settle_locked(d)
 
     def submit(self, states, tx_id, caller: str):
         """Returns (conflict_or_None, {replica: sig}) after quorum."""
@@ -669,7 +674,7 @@ class BFTClusterClient:
                                 client._messaging.send(r, T_REQUEST, payload)
                 finally:
                     with client._lock:
-                        client._settle(d, fut)
+                        client._settle_locked(d, fut)
                 return deserialize(outcome_bytes), sigs
 
         pending = _PendingSubmit()
@@ -682,7 +687,7 @@ class BFTClusterClient:
 
         def _abandoned(client=self, d=d, fut=fut):
             with client._lock:
-                client._settle(d, fut)
+                client._settle_locked(d, fut)
 
         weakref.finalize(pending, _abandoned)
         return pending

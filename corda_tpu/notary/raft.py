@@ -215,7 +215,8 @@ class RaftNode:
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        self._reset_timer()
+        with self._lock:
+            self._reset_timer_locked()
         self._thread = threading.Thread(
             target=self._tick_loop, daemon=True, name=f"raft-{self.name}"
         )
@@ -235,7 +236,7 @@ class RaftNode:
         return min(2.0 ** min(self._elections_since_leader, 6),
                    RaftNode.ELECTION_BACKOFF_CAP)
 
-    def _reset_timer(self) -> None:
+    def _reset_timer_locked(self) -> None:
         self._deadline = time.monotonic() + (
             self._rng.uniform(*self._timeout_range) * self._election_backoff()
         )
@@ -249,7 +250,7 @@ class RaftNode:
                         self._deadline = now + self._heartbeat_s
                         self._broadcast_append()
                 elif now >= self._deadline:
-                    self._start_election()
+                    self._start_election_locked()
 
     def _persist_term_vote(self) -> None:
         """Raft's persistence contract: term/vote are on disk BEFORE any
@@ -259,7 +260,7 @@ class RaftNode:
 
     # ------------------------------------------------------------ election
 
-    def _start_election(self) -> None:
+    def _start_election_locked(self) -> None:
         self.role = RaftNode.CANDIDATE
         self._elections_since_leader += 1
         self.current_term += 1
@@ -267,18 +268,18 @@ class RaftNode:
         self._persist_term_vote()
         self._votes = {self.name}
         self.leader = None
-        self._reset_timer()
+        self._reset_timer_locked()
         req = {"term": self.current_term, "candidate": self.name,
                "last_log_index": self.log.last_index(),
                "last_log_term": self.log.last_term()}
         for p in self.peers:
             self._messaging.send(p, T_VOTE, serialize(req))
-        self._maybe_win()  # single-node cluster wins immediately
+        self._maybe_win_locked()  # single-node cluster wins immediately
 
     def _on_vote(self, msg) -> None:
         req = deserialize(msg.payload)
         with self._lock:
-            self._observe_term(req["term"])
+            self._observe_term_locked(req["term"])
             grant = False
             if req["term"] >= self.current_term and self.voted_for in (None, req["candidate"]):
                 up_to_date = (req["last_log_term"], req["last_log_index"]) >= (
@@ -288,7 +289,7 @@ class RaftNode:
                     grant = True
                     self.voted_for = req["candidate"]
                     self._persist_term_vote()
-                    self._reset_timer()
+                    self._reset_timer_locked()
             self._messaging.send(
                 msg.sender, T_VOTE_REPLY,
                 serialize({"term": self.current_term, "granted": grant,
@@ -298,14 +299,14 @@ class RaftNode:
     def _on_vote_reply(self, msg) -> None:
         rep = deserialize(msg.payload)
         with self._lock:
-            self._observe_term(rep["term"])
+            self._observe_term_locked(rep["term"])
             if self.role != RaftNode.CANDIDATE or rep["term"] != self.current_term:
                 return
             if rep["granted"]:
                 self._votes.add(rep["voter"])
-                self._maybe_win()
+                self._maybe_win_locked()
 
-    def _maybe_win(self) -> None:
+    def _maybe_win_locked(self) -> None:
         if self.role == RaftNode.CANDIDATE and len(self._votes) * 2 > len(self.peers) + 1:
             self.role = RaftNode.LEADER
             self.leader = self.name
@@ -316,7 +317,7 @@ class RaftNode:
             self._deadline = 0.0  # heartbeat immediately
             self._broadcast_append()
 
-    def _observe_term(self, term: int) -> None:
+    def _observe_term_locked(self, term: int) -> None:
         if term > self.current_term:
             self.current_term = term
             self.role = RaftNode.FOLLOWER
@@ -359,7 +360,7 @@ class RaftNode:
     def _on_snapshot(self, msg) -> None:
         req = deserialize(msg.payload)
         with self._lock:
-            self._observe_term(req["term"])
+            self._observe_term_locked(req["term"])
             if req["term"] != self.current_term:
                 return
             installer = (
@@ -380,7 +381,7 @@ class RaftNode:
             self.role = RaftNode.FOLLOWER
             self.leader = req["leader"]
             self._elections_since_leader = 0
-            self._reset_timer()
+            self._reset_timer_locked()
             last_idx = req["last_idx"]
             if last_idx > self.last_applied:
                 installer(req["rows"], last_idx, req["last_term"])
@@ -397,14 +398,14 @@ class RaftNode:
     def _on_append(self, msg) -> None:
         req = deserialize(msg.payload)
         with self._lock:
-            self._observe_term(req["term"])
+            self._observe_term_locked(req["term"])
             ok = False
             match_index = -1
             if req["term"] == self.current_term:
                 self.role = RaftNode.FOLLOWER
                 self.leader = req["leader"]
                 self._elections_since_leader = 0  # live leader: no storm
-                self._reset_timer()
+                self._reset_timer_locked()
                 prev_idx = req["prev_log_index"]
                 entries = req["entries"]
                 if prev_idx < self.log.base - 1:
@@ -426,7 +427,7 @@ class RaftNode:
                         have = self.log.term_at(idx)
                         if have is not None and have != term:
                             self.log.truncate_from(idx)
-                            self._fail_waiters_from(idx)
+                            self._fail_waiters_from_locked(idx)
                             have = None
                         if have is None and idx > self.log.last_index():
                             self.log.append(LogEntry(term, cmd))
@@ -445,7 +446,7 @@ class RaftNode:
                         self.commit_index = min(
                             req["leader_commit"], self.log.last_index()
                         )
-                        self._apply_committed()
+                        self._apply_committed_locked()
             self._messaging.send(
                 msg.sender, T_APPEND_REPLY,
                 serialize({"term": self.current_term, "ok": ok,
@@ -455,7 +456,7 @@ class RaftNode:
     def _on_append_reply(self, msg) -> None:
         rep = deserialize(msg.payload)
         with self._lock:
-            self._observe_term(rep["term"])
+            self._observe_term_locked(rep["term"])
             if self.role != RaftNode.LEADER or rep["term"] != self.current_term:
                 return
             p = rep["follower"]
@@ -463,12 +464,12 @@ class RaftNode:
                 self._match_index[p] = max(self._match_index.get(p, -1),
                                            rep["match_index"])
                 self._next_index[p] = self._match_index[p] + 1
-                self._advance_commit()
+                self._advance_commit_locked()
             else:
                 self._next_index[p] = max(0, self._next_index.get(p, 1) - 1)
                 self._send_append(p)
 
-    def _advance_commit(self) -> None:
+    def _advance_commit_locked(self) -> None:
         n = len(self.peers) + 1
         for idx in range(self.log.last_index(), self.commit_index, -1):
             if self.log.term_at(idx) != self.current_term:
@@ -476,17 +477,17 @@ class RaftNode:
             votes = 1 + sum(1 for p in self.peers if self._match_index.get(p, -1) >= idx)
             if votes * 2 > n:
                 self.commit_index = idx
-                self._apply_committed()
+                self._apply_committed_locked()
                 break
 
-    def _fail_waiters_from(self, idx: int) -> None:
+    def _fail_waiters_from_locked(self, idx: int) -> None:
         """A truncation invalidated every proposal at >= idx."""
         for i in [i for i in self._waiters if i >= idx]:
             _entry, fut = self._waiters.pop(i)
             if not fut.done():
                 fut.set_exception(NotLeaderError(self.leader))
 
-    def _apply_committed(self) -> None:
+    def _apply_committed_locked(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             entry = self.log.get(self.last_applied)
@@ -526,7 +527,7 @@ class RaftNode:
             self._waiters[idx] = (entry, fut)
             if not self.peers:  # single-node cluster commits immediately
                 self.commit_index = idx
-                self._apply_committed()
+                self._apply_committed_locked()
             else:
                 self._broadcast_append()
             return fut

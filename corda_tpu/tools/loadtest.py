@@ -94,7 +94,8 @@ class LoadTestRunner:
                         undo = d.apply()
                         if undo:
                             undos.append(undo)
-                        self.metrics["disruptions"] += 1
+                        with self._metrics_lock:
+                            self.metrics["disruptions"] += 1
                 commands = self.test.generate(state, self.params.parallelism)
                 # interpret first: expected state is defined by the model,
                 # not by what happened to succeed
@@ -131,7 +132,8 @@ class LoadTestRunner:
 
     def _gather_and_check(self, expected) -> None:
         observed = self.test.gather()
-        self.metrics["gathers"] += 1
+        with self._metrics_lock:
+            self.metrics["gathers"] += 1
         if observed != expected:
             raise LoadTestError(
                 f"{self.test.name}: observed state diverged.\n"

@@ -296,10 +296,13 @@ class BatchedVerifierService(TransactionVerifierService):
             for p in batch:
                 _complete(p.future, error=e)
             return
-        self.stats["batches"] += 1
-        self.stats["txs"] += len(batch)
-        self.stats["sigs"] += report.n_sigs
-        self.stats["device_sigs"] += report.n_device
+        # same lock the scheduler-routed settle callbacks take: stats is
+        # one surface whichever route served the batch
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["txs"] += len(batch)
+            self.stats["sigs"] += report.n_sigs
+            self.stats["device_sigs"] += report.n_device
 
         def finish(p: _Pending, sig_err):
             if sig_err is not None:

@@ -655,17 +655,13 @@ class TestMetricsLint:
         assert "all documented" in proc.stdout
 
     def test_lint_catches_undocumented_name(self, tmp_path):
-        """The lint must actually FAIL on an undocumented metric — run it
-        against a scratch tree with one rogue counter."""
-        import shutil
-
+        """The lint must actually FAIL on an undocumented metric — run
+        the metrics-doc pass (the folded tools_metrics_lint.py, now in
+        corda_tpu/analysis) against a scratch tree with one rogue
+        counter via the driver's --root."""
         scratch = tmp_path / "repo"
         (scratch / "corda_tpu" / "observability").mkdir(parents=True)
         (scratch / "docs").mkdir()
-        shutil.copy(
-            os.path.join(REPO_ROOT, "tools_metrics_lint.py"),
-            scratch / "tools_metrics_lint.py",
-        )
         (scratch / "docs" / "OBSERVABILITY.md").write_text(
             "| `serving.documented` | counter | fine |\n"
         )
@@ -680,10 +676,11 @@ class TestMetricsLint:
             'm.counter("serving.rogue_name").inc()\n'
         )
         proc = subprocess.run(
-            [sys.executable, str(scratch / "tools_metrics_lint.py")],
+            [sys.executable, os.path.join(REPO_ROOT, "tools_analyze.py"),
+             "--root", str(scratch), "--passes", "metrics-doc"],
             capture_output=True, text=True, timeout=60,
         )
-        assert proc.returncode == 1
+        assert proc.returncode == 1, proc.stdout + proc.stderr
         assert "serving.rogue_name" in proc.stdout
         assert "flow" in proc.stdout  # the undocumented span too
         assert "rogue.kernel" in proc.stdout  # the undocumented kernel too

@@ -146,9 +146,29 @@ class TestSeededChaosSoak:
     deliberate client re-submissions and double-spend attempts) runs.
     The run must end with every honest commit applied exactly once, every
     double-spend rejected, and bit-identical uniqueness state on all
-    replicas — and the plan must actually have injected faults."""
+    replicas — and the plan must actually have injected faults.
+
+    The lock-order sanitizer (observability/lockwatch, ISSUE 6) is
+    installed for the whole storm: every lock the cluster constructs is
+    watched, and the run additionally asserts an EMPTY cycle report —
+    chaos interleavings are exactly when an A→B/B→A inversion would
+    surface."""
 
     def test_chaos_storm_converges_to_identical_state(self, tmp_path):
+        from corda_tpu.observability import lockwatch
+
+        # watch every lock the cluster is about to construct; the patch
+        # must be UNDONE even when cluster setup itself raises, so the
+        # whole storm (setup included) runs inside this try
+        lockwatch.reset()
+        lockwatch.install()
+        try:
+            self._storm(tmp_path)
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+
+    def _storm(self, tmp_path):
         from corda_tpu.crypto import SecureHash
         from corda_tpu.faultinject import (
             ChaosOrchestrator,
@@ -159,6 +179,7 @@ class TestSeededChaosSoak:
         from corda_tpu.ledger import StateRef
         from corda_tpu.messaging import InMemoryMessagingNetwork
         from corda_tpu.notary import NotaryError, RaftUniquenessProvider
+        from corda_tpu.observability import lockwatch
 
         def ref(n):
             return StateRef(SecureHash(n.to_bytes(2, "big") * 16), 0)
@@ -264,6 +285,14 @@ class TestSeededChaosSoak:
             kinds = {e.kind for e in inj.trace}
             assert "crash" in kinds and "restart" in kinds
             assert kinds & {"drop", "delay", "duplicate"}
+            # the lock-order sanitizer saw the whole storm: any A→B/B→A
+            # inversion across the raft/messaging/flow locks is a
+            # potential deadlock even though this run survived it
+            report = lockwatch.cycle_report()
+            assert report == [], (
+                "lock-order inversions under chaos: "
+                + "; ".join(" -> ".join(c["cycle"]) for c in report)
+            )
         finally:
             for p in providers.values():
                 try:

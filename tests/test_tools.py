@@ -323,6 +323,47 @@ class TestPerfGate:
         ).returncode == 0
 
 
+class TestAnalyze:
+    """CI/tooling satellite (ISSUE 6): `tools_analyze.py` — the
+    concurrency & device-invariant analyzer — runs deviceless over the
+    real tree in tier-1 and must report ZERO unsuppressed findings and
+    no stale baseline entries, inside the 30s acceptance budget. The
+    per-pass defect-detection coverage lives in tests/test_analysis.py."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ANALYZE = os.path.join(REPO, "tools_analyze.py")
+
+    def test_tree_is_clean_and_fast(self):
+        import time
+
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, self.ANALYZE],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        dt = time.monotonic() - t0
+        # rc 0 ⇒ no unsuppressed findings AND no stale baseline entries
+        # (the driver fails on either)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "tpu-lint ok" in proc.stdout
+        assert "STALE" not in proc.stdout
+        assert dt < 30, f"analysis took {dt:.1f}s (budget 30s)"
+
+    def test_baseline_is_well_formed(self):
+        """The checked-in baseline parses and every entry names a known
+        pass id — a typo'd pass would silently never match anything."""
+        with open(os.path.join(self.REPO, "ANALYSIS_BASELINE.json")) as f:
+            doc = json.load(f)
+        assert doc["schema"] == 1
+        from corda_tpu.analysis import ALL_PASSES
+
+        known = {p.id for p in ALL_PASSES}
+        for entry in doc["suppress"]:
+            assert entry["pass"] in known, entry
+            assert entry["key"], entry
+
+
 class TestGraphs:
     """tools/graphs parity (reference: gradle dependency-graph scripts):
     the package dependency graph extracts, renders, and layer-checks."""

@@ -1,0 +1,132 @@
+"""tpu-lint driver: the project's concurrency & device-invariant analyzer.
+
+Runs the AST-based analysis passes in ``corda_tpu/analysis/`` over the
+tree — deviceless, no jax import, seconds not minutes — and exits
+nonzero on any unsuppressed finding OR any stale baseline entry. Wired
+into tier-1 by ``tests/test_tools.py``; the full catalogue of passes,
+the suppression format, and the runtime lockwatch sanitizer are in
+docs/STATIC_ANALYSIS.md.
+
+    python tools_analyze.py                     # default scan: corda_tpu/ + top-level *.py
+    python tools_analyze.py corda_tpu/serving   # scoped scan
+    python tools_analyze.py --passes lock-discipline,thread-lifecycle
+    python tools_analyze.py --list-passes
+    python tools_analyze.py --root /some/tree   # analyze another checkout
+
+Suppressions:
+
+- inline: ``# tpu-lint: allow=<pass-id>[,<pass-id>]`` on the offending
+  line or a comment line directly above it — use for invariants that
+  are deliberate, with the reason in the comment;
+- baseline: ``ANALYSIS_BASELINE.json`` entries ``{"pass", "key",
+  "reason"}`` keyed on the finding's stable key (printed with ``-v``).
+  Stale entries FAIL the run, so the baseline only ever shrinks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+sys.path.insert(0, str(ROOT))
+
+from corda_tpu.analysis import (  # noqa: E402
+    BaselineError,
+    Project,
+    get_passes,
+    load_baseline,
+    run_passes,
+)
+from corda_tpu.analysis.core import BASELINE_NAME, split_suppressed  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", help=(
+        "files/dirs to scan, relative to --root (default: corda_tpu/ "
+        "plus the top-level *.py entry points)"
+    ))
+    ap.add_argument("--root", default=str(ROOT), help=(
+        "tree root: docs/ and the baseline resolve from here"
+    ))
+    ap.add_argument("--passes", default="", help=(
+        "comma-separated pass ids to run (default: all)"
+    ))
+    ap.add_argument("--baseline", default=None, help=(
+        f"baseline file (default: <root>/{BASELINE_NAME})"
+    ))
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--list-passes", action="store_true")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print suppressed findings and stable keys")
+    args = ap.parse_args(argv)
+
+    passes = get_passes(
+        [p for p in args.passes.split(",") if p] or None
+    )
+    if args.list_passes:
+        for p in passes:
+            print(f"{p.id:20s} {p.doc}")
+        return 0
+
+    t0 = time.monotonic()
+    root = Path(args.root).resolve()
+    project = Project(root, args.paths or None)
+    if project.parse_errors:
+        for e in project.parse_errors:
+            print(f"PARSE FAIL: {e}")
+        return 1
+
+    baseline_path = (
+        Path(args.baseline) if args.baseline else root / BASELINE_NAME
+    )
+    try:
+        baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    except BaselineError as e:
+        print(f"FAIL: {e}")
+        return 1
+
+    findings = run_passes(project, passes)
+    live, inline, baselined, stale = split_suppressed(
+        project, findings, baseline
+    )
+
+    for f in live:
+        print(f.render())
+        if args.verbose:
+            print(f"    key: {f.key}")
+    if args.verbose:
+        for f in inline:
+            print(f"suppressed-inline: {f.render()}")
+        for f in baselined:
+            print(f"suppressed-baseline: {f.render()}")
+    for pass_id, key in stale:
+        print(
+            f"STALE baseline entry [{pass_id}] {key} — the finding it "
+            "suppressed is gone; remove it from "
+            f"{baseline_path.name}"
+        )
+
+    dt = time.monotonic() - t0
+    n_files = len(project.files)
+    if live or stale:
+        print(
+            f"tpu-lint: {len(live)} unsuppressed finding(s), "
+            f"{len(stale)} stale baseline entr(y/ies) over {n_files} "
+            f"files in {dt:.1f}s"
+        )
+        return 1
+    print(
+        f"tpu-lint ok: {len(passes)} passes over {n_files} files in "
+        f"{dt:.1f}s ({len(inline)} inline-suppressed, "
+        f"{len(baselined)} baselined)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

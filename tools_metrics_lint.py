@@ -1,108 +1,56 @@
-"""Metric/span/kernel name lint: code vs the docs/OBSERVABILITY.md registry.
+"""Metric/span/kernel/fault-site name lint — THIN SHIM.
 
-Greps the tree for every name created against a MetricRegistry
-(``.counter("…")`` / ``.meter(`` / ``.timer(`` / ``.gauge(``), every
-canonical span name (the ``SPAN_*`` constants in
-``corda_tpu/observability/trace.py``, which all span creation goes
-through), and every profiler kernel name (the ``KERNEL_*`` constants in
-``corda_tpu/observability/profiler.py``, which all profiled dispatch
-goes through), then fails if any name is missing from the
-registry/taxonomy tables in ``docs/OBSERVABILITY.md``. A metric that is
-not in the table is a metric no operator will ever find — the doc IS
-the registry, and this lint is what keeps it true. Run from tier-1 by
-``tests/test_observability.py``.
+The real implementation moved into the analysis suite as the
+``metrics-doc`` and ``fault-sites`` passes
+(``corda_tpu/analysis/registry_docs.py``, ISSUE 6 satellite): every
+metric name created against a MetricRegistry, every ``SPAN_*`` /
+``KERNEL_*`` constant, and every ``check_site``/``fail_op`` fault-site
+literal must appear in its registry doc (docs/OBSERVABILITY.md /
+docs/FAULT_INJECTION.md). This entry point stays so existing tier-1
+invocations (`python tools_metrics_lint.py`) keep working; new callers
+should run ``tools_analyze.py`` (all passes) instead.
 
     python tools_metrics_lint.py            # rc 0 clean, rc 1 violations
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 ROOT = Path(__file__).parent
-DOC = ROOT / "docs" / "OBSERVABILITY.md"
-
-_METRIC_CALL = re.compile(
-    r"\.(?:counter|meter|timer|gauge)\(\s*\n?\s*[\"']([A-Za-z0-9_.]+)[\"']"
-)
-_SPAN_CONST = re.compile(r"^SPAN_[A-Z_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
-_KERNEL_CONST = re.compile(r"^KERNEL_[A-Z0-9_]+\s*=\s*[\"']([^\"']+)[\"']", re.M)
-
-
-def collect_metric_names() -> dict[str, list[str]]:
-    """metric name → files using it, from every .py under corda_tpu/ plus
-    the top-level entry points."""
-    names: dict[str, list[str]] = {}
-    files = sorted((ROOT / "corda_tpu").rglob("*.py"))
-    files += sorted(ROOT.glob("*.py"))
-    for py in files:
-        if py.name == Path(__file__).name:
-            continue
-        try:
-            src = py.read_text()
-        except OSError:
-            continue
-        for m in _METRIC_CALL.finditer(src):
-            names.setdefault(m.group(1), []).append(
-                str(py.relative_to(ROOT))
-            )
-    return names
-
-
-def collect_span_names() -> dict[str, list[str]]:
-    trace_py = ROOT / "corda_tpu" / "observability" / "trace.py"
-    src = trace_py.read_text()
-    return {
-        m.group(1): [str(trace_py.relative_to(ROOT))]
-        for m in _SPAN_CONST.finditer(src)
-    }
-
-
-def collect_kernel_names() -> dict[str, list[str]]:
-    """Profiler kernel names — every instrumented dispatch profiles
-    through a KERNEL_* constant, so this enumerates what
-    ``profiler_snapshot()`` (and the bench's ``profile`` section) can
-    ever report."""
-    prof_py = ROOT / "corda_tpu" / "observability" / "profiler.py"
-    src = prof_py.read_text()
-    return {
-        m.group(1): [str(prof_py.relative_to(ROOT))]
-        for m in _KERNEL_CONST.finditer(src)
-    }
-
-
-def documented_names() -> set[str]:
-    """Names appearing in backticks inside docs/OBSERVABILITY.md tables
-    (any backticked token qualifies — the lint checks presence, the
-    human reviewer checks placement)."""
-    text = DOC.read_text()
-    return set(re.findall(r"`([A-Za-z0-9_.]+)`", text))
+sys.path.insert(0, str(ROOT))
 
 
 def run() -> int:
-    if not DOC.exists():
-        print(f"FAIL: {DOC} does not exist")
+    from corda_tpu.analysis import (
+        Project,
+        get_passes,
+        load_baseline,
+        run_passes,
+    )
+    from corda_tpu.analysis.core import BASELINE_NAME, split_suppressed
+    from corda_tpu.analysis.registry_docs import MetricsDocPass
+
+    project = Project(ROOT)
+    all_findings = run_passes(
+        project, get_passes(["metrics-doc", "fault-sites"])
+    )
+    # honor the same suppression channels as tools_analyze.py — the two
+    # gates must agree on what counts as a violation (stale-baseline
+    # policing stays the driver's job)
+    findings, _inline, _baselined, _stale = split_suppressed(
+        project, all_findings, load_baseline(ROOT / BASELINE_NAME)
+    )
+    if findings:
+        print(
+            "metric/span/kernel/fault-site names out of sync with the "
+            "registry docs:"
+        )
+        for f in findings:
+            print(f"  {f.render()}")
         return 1
-    documented = documented_names()
-    missing = []
-    for kind, found in (
-        ("metric", collect_metric_names()),
-        ("span", collect_span_names()),
-        ("kernel", collect_kernel_names()),
-    ):
-        for name, files in sorted(found.items()):
-            if name not in documented:
-                missing.append((kind, name, files))
-    if missing:
-        print("metric/span/kernel names missing from docs/OBSERVABILITY.md:")
-        for kind, name, files in missing:
-            print(f"  {kind} {name!r}  (used in {', '.join(sorted(set(files)))})")
-        return 1
-    n_metrics = len(collect_metric_names())
-    n_spans = len(collect_span_names())
-    n_kernels = len(collect_kernel_names())
+    n_metrics, n_spans, n_kernels = MetricsDocPass.counts(project)
     print(f"metrics-lint ok: {n_metrics} metric names, {n_spans} span names, "
           f"{n_kernels} kernel names all documented")
     return 0
