@@ -1185,6 +1185,77 @@ def run_smoke_tracing() -> dict:
     }
 
 
+def run_smoke_devicemon() -> dict:
+    """The smoke's devicemon leg (docs/OBSERVABILITY.md §Device
+    telemetry): per-device telemetry forced on around a few REAL device
+    dispatches through a fresh scheduler (the CPU backend counts as a
+    1-device mesh), asserting the acceptance reconciliation — the
+    per-ordinal rows/dispatches in ``monitoring_snapshot()["devices"]``
+    and the Prometheus ``device.*`` families sum EXACTLY to the
+    scheduler's own dispatch counters. Runs AFTER the profile pass so
+    the ed25519 kernel is already compiled at the small pad bucket this
+    pass pins (ShapeTable override) — no fresh XLA compile, and the
+    devicemon syncs cannot touch any measured number above."""
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.node.monitoring import monitoring_snapshot
+    from corda_tpu.observability import (
+        configure_devicemon,
+        metrics_text,
+        parse_prometheus,
+    )
+    from corda_tpu.serving import DeviceScheduler, ShapeTable
+
+    configure_devicemon(enabled=True, reset=True)
+    per: dict = {}
+    try:
+        sched = DeviceScheduler(
+            use_device_default=True,
+            shapes=ShapeTable({"buckets": [8, 16, 32, 64, 128],
+                               "source": "smoke-devicemon"}),
+        )
+        kp = generate_keypair()
+        rows = []
+        for i in range(5):
+            msg = b"devicemon-%d" % i
+            rows.append((kp.public, sign(kp.private, msg), msg))
+        for _ in range(2):
+            rr = sched.submit_rows(rows, use_device=True).result(timeout=300)
+            assert rr.mask.all(), "devicemon pass rejected valid sigs"
+            assert rr.device is not None, "RowResult lost its device ordinal"
+        real, padded = sched._real_rows, sched._padded_rows
+        sched.shutdown()
+        snap = monitoring_snapshot()["devices"]
+        assert snap["enabled"] is True, snap
+        per = snap["devices"]
+        assert sum(e["rows"] for e in per.values()) == real == 10, per
+        assert sum(e["padded_rows"] for e in per.values()) == padded, per
+        assert sum(e["dispatches"] for e in per.values()) == 2, per
+        assert sum(e["settles"] for e in per.values()) == 2, per
+        assert sum(e["inflight"] for e in per.values()) == 0, per
+        # the Prometheus device.* families must tell the same story
+        samples = parse_prometheus(metrics_text())
+        prom_rows = sum(
+            int(float(v)) for k, v in samples.items()
+            if isinstance(v, str)
+            and k.startswith("cordatpu_device_rows_total{")
+        )
+        assert prom_rows == real, samples
+    finally:
+        configure_devicemon(enabled=False)
+    devices = {
+        o: {k: e[k] for k in ("dispatches", "settles", "rows",
+                              "padded_rows", "inflight", "failures")}
+        for o, e in per.items()
+    }
+    return {
+        "devices": devices,
+        "devicemon_rows": sum(e["rows"] for e in per.values()),
+        "devicemon_dispatches": sum(
+            e["dispatches"] for e in per.values()
+        ),
+    }
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1291,9 +1362,16 @@ def run_smoke() -> int:
         # 7. profile pass (docs/OBSERVABILITY.md §Profiling): kernel
         # profiler forced on, small ed25519-verify + Merkle-id dispatches;
         # emits the per-stage compile/execute split and batch-efficiency
-        # ratios the perf gate consumes. Runs LAST — the profiler's
-        # blocking syncs must not touch any measured number above.
+        # ratios the perf gate consumes. Runs after the measured sections
+        # — the profiler's blocking syncs must not touch any number above.
         out["profile"] = run_profile_pass()
+
+        # 8. devicemon pass (docs/OBSERVABILITY.md §Device telemetry):
+        # per-device telemetry forced on around real device dispatches;
+        # per-ordinal rows/dispatches must reconcile exactly with the
+        # scheduler's counters, in both the snapshot and the Prometheus
+        # device.* families. Reuses the profile pass's compiled bucket.
+        out.update(run_smoke_devicemon())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

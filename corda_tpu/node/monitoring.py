@@ -290,12 +290,20 @@ def monitoring_snapshot() -> dict:
     mirror (compile/execute timers, row/pad counters — empty until the
     first profiled dispatch, and retaining the last profiled run's
     numbers after the profiler is disabled; the per-kernel detail is
-    ``CordaRPCOps.profiler_snapshot()``), ``process`` the remaining
-    cross-cutting metrics (e.g. the verifier's ``device_failover``
-    counters)."""
+    ``CordaRPCOps.profiler_snapshot()``), ``devices`` the per-device
+    telemetry registry (observability/devicemon — ``{"enabled": false}``
+    while off), ``slo`` the SLO monitor's evaluated objectives
+    (observability/slo, same off-marker contract), ``process`` the
+    remaining cross-cutting metrics (e.g. the verifier's
+    ``device_failover`` counters)."""
+    from corda_tpu.observability.devicemon import devices_section
+    from corda_tpu.observability.slo import slo_section
+
     return {
         "serving": _process_registry.section("serving."),
         "profiler": _process_registry.section("profiler."),
+        "devices": devices_section(),
+        "slo": slo_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler."))

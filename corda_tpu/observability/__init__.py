@@ -5,18 +5,38 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
 - ``trace`` — ``Span``/``Tracer`` with process-unique trace/span ids, a
   thread-local + explicitly-propagated context, wire propagation through
   session messages, a bounded in-memory ring, and an off-by-default JSONL
-  sink. A flow's trace id travels flow → serving scheduler → device batch
-  → notary, and injected chaos events are stamped with it.
+  sink (rotated at a byte cap). A flow's trace id travels flow → serving
+  scheduler → device batch → notary, and injected chaos events are
+  stamped with it.
 - ``exposition`` — Prometheus-text rendering of the metric registries,
   including the p50/p95/p99 quantiles the reservoir upgrade added to
-  ``Timer``/``Meter``.
+  ``Timer``/``Meter``, plus the labeled ``device.*``/``slo.*`` families
+  while those monitors are on.
 - ``profiler`` — the off-by-default kernel profiler: per kernel × shape
   bucket compile/execute wall split (keyed first-dispatch latch), batch
   efficiency (real vs padded lanes), bytes in/out, and the roofline join
   against BASELINE.json. Snapshots ride the registry/exposition above
   and ``CordaRPCOps.profiler_snapshot()``.
+- ``devicemon`` — the off-by-default per-device telemetry registry (one
+  slot per ``jax.devices()`` ordinal: in-flight depth, dispatch/settle
+  counts, rows vs padded lanes, execute-wall EWMA, completion heartbeat,
+  best-effort HBM occupancy) plus the straggler/stall watchdog emitting
+  ``device.unhealthy`` events.
+- ``slo`` — sliding-window SLO objectives over the serving priority
+  classes (windowed p99 + error/shed rate, edge-triggered breaches) and
+  the black-box flight recorder (``flight_dump``/``read_flight_dump``)
+  a breach — or an operator RPC, or an opt-in crash hook — snapshots.
 """
 
+from .devicemon import (
+    DeviceMonitor,
+    DeviceWatchdog,
+    active_devicemon,
+    configure_devicemon,
+    default_device_ordinal,
+    device_watchdog,
+    devicemon,
+)
 from .exposition import metrics_text, parse_prometheus, render_prometheus
 from .profiler import (
     DeviceProfiler,
@@ -24,6 +44,17 @@ from .profiler import (
     configure_profiler,
     profiler,
     stamp_span,
+)
+from .slo import (
+    SLOMonitor,
+    SLOObjective,
+    active_slo,
+    configure_slo,
+    flight_dump,
+    install_crash_dump,
+    read_flight_dump,
+    slo_monitor,
+    uninstall_crash_dump,
 )
 from .trace import (
     NOOP_SPAN,
@@ -45,8 +76,12 @@ from .trace import (
 )
 
 __all__ = [
+    "DeviceMonitor",
     "DeviceProfiler",
+    "DeviceWatchdog",
     "NOOP_SPAN",
+    "SLOMonitor",
+    "SLOObjective",
     "SPAN_FLOW",
     "SPAN_FLOW_RESPONDER",
     "SPAN_FLOW_VERIFY",
@@ -59,14 +94,26 @@ __all__ = [
     "Span",
     "TraceContext",
     "Tracer",
+    "active_devicemon",
     "active_profiler",
+    "active_slo",
+    "configure_devicemon",
     "configure_profiler",
+    "configure_slo",
     "configure_tracing",
     "current_trace_id",
+    "default_device_ordinal",
+    "device_watchdog",
+    "devicemon",
+    "flight_dump",
+    "install_crash_dump",
     "metrics_text",
     "parse_prometheus",
     "profiler",
+    "read_flight_dump",
     "render_prometheus",
+    "slo_monitor",
     "stamp_span",
     "tracer",
+    "uninstall_crash_dump",
 ]

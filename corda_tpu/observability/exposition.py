@@ -132,10 +132,25 @@ def metrics_text(node_registry=None) -> str:
     """The process-global registry (serving/verifier families) plus an
     optional node-local registry (rendered under the ``node_`` namespace)
     as one scrapeable document — the body behind
-    ``CordaRPCOps.metrics_text()``."""
+    ``CordaRPCOps.metrics_text()``. When the per-device telemetry
+    registry or the SLO monitor is enabled, their labeled ``device.*`` /
+    ``slo.*`` families append here (one attribute-read check each while
+    off — the exposition must stay free for idle processes)."""
     from corda_tpu.node.monitoring import node_metrics
+    from corda_tpu.observability.devicemon import active_devicemon
+    from corda_tpu.observability.slo import active_slo
 
     out = render_prometheus(node_metrics().snapshot())
+    devmon = active_devicemon()
+    if devmon is not None:
+        lines = devmon.prometheus_lines()
+        if lines:
+            out += "\n".join(lines) + "\n"
+    slo = active_slo()
+    if slo is not None:
+        lines = slo.prometheus_lines()
+        if lines:
+            out += "\n".join(lines) + "\n"
     if node_registry is not None:
         out += render_prometheus(node_registry.snapshot(), namespace="node.")
     return out

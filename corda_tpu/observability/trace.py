@@ -214,8 +214,15 @@ class Tracer:
     stack so same-thread descendants parent automatically via
     ``current()``."""
 
+    # sink rotation default: the opt-in JSONL sink must not grow without
+    # limit under sampling — at this many bytes the current file rotates
+    # to ``<path>.1`` (keep-1: the previous rotation is overwritten) and
+    # a fresh file opens. 0 disables rotation (explicitly unbounded).
+    SINK_MAX_BYTES_DEFAULT = 64 * 1024 * 1024
+
     def __init__(self, *, sample_rate: float | None = None,
-                 ring_size: int = 4096, jsonl_path: str | None = None):
+                 ring_size: int = 4096, jsonl_path: str | None = None,
+                 jsonl_max_bytes: int | None = None):
         if sample_rate is None:
             try:
                 sample_rate = float(
@@ -234,6 +241,11 @@ class Tracer:
         self._sink_lock = threading.Lock()
         self._jsonl_path = jsonl_path
         self._jsonl_file = None
+        self._jsonl_max_bytes = (
+            self.SINK_MAX_BYTES_DEFAULT if jsonl_max_bytes is None
+            else max(0, int(jsonl_max_bytes))
+        )
+        self._sink_bytes = 0
 
     # ------------------------------------------------------------- config
     @property
@@ -242,12 +254,16 @@ class Tracer:
 
     def configure(self, *, sample_rate: float | None = None,
                   ring_size: int | None = None,
-                  jsonl_path: str | None | object = "__unset__") -> None:
+                  jsonl_path: str | None | object = "__unset__",
+                  jsonl_max_bytes: int | None = None) -> None:
         with self._lock:
             if sample_rate is not None:
                 self._sample_rate = max(0.0, min(1.0, sample_rate))
             if ring_size is not None:
                 self._ring = deque(self._ring, maxlen=max(16, ring_size))
+        if jsonl_max_bytes is not None:
+            with self._sink_lock:
+                self._jsonl_max_bytes = max(0, int(jsonl_max_bytes))
         if jsonl_path != "__unset__":
             with self._sink_lock:
                 if self._jsonl_file is not None:
@@ -256,6 +272,7 @@ class Tracer:
                     except Exception:
                         pass
                     self._jsonl_file = None
+                self._sink_bytes = 0
                 with self._lock:
                     self._jsonl_path = jsonl_path
 
@@ -314,8 +331,24 @@ class Tracer:
                         if self._jsonl_path is None:
                             return  # sink disabled while we waited
                         self._jsonl_file = open(self._jsonl_path, "a")
-                    self._jsonl_file.write(json.dumps(span.to_dict()) + "\n")
+                        # append mode: an existing file's size counts
+                        # toward this rotation window
+                        self._sink_bytes = self._jsonl_file.tell()
+                    line = json.dumps(span.to_dict()) + "\n"
+                    self._jsonl_file.write(line)
                     self._jsonl_file.flush()
+                    self._sink_bytes += len(line)
+                    # max-bytes rotation (keep-1): the full file becomes
+                    # <path>.1 via an atomic rename (overwriting the
+                    # previous rotation) and a fresh file opens on the
+                    # next span — the sink can hold at most ~2× the cap.
+                    if (self._jsonl_max_bytes
+                            and self._sink_bytes >= self._jsonl_max_bytes):
+                        path = self._jsonl_path
+                        self._jsonl_file.close()
+                        self._jsonl_file = None
+                        self._sink_bytes = 0
+                        os.replace(path, path + ".1")
                 except Exception:
                     # a broken sink must never break the traced code path
                     self._jsonl_file = None
@@ -398,13 +431,17 @@ def tracer() -> Tracer:
 
 def configure_tracing(*, sample_rate: float | None = None,
                       ring_size: int | None = None,
-                      jsonl_path: str | None | object = "__unset__") -> Tracer:
+                      jsonl_path: str | None | object = "__unset__",
+                      jsonl_max_bytes: int | None = None) -> Tracer:
     """The sampling/sink knobs (docs/OBSERVABILITY.md): ``sample_rate``
     0.0 disables tracing entirely (the default — production hot paths pay
     one attribute read), 1.0 traces every flow; ``jsonl_path`` enables the
-    off-by-default JSONL sink."""
+    off-by-default JSONL sink, bounded by ``jsonl_max_bytes`` rotation
+    (keep-1: ``<path>.1`` holds the previous window; default 64 MiB,
+    0 = unbounded)."""
     _global.configure(sample_rate=sample_rate, ring_size=ring_size,
-                      jsonl_path=jsonl_path)
+                      jsonl_path=jsonl_path,
+                      jsonl_max_bytes=jsonl_max_bytes)
     return _global
 
 

@@ -249,6 +249,25 @@ class MeshVerifier:
             max(n, 1), max(min_bucket or 0, 8 * self.n_devices)
         )
 
+    def _ordinals(self) -> list[int]:
+        return [int(d.id) for d in self.mesh.devices.reshape(-1)]
+
+    def _record_shard(self, rows: int, padded_lanes: int) -> None:
+        """Per-device telemetry attribution for one sharded dispatch:
+        ``NamedSharding`` splits the padded lanes into contiguous
+        shards, real rows occupying the leading lanes — the registry's
+        sharded-dispatch helper mirrors exactly that layout. Called
+        AFTER the enqueue so a failing dispatch never inflates the
+        counts (attribution is ground truth). Two attribute reads when
+        the monitor is off."""
+        from corda_tpu.observability.devicemon import active_devicemon
+
+        mon = active_devicemon()
+        if mon is not None:
+            mon.record_sharded_dispatch(
+                self._ordinals(), rows=rows, padded_lanes=padded_lanes
+            )
+
     def dispatch_rows(
         self,
         pubkeys: list[bytes],
@@ -272,13 +291,17 @@ class MeshVerifier:
         planes = prep_core_planes(pubkeys, signatures, messages, b)
         if spent_hashes is None:
             args = tuple(shard_batch(self.mesh, a) for a in planes)
-            return self._step_mask(*args), None, None
+            result = self._step_mask(*args), None, None
+            self._record_shard(n, b)
+            return result
         spent = np.zeros((b, 8), np.int32)
         spent[:n] = spent_hashes
         args = tuple(
             shard_batch(self.mesh, a) for a in (*planes, spent)
         )
-        return self._step_spent(*args)
+        result = self._step_spent(*args)
+        self._record_shard(n, b)
+        return result
 
     # ------------------------------------------------- mixed-scheme fan-out
 
@@ -312,7 +335,9 @@ class MeshVerifier:
                 self.mesh, curve_name
             )
         args = tuple(shard_batch(self.mesh, np.asarray(a)) for a in planes)
-        return step(*args)
+        result = step(*args)
+        self._record_shard(n, b)
+        return result
 
     def dispatch_sphincs_rows(
         self,
@@ -347,6 +372,9 @@ class MeshVerifier:
         bounds = [
             (c * step, min(n, (c + 1) * step)) for c in range(n_chunks)
         ]
+        from corda_tpu.observability.devicemon import active_devicemon
+
+        mon = active_devicemon()
         parts: list[tuple[int, int, object]] = []
         for dev, (lo, hi) in zip(devs, bounds):
             if hi == lo:
@@ -356,4 +384,15 @@ class MeshVerifier:
                     pubkeys[lo:hi], signatures[lo:hi], messages[lo:hi],
                     min_bucket=min_bucket,
                 )))
+            if mon is not None:
+                # per-chunk attribution AFTER the enqueue (a failing
+                # chunk must not inflate the counts); the SPHINCS
+                # fan-out is per-device streams, not shard_map, and the
+                # scheme's internal pad bucket is not visible here, so
+                # lanes report as rows — a best-effort floor, never a
+                # lie high
+                mon.record_dispatch(
+                    int(dev.id), rows=hi - lo, padded_lanes=hi - lo,
+                    track_inflight=False,
+                )
         return ChunkedMask(parts, n)

@@ -238,8 +238,13 @@ def verify_transaction_dag(
             attrs={"txs": len(tids), "levels": len(win_levels)},
         )
         pending_ids = None
+        probe = None
         try:
             if check_ids:
+                from corda_tpu.observability.devicemon import (
+                    active_devicemon,
+                    default_device_ordinal,
+                )
                 from corda_tpu.ops.txid import dispatch_check_ids
 
                 # optimistically prime each tx's id cache with its
@@ -255,12 +260,24 @@ def verify_transaction_dag(
                 pending_ids = dispatch_check_ids(
                     {tid: stxs[tid] for tid in tids}
                 )
-            return span, pending_ids, _dispatch_sigs(tids, span)
+                # chip attribution for the window's own device work (the
+                # id sweep — the signature batch is attributed by the
+                # scheduler it rides): stamped on the span always, fed to
+                # the per-device telemetry registry when it is on
+                span.set_attr("device", default_device_ordinal())
+                mon = active_devicemon()
+                if mon is not None:
+                    probe = mon.probe(
+                        default_device_ordinal(), len(tids)
+                    )
+            return span, pending_ids, _dispatch_sigs(tids, span), probe
         except BaseException as e:
             # a dispatch-time failure must still land the window span in
             # the ring — failing resolves are the traces worth reading —
             # and must not leave THIS window's unchecked claimed ids
             # cached on the shared tx objects
+            if probe is not None:
+                probe.settle(ok=False)
             if pending_ids is not None:
                 pending_ids.abort()
             elif check_ids:
@@ -307,16 +324,25 @@ def verify_transaction_dag(
         verdicts, then the order-dependent walk over its levels. The
         window span opened at dispatch closes here — it covers
         enqueue→device→walk, the per-window latency the pipeline hides."""
-        span, pending_ids, pending = staged
+        span, pending_ids, pending, probe = staged
         with span:
-            _walk_window_inner(win_levels, pending_ids, pending)
+            _walk_window_inner(win_levels, pending_ids, pending, probe)
 
-    def _walk_window_inner(win_levels, pending_ids, pending):
+    def _walk_window_inner(win_levels, pending_ids, pending, probe):
         nonlocal n_sigs
         if pending_ids is not None:
             # the forged-chain-link check lands at ITS window, before any
-            # verdict derived from the claimed id is consumed
-            pending_ids.collect()
+            # verdict derived from the claimed id is consumed; the
+            # telemetry probe settles either way (a failed sweep must
+            # not leak the ordinal's in-flight depth)
+            try:
+                pending_ids.collect()
+            except BaseException:
+                if probe is not None:
+                    probe.settle(ok=False)
+                raise
+            if probe is not None:
+                probe.settle()
         report = pending.collect()
         report.raise_first()
         n_sigs += report.n_sigs
@@ -372,7 +398,9 @@ def verify_transaction_dag(
         # trace shows the whole pipeline, not a truncated prefix — and
         # roll back their optimistically primed CLAIMED ids, which the
         # abandoned sweeps never got to check against the bytes
-        for _win_levels, (span, pids, _pending) in in_flight:
+        for _win_levels, (span, pids, _pending, probe) in in_flight:
+            if probe is not None:
+                probe.settle(ok=False)
             if pids is not None:
                 pids.abort()
             span.set_error(e)

@@ -587,6 +587,22 @@ def profiler_snapshot_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.profiler_snapshot())
 
 
+def devicemon_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the per-device telemetry registry
+    (``CordaRPCOps.devicemon_snapshot``): per-ordinal in-flight depth,
+    dispatch/settle counts, rows vs padded lanes, execute EWMA,
+    heartbeat age and health flags — refresh to watch a straggler
+    develop in the explorer's mesh view."""
+    return PolledValue(lambda: proxy.devicemon_snapshot())
+
+
+def slo_status_value(proxy) -> PolledValue:
+    """Read binding over the SLO monitor's evaluated objectives
+    (``CordaRPCOps.slo_status``): windowed p99 / error-rate per
+    objective with breach flags — the attainment widget's feed."""
+    return PolledValue(lambda: proxy.slo_status())
+
+
 def metrics_text_value(proxy) -> PolledValue:
     """Read binding over the Prometheus text exposition
     (``CordaRPCOps.metrics_text``) — the scrape body as a live value the

@@ -198,10 +198,43 @@ class CordaRPCOps:
         """Prometheus text exposition of the process-global AND node-local
         registries (docs/OBSERVABILITY.md §exposition) — counters as
         ``_total``, timers/meters as summaries with p50/p95/p99
-        ``quantile`` labels from the reservoirs. The scrape endpoint body."""
+        ``quantile`` labels from the reservoirs, plus the labeled
+        ``device.*``/``slo.*`` families while those monitors are on. The
+        scrape endpoint body."""
         from corda_tpu.observability import metrics_text
 
         return metrics_text(self._services.metrics)
+
+    def devicemon_snapshot(self) -> dict:
+        """The per-device telemetry registry (docs/OBSERVABILITY.md
+        §Device telemetry): one entry per ``jax.devices()`` ordinal with
+        in-flight depth, dispatch/settle counts, real vs padded rows,
+        execute-wall EWMA, completion-heartbeat age, best-effort HBM
+        occupancy, and the watchdog's health flag + recent events.
+        ``{"enabled": false}`` while the monitor is off (the default)."""
+        from corda_tpu.observability.devicemon import devices_section
+
+        return devices_section()
+
+    def slo_status(self) -> dict:
+        """The SLO monitor's evaluated objectives (docs/OBSERVABILITY.md
+        §SLO monitor): windowed p99 + error/shed rate per objective,
+        breach flags, breach count and recent breach/recovery events.
+        ``{"enabled": false}`` while SLO tracking is off (the default)."""
+        from corda_tpu.observability.slo import slo_section
+
+        return slo_section()
+
+    def flight_dump(self, path: str | None = None,
+                    reason: str = "rpc") -> str:
+        """Write a black-box flight-recorder dump (docs/OBSERVABILITY.md
+        §Flight recorder): recent spans, the full monitoring snapshot,
+        per-device state + health events, SLO status, and injected fault
+        events as one JSONL file. Returns the path written (a default
+        under ``CORDA_TPU_FLIGHT_DIR``/tmp when none is given)."""
+        from corda_tpu.observability.slo import flight_dump
+
+        return flight_dump(path, reason=reason)
 
     # ------------------------------------------------------------ tracing
     def trace_dump(self, limit: int = 200) -> list:

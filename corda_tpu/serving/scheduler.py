@@ -63,11 +63,16 @@ from corda_tpu.observability import (
     SPAN_SERVING_QUEUE,
     tracer,
 )
+from corda_tpu.observability.devicemon import (
+    active_devicemon,
+    default_device_ordinal,
+)
 from corda_tpu.observability.profiler import (
     KERNEL_SERVING_DISPATCH,
     active_profiler,
     stamp_span,
 )
+from corda_tpu.observability.slo import active_slo
 
 from .shapes import shape_table
 
@@ -106,16 +111,20 @@ class DeadlineExceededError(ServingError):
 
 class RowResult:
     """What a row-level submission resolves to: the (N,) bool verdict
-    mask, how many rows actually settled on device, and the sequence
-    number of the device batch that served it (shared by every request
-    coalesced into that batch — the cross-client coalescing witness)."""
+    mask, how many rows actually settled on device, the sequence number
+    of the device batch that served it (shared by every request
+    coalesced into that batch — the cross-client coalescing witness),
+    and the device ordinal the batch ran on (None for host-settled
+    batches) — per-chip attribution even before the mesh scheduler."""
 
-    __slots__ = ("mask", "n_device", "batch_seq")
+    __slots__ = ("mask", "n_device", "batch_seq", "device")
 
-    def __init__(self, mask: np.ndarray, n_device: int, batch_seq: int):
+    def __init__(self, mask: np.ndarray, n_device: int, batch_seq: int,
+                 device: int | None = None):
         self.mask = mask
         self.n_device = n_device
         self.batch_seq = batch_seq
+        self.device = device
 
 
 class _Request:
@@ -144,10 +153,10 @@ class _InFlight:
     settle on the scheduler's host pool straight from dispatch."""
 
     __slots__ = ("requests", "pending", "n_rows", "dev_map", "seq", "t0",
-                 "span")
+                 "span", "device")
 
     def __init__(self, requests, pending, n_rows, dev_map, seq, t0,
-                 span=NOOP_SPAN):
+                 span=NOOP_SPAN, device=None):
         self.requests = requests
         self.pending = pending
         self.n_rows = n_rows
@@ -155,6 +164,7 @@ class _InFlight:
         self.seq = seq
         self.t0 = t0
         self.span = span            # serving.batch span, finished at settle
+        self.device = device        # ordinal the dispatch ran on
 
 
 def _metrics():
@@ -201,8 +211,11 @@ class DeviceScheduler:
         max_queue_rows: int = 131072,
         depth: int = 3,
         host_workers: int = 4,
+        shapes=None,
     ):
-        self._shapes = shape_table()
+        # `shapes`: an explicit ShapeTable override (tests and the smoke
+        # harness pin small pad buckets to reuse already-compiled shapes)
+        self._shapes = shapes or shape_table()
         self._use_device_default = use_device_default
         self._max_batch_rows = max_batch_rows or self._shapes.max_bucket
         self._min_batch_rows = min_batch_rows
@@ -299,6 +312,12 @@ class DeviceScheduler:
                 raise err
             if self._queued_rows + len(rows) > self._max_queue_rows:
                 _metrics().counter("serving.rejected").inc()
+                slo = active_slo()
+                if slo is not None:
+                    # an admission reject is an SLO error for its class
+                    # with NO latency sample — the request never ran, and
+                    # instant rejects must not read as a perfect p99
+                    slo.observe(priority, None, error=True)
                 err = SchedulerSaturatedError(
                     f"serving queue full ({self._queued_rows} rows queued, "
                     f"bound {self._max_queue_rows})"
@@ -355,7 +374,7 @@ class DeviceScheduler:
                 rr: RowResult = f.result()
                 report = tx_report_from_mask(
                     stxs, allowed_missing, rr.mask, row_tx, row_sig,
-                    rr.n_device, batch_seq=rr.batch_seq,
+                    rr.n_device, batch_seq=rr.batch_seq, device=rr.device,
                 )
                 _complete(out, result=report)
             except Exception as e:
@@ -440,7 +459,12 @@ class DeviceScheduler:
         """Complete shed requests with DeadlineExceededError (counted,
         spans landed) — shared by assembly-time and slot-wait shedding."""
         _metrics().counter("serving.shed").inc(len(requests))
+        slo = active_slo()
+        now = time.monotonic()
         for r in requests:
+            if slo is not None:
+                # a shed IS the SLO signal: the request aged out
+                slo.observe(r.priority, now - r.enqueued_at, error=True)
             err = DeadlineExceededError(
                 "request shed: deadline passed while queued"
             )
@@ -544,6 +568,7 @@ class DeviceScheduler:
         pending = None
         dev_rows: list = []
         dev_map: list = []
+        ordinal = None
         if dev_reqs:
             floor = 0
             for i, r in enumerate(dev_reqs):
@@ -598,9 +623,24 @@ class DeviceScheduler:
                 )
                 self._real_rows += len(dev_rows)
                 self._padded_rows += padded
+                # per-chip attribution: single-chip dispatch runs on the
+                # default ordinal (jax is up — the dispatch succeeded);
+                # stamped on the span + result even before the mesh
+                # scheduler lands, and fed to the per-device telemetry
+                # registry when it is on
+                ordinal = default_device_ordinal()
+                batch_span.set_attr("device", ordinal)
+                mon = active_devicemon()
+                if mon is not None:
+                    mon.record_dispatch(
+                        ordinal, rows=len(dev_rows), padded_lanes=padded
+                    )
             except Exception:
                 m.counter("serving.device_failover").inc()
                 batch_span.set_attr("device_failover", True)
+                mon = active_devicemon()
+                if mon is not None:
+                    mon.record_failure(default_device_ordinal())
                 host_reqs = host_reqs + dev_reqs
                 dev_reqs, pending = [], None
         device_entry = bool(dev_reqs and pending is not None)
@@ -619,7 +659,7 @@ class DeviceScheduler:
                 self._settle_host(host_reqs, seq, host_span)  # pool closed
         if device_entry:
             return _InFlight(dev_reqs, pending, len(dev_rows), dev_map,
-                             seq, t0, span=batch_span)
+                             seq, t0, span=batch_span, device=ordinal)
         return None
 
     # ------------------------------------------------------------ collect
@@ -630,13 +670,23 @@ class DeviceScheduler:
         unrelated batch's settlement."""
         from corda_tpu.crypto import is_valid
 
+        slo = active_slo()
         for r in requests:
             try:
                 mask = np.array(
                     [is_valid(k, s, m) for k, s, m in r.rows], dtype=bool
                 )
+                if slo is not None:
+                    slo.observe(
+                        r.priority, time.monotonic() - r.enqueued_at
+                    )
                 _complete(r.future, result=RowResult(mask, 0, seq))
             except Exception as e:
+                if slo is not None:
+                    slo.observe(
+                        r.priority, time.monotonic() - r.enqueued_at,
+                        error=True,
+                    )
                 span.set_error(e)
                 _complete(r.future, error=e)
         span.finish()
@@ -678,6 +728,18 @@ class DeviceScheduler:
         try:
             self._settle(entry)
         except Exception as e:
+            mon = active_devicemon()
+            if mon is not None and entry.device is not None:
+                mon.record_settle(
+                    entry.device, time.monotonic() - entry.t0, ok=False
+                )
+            slo = active_slo()
+            if slo is not None:
+                now = time.monotonic()
+                for r in entry.requests:
+                    slo.observe(
+                        r.priority, now - r.enqueued_at, error=True
+                    )
             entry.span.set_error(e)
             entry.span.finish()
             for r in entry.requests:
@@ -702,6 +764,18 @@ class DeviceScheduler:
         latency = time.monotonic() - entry.t0
         m = _metrics()
         m.timer("serving.batch_latency_s").update(latency)
+        mon = active_devicemon()
+        if mon is not None and entry.device is not None:
+            # the per-device completion heartbeat + execute-wall EWMA the
+            # watchdog's straggler/stall rules evaluate
+            mon.record_settle(entry.device, latency)
+        slo = active_slo()
+        if slo is not None:
+            now = time.monotonic()
+            for r in entry.requests:
+                # end-to-end (admission→settle) latency per priority
+                # class — the windowed p99 the SLO objectives bound
+                slo.observe(r.priority, now - r.enqueued_at)
         entry.span.set_attr("n_rows", entry.n_rows)
         entry.span.set_attr("device_rows", int(sum(n_device)))
         entry.span.finish()
@@ -711,7 +785,9 @@ class DeviceScheduler:
                 else 0.7 * self._latency_ewma + 0.3 * latency
             )
         for r, mask, nd in zip(entry.requests, masks, n_device):
-            _complete(r.future, result=RowResult(mask, nd, entry.seq))
+            _complete(r.future, result=RowResult(
+                mask, nd, entry.seq, device=entry.device,
+            ))
 
     # ----------------------------------------------------------- lifecycle
     def shutdown(self, timeout: float = 30.0) -> None:

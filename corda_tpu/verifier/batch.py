@@ -321,6 +321,9 @@ class BatchVerifyReport:
     # scheduler (requests coalesced into one device batch share it); None
     # on the direct dispatch path
     batch_seq: int | None = None
+    # device ordinal the scheduler batch ran on (None when host-settled
+    # or on the direct dispatch path) — per-chip attribution
+    device: int | None = None
 
     @property
     def ok(self) -> bool:
@@ -386,6 +389,7 @@ def flatten_signature_rows(stxs: list[SignedTransaction]):
 
 def tx_report_from_mask(
     stxs, allowed, mask, row_tx, row_sig, n_device, batch_seq=None,
+    device=None,
 ) -> BatchVerifyReport:
     """The per-transaction signer-set algebra over a row verdict mask —
     shared by the direct path (``PendingTxCheck``) and the serving
@@ -412,6 +416,7 @@ def tx_report_from_mask(
             results[t] = SignaturesMissingException(missing, stx.id)
     return BatchVerifyReport(
         results, n_sigs=len(row_tx), n_device=n_device, batch_seq=batch_seq,
+        device=device,
     )
 
 

@@ -1,0 +1,701 @@
+"""Mesh-wide device telemetry, SLO watchdog & flight recorder (ISSUE 7).
+
+Covers the per-device telemetry registry (devicemon), the straggler/
+stall watchdog under a fake clock, windowed SLO evaluation + breach →
+flight-recorder dump, the dump → parse round trip, the serving
+scheduler's per-ordinal attribution (sums reconcile exactly with the
+scheduler's own counters on the CPU tier), the trace-sink rotation
+bound, and the off-by-default overhead contract (no metrics, no
+threads, no jax touch while everything is off).
+"""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair, sign
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.observability import (
+    SLOObjective,
+    active_devicemon,
+    active_slo,
+    configure_devicemon,
+    configure_slo,
+    configure_tracing,
+    flight_dump,
+    metrics_text,
+    parse_prometheus,
+    read_flight_dump,
+    tracer,
+)
+from corda_tpu.observability.devicemon import DeviceMonitor, DeviceWatchdog
+from corda_tpu.observability.slo import (
+    SLOMonitor,
+    _crash_dump,
+    install_crash_dump,
+    uninstall_crash_dump,
+)
+from corda_tpu.serving import INTERACTIVE, DeviceScheduler, ShapeTable
+
+
+@pytest.fixture(autouse=True)
+def _monitors_off():
+    """Every test leaves the process-global monitors the way production
+    starts: off, empty, no watchdog/evaluation threads."""
+    yield
+    configure_devicemon(enabled=False, reset=True, watchdog=False)
+    configure_slo(enabled=False, reset=True, objectives=(),
+                  breach_handler=SLOMonitor.DEFAULT_HANDLER)
+    configure_tracing(sample_rate=0.0)
+
+
+def make_rows(n, tamper=()):
+    kp = generate_keypair()
+    rows = []
+    for i in range(n):
+        msg = b"devmon-%d" % i
+        sig = sign(kp.private, msg)
+        if i in tamper:
+            sig = bytes([sig[0] ^ 1]) + sig[1:]
+        rows.append((kp.public, sig, msg))
+    return rows
+
+
+# ------------------------------------------------------------ off by default
+
+class TestOffByDefault:
+    def test_monitors_inactive_and_sections_marked_disabled(self):
+        assert active_devicemon() is None
+        assert active_slo() is None
+        snap = monitoring_snapshot()
+        assert snap["devices"] == {"enabled": False}
+        assert snap["slo"] == {"enabled": False}
+
+    def test_no_monitor_threads_exist(self):
+        names = {t.name for t in threading.enumerate()}
+        assert "devicemon-watchdog" not in names
+        assert "slo-monitor" not in names
+
+    def test_scheduler_traffic_creates_no_device_or_slo_metrics(self):
+        """The overhead pin: with both monitors off, a full scheduler
+        round trip must create zero device.*/slo.* registry metrics and
+        zero labeled exposition families."""
+        before = set(node_metrics().snapshot())
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            rr = s.submit_rows(make_rows(3)).result(timeout=30)
+            assert rr.mask.all()
+            assert rr.device is None  # host-settled: no ordinal claimed
+        finally:
+            s.shutdown()
+        new = set(node_metrics().snapshot()) - before
+        assert not [k for k in new if k.startswith(("device.", "slo."))], new
+        text = metrics_text()
+        assert "cordatpu_device_" not in text
+        assert "cordatpu_slo_" not in text
+
+
+# ------------------------------------------------------- monitor accounting
+
+class TestDeviceMonitor:
+    def test_dispatch_settle_accounting(self):
+        clk = [100.0]
+        mon = DeviceMonitor(n_devices=2, enabled=True,
+                            clock=lambda: clk[0])
+        mon.record_dispatch(0, rows=5, padded_lanes=8)
+        mon.record_dispatch(1, rows=3, padded_lanes=8)
+        snap = mon.snapshot()
+        assert snap["n_devices"] == 2
+        assert snap["devices"]["0"]["inflight"] == 1
+        clk[0] = 100.5
+        mon.record_settle(0, 0.5)
+        clk[0] = 101.0
+        snap = mon.snapshot()
+        d0 = snap["devices"]["0"]
+        assert d0["inflight"] == 0
+        assert d0["dispatches"] == 1 and d0["settles"] == 1
+        assert d0["rows"] == 5 and d0["padded_rows"] == 8
+        assert d0["fill_ratio"] == 0.625
+        assert d0["execute_ewma_s"] == 0.5
+        assert d0["heartbeat_age_s"] == 0.5
+        # ordinal 1 never settled: in flight, no heartbeat field
+        d1 = snap["devices"]["1"]
+        assert d1["inflight"] == 1 and "heartbeat_age_s" not in d1
+
+    def test_failed_settle_counts_failure_not_ewma(self):
+        mon = DeviceMonitor(n_devices=1, enabled=True)
+        mon.record_dispatch(0, rows=2, padded_lanes=2)
+        mon.record_settle(0, 9.0, ok=False)
+        d = mon.snapshot()["devices"]["0"]
+        assert d["failures"] == 1 and d["execute_ewma_s"] == 0.0
+        assert d["inflight"] == 0
+
+    def test_sharded_dispatch_splits_like_namedsharding(self):
+        """8 real rows over 16 lanes on 4 ordinals: 4 lanes each, real
+        rows fill the leading shards (4, 4, 0, 0)."""
+        mon = DeviceMonitor(n_devices=4, enabled=True)
+        mon.record_sharded_dispatch([0, 1, 2, 3], rows=8, padded_lanes=16)
+        per = mon.snapshot()["devices"]
+        assert [per[str(o)]["rows"] for o in range(4)] == [4, 4, 0, 0]
+        assert all(per[str(o)]["padded_rows"] == 4 for o in range(4))
+        assert all(per[str(o)]["inflight"] == 0 for o in range(4))
+        assert sum(per[str(o)]["rows"] for o in range(4)) == 8
+
+    def test_sharded_dispatch_remainder_goes_to_last_ordinal(self):
+        """Non-divisible lane counts must still reconcile exactly: the
+        last ordinal takes the remainder, nothing is dropped."""
+        mon = DeviceMonitor(n_devices=3, enabled=True)
+        mon.record_sharded_dispatch([0, 1, 2], rows=32, padded_lanes=32)
+        per = mon.snapshot()["devices"]
+        assert sum(per[str(o)]["rows"] for o in range(3)) == 32
+        assert sum(per[str(o)]["padded_rows"] for o in range(3)) == 32
+        assert per["2"]["padded_rows"] == 12  # 10 + 10 + remainder 12
+
+    def test_probe_settles_exactly_once(self):
+        mon = DeviceMonitor(n_devices=1, enabled=True)
+        probe = mon.probe(0, rows=4, padded_lanes=4)
+        assert mon.snapshot()["devices"]["0"]["inflight"] == 1
+        probe.settle()
+        probe.settle()  # idempotent
+        d = mon.snapshot()["devices"]["0"]
+        assert d["inflight"] == 0 and d["settles"] == 1
+
+    def test_reset_drops_slots_and_events(self):
+        mon = DeviceMonitor(n_devices=2, enabled=True)
+        mon.record_dispatch(1, rows=1)
+        mon.reset()
+        snap = mon.snapshot()
+        assert snap["devices"]["1"]["dispatches"] == 0
+        assert snap["events"] == []
+
+    def test_deviceless_fallback_is_one_slot(self):
+        """A monitor that cannot reach jax lays out a single slot rather
+        than raising — telemetry never takes down what it observes."""
+        mon = DeviceMonitor(n_devices=None, enabled=True)
+        mon._fixed_n = None
+        # simulate the deviceless box: make the jax import path blow up
+        # by pre-marking sized with a poisoned layout, then reset and
+        # size through the real path — on this box jax IS importable, so
+        # instead verify the documented contract on the fallback branch
+        # directly
+        try:
+            import builtins
+
+            real_import = builtins.__import__
+
+            def no_jax(name, *a, **k):
+                if name == "jax":
+                    raise ImportError("no jax on this box")
+                return real_import(name, *a, **k)
+
+            builtins.__import__ = no_jax
+            mon.reset()
+            assert mon.ordinals() == [0]
+        finally:
+            builtins.__import__ = real_import
+
+
+# ---------------------------------------------------------------- watchdog
+
+class TestWatchdog:
+    def _loaded_monitor(self, clk):
+        mon = DeviceMonitor(n_devices=4, enabled=True,
+                            clock=lambda: clk[0])
+        for o in range(4):
+            for _ in range(5):
+                mon.record_dispatch(o, rows=8, padded_lanes=8)
+                mon.record_settle(o, 0.09 if o == 3 else 0.01)
+        return mon
+
+    def test_straggler_flagged_exactly_once_and_recovers(self):
+        clk = [0.0]
+        mon = self._loaded_monitor(clk)
+        wd = DeviceWatchdog(mon, straggler_factor=3.0, min_settles=3,
+                            stall_s=60.0)
+        c0 = node_metrics().counter("device.unhealthy_events").count
+        events = wd.check_once(now=1.0)
+        assert [e["kind"] for e in events] == ["device.unhealthy"]
+        assert events[0]["device"] == 3
+        assert "straggler" in events[0]["reason"]
+        assert mon.unhealthy_ordinals() == [3]
+        # a second sweep with unchanged state re-flags NOTHING
+        assert wd.check_once(now=2.0) == []
+        assert node_metrics().counter(
+            "device.unhealthy_events"
+        ).count == c0 + 1
+        # recovery: the EWMA converges back to the pack
+        for _ in range(40):
+            mon.record_dispatch(3, rows=1)
+            mon.record_settle(3, 0.01)
+        events = wd.check_once(now=3.0)
+        assert [e["kind"] for e in events] == ["device.recovered"]
+        assert mon.unhealthy_ordinals() == []
+        # both transitions are in the event ring, in order
+        kinds = [e["kind"] for e in mon.snapshot()["events"]]
+        assert kinds == ["device.unhealthy", "device.recovered"]
+
+    def test_stalled_heartbeat_flagged_once_and_clears(self):
+        clk = [0.0]
+        mon = DeviceMonitor(n_devices=2, enabled=True,
+                            clock=lambda: clk[0])
+        mon.record_dispatch(0, rows=4, padded_lanes=4)  # never settles
+        wd = DeviceWatchdog(mon, stall_s=5.0, min_settles=3)
+        assert wd.check_once(now=1.0) == []  # within the stall budget
+        events = wd.check_once(now=10.0)
+        assert [e["kind"] for e in events] == ["device.unhealthy"]
+        assert "stalled" in events[0]["reason"]
+        assert wd.check_once(now=11.0) == []  # flagged exactly once
+        # the stuck batch finally lands: flag clears
+        clk[0] = 12.0
+        mon.record_settle(0, 12.0)
+        events = wd.check_once(now=12.5)
+        assert [e["kind"] for e in events] == ["device.recovered"]
+
+    def test_two_device_mesh_straggler_is_detectable(self):
+        """With exactly two participants the median must bias LOW —
+        the upper middle is the straggler's own EWMA, against which
+        nothing can ever deviate (a 100×-slower second chip would go
+        unflagged)."""
+        clk = [0.0]
+        mon = DeviceMonitor(n_devices=2, enabled=True,
+                            clock=lambda: clk[0])
+        for o in range(2):
+            for _ in range(5):
+                mon.record_dispatch(o, rows=1)
+                mon.record_settle(o, 1.0 if o == 1 else 0.01)
+        wd = DeviceWatchdog(mon, straggler_factor=3.0, min_settles=3,
+                            stall_s=60.0)
+        events = wd.check_once(now=1.0)
+        assert [e["device"] for e in events
+                if e["kind"] == "device.unhealthy"] == [1]
+
+    def test_single_device_mesh_never_self_flags_straggler(self):
+        clk = [0.0]
+        mon = DeviceMonitor(n_devices=1, enabled=True,
+                            clock=lambda: clk[0])
+        for _ in range(10):
+            mon.record_dispatch(0, rows=1)
+            mon.record_settle(0, 5.0)  # slow, but there is no peer
+        wd = DeviceWatchdog(mon, straggler_factor=3.0, min_settles=3,
+                            stall_s=60.0)
+        assert wd.check_once(now=1.0) == []
+
+    def test_watchdog_thread_lifecycle(self):
+        configure_devicemon(enabled=True, reset=True, watchdog=True,
+                            interval_s=0.05)
+        try:
+            names = {t.name for t in threading.enumerate()}
+            assert "devicemon-watchdog" in names
+        finally:
+            configure_devicemon(watchdog=False)
+        time.sleep(0.05)
+        names = {t.name for t in threading.enumerate()}
+        assert "devicemon-watchdog" not in names
+
+
+# -------------------------------------------------------------- SLO monitor
+
+class TestSLOMonitor:
+    def test_windowed_not_lifetime_p99(self):
+        """Old slow samples age out of the window: the lifetime p99
+        stays terrible, the WINDOWED p99 recovers — exactly the property
+        the lifetime reservoirs cannot express."""
+        clk = [0.0]
+        m = SLOMonitor(objectives=[SLOObjective(
+            "int", priority=INTERACTIVE, p99_s=0.05, window_s=10.0,
+            min_samples=5,
+        )], clock=lambda: clk[0], breach_handler=None)
+        m.enable()
+        for _ in range(20):
+            m.observe(INTERACTIVE, 0.5)  # awful
+        assert m.evaluate()[0]["breached"]
+        clk[0] = 30.0  # the bad samples are now outside the window
+        for _ in range(20):
+            m.observe(INTERACTIVE, 0.01)
+        st = m.evaluate()[0]
+        assert not st["breached"]
+        assert st["p99_s"] == 0.01
+        assert st["samples"] == 20
+
+    def test_breach_fires_handler_exactly_once_then_recovers(self):
+        clk = [0.0]
+        fired = []
+        m = SLOMonitor(objectives=[SLOObjective(
+            "int", priority=INTERACTIVE, p99_s=0.05, window_s=10.0,
+            min_samples=5,
+        )], clock=lambda: clk[0], breach_handler=fired.append)
+        m.enable()
+        c0 = node_metrics().counter("slo.breach").count
+        for _ in range(10):
+            m.observe(INTERACTIVE, 0.2)
+        assert m.evaluate()[0]["breached"]
+        assert len(fired) == 1 and fired[0]["objective"] == "int"
+        m.evaluate()  # still breached: no re-fire
+        assert len(fired) == 1
+        assert node_metrics().counter("slo.breach").count == c0 + 1
+        clk[0] = 30.0
+        for _ in range(10):
+            m.observe(INTERACTIVE, 0.001)
+        assert not m.evaluate()[0]["breached"]
+        kinds = [e["kind"] for e in m.snapshot()["events"]]
+        assert kinds == ["slo.breach", "slo.recovered"]
+        # re-breach fires the handler again (latch cleared)
+        for _ in range(10):
+            m.observe(INTERACTIVE, 0.2)
+        m.evaluate()
+        assert len(fired) == 2
+
+    def test_error_rate_objective_counts_sheds(self):
+        m = SLOMonitor(objectives=[SLOObjective(
+            "err", priority=None, max_error_rate=0.1, window_s=60.0,
+            min_samples=5,
+        )], breach_handler=None)
+        m.enable()
+        for _ in range(8):
+            m.observe(INTERACTIVE, 0.01)
+        for _ in range(2):
+            m.observe(INTERACTIVE, 0.01, error=True)  # 20% > 10%
+        st = m.evaluate()[0]
+        assert st["breached"] and st["error_rate"] == 0.2
+
+    def test_rejects_count_as_errors_without_poisoning_p99(self):
+        """An admission reject carries NO latency sample: a saturated
+        scheduler rejecting everything instantly must read as an
+        error-rate breach, never as a perfect (~0) p99."""
+        m = SLOMonitor(objectives=[
+            SLOObjective("lat", priority=INTERACTIVE, p99_s=0.05,
+                         window_s=60.0, min_samples=5),
+            SLOObjective("err", priority=INTERACTIVE, max_error_rate=0.2,
+                         window_s=60.0, min_samples=5),
+        ], breach_handler=None)
+        m.enable()
+        for _ in range(5):
+            m.observe(INTERACTIVE, 0.2)          # the few served: slow
+        for _ in range(95):
+            m.observe(INTERACTIVE, None, error=True)  # instant rejects
+        lat, err = m.evaluate()
+        assert lat["p99_s"] == 0.2       # rejects never entered the pool
+        assert lat["breached"]           # the served traffic breaches
+        assert err["breached"] and err["error_rate"] == 0.95
+
+    def test_min_samples_guards_cold_windows(self):
+        m = SLOMonitor(objectives=[SLOObjective(
+            "int", priority=INTERACTIVE, p99_s=0.001, min_samples=20,
+        )], breach_handler=None)
+        m.enable()
+        for _ in range(5):
+            m.observe(INTERACTIVE, 1.0)  # terrible, but only 5 samples
+        assert not m.evaluate()[0]["breached"]
+
+
+# ----------------------------------------------------------- flight recorder
+
+class TestFlightRecorder:
+    def test_dump_parse_round_trip(self, tmp_path):
+        """Acceptance: a dump reconstructs spans, metric snapshots and
+        per-device state exactly."""
+        configure_tracing(sample_rate=1.0)
+        with tracer().root("flight.test", force=True) as root:
+            root.set_attr("marker", "xyzzy")
+        configure_devicemon(enabled=True, reset=True)
+        mon = active_devicemon()
+        mon.record_dispatch(0, rows=7, padded_lanes=8)
+        mon.record_settle(0, 0.02)
+        configure_slo(enabled=True, reset=True, objectives=[
+            SLOObjective("int", priority=INTERACTIVE, p99_s=1.0),
+        ], breach_handler=None)
+        path = str(tmp_path / "flight.jsonl")
+        out = flight_dump(path, reason="round-trip")
+        assert out == path and os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")  # atomic rename
+        # every line is one JSON object
+        with open(path) as f:
+            for line in f:
+                json.loads(line)
+        back = read_flight_dump(path)
+        assert back["header"]["reason"] == "round-trip"
+        mine = [s for s in back["spans"] if s["name"] == "flight.test"]
+        assert mine and mine[0]["attrs"]["marker"] == "xyzzy"
+        assert back["metrics"]["devices"]["enabled"] is True
+        d0 = back["devices"]["devices"]["0"]
+        assert d0["rows"] == 7 and d0["settles"] == 1
+        assert back["slo"]["objectives"][0]["objective"] == "int"
+
+    def test_seeded_breach_triggers_dump(self, tmp_path):
+        """Acceptance: a tight p99 objective under injected delay
+        produces a flight dump whose spans round-trip."""
+        path = str(tmp_path / "breach.jsonl")
+        configure_tracing(sample_rate=1.0)
+        with tracer().root("breach.witness", force=True):
+            pass
+        configure_slo(enabled=True, reset=True, objectives=[
+            SLOObjective("tight", priority=INTERACTIVE, p99_s=1e-6,
+                         window_s=60.0, min_samples=3),
+        ], breach_handler=lambda status: flight_dump(
+            path, reason=f"slo-breach:{status['objective']}"
+        ))
+        slo = active_slo()
+        for _ in range(5):
+            slo.observe(INTERACTIVE, 0.25)  # the injected delay
+        st = slo.evaluate()
+        assert st[0]["breached"]
+        assert os.path.exists(path)
+        back = read_flight_dump(path)
+        assert back["header"]["reason"] == "slo-breach:tight"
+        assert any(s["name"] == "breach.witness" for s in back["spans"])
+        assert any(e["kind"] == "slo.breach" for e in back["events"])
+
+    def test_crash_dump_opt_in_bookkeeping(self, tmp_path):
+        """install/uninstall is opt-in and reversible; an uninstalled
+        hook is inert (the atexit registration must not dump)."""
+        path = str(tmp_path / "crash.jsonl")
+        install_crash_dump(path, signals=())
+        try:
+            _crash_dump("unit")
+            assert os.path.exists(path)
+            os.remove(path)
+        finally:
+            uninstall_crash_dump()
+        _crash_dump("after-uninstall")
+        assert not os.path.exists(path)
+
+
+# ------------------------------------------- scheduler integration (device)
+
+class TestSchedulerAttribution:
+    def test_per_ordinal_sums_reconcile_with_scheduler_counters(self):
+        """Acceptance: per-ordinal rows/dispatches in the snapshot AND
+        the Prometheus device.* families sum exactly to the scheduler's
+        global counters (CPU backend: real device dispatches)."""
+        configure_devicemon(enabled=True, reset=True)
+        configure_tracing(sample_rate=1.0)
+        sched = DeviceScheduler(
+            use_device_default=True,
+            shapes=ShapeTable({"buckets": [8, 16, 32],
+                               "source": "test-devicemon"}),
+        )
+        try:
+            root = tracer().root("devmon.batch", force=True)
+            rows = make_rows(5)
+            results = [
+                sched.submit_rows(rows, use_device=True, trace=root)
+                .result(timeout=300)
+                for _ in range(2)
+            ]
+            root.finish()
+            real, padded = sched._real_rows, sched._padded_rows
+        finally:
+            sched.shutdown()
+        for rr in results:
+            assert rr.mask.all()
+            assert rr.device is not None  # satellite: result attribution
+        snap = monitoring_snapshot()["devices"]
+        assert snap["enabled"] is True
+        per = snap["devices"]
+        assert sum(e["rows"] for e in per.values()) == real == 10
+        assert sum(e["padded_rows"] for e in per.values()) == padded == 16
+        assert sum(e["dispatches"] for e in per.values()) == 2
+        assert sum(e["settles"] for e in per.values()) == 2
+        assert sum(e["inflight"] for e in per.values()) == 0
+        # the Prometheus families agree
+        samples = parse_prometheus(metrics_text())
+        prom_rows = sum(
+            int(float(v)) for k, v in samples.items()
+            if isinstance(v, str)
+            and k.startswith("cordatpu_device_rows_total{")
+        )
+        assert prom_rows == real
+        # satellite: serving.batch spans carry the ordinal
+        spans = [
+            s for s in tracer().dump(limit=100)
+            if s["name"] == "serving.batch"
+            and s["trace_id"] == root.trace_id
+        ]
+        assert spans
+        assert all(
+            s["attrs"]["device"] == results[0].device for s in spans
+        )
+
+    def test_report_carries_device_ordinal(self):
+        from corda_tpu.verifier.batch import tx_report_from_mask
+
+        report = tx_report_from_mask([], [], [], [], [], 0,
+                                     batch_seq=7, device=3)
+        assert report.device == 3 and report.batch_seq == 7
+
+    def test_shed_and_reject_feed_slo_errors(self):
+        configure_slo(enabled=True, reset=True, objectives=[
+            SLOObjective("errs", priority=None, max_error_rate=0.5,
+                         window_s=60.0, min_samples=1),
+        ], breach_handler=None)
+        sched = DeviceScheduler(use_device_default=False)
+        try:
+            sched.pause()
+            fut = sched.submit_rows(
+                make_rows(1), use_device=False, deadline_s=0.01,
+                priority=INTERACTIVE,
+            )
+            time.sleep(0.05)
+            sched.resume()
+            with pytest.raises(Exception):
+                fut.result(timeout=30)
+        finally:
+            sched.shutdown()
+        st = active_slo().evaluate()[0]
+        assert st["errors"] >= 1 and st["breached"]
+
+
+# --------------------------------------------- wavefront + mesh attribution
+
+class TestWavefrontAttribution:
+    def test_window_spans_and_probes_attribute_device(self):
+        """The wavefront's own device work (the id sweep) feeds the
+        registry per window, probes never leak in-flight depth, and the
+        window span carries the ordinal."""
+        from test_wavefront_pipeline import _clear_ids, make_chain
+
+        from corda_tpu.parallel.wavefront import verify_transaction_dag
+
+        stxs, notary, _a, _k = make_chain(15)
+        _clear_ids(stxs)
+        dag = {s.id: s for s in stxs}
+        allowed = lambda s: {notary.owning_key}  # noqa: E731
+        configure_devicemon(enabled=True, reset=True)
+        configure_tracing(sample_rate=1.0)
+        root = tracer().root("devmon.dag", force=True)
+        with tracer().activate(root):
+            res = verify_transaction_dag(
+                dag, allowed_missing_fn=allowed, use_device=True,
+                window=4, depth=3,
+            )
+        root.finish()
+        assert len(res.order) == len(stxs)
+        snap = monitoring_snapshot()["devices"]
+        per = snap["devices"]
+        assert sum(e["dispatches"] for e in per.values()) >= 4
+        assert sum(e["inflight"] for e in per.values()) == 0
+        spans = [
+            s for s in tracer().dump(limit=200)
+            if s["name"] == "wavefront.window"
+            and s["trace_id"] == root.trace_id
+        ]
+        assert spans
+        assert all("device" in s["attrs"] for s in spans)
+
+    def test_mesh_sharded_dispatch_attributes_all_ordinals(self):
+        """The 8-virtual-device test mesh: a sharded ed25519 batch
+        attributes lanes to every ordinal."""
+        from corda_tpu.parallel.mesh import MeshVerifier
+
+        import numpy as np
+
+        configure_devicemon(enabled=True, reset=True)
+        mesh_v = MeshVerifier()
+        kp = generate_keypair()
+        msgs = [b"mesh-%d" % i for i in range(32)]
+        keys = [kp.public.encoded] * 32
+        sigs = [sign(kp.private, m) for m in msgs]
+        mask, _spent, _tot = mesh_v.dispatch_rows(keys, sigs, msgs)
+        assert np.asarray(mask)[:32].all()
+        per = monitoring_snapshot()["devices"]["devices"]
+        active = [e for e in per.values() if e["dispatches"]]
+        assert len(active) == mesh_v.n_devices
+        assert sum(e["rows"] for e in per.values()) == 32
+
+
+# ------------------------------------------------------- trace sink rotation
+
+class TestTraceSinkRotation:
+    def test_sink_rotates_at_max_bytes_keep_one(self, tmp_path):
+        """Satellite: the opt-in JSONL sink is bounded — at the byte cap
+        the file rotates to <path>.1 (previous rotation overwritten) and
+        every surviving line still parses."""
+        path = str(tmp_path / "sink.jsonl")
+        cap = 800
+        configure_tracing(sample_rate=1.0, jsonl_path=path,
+                          jsonl_max_bytes=cap)
+        try:
+            for _ in range(60):
+                with tracer().root("rotate.me", force=True):
+                    pass
+        finally:
+            configure_tracing(sample_rate=0.0, jsonl_path=None)
+        assert os.path.exists(path + ".1")
+        line_len = None
+        for f in (path, path + ".1"):
+            if not os.path.exists(f):
+                continue  # the live file may have JUST rotated away
+            size = os.path.getsize(f)
+            with open(f) as fh:
+                for line in fh:
+                    json.loads(line)
+                    line_len = len(line)
+            assert size <= cap + (line_len or 0), (f, size)
+
+    def test_unbounded_when_cap_is_zero(self, tmp_path):
+        path = str(tmp_path / "unbounded.jsonl")
+        configure_tracing(sample_rate=1.0, jsonl_path=path,
+                          jsonl_max_bytes=0)
+        try:
+            for _ in range(30):
+                with tracer().root("nope.rotate", force=True):
+                    pass
+        finally:
+            configure_tracing(sample_rate=0.0, jsonl_path=None)
+        assert os.path.exists(path)
+        assert not os.path.exists(path + ".1")
+        assert len(open(path).readlines()) == 30
+
+
+# ------------------------------------------------------------ RPC + bindings
+
+class TestRPCSurface:
+    def test_ops_methods_no_services_needed(self, tmp_path):
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        ops = CordaRPCOps(None, None)
+        assert ops.devicemon_snapshot() == {"enabled": False}
+        assert ops.slo_status() == {"enabled": False}
+        path = ops.flight_dump(str(tmp_path / "rpc.jsonl"), reason="rpc")
+        back = read_flight_dump(path)
+        assert back["header"]["reason"] == "rpc"
+        assert back["devices"] == {"enabled": False}
+
+    def test_string_call_reachable(self, tmp_path):
+        from corda_tpu.rpc.ops import CordaRPCOps
+        from corda_tpu.rpc.string_calls import StringToMethodCallParser
+
+        parser = StringToMethodCallParser(CordaRPCOps(None, None))
+        assert parser.invoke("devicemon_snapshot") == {"enabled": False}
+        assert parser.invoke("slo_status") == {"enabled": False}
+        out = parser.invoke(
+            f"flight_dump path: \"{tmp_path / 'sc.jsonl'}\", reason: shell"
+        )
+        assert read_flight_dump(out)["header"]["reason"] == "shell"
+
+    def test_read_bindings_poll(self):
+        from corda_tpu.rpc.bindings import (
+            devicemon_snapshot_value,
+            slo_status_value,
+        )
+
+        class Proxy:
+            def __init__(self):
+                self.n = 0
+
+            def devicemon_snapshot(self):
+                self.n += 1
+                return {"enabled": False, "calls": self.n}
+
+            def slo_status(self):
+                return {"enabled": False}
+
+        proxy = Proxy()
+        v = devicemon_snapshot_value(proxy)
+        assert v.get()["calls"] == 1
+        v.refresh()
+        assert v.get()["calls"] == 2
+        assert slo_status_value(proxy).get() == {"enabled": False}

@@ -330,7 +330,12 @@ class TestMonitoringSurface:
         node_metrics().counter("serving.shed").inc()
         node_metrics().counter("verifier.device_failover").inc()
         snap = monitoring_snapshot()
-        assert set(snap) == {"serving", "profiler", "process"}
+        assert set(snap) == {"serving", "profiler", "devices", "slo",
+                             "process"}
+        # devicemon/slo are off by default: bare disabled markers, no
+        # slots laid out, no metrics created (ISSUE 7 overhead contract)
+        assert snap["devices"] == {"enabled": False}
+        assert snap["slo"] == {"enabled": False}
         assert "shed" in snap["serving"]
         assert "device_failover" not in snap["serving"]
         assert "verifier.device_failover" in snap["process"]

@@ -809,9 +809,22 @@ class TestBenchSmoke:
             assert prof["compile_count"] >= 1
             assert prof["execute_count"] >= 1
             assert 0 < prof["batch_efficiency"] <= 1.0
+        # acceptance (ISSUE 7): the devicemon pass emits a per-ordinal
+        # devices table whose rows/dispatches reconciled in-process
+        # against the scheduler's counters (deviceless CPU backend = a
+        # 1-device mesh); --check-schema below validates its shape
+        assert out["devicemon_rows"] == 10
+        assert out["devicemon_dispatches"] == 2
+        assert sum(
+            e["rows"] for e in out["devices"].values()
+        ) == out["devicemon_rows"]
+        for entry in out["devices"].values():
+            assert entry["inflight"] == 0
+            assert entry["rows"] <= entry["padded_rows"]
 
         # acceptance: a baseline generated from this same output gates
-        # green; an injected profile regression gates red
+        # green; an injected profile regression gates red — and the
+        # schema mode accepts the devices table
         result = tmp_path / "smoke.json"
         result.write_text(line)
         baseline = tmp_path / "PERF_BASELINE.json"
@@ -823,6 +836,8 @@ class TestBenchSmoke:
                 capture_output=True, text=True, timeout=60,
             )
 
+        schema = run_gate("--result", str(result), "--check-schema")
+        assert schema.returncode == 0, schema.stdout + schema.stderr
         wrote = run_gate("--result", str(result), "--write-baseline",
                          "--baseline", str(baseline))
         assert wrote.returncode == 0, wrote.stdout + wrote.stderr

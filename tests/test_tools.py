@@ -229,6 +229,35 @@ class TestPerfGate:
             "--result", str(bad2), "--check-schema"
         ).returncode == 1
 
+    def test_check_schema_validates_devices_section(self, tmp_path):
+        """ISSUE 7 satellite: the per-ordinal `devices` table the smoke's
+        devicemon pass emits is schema-validated — well-formed passes,
+        missing/negative counters and rows>padded fail."""
+        good = dict(self.SYNTHETIC)
+        good["devices"] = {
+            "0": {"dispatches": 2, "settles": 2, "rows": 10,
+                  "padded_rows": 16, "inflight": 0, "failures": 0},
+        }
+        ok = tmp_path / "devs.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d["0"].pop("settles"), "missing numeric 'settles'"),
+            (lambda d: d["0"].__setitem__("rows", -1), "negative rows"),
+            (lambda d: d["0"].__setitem__("rows", 99), "exceed padded"),
+            (lambda d: d.__setitem__("chip-a", dict(d["0"])),
+             "not an integer"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["devices"])
+            bad = tmp_path / "devs_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
     def test_gate_passes_in_tolerance_fails_on_20pct_regression(
         self, tmp_path
     ):

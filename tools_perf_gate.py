@@ -101,6 +101,12 @@ PROFILE_REQUIRED_KEYS = (
     "compile_s", "execute_total_s", "batch_efficiency",
 )
 
+# keys every per-ordinal devices entry must carry for --check-schema
+# (the per-device telemetry table bench.py --smoke emits — devicemon)
+DEVICES_REQUIRED_KEYS = (
+    "dispatches", "settles", "rows", "padded_rows",
+)
+
 
 def resolve_path(data: dict, path: str):
     """Walk a ``/``-separated path; None when any hop is missing or the
@@ -156,6 +162,43 @@ def check_schema(result: dict) -> list[str]:
                     problems.append(
                         f"profile/{kernel}: batch_efficiency {eff} "
                         "outside (0, 1]"
+                    )
+    devices = result.get("devices")
+    if devices is not None:
+        if not isinstance(devices, dict):
+            problems.append(
+                "devices: expected an object of per-ordinal entries"
+            )
+        else:
+            for ordinal, entry in devices.items():
+                if not str(ordinal).isdigit():
+                    problems.append(
+                        f"devices/{ordinal}: ordinal key is not an integer"
+                    )
+                if not isinstance(entry, dict):
+                    problems.append(
+                        f"devices/{ordinal}: expected an object"
+                    )
+                    continue
+                for key in DEVICES_REQUIRED_KEYS:
+                    v = entry.get(key)
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool):
+                        problems.append(
+                            f"devices/{ordinal}: missing numeric {key!r}"
+                        )
+                    elif v < 0:
+                        problems.append(
+                            f"devices/{ordinal}: negative {key} {v}"
+                        )
+                rows = entry.get("rows")
+                padded = entry.get("padded_rows")
+                if (isinstance(rows, (int, float))
+                        and isinstance(padded, (int, float))
+                        and rows > padded):
+                    problems.append(
+                        f"devices/{ordinal}: rows {rows} exceed padded "
+                        f"lanes {padded}"
                     )
     return problems
 
