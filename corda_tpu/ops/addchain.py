@@ -1,0 +1,106 @@
+"""Addition chains and batched inversion — the exponentiation toolbox.
+
+The signature kernels used to evaluate their fixed public exponents
+(field inversion a^(p−2), the decompression square root's a^((p−5)/8))
+with plain square-and-multiply.  For p = 2^255 − 19 both exponents are
+nearly all-ones, so each evaluation cost ~254 squarings **plus ~250
+multiplications** — twice the field work the exponent actually needs.
+This module carries the standard curve25519 addition chains (the ref10
+``pow225521``/``pow22523`` schedules): ~254 squarings and **11–12**
+multiplications, shared by every tier through two backend hooks:
+
+- ``mul(a, b)`` / ``sq(a)``: one field multiply / square;
+- ``sq_n(a, n)``: n successive squarings.  The pallas tiers unroll it in
+  Python (Mosaic needs static structure anyway); the XLA tier passes a
+  ``lax.fori_loop`` wrapper so its traced graph stays ~11 compact loops
+  instead of 254 inline multiplies (XLA:CPU compiles the unrolled form
+  pathologically slowly — the same lesson as fe25519's einsum split).
+
+Host-side Montgomery batch inversion lives here too (``batch_modinv``):
+k inverses for ONE modular exponentiation plus 3(k−1) multiplications.
+``secp256._prep_byte_planes`` already used the trick for the per-lane
+s⁻¹; the fixed-base comb table builders reuse it so a 256-entry table
+costs one inversion, not 256.
+
+Chain correctness is test-pinned against ``pow(x, e, p)`` over random
+ints, and the exported op counts against the real call counts
+(tests/test_ops_kernel_arith.py::TestAdditionChains — that suite needs
+no OpenSSL oracle, so it runs on minimal containers too).
+"""
+
+from __future__ import annotations
+
+P25519 = 2**255 - 19
+
+
+def chain_25519_core(z, sq, mul, sq_n):
+    """z → (z^11, z^(2^250 − 1)): the shared prefix of both exponent
+    chains (ref10's t0/t1/t2 schedule)."""
+    z2 = sq(z)                      # 2
+    z8 = sq_n(z2, 2)                # 8
+    z9 = mul(z, z8)                 # 9
+    z11 = mul(z2, z9)               # 11
+    z22 = sq(z11)                   # 22
+    z_5 = mul(z9, z22)              # 2^5 − 1
+    z_10 = mul(sq_n(z_5, 5), z_5)   # 2^10 − 1
+    z_20 = mul(sq_n(z_10, 10), z_10)
+    z_40 = mul(sq_n(z_20, 20), z_20)
+    z_50 = mul(sq_n(z_40, 10), z_10)
+    z_100 = mul(sq_n(z_50, 50), z_50)
+    z_200 = mul(sq_n(z_100, 100), z_100)
+    z_250 = mul(sq_n(z_200, 50), z_50)
+    return z11, z_250
+
+
+def pow_p_minus_2(z, sq, mul, sq_n=None):
+    """z^(p−2) for p = 2^255 − 19: field inversion in 254 S + 11 M
+    (z = 0 maps to 0 — callers gate on validity masks, not exceptions).
+
+    p − 2 = 2^255 − 21 = (2^250 − 1)·2^5 + 11."""
+    sq_n = sq_n or (lambda a, n: _sq_loop(a, n, sq))
+    z11, z_250 = chain_25519_core(z, sq, mul, sq_n)
+    return mul(sq_n(z_250, 5), z11)
+
+
+def pow_p_minus_5_over_8(z, sq, mul, sq_n=None):
+    """z^((p−5)/8) for p = 2^255 − 19: the decompression square-root
+    exponent, 251 S + 11 M.
+
+    (p − 5)/8 = 2^252 − 3 = (2^250 − 1)·2^2 + 1."""
+    sq_n = sq_n or (lambda a, n: _sq_loop(a, n, sq))
+    _z11, z_250 = chain_25519_core(z, sq, mul, sq_n)
+    return mul(sq_n(z_250, 2), z)
+
+
+def _sq_loop(a, n, sq):
+    for _ in range(n):
+        a = sq(a)
+    return a
+
+
+# The chains' op counts, exported for the kernel op model
+# (corda_tpu/ops/opcount.py) so the accounting can never drift from the
+# schedule actually shipped: (squarings, multiplies).
+INV_CHAIN_OPS = (254, 11)
+SQRT_CHAIN_OPS = (251, 11)
+
+
+def batch_modinv(values: list[int], m: int) -> list[int]:
+    """Montgomery batch inversion mod ``m``: ONE modular exponentiation +
+    3(k−1) multiplications for k inverses.  Every input must be nonzero
+    mod m (callers pre-check); host-side Python ints only."""
+    k = len(values)
+    if k == 0:
+        return []
+    prefix = [0] * k  # prefix[i] = v0·v1·…·vi mod m
+    acc = 1
+    for i, v in enumerate(values):
+        acc = acc * v % m
+        prefix[i] = acc
+    inv_all = pow(acc, m - 2, m)
+    out = [0] * k
+    for i in range(k - 1, 0, -1):
+        out[i] = inv_all * prefix[i - 1] % m
+        inv_all = inv_all * values[i] % m
+    out[0] = inv_all
+    return out

@@ -51,7 +51,7 @@ from .fe25519 import (
     fe_mul,
     fe_mul_small,
     fe_neg,
-    fe_pow_const,
+    fe_pow_sqrt,
     fe_sq,
     fe_sub,
     int_to_limbs,
@@ -204,7 +204,7 @@ def decompress(y: jax.Array, sign: jax.Array) -> tuple[Point, jax.Array]:
     v = fe_add(fe_mul(_const_fe(_D_L, b), y2), one)
     v3 = fe_mul(fe_sq(v), v)
     v7 = fe_mul(fe_sq(v3), v)
-    x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), (P - 5) // 8))
+    x = fe_mul(fe_mul(u, v3), fe_pow_sqrt(fe_mul(u, v7)))
     vx2 = fe_mul(v, fe_sq(x))
     root_ok = fe_eq(vx2, u)
     flip_ok = fe_eq(vx2, fe_neg(u))

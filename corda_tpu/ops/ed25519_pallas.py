@@ -17,14 +17,22 @@ radix-256 bit-serial kernel):
   wrap is split as 9728 = 2·4096 + 1536 across limbs 0 and 1 so wrap
   carries cannot overflow int32.
 
-- **Dual 4-bit-window Straus ladder**: 64 windows × (4 doubles + 2 table
-  adds) = 256 doubles + 128 adds, versus 256 doubles + 256 adds for the
-  bit-serial joint ladder. The fixed-base table (multiples 0..15 of B) is
-  a compile-time constant in precomputed ``(y−x, y+x, 2dt)`` form (7-mul
-  mixed adds); the variable-base table (multiples 0..15 of −A) is built
-  per block (15 point ops) and pre-transformed to ``(Y−X, Y+X, 2dT, 2Z)``
-  form (8-mul adds). Doubles that feed another double skip the T output
-  (dbl-2008-hwcd never reads T1): 7 muls instead of 8.
+- **Split-window Straus ladder**: the variable base (−A, built per
+  block) keeps 4-bit windows — 64 table adds from a 16-entry table pre-
+  transformed to ``(Y−X, Y+X, 2dT, 2Z)`` form (8-mul adds) — while the
+  FIXED base B, whose table is a compile-time constant, uses an 8-bit
+  comb: 32 mixed adds (7-mul, ``(y−x, y+x, 2dt)`` form) from a 256-entry
+  table, half the fixed-base adds of the r5 dual-4-bit shape. The comb
+  rides the variable base's doubling chain (adds land on even windows
+  only), so no extra doubles are paid; the trade is a 256-way constant-
+  table select per comb add vs two 16-way selects — MAC count strictly
+  drops, select cost awaits an on-chip A/B
+  (``CORDA_TPU_ED25519_FIXED_WIN=4`` pins the r5 shape). Doubles that
+  feed another double skip the T output (dbl-2008-hwcd never reads T1):
+  7 muls instead of 8. The fixed exponent chains (inversion a^(p−2),
+  decompression sqrt a^((p−5)/8)) run the standard curve25519 addition
+  chains (254 S + 11 M / 251 S + 11 M — square-and-multiply paid ~250
+  extra muls each on these near-all-ones exponents; ops/addchain.py).
 
 Lazy-carry invariants (values congruent mod p, limbs bounded):
   M  = mul/sub output:   limb0 ≤ 5631, limbs 1..21 ≤ 4116
@@ -105,29 +113,61 @@ def _affine_add(p1, p2):
     return (x3, y3)
 
 
-def _b_table_host() -> list[tuple[int, int, int]]:
-    """(y−x, y+x, 2d·x·y) mod p for i·B, i = 0..15; i=0 is the identity."""
+def _ext_add_host(p1, p2):
+    """Extended-coordinate (X:Y:Z:T) Edwards add over Python ints —
+    inversion-free, so table builds cost bigint muls only."""
+    x1, y1, z1, t1 = p1
+    x2, y2, z2, t2 = p2
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * _D * t1 % P * t2 % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = (b - a) % P, (d - c) % P, (d + c) % P, (b + a) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+@functools.lru_cache(maxsize=4)
+def _b_comb_host(n: int = 256) -> list[tuple[int, int, int]]:
+    """(y−x, y+x, 2d·x·y) mod p for v·B, v = 0..n−1 (v=0 → identity).
+
+    n=16 is the 4-bit window tier's table, n=256 the 8-bit comb. Built
+    projectively and normalized with ONE Montgomery-batched inversion
+    (ops/addchain.py) — not n per-entry inversions."""
+    from .addchain import batch_modinv
+
+    b_ext = (_BX, _BY, 1, _BX * _BY % P)
+    pts = [(0, 1, 1, 0)]
+    for _ in range(n - 1):
+        pts.append(_ext_add_host(pts[-1], b_ext))
     rows = []
-    pt = (0, 1)
-    for _ in range(16):
-        x, y = pt
+    for (px, py, _pz, _pt), zi in zip(
+        pts, batch_modinv([pt[2] for pt in pts], P)
+    ):
+        x, y = px * zi % P, py * zi % P
         rows.append(((y - x) % P, (y + x) % P, 2 * _D * x % P * y % P))
-        pt = _affine_add(pt, (_BX, _BY))
     return rows
 
 
-# ------------------------------------------------- consts matrix (64, 128)
+def _b_table_host() -> list[tuple[int, int, int]]:
+    """(y−x, y+x, 2d·x·y) mod p for i·B, i = 0..15; i=0 is the identity."""
+    return list(_b_comb_host(256)[:16])  # prefix of the cached comb build
+
+
+# ----------------------------------------------- consts matrix (824, 128)
 # row 0: K2 (subtraction offset)    row 1: p    row 2: d    row 3: 2d
 # row 4: sqrt(-1)                   rows 8+3i..10+3i: B-table entry i
-_CONSTS_HOST = np.zeros((64, 128), dtype=np.int32)
+# rows 56+3v..58+3v (v = 0..255): 8-bit comb entry v·B
+_CONSTS_HOST = np.zeros((824, 128), dtype=np.int32)
 _CONSTS_HOST[0, :LIMBS] = _K2
 _CONSTS_HOST[1, :LIMBS] = _P12
 _CONSTS_HOST[2, :LIMBS] = int_to_limbs12(_D)
 _CONSTS_HOST[3, :LIMBS] = int_to_limbs12(_D2)
 _CONSTS_HOST[4, :LIMBS] = int_to_limbs12(_SQRT_M1)
-for _i, _row in enumerate(_b_table_host()):
+for _v, _row in enumerate(_b_comb_host(256)):
     for _c in range(3):
-        _CONSTS_HOST[8 + 3 * _i + _c, :LIMBS] = int_to_limbs12(_row[_c])
+        if _v < 16:
+            _CONSTS_HOST[8 + 3 * _v + _c, :LIMBS] = int_to_limbs12(_row[_c])
+        _CONSTS_HOST[56 + 3 * _v + _c, :LIMBS] = int_to_limbs12(_row[_c])
 
 
 @dataclasses.dataclass
@@ -140,6 +180,7 @@ class Env:
     d2: jax.Array
     sqrt_m1: jax.Array
     b_table: tuple       # 16 × (ymx, ypx, t2d) const planes
+    b_comb: tuple | None = None   # 256 × comb entries (8-bit fixed base)
 
 
 # ------------------------------------------------- limb-major field ops
@@ -249,7 +290,10 @@ def fe_mul_small(a, k):
 
 def fe_pow_const(a, exponent: int):
     """a^e for a compile-time exponent, square-and-multiply unrolled in
-    Python (no dynamic indexing — Mosaic restriction)."""
+    Python (no dynamic indexing — Mosaic restriction). The hot exponents
+    (p−2, (p−5)/8) do NOT come through here any more: their addition
+    chains (fe_inv_chain / fe_pow_sqrt_chain) spend ~11 multiplies where
+    square-and-multiply spent ~250."""
     n = exponent.bit_length()
     r = None
     for i in range(n):
@@ -259,6 +303,21 @@ def fe_pow_const(a, exponent: int):
             r = a if r is None else fe_mul(r, a)
     assert r is not None
     return r
+
+
+def fe_inv_chain(a):
+    """a^(p−2) via the curve25519 addition chain (254 S + 11 M),
+    unrolled — Mosaic needs static structure."""
+    from .addchain import pow_p_minus_2
+
+    return pow_p_minus_2(a, fe_sq, fe_mul)
+
+
+def fe_pow_sqrt_chain(a):
+    """a^((p−5)/8) via the addition chain (251 S + 11 M)."""
+    from .addchain import pow_p_minus_5_over_8
+
+    return pow_p_minus_5_over_8(a, fe_sq, fe_mul)
 
 
 def fe_canonical(env, a):
@@ -401,14 +460,18 @@ def point_neg(env, p):
     return (fe_neg(env, px), py, pz, fe_neg(env, pt))
 
 
-def _select16(idx_row, entries):
-    """Branch-free 16-way select: binary tree of wheres on idx bits.
+def _select_table(idx_row, entries):
+    """Branch-free 2^k-way select: binary tree of wheres on idx bits.
 
-    entries: list of 16 tuples of (22, blk) planes; idx_row: (blk,) int32
-    in [0, 16). Select cost (~15 wheres per plane) is ~7% of one mul —
-    negligible next to the table add it feeds."""
-    level = entries
-    for bit in range(4):
+    entries: list of 2^k tuples of (22, blk) planes; idx_row: (blk,)
+    int32 in [0, 2^k). 2^k − 1 entry-selects total — for the 16-entry
+    tables that is small next to the table add it feeds; the 256-entry
+    comb pays ~16x the select work to HALVE the fixed-base adds (the
+    MAC count strictly drops; whether the wider select's cheap-ALU ops
+    cost wall time is the comb-vs-window on-chip A/B,
+    CORDA_TPU_ED25519_FIXED_WIN)."""
+    level = list(entries)
+    for bit in range((len(entries) - 1).bit_length()):
         b_mask = ((idx_row >> bit) & 1) == 1
         level = [
             tuple(
@@ -420,6 +483,10 @@ def _select16(idx_row, entries):
     return level[0]
 
 
+# 16-way alias: the name the component tests and the sign kernel bind
+_select16 = _select_table
+
+
 def decompress(env, y, sign_row):
     """RFC 8032 §5.1.3: y limbs (< p, host-checked) + parity bit →
     (Point, ok-mask); off-curve lanes flagged and carry harmless garbage."""
@@ -429,7 +496,7 @@ def decompress(env, y, sign_row):
     v = fe_carry1(fe_add(fe_mul(env.d, y2), one))
     v3 = fe_mul(fe_sq(v), v)
     v7 = fe_mul(fe_sq(v3), v)
-    x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), _SQRT_EXP))
+    x = fe_mul(fe_mul(u, v3), fe_pow_sqrt_chain(fe_mul(u, v7)))
     vx2 = fe_mul(v, fe_sq(x))
     root_ok = fe_eq(env, vx2, u)
     flip_ok = fe_eq(env, vx2, fe_neg(env, u))
@@ -445,7 +512,7 @@ def compress_y_parity(env, p):
     """Point → (canonical y limbs, x parity): the comparable form of the
     32-byte encoding without materializing bytes."""
     px, py, pz, _ = p
-    zinv = fe_pow_const(pz, _INV_EXP)
+    zinv = fe_inv_chain(pz)
     x = fe_canonical(env, fe_mul(px, zinv))
     y = fe_canonical(env, fe_mul(py, zinv))
     return y, x[0, :] & 1
@@ -453,63 +520,84 @@ def compress_y_parity(env, p):
 
 # ------------------------------------------------------------- kernel
 
-def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
-                   sign_ref, pre_ref, out_ref):
-    from jax.experimental import pallas as pl
+def _make_verify_kernel(fixed_win: int):
+    def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
+                       sign_ref, pre_ref, out_ref):
+        from jax.experimental import pallas as pl
 
-    blk = a_y_ref.shape[1]
-    consts = consts_ref[:, :]
+        blk = a_y_ref.shape[1]
+        consts = consts_ref[:, :]
 
-    def cfull(i):
-        return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
+        def cfull(i):
+            return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
 
-    env = Env(
-        k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
-        sqrt_m1=cfull(4),
-        b_table=tuple(
-            (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
-            for i in range(16)
-        ),
-    )
+        env = Env(
+            k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+            sqrt_m1=cfull(4),
+            b_table=tuple(
+                (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+                for i in range(16)
+            ) if fixed_win == 4 else None,
+            b_comb=tuple(
+                (cfull(56 + 3 * v), cfull(57 + 3 * v), cfull(58 + 3 * v))
+                for v in range(256)
+            ) if fixed_win == 8 else None,
+        )
 
-    a_y = a_y_ref[:, :][:LIMBS]
-    r12 = r_ref[:, :][:LIMBS]
-    sign_row = sign_ref[0, :]
+        a_y = a_y_ref[:, :][:LIMBS]
+        r12 = r_ref[:, :][:LIMBS]
+        sign_row = sign_ref[0, :]
 
-    a_pt, a_ok = decompress(env, a_y, sign_row)
-    minus_a = point_neg(env, a_pt)
+        a_pt, a_ok = decompress(env, a_y, sign_row)
+        minus_a = point_neg(env, a_pt)
 
-    # per-lane table: k·(−A) for k = 0..15, in (Y−X, Y+X, 2dT, 2Z) form
-    pts = [identity_point(blk), minus_a]
-    for k in range(2, 16):
-        if k % 2 == 0:
-            pts.append(point_double(env, pts[k // 2]))
-        else:
-            pts.append(point_add(env, pts[k - 1], minus_a))
-    a_table = [to_planes(env, pt) for pt in pts]
+        # per-lane table: k·(−A) for k = 0..15, in (Y−X, Y+X, 2dT, 2Z) form
+        pts = [identity_point(blk), minus_a]
+        for k in range(2, 16):
+            if k % 2 == 0:
+                pts.append(point_double(env, pts[k // 2]))
+            else:
+                pts.append(point_add(env, pts[k - 1], minus_a))
+        a_table = [to_planes(env, pt) for pt in pts]
 
-    def chunk_body(cj, acc):
-        # dynamic sublane offsets must be 8-aligned: read 8 window rows at
-        # a time (MSB-first: chunk cj covers windows 63−8·cj … 56−8·cj)
-        base_row = 56 - 8 * cj
-        s_rows = s_win_ref[pl.ds(base_row, 8), :]   # (8, blk)
-        h_rows = h_win_ref[pl.ds(base_row, 8), :]
-        for k in range(7, -1, -1):
-            for i in range(4):
-                acc = point_double(env, acc, want_t=(i == 3))
-            acc = _add_b_entry(env, acc, _select16(s_rows[k, :], env.b_table))
-            acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
-        return acc
+        def chunk_body(cj, acc):
+            # dynamic sublane offsets must be 8-aligned: read 8 window rows
+            # at a time (MSB-first: chunk cj covers windows 63−8·cj…56−8·cj)
+            base_row = 56 - 8 * cj
+            s_rows = s_win_ref[pl.ds(base_row, 8), :]   # (8, blk)
+            h_rows = h_win_ref[pl.ds(base_row, 8), :]
+            for k in range(7, -1, -1):
+                for i in range(4):
+                    acc = point_double(env, acc, want_t=(i == 3))
+                if env.b_comb is not None:
+                    # 8-bit comb: the fixed-base add lands on EVEN windows
+                    # only, carrying the odd window's digit ×16 (pairs
+                    # never straddle a chunk — chunks are 8-aligned)
+                    if k % 2 == 0:
+                        acc = _add_b_entry(env, acc, _select_table(
+                            s_rows[k, :] + 16 * s_rows[k + 1, :],
+                            env.b_comb,
+                        ))
+                else:
+                    acc = _add_b_entry(
+                        env, acc, _select16(s_rows[k, :], env.b_table)
+                    )
+                acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
+            return acc
 
-    result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
-    enc_y, enc_parity = compress_y_parity(env, result)
+        result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
+        enc_y, enc_parity = compress_y_parity(env, result)
 
-    r_y = jnp.concatenate([r12[: LIMBS - 1], r12[LIMBS - 1 :] & 7], axis=0)
-    r_sign = (r12[LIMBS - 1, :] >> 3) & 1
-    match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
-    verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
-    # 8-sublane output block (1-row vector blocks crash Mosaic windowing)
-    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
+        r_y = jnp.concatenate(
+            [r12[: LIMBS - 1], r12[LIMBS - 1 :] & 7], axis=0
+        )
+        r_sign = (r12[LIMBS - 1, :] >> 3) & 1
+        match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
+        verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
+        # 8-sublane output block (1-row vectors crash Mosaic windowing)
+        out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
+
+    return _verify_kernel
 
 
 # ------------------------------------------------------- device-side prep
@@ -563,6 +651,18 @@ def _use_radix_8192() -> bool:
     ).strip() == "8192"
 
 
+def _fixed_base_win() -> int:
+    """Fixed-base table shape (read at trace time — set before first use,
+    like the radix switch): 8 = the 256-entry comb (32 mixed adds per
+    verify, production default), 4 = the r5 16-entry window tier (64
+    adds; CORDA_TPU_ED25519_FIXED_WIN=4 pins it for fallback + A/B)."""
+    import os
+
+    return 4 if os.environ.get(
+        "CORDA_TPU_ED25519_FIXED_WIN", "8"
+    ).strip() == "4" else 8
+
+
 def verify_pallas_windows(
     y_bytes: jax.Array,    # (B, 32) uint8 pubkey y bytes (top bit cleared)
     r_bytes: jax.Array,    # (B, 32) uint8 signature R
@@ -572,6 +672,7 @@ def verify_pallas_windows(
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
     block: int | None = None,
+    fixed_win: int | None = None,
 ) -> jax.Array:
     """Launch the kernel with the challenge already in window form (the
     fused on-device SHA-512→mod-L path lands here)."""
@@ -580,13 +681,14 @@ def verify_pallas_windows(
 
         return ed25519_pallas13.verify_pallas_windows(
             y_bytes, r_bytes, s_bytes, h_win_t, sign, precheck,
-            interpret=interpret, block=block,
+            interpret=interpret, block=block, fixed_win=fixed_win,
         )
     from jax.experimental import pallas as pl
 
     from ._blockpack import ED25519_BLOCK
 
     block = block or ED25519_BLOCK
+    fixed_win = fixed_win or _fixed_base_win()
     b = y_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
@@ -598,25 +700,30 @@ def verify_pallas_windows(
     def col_spec(rows):
         return pl.BlockSpec((rows, block), lambda i: (0, i))
 
+    # win4 ships only the first 64 consts rows (the r5 shape — the comb's
+    # 766 unused rows must not ride along in VMEM on the fallback/A-B leg)
+    consts = _CONSTS_HOST if fixed_win == 8 else _CONSTS_HOST[:64]
     mask = pl.pallas_call(
-        _verify_kernel,
+        _make_verify_kernel(fixed_win),
         out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(_CONSTS_HOST.shape, lambda i: (0, 0)),
+            pl.BlockSpec(consts.shape, lambda i: (0, 0)),
             col_spec(24), col_spec(24), col_spec(64), col_spec(64),
             col_spec(8), col_spec(8),
         ],
         out_specs=col_spec(8),
         interpret=interpret,
     )(
-        jnp.asarray(_CONSTS_HOST),
+        jnp.asarray(consts),
         a_y_t, r_t, s_win_t, h_win_t, _pad8(sign), _pad8(precheck),
     )
     return mask[0] != 0
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "block", "fixed_win")
+)
 def ed25519_verify_pallas(
     y_bytes: jax.Array,    # (B, 32) uint8 pubkey y bytes (top bit cleared)
     r_bytes: jax.Array,    # (B, 32) uint8 signature R
@@ -626,8 +733,10 @@ def ed25519_verify_pallas(
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
     block: int | None = None,
+    fixed_win: int | None = None,
 ) -> jax.Array:
     return verify_pallas_windows(
         y_bytes, r_bytes, s_bytes, bytes_to_windows_t(h_bytes),
         sign, precheck, interpret=interpret, block=block,
+        fixed_win=fixed_win,
     )

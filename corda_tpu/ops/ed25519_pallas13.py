@@ -1,9 +1,12 @@
 """Pallas TPU kernel for batched ed25519 verification — radix-8192 tier.
 
 The r5 widening of the production radix-4096 kernel
-(``ed25519_pallas.py`` — same dual-4-bit-window Straus ladder, same
-reference hot path Crypto.kt:621-624): 20 little-endian 13-bit limbs in
-int32 lanes instead of 22 × 12-bit. Why this helps, measured not assumed:
+(``ed25519_pallas.py`` — same split-window Straus ladder: 4-bit variable
+base + 8-bit fixed-base comb, same reference hot path
+Crypto.kt:621-624): 20 little-endian 13-bit limbs in int32 lanes instead
+of 22 × 12-bit. The comb/window switch, the fixed-base tables, and the
+addition-chain exponentiations are shared with (imported from) the
+radix-4096 module; see its header for the comb layout and chain counts. Why this helps, measured not assumed:
 the r5 fast-squaring A/B showed the ladder is MAC-bound (a 24% MAC
 reduction bought +25% throughput), and radix-8192 removes another ~17%
 of MACs — 400 per schoolbook mul (210 per square) vs 484 (253).
@@ -46,7 +49,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from .ed25519 import _D, _SQRT_M1, P
-from .ed25519_pallas import _b_table_host, bytes_to_windows_t, _pad8
+from .ed25519_pallas import (
+    _b_comb_host,
+    _fixed_base_win,
+    _pad8,
+    _select_table,
+    bytes_to_windows_t,
+)
 
 LIMBS = 20
 RADIX = 13
@@ -84,16 +93,19 @@ _K2 = _k2_limbs()
 _P13 = int_to_limbs13(P)
 
 # consts matrix rows mirror the radix-4096 kernel's layout:
-# 0 K2, 1 p, 2 d, 3 2d, 4 sqrt(-1), 8+3i..10+3i: B-table entry i
-_CONSTS_HOST = np.zeros((64, 128), dtype=np.int32)
+# 0 K2, 1 p, 2 d, 3 2d, 4 sqrt(-1), 8+3i..10+3i: B-table entry i,
+# 56+3v..58+3v (v = 0..255): 8-bit comb entry v·B
+_CONSTS_HOST = np.zeros((824, 128), dtype=np.int32)
 _CONSTS_HOST[0, :LIMBS] = _K2
 _CONSTS_HOST[1, :LIMBS] = _P13
 _CONSTS_HOST[2, :LIMBS] = int_to_limbs13(_D)
 _CONSTS_HOST[3, :LIMBS] = int_to_limbs13(_D2)
 _CONSTS_HOST[4, :LIMBS] = int_to_limbs13(_SQRT_M1)
-for _i, _row in enumerate(_b_table_host()):
+for _v, _row in enumerate(_b_comb_host(256)):
     for _c in range(3):
-        _CONSTS_HOST[8 + 3 * _i + _c, :LIMBS] = int_to_limbs13(_row[_c])
+        if _v < 16:
+            _CONSTS_HOST[8 + 3 * _v + _c, :LIMBS] = int_to_limbs13(_row[_c])
+        _CONSTS_HOST[56 + 3 * _v + _c, :LIMBS] = int_to_limbs13(_row[_c])
 
 
 @dataclasses.dataclass
@@ -106,6 +118,7 @@ class Env:
     d2: jax.Array
     sqrt_m1: jax.Array
     b_table: tuple
+    b_comb: tuple | None = None   # 256 × comb entries (8-bit fixed base)
 
 
 # ------------------------------------------------- limb-major field ops
@@ -193,6 +206,21 @@ def fe_pow_const(a, exponent: int):
             r = a if r is None else fe_mul(r, a)
     assert r is not None
     return r
+
+
+def fe_inv_chain(a):
+    """a^(p−2) via the curve25519 addition chain (254 S + 11 M) —
+    square-and-multiply paid ~250 extra muls on this exponent."""
+    from .addchain import pow_p_minus_2
+
+    return pow_p_minus_2(a, fe_sq, fe_mul)
+
+
+def fe_pow_sqrt_chain(a):
+    """a^((p−5)/8) via the addition chain (251 S + 11 M)."""
+    from .addchain import pow_p_minus_5_over_8
+
+    return pow_p_minus_5_over_8(a, fe_sq, fe_mul)
 
 
 def fe_canonical(env, a):
@@ -324,18 +352,8 @@ def point_neg(env, p):
     return (fe_neg(env, px), py, pz, fe_neg(env, pt))
 
 
-def _select16(idx_row, entries):
-    level = entries
-    for bit in range(4):
-        b_mask = ((idx_row >> bit) & 1) == 1
-        level = [
-            tuple(
-                jnp.where(b_mask[None, :], hi_p, lo_p)
-                for lo_p, hi_p in zip(lo, hi)
-            )
-            for lo, hi in zip(level[0::2], level[1::2])
-        ]
-    return level[0]
+# one select-tree implementation across tiers (radix-4096 module owns it)
+_select16 = _select_table
 
 
 def decompress(env, y, sign_row):
@@ -345,7 +363,7 @@ def decompress(env, y, sign_row):
     v = fe_add(fe_mul(env.d, y2), one)
     v3 = fe_mul(fe_sq(v), v)
     v7 = fe_mul(fe_sq(v3), v)
-    x = fe_mul(fe_mul(u, v3), fe_pow_const(fe_mul(u, v7), _SQRT_EXP))
+    x = fe_mul(fe_mul(u, v3), fe_pow_sqrt_chain(fe_mul(u, v7)))
     vx2 = fe_mul(v, fe_sq(x))
     root_ok = fe_eq(env, vx2, u)
     flip_ok = fe_eq(env, vx2, fe_neg(env, u))
@@ -359,7 +377,7 @@ def decompress(env, y, sign_row):
 
 def compress_y_parity(env, p):
     px, py, pz, _ = p
-    zinv = fe_pow_const(pz, _INV_EXP)
+    zinv = fe_inv_chain(pz)
     x = fe_canonical(env, fe_mul(px, zinv))
     y = fe_canonical(env, fe_mul(py, zinv))
     return y, x[0, :] & 1
@@ -367,62 +385,80 @@ def compress_y_parity(env, p):
 
 # ------------------------------------------------------------- kernel
 
-def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
-                   sign_ref, pre_ref, out_ref):
-    from jax.experimental import pallas as pl
+def _make_verify_kernel(fixed_win: int):
+    def _verify_kernel(consts_ref, a_y_ref, r_ref, s_win_ref, h_win_ref,
+                       sign_ref, pre_ref, out_ref):
+        from jax.experimental import pallas as pl
 
-    blk = a_y_ref.shape[1]
-    consts = consts_ref[:, :]
+        blk = a_y_ref.shape[1]
+        consts = consts_ref[:, :]
 
-    def cfull(i):
-        return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
+        def cfull(i):
+            return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
 
-    env = Env(
-        k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
-        sqrt_m1=cfull(4),
-        b_table=tuple(
-            (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
-            for i in range(16)
-        ),
-    )
+        env = Env(
+            k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+            sqrt_m1=cfull(4),
+            b_table=tuple(
+                (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+                for i in range(16)
+            ) if fixed_win == 4 else None,
+            b_comb=tuple(
+                (cfull(56 + 3 * v), cfull(57 + 3 * v), cfull(58 + 3 * v))
+                for v in range(256)
+            ) if fixed_win == 8 else None,
+        )
 
-    a_y = a_y_ref[:, :][:LIMBS]
-    r13 = r_ref[:, :][:LIMBS]
-    sign_row = sign_ref[0, :]
+        a_y = a_y_ref[:, :][:LIMBS]
+        r13 = r_ref[:, :][:LIMBS]
+        sign_row = sign_ref[0, :]
 
-    a_pt, a_ok = decompress(env, a_y, sign_row)
-    minus_a = point_neg(env, a_pt)
+        a_pt, a_ok = decompress(env, a_y, sign_row)
+        minus_a = point_neg(env, a_pt)
 
-    pts = [identity_point(blk), minus_a]
-    for k in range(2, 16):
-        if k % 2 == 0:
-            pts.append(point_double(env, pts[k // 2]))
-        else:
-            pts.append(point_add(env, pts[k - 1], minus_a))
-    a_table = [to_planes(env, pt) for pt in pts]
+        pts = [identity_point(blk), minus_a]
+        for k in range(2, 16):
+            if k % 2 == 0:
+                pts.append(point_double(env, pts[k // 2]))
+            else:
+                pts.append(point_add(env, pts[k - 1], minus_a))
+        a_table = [to_planes(env, pt) for pt in pts]
 
-    def chunk_body(cj, acc):
-        base_row = 56 - 8 * cj
-        s_rows = s_win_ref[pl.ds(base_row, 8), :]
-        h_rows = h_win_ref[pl.ds(base_row, 8), :]
-        for k in range(7, -1, -1):
-            for i in range(4):
-                acc = point_double(env, acc, want_t=(i == 3))
-            acc = _add_b_entry(env, acc, _select16(s_rows[k, :], env.b_table))
-            acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
-        return acc
+        def chunk_body(cj, acc):
+            base_row = 56 - 8 * cj
+            s_rows = s_win_ref[pl.ds(base_row, 8), :]
+            h_rows = h_win_ref[pl.ds(base_row, 8), :]
+            for k in range(7, -1, -1):
+                for i in range(4):
+                    acc = point_double(env, acc, want_t=(i == 3))
+                if env.b_comb is not None:
+                    # 8-bit comb: fixed-base adds land on even windows
+                    # only (see the radix-4096 kernel's walk)
+                    if k % 2 == 0:
+                        acc = _add_b_entry(env, acc, _select_table(
+                            s_rows[k, :] + 16 * s_rows[k + 1, :],
+                            env.b_comb,
+                        ))
+                else:
+                    acc = _add_b_entry(
+                        env, acc, _select16(s_rows[k, :], env.b_table)
+                    )
+                acc = _add_q_planes(env, acc, _select16(h_rows[k, :], a_table))
+            return acc
 
-    result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
-    enc_y, enc_parity = compress_y_parity(env, result)
+        result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
+        enc_y, enc_parity = compress_y_parity(env, result)
 
-    # bit 255 (the sign) lives at limb 19 bit 8; y's limb 19 is 8 bits
-    r_y = jnp.concatenate(
-        [r13[: LIMBS - 1], r13[LIMBS - 1 :] & 255], axis=0
-    )
-    r_sign = (r13[LIMBS - 1, :] >> 8) & 1
-    match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
-    verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
-    out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
+        # bit 255 (the sign) lives at limb 19 bit 8; y's limb 19 is 8 bits
+        r_y = jnp.concatenate(
+            [r13[: LIMBS - 1], r13[LIMBS - 1 :] & 255], axis=0
+        )
+        r_sign = (r13[LIMBS - 1, :] >> 8) & 1
+        match = jnp.all(enc_y == r_y, axis=0) & (enc_parity == r_sign)
+        verdict = (a_ok & match & (pre_ref[0, :] == 1)).astype(jnp.int32)
+        out_ref[:, :] = jnp.broadcast_to(verdict[None, :], (8, blk))
+
+    return _verify_kernel
 
 
 # ------------------------------------------------------- device-side prep
@@ -453,6 +489,7 @@ def verify_pallas_windows(
     precheck: jax.Array,
     interpret: bool = False,
     block: int | None = None,
+    fixed_win: int | None = None,
 ) -> jax.Array:
     """Same contract as ed25519_pallas.verify_pallas_windows, radix-8192."""
     from jax.experimental import pallas as pl
@@ -460,6 +497,7 @@ def verify_pallas_windows(
     from ._blockpack import ED25519_BLOCK
 
     block = block or ED25519_BLOCK
+    fixed_win = fixed_win or _fixed_base_win()
     b = y_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
@@ -471,25 +509,29 @@ def verify_pallas_windows(
     def col_spec(rows):
         return pl.BlockSpec((rows, block), lambda i: (0, i))
 
+    # win4 ships only the first 64 consts rows (see the radix-4096 tier)
+    consts = _CONSTS_HOST if fixed_win == 8 else _CONSTS_HOST[:64]
     mask = pl.pallas_call(
-        _verify_kernel,
+        _make_verify_kernel(fixed_win),
         out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(_CONSTS_HOST.shape, lambda i: (0, 0)),
+            pl.BlockSpec(consts.shape, lambda i: (0, 0)),
             col_spec(24), col_spec(24), col_spec(64), col_spec(64),
             col_spec(8), col_spec(8),
         ],
         out_specs=col_spec(8),
         interpret=interpret,
     )(
-        jnp.asarray(_CONSTS_HOST),
+        jnp.asarray(consts),
         a_y_t, r_t, s_win_t, h_win_t, _pad8(sign), _pad8(precheck),
     )
     return mask[0] != 0
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "block", "fixed_win")
+)
 def ed25519_verify_pallas(
     y_bytes: jax.Array,
     r_bytes: jax.Array,
@@ -499,8 +541,10 @@ def ed25519_verify_pallas(
     precheck: jax.Array,
     interpret: bool = False,
     block: int | None = None,
+    fixed_win: int | None = None,
 ) -> jax.Array:
     return verify_pallas_windows(
         y_bytes, r_bytes, s_bytes, bytes_to_windows_t(h_bytes),
         sign, precheck, interpret=interpret, block=block,
+        fixed_win=fixed_win,
     )

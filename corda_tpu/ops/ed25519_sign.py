@@ -120,9 +120,9 @@ def _comb_kernel(consts_ref, r_win_ref, y_out_ref, parity_ref):
 
     result = jax.lax.fori_loop(0, 8, chunk_body, identity_point(blk))
     px, py, pz, _ = result
-    from .ed25519_pallas import fe_mul, fe_pow_const, _INV_EXP
+    from .ed25519_pallas import fe_inv_chain, fe_mul
 
-    zinv = fe_pow_const(pz, _INV_EXP)
+    zinv = fe_inv_chain(pz)
     x = fe_canonical(env, fe_mul(px, zinv))
     y = fe_canonical(env, fe_mul(py, zinv))
     y_out_ref[:, :] = jnp.pad(y, ((0, 24 - LIMBS), (0, 0)))

@@ -136,10 +136,31 @@ def fe_pow_const(a: jax.Array, exponent: int) -> jax.Array:
     return jax.lax.fori_loop(0, nbits, body, one)
 
 
+def _sq_n(a: jax.Array, n: int) -> jax.Array:
+    """n successive squarings as ONE fori_loop — the addition chains'
+    squaring runs stay compact in the traced graph (XLA:CPU compiles the
+    unrolled form pathologically slowly, same lesson as the einsum split
+    above)."""
+    return jax.lax.fori_loop(0, n, lambda _i, r: fe_sq(r), a)
+
+
 def fe_inv(a: jax.Array) -> jax.Array:
-    """Fermat inversion a^(p-2); a == 0 maps to 0 (callers gate on validity
-    masks, never on exceptions — invalid lanes compute garbage safely)."""
-    return fe_pow_const(a, P - 2)
+    """Inversion a^(p-2) via the standard curve25519 addition chain
+    (254 S + 11 M — square-and-multiply paid ~250 extra multiplies for
+    this near-all-ones exponent); a == 0 maps to 0 (callers gate on
+    validity masks, never on exceptions — invalid lanes compute garbage
+    safely)."""
+    from .addchain import pow_p_minus_2
+
+    return pow_p_minus_2(a, fe_sq, fe_mul, _sq_n)
+
+
+def fe_pow_sqrt(a: jax.Array) -> jax.Array:
+    """a^((p-5)/8) via the addition chain (251 S + 11 M): the RFC 8032
+    decompression square-root exponent."""
+    from .addchain import pow_p_minus_5_over_8
+
+    return pow_p_minus_5_over_8(a, fe_sq, fe_mul, _sq_n)
 
 
 def fe_canonical(a: jax.Array) -> jax.Array:

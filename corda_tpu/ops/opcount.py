@@ -1,0 +1,200 @@
+"""Parameterized per-verify VPU op model for the signature kernels.
+
+bench.py's MFU table used to convert measured sigs/sec into achieved
+ops/sec through HAND-WRITTEN constants ("~3,150 field muls × 550 ops")
+that silently went stale: the r5 capture still described the radix-4096
+ed25519 tier after radix-8192 became the production default. This module
+derives the counts FROM THE KERNEL PARAMETERS — limb counts, fold tables,
+window/comb shapes, addition-chain schedules — and reads the ACTIVE tier
+switches, so the emitted ``mfu`` section always describes the kernel that
+actually ran, and a tier change moves the model with it (consistency is
+test-pinned in tests/test_tools.py::TestOpCount against the live kernel
+modules).
+
+Accounting convention (documented in docs/KERNEL_ARITHMETIC.md):
+
+- one **MAC** (the schoolbook/fold multiply-accumulates — the
+  multiplier-bound resource the r5 fast-squaring A/B showed dominates
+  wall time) counts as one op;
+- one **carry row** (one limb row of one carry pass) counts as one op;
+- table-select wheres, adds between multiplies, and canonicalization are
+  NOT counted (cheap-ALU traffic that coissues around the multiplier) —
+  the convention the r5 numbers used, kept so the trajectory stays
+  comparable.
+
+Everything here is plain Python over small ints; kernel modules are
+imported lazily only to read derived constants and active env switches.
+"""
+
+from __future__ import annotations
+
+
+# ------------------------------------------------------- field tier costs
+
+def _field_tier(name: str) -> dict:
+    """Per-field-op cost table for one radix tier: schoolbook MACs, fold
+    MACs, and carry rows per multiply/square (derived constants are read
+    from the kernel modules so they cannot drift)."""
+    if name == "ed25519-8192":
+        from .ed25519_pallas13 import LIMBS as limbs
+
+        # one fold term per hi column (lo + 608·hi, _fold_cols40) + the
+        # 2 carry passes of fe_mul; structural constants of those
+        # functions, cross-pinned in TestOpCount
+        fold_macs, passes = limbs, 2
+    elif name == "ed25519-4096":
+        from .ed25519_pallas import LIMBS as limbs
+
+        # split 2^264 fold (fe25519 wrap split across limbs 0/1):
+        # 1536·hi(22) + 2·hi(21) + 3072·top + 4·top rows of _fold_cols44
+        fold_macs, passes = 45, 3
+    elif name == "ecdsa-4096-k1":
+        from .secp256_pallas import K1_LIMBS as limbs
+
+        # sparse-W fold (_k1_fold_cols): 256·hi(22) + 61·hi(21) +
+        # 16·hi(19) + 14 overflow-row MACs, then 2 carry passes
+        fold_macs, passes = 22 + 21 + 19 + 14, 2
+    elif name == "ecdsa-4096-r1":
+        from .secp256_pallas import _field4096_host
+
+        limbs = 22
+        fold_macs = _field4096_host("secp256r1").fold_macs
+        passes = 2
+    elif name.startswith("ecdsa-256"):
+        from .secp256 import _CURVES
+
+        curve = "secp256k1" if name.endswith("k1") else "secp256r1"
+        f = _CURVES[curve].field
+        limbs = 32
+        # word-level fold matrix: each (word k → word j) coeff is a
+        # 4-limb-wide MAC; 4 wrap passes with per-pass injections
+        fold_macs = 4 * sum(len(r) for r in f.red_rows)
+        passes = 4
+        mul_ops = 32 * 32 + fold_macs + passes * (limbs + len(f.wrap_inj))
+        sq_ops = 32 * 33 // 2 + fold_macs + passes * (limbs + len(f.wrap_inj))
+        return {"limbs": limbs, "mul_macs": 32 * 32,
+                "sq_macs": 32 * 33 // 2, "mul_ops": mul_ops,
+                "sq_ops": sq_ops}
+    else:
+        raise ValueError(name)
+    carry_rows = passes * limbs + limbs  # post-fold passes + the raw pass
+    return {
+        "limbs": limbs,
+        "mul_macs": limbs * limbs,
+        "sq_macs": limbs * (limbs + 1) // 2,
+        "mul_ops": limbs * limbs + fold_macs + carry_rows,
+        "sq_ops": limbs * (limbs + 1) // 2 + fold_macs + carry_rows,
+    }
+
+
+def _naive_pow_ops(exponent: int) -> tuple[int, int]:
+    """(squarings, multiplies) of plain square-and-multiply."""
+    return (
+        exponent.bit_length() - 1,
+        bin(exponent).count("1") - 1,
+    )
+
+
+# --------------------------------------------------------- scheme configs
+
+def ed25519_config(
+    radix: int | None = None,
+    fixed_win: int | None = None,
+    chains: bool = True,
+) -> dict:
+    """Active (or pinned) ed25519 kernel configuration. ``chains=False``
+    models the pre-chain square-and-multiply exponentiations (the r5
+    shape) for old-vs-new accounting."""
+    if radix is None or fixed_win is None:
+        from .ed25519_pallas import _fixed_base_win, _use_radix_8192
+
+        radix = radix or (8192 if _use_radix_8192() else 4096)
+        fixed_win = fixed_win or _fixed_base_win()
+    return {"scheme": "ed25519", "radix": radix, "fixed_win": fixed_win,
+            "chains": chains}
+
+
+def ecdsa_config(
+    curve: str = "secp256k1",
+    radix: int | None = None,
+    fixed_win: int | None = None,
+) -> dict:
+    """Active (or pinned) ECDSA kernel configuration for one curve."""
+    from .secp256_pallas import Env, _env_class, _fixed_base_win
+
+    if radix is None:
+        radix = 256 if _env_class(curve) is Env else 4096
+    if fixed_win is None:
+        fixed_win = _fixed_base_win()
+    return {"scheme": "ecdsa", "curve": curve, "radix": radix,
+            "fixed_win": fixed_win}
+
+
+def ops_per_verify(cfg: dict) -> dict:
+    """Field-op census for one verify under ``cfg`` → dict with
+    ``muls``/``sqs`` (field multiply/square counts), ``macs`` (multiplier
+    ops) and ``ops`` (MACs + carry rows — the MFU numerator)."""
+    if cfg["scheme"] == "ed25519":
+        tier = _field_tier(f"ed25519-{cfg['radix']}")
+        fixed_adds = 32 if cfg["fixed_win"] == 8 else 64
+        # ladder: 64 windows × 4 doubles (inner 3 skip T: 3M+4S; window
+        # boundary 4M+4S), 64 var-base 8M adds, fixed-base 7M mixed adds
+        muls = 192 * 3 + 64 * 4 + 64 * 8 + fixed_adds * 7
+        sqs = 256 * 4
+        # per-block var table: 7 doubles (4M+4S) + 7 adds (9M) + 16
+        # to_planes (1M)
+        muls += 7 * 4 + 7 * 9 + 16
+        sqs += 7 * 4
+        # decompress (fixed part) + final compare prep
+        muls += 9
+        sqs += 4
+        if cfg["chains"]:
+            from .addchain import INV_CHAIN_OPS, SQRT_CHAIN_OPS
+
+            sqrt_s, sqrt_m = SQRT_CHAIN_OPS
+            inv_s, inv_m = INV_CHAIN_OPS
+        else:
+            p = 2**255 - 19
+            sqrt_s, sqrt_m = _naive_pow_ops((p - 5) // 8)
+            inv_s, inv_m = _naive_pow_ops(p - 2)
+        muls += sqrt_m + inv_m + 2   # chains + the two zinv muls
+        sqs += sqrt_s + inv_s
+    else:
+        curve = cfg["curve"]
+        tier = _field_tier(
+            f"ecdsa-{cfg['radix']}-{'k1' if curve == 'secp256k1' else 'r1'}"
+        )
+        a_zero = curve == "secp256k1"
+        dbl_m, add_m = (10, 14) if a_zero else (13, 17)
+        fixed_adds = 32 if cfg["fixed_win"] == 8 else 64
+        muls = 256 * dbl_m + (64 + fixed_adds) * add_m
+        sqs = 256 * 3
+        # per-block Q table: 7 doubles + 7 adds
+        muls += 7 * dbl_m + 7 * add_m
+        sqs += 7 * 3
+        # on-curve check + the projective accept rule's two r·Z muls
+        muls += (2 if a_zero else 3) + 2
+        sqs += 2
+    macs = muls * tier["mul_macs"] + sqs * tier["sq_macs"]
+    ops = muls * tier["mul_ops"] + sqs * tier["sq_ops"]
+    return {"muls": muls, "sqs": sqs, "macs": macs, "ops": ops,
+            "mul_ops": tier["mul_ops"], "sq_ops": tier["sq_ops"]}
+
+
+def active_models() -> dict:
+    """The per-scheme op models for the ACTIVE kernel configuration —
+    what bench.py's MFU table consumes. The ecdsa entry describes
+    secp256k1 (the curve the dedicated ECDSA bench line measures)."""
+    out = {}
+    for name, cfg in (
+        ("ed25519", ed25519_config()),
+        ("ecdsa", ecdsa_config("secp256k1")),
+    ):
+        census = ops_per_verify(cfg)
+        out[name] = {
+            "config": {k: v for k, v in cfg.items() if k != "scheme"},
+            "ops_per_verify": census["ops"],
+            "macs_per_verify": census["macs"],
+            "field_muls_per_verify": census["muls"] + census["sqs"],
+        }
+    return out

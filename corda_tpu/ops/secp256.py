@@ -515,22 +515,12 @@ def _batch_invert(values: list[int], n: int) -> list[int]:
     3(k−1) multiplications for k inverses. The per-signature
     ``pow(s, n-2, n)`` was the dominant host-prep cost (~100 µs each —
     2048 lanes paid ~0.2 s of pure Python bigint exponentiation per
-    batch); every input must be nonzero mod n (callers pre-check)."""
-    k = len(values)
-    if k == 0:
-        return []
-    prefix = [0] * k  # prefix[i] = v0·v1·…·vi mod n
-    acc = 1
-    for i, v in enumerate(values):
-        acc = acc * v % n
-        prefix[i] = acc
-    inv_all = pow(acc, n - 2, n)
-    out = [0] * k
-    for i in range(k - 1, 0, -1):
-        out[i] = inv_all * prefix[i - 1] % n
-        inv_all = inv_all * values[i] % n
-    out[0] = inv_all
-    return out
+    batch); every input must be nonzero mod n (callers pre-check). The
+    shared implementation lives in ops/addchain.py (the fixed-base comb
+    table builders batch their normalizations through it too)."""
+    from .addchain import batch_modinv
+
+    return batch_modinv(values, n)
 
 
 def _prep_byte_planes(
@@ -605,9 +595,11 @@ def _ecdsa_pallas_donated(
     planes are freshly built per dispatch here (``_prep_byte_planes``),
     so XLA may recycle their device memory across back-to-back
     dispatches of the same shape bucket instead of holding one upload
-    arena per in-flight batch. Callers that REUSE plane arrays across
-    calls (the bench's rep loop) must keep using ``ecdsa_verify_pallas``
-    directly — donation would invalidate their buffers."""
+    arena per in-flight batch. bench.py's ECDSA rep loop measures THIS
+    entry (fresh upload per rep — the production dispatch shape); any
+    caller that reuses plane arrays across calls must use
+    ``ecdsa_verify_pallas`` directly — donation would invalidate its
+    buffers."""
     from .secp256_pallas import ecdsa_verify_pallas
 
     return ecdsa_verify_pallas(

@@ -6,19 +6,25 @@ where ECDSA had only the XLA 1-bit ladder: this kernel keeps the whole
 joint scalar multiplication R = u1·G + u2·Q resident in VMEM with the
 same two structural ideas as the ed25519 kernel (ed25519_pallas.py):
 
-- **Limb-major radix-256 field**: 32 little-endian 8-bit limbs in int32
-  lanes, ``(32, blk)`` — signature/key BYTES are already the limbs, so
-  host prep ships raw byte planes and the transpose happens on device.
-  All reduction machinery (wrap injections, word-level fold matrix,
-  positivity offsets) is DERIVED from the prime exactly as in
-  ``secp256.FieldCtx`` — the lazy bounds proven there carry over 1:1
-  because the ops are direct axis-swapped ports.
+- **Limb-major derived fields, radix 4096 production / radix 256
+  fallback**: the production tier runs 22 little-endian 12-bit limbs in
+  int32 lanes — 484 MACs per schoolbook mul (253 per square) vs the
+  32-limb radix-256 tier's 1024 (528) — for BOTH curves: secp256k1 via
+  the hand-audited sparse-W fold (``K1Env4096``), secp256r1 via the
+  generic derived residue fold (``Env4096`` — see the "derived
+  radix-4096 field" section; the same derivation reproduces k1's wrap
+  digits, test-pinned). The radix-256 tier stays as the proven fallback
+  (``CORDA_TPU_K1_RADIX=256`` / ``CORDA_TPU_R1_RADIX=256``); all its
+  reduction machinery is DERIVED from the prime exactly as in
+  ``secp256.FieldCtx``.
 
-- **Joint 4-bit-window Straus ladder**: 64 windows × (4 doubles + 2 table
-  adds) = 256 doubles + 128 adds, versus 256 doubles + 256 adds for the
-  XLA bit-serial ladder. The fixed-base table (0..15 · G, projective,
-  identity included) is a compile-time constant; the variable-base table
-  (0..15 · Q) is built per block with 14 point ops.
+- **Split-window Straus ladder**: the variable base Q keeps 4-bit
+  windows (64 adds from a per-block 16-entry table, 14 point ops to
+  build); the FIXED base G, whose table is a compile-time constant,
+  uses an 8-bit comb — 32 adds from a 256-entry table riding the same
+  doubling chain (adds land on even windows only), half the fixed-base
+  adds of the r5 dual-4-bit shape (``CORDA_TPU_ECDSA_FIXED_WIN=4`` pins
+  the old shape for fallback + A/B).
 
 Point arithmetic stays the COMPLETE Renes–Costello–Batina formulas (no
 exceptional cases — mandatory for a verifier facing adversarial inputs,
@@ -65,25 +71,65 @@ def _affine_add(cv: CurveCtx, p1, p2):
     return (x3, y3)
 
 
+def _proj_add_host(cv: CurveCtx, P1, P2):
+    """Complete projective add (RCB16 Alg 1) over Python ints — the
+    inversion-free host mirror of the device formulas, so table builds
+    cost bigint muls only."""
+    p, a, b3 = cv.p, cv.a % cv.p, 3 * cv.b % cv.p
+    X1, Y1, Z1 = P1
+    X2, Y2, Z2 = P2
+    t0, t1, t2 = X1 * X2 % p, Y1 * Y2 % p, Z1 * Z2 % p
+    t3 = ((X1 + Y1) * (X2 + Y2) - t0 - t1) % p
+    t4 = ((X1 + Z1) * (X2 + Z2) - t0 - t2) % p
+    t5 = ((Y1 + Z1) * (Y2 + Z2) - t1 - t2) % p
+    Z3 = (b3 * t2 + a * t4) % p
+    X3 = (t1 - Z3) % p
+    Z3 = (t1 + Z3) % p
+    Y3 = X3 * Z3 % p
+    t1 = (3 * t0 + a * t2) % p
+    t4n = (b3 * t4 + a * (t0 - a * t2)) % p
+    Y3 = (Y3 + t1 * t4n) % p
+    X3n = (X3 * t3 - t5 * t4n) % p
+    Z3n = (t5 * Z3 + t3 * t1) % p
+    return (X3n, Y3, Z3n)
+
+
+@functools.lru_cache(maxsize=4)
+def _g_comb_host(curve_name: str) -> tuple:
+    """Projective (X, Y, Z) rows for v·G, v = 0..255 (v=0 → (0, 1, 0),
+    Z=1 otherwise) — the 8-bit fixed-base comb table; its first 16 rows
+    ARE the 4-bit window table. Built with the inversion-free projective
+    adds and normalized by ONE Montgomery-batched inversion
+    (ops/addchain.py) instead of ~500 per-entry inversions."""
+    from .addchain import batch_modinv
+
+    cv = _CURVES[curve_name]
+    g = (cv.gx, cv.gy, 1)
+    pts = [(0, 1, 0), g]
+    for _ in range(254):
+        pts.append(_proj_add_host(cv, pts[-1], g))
+    zinv = batch_modinv([pt[2] for pt in pts[1:]], cv.p)
+    rows = [(0, 1, 0)]
+    for (x_p, y_p, _z), zi in zip(pts[1:], zinv):
+        rows.append((x_p * zi % cv.p, y_p * zi % cv.p, 1))
+    return tuple(rows)
+
+
 def _g_table_host(cv: CurveCtx) -> list[tuple[int, int, int]]:
     """Projective (X, Y, Z) rows for k·G, k = 0..15 (k=0 → (0, 1, 0))."""
-    rows = [(0, 1, 0)]
-    pt = None
-    for _ in range(15):
-        pt = _affine_add(cv, pt, (cv.gx, cv.gy))
-        rows.append((pt[0], pt[1], 1))
-    return rows
+    return list(_g_comb_host(cv.name)[:16])
 
 
 # ---------------------------------------------------- per-curve constants
 # consts matrix rows: 0 k_sub, 1 k_fold, 2 k_canon, 3 p, 4 a, 5 b, 6 b3,
-# 8+3k..10+3k: G-table entry k (X, Y, Z)
+# 8+3k..10+3k: G-table entry k (X, Y, Z),
+# 56+3v..58+3v (v = 0..255): 8-bit comb entry v·G
 
 @functools.lru_cache(maxsize=4)
 def _consts_host(curve_name: str) -> np.ndarray:
     cv = _CURVES[curve_name]
     f = cv.field
-    m = np.zeros((64, 128), dtype=np.int32)
+    m = np.zeros((824, 128), dtype=np.int32)
     m[0, :LIMBS] = f.k_sub
     m[1, :LIMBS] = f.k_fold
     m[2, :LIMBS] = f.k_canon
@@ -91,10 +137,14 @@ def _consts_host(curve_name: str) -> np.ndarray:
     m[4, :LIMBS] = cv.a_limbs
     m[5, :LIMBS] = cv.b_limbs
     m[6, :LIMBS] = cv.b3_limbs
-    for k, (x, y, z) in enumerate(_g_table_host(cv)):
-        m[8 + 3 * k, :LIMBS] = _int_to_limbs(x)
-        m[9 + 3 * k, :LIMBS] = _int_to_limbs(y)
-        m[10 + 3 * k, :LIMBS] = _int_to_limbs(z)
+    for v, (x, y, z) in enumerate(_g_comb_host(curve_name)):
+        if v < 16:
+            m[8 + 3 * v, :LIMBS] = _int_to_limbs(x)
+            m[9 + 3 * v, :LIMBS] = _int_to_limbs(y)
+            m[10 + 3 * v, :LIMBS] = _int_to_limbs(z)
+        m[56 + 3 * v, :LIMBS] = _int_to_limbs(x)
+        m[57 + 3 * v, :LIMBS] = _int_to_limbs(y)
+        m[58 + 3 * v, :LIMBS] = _int_to_limbs(z)
     return m
 
 
@@ -105,11 +155,11 @@ class Env:
     surface at radix 4096 for secp256k1."""
 
     __slots__ = ("k_sub", "k_fold", "k_canon", "p_limbs", "a", "b", "b3",
-                 "g_table", "wrap_inj", "red_rows", "a_is_zero")
+                 "g_table", "g_comb", "wrap_inj", "red_rows", "a_is_zero")
 
     LIMBS = LIMBS
 
-    def __init__(self, consts, blk, cv: CurveCtx):
+    def __init__(self, consts, blk, cv: CurveCtx, fixed_win: int = 4):
         def cfull(i):
             return jnp.broadcast_to(consts[i, :LIMBS][:, None], (LIMBS, blk))
 
@@ -124,6 +174,10 @@ class Env:
             (cfull(8 + 3 * k), cfull(9 + 3 * k), cfull(10 + 3 * k))
             for k in range(16)
         )
+        self.g_comb = tuple(
+            (cfull(56 + 3 * v), cfull(57 + 3 * v), cfull(58 + 3 * v))
+            for v in range(256)
+        ) if fixed_win == 8 else None
         self.wrap_inj = cv.field.wrap_inj      # static python data
         self.red_rows = cv.field.red_rows
         self.a_is_zero = cv.a_is_zero
@@ -459,14 +513,16 @@ class K1Env4096:
     """secp256k1 field/curve env at radix 4096 — same method surface as
     ``Env``, consumed by the shared RCB point formulas and
     ``_verify_block``. Consts matrix rows mirror ``_consts_host``'s row
-    layout (0 k_sub, 3 p, 5 b, 6 b3, 8+3k G-table) with 12-bit limbs."""
+    layout (0 k_sub, 3 p, 5 b, 6 b3, 8+3k G-table, 56+3v comb) with
+    12-bit limbs."""
 
-    __slots__ = ("k_sub", "p_limbs", "b", "b3", "g_table", "a")
+    __slots__ = ("k_sub", "p_limbs", "b", "b3", "g_table", "g_comb", "a")
 
     LIMBS = K1_LIMBS
     a_is_zero = True
 
-    def __init__(self, consts, blk, cv: CurveCtx | None = None):
+    def __init__(self, consts, blk, cv: CurveCtx | None = None,
+                 fixed_win: int = 4):
         def cfull(i):
             return jnp.broadcast_to(
                 consts[i, :K1_LIMBS][:, None], (K1_LIMBS, blk)
@@ -480,6 +536,10 @@ class K1Env4096:
             (cfull(8 + 3 * k), cfull(9 + 3 * k), cfull(10 + 3 * k))
             for k in range(16)
         )
+        self.g_comb = tuple(
+            (cfull(56 + 3 * v), cfull(57 + 3 * v), cfull(58 + 3 * v))
+            for v in range(256)
+        ) if fixed_win == 8 else None
         self.a = None  # a = 0: mul_a folds away in the shared formulas
 
     def mul(self, a, b):
@@ -517,15 +577,338 @@ class K1Env4096:
 @functools.lru_cache(maxsize=1)
 def _consts_host_k1() -> np.ndarray:
     cv = _CURVES["secp256k1"]
-    m = np.zeros((64, 128), dtype=np.int32)
+    m = np.zeros((824, 128), dtype=np.int32)
     m[0, :K1_LIMBS] = _K1_KSUB
     m[3, :K1_LIMBS] = _K1_PLIMBS
     m[5, :K1_LIMBS] = _k1_int_to_limbs(cv.b)
     m[6, :K1_LIMBS] = _k1_int_to_limbs(3 * cv.b % cv.p)
-    for k, (x, y, z) in enumerate(_g_table_host(cv)):
-        m[8 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(x)
-        m[9 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(y)
-        m[10 + 3 * k, :K1_LIMBS] = _k1_int_to_limbs(z)
+    for v, (x, y, z) in enumerate(_g_comb_host(cv.name)):
+        if v < 16:
+            m[8 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(x)
+            m[9 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(y)
+            m[10 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(z)
+        m[56 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(x)
+        m[57 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(y)
+        m[58 + 3 * v, :K1_LIMBS] = _k1_int_to_limbs(z)
+    return m
+
+
+# --------------------------------------- derived radix-4096 field (any p)
+#
+# The generalization of the K1 tier's wrap/fold machinery, DERIVED from
+# the prime the way ``secp256.FieldCtx`` derives its radix-256 tables —
+# this is what lets secp256r1 run the 22-limb schoolbook (484 MACs/mul,
+# 253/square) that the r5 note ruled out: the old approach substituted
+# overflow rows through W = 2^264 mod p repeatedly, and r1's top W digit
+# at limb 19 makes that cascade explode past int32 after 4 levels.
+# Instead, every schoolbook column 22..43 folds through a PRECOMPUTED
+# residue table: 2^(264+12j) mod p expressed in sparse signed balanced
+# radix-4096 digits (for r1: 122 shifted MACs total, |coeff| ≤ 768; for
+# k1 the same derivation reproduces the hand-built 3-digit W — pinned by
+# test). No cascade, no coefficient growth — the residues are already
+# fully reduced.
+#
+# Signed-limb discipline (unlike the all-positive K1 tier): r1's wrap
+# digits include −256 injections at limbs 8 and 16, so lazy limbs live
+# in a signed band. All carry machinery uses arithmetic shifts (exact
+# for negatives); positivity is restored only at sub (k_sub) and
+# canonical (k_canon) boundaries, exactly like the radix-256 FieldCtx.
+# The signed per-limb interval audit in
+# tests/test_ops_secp256_pallas.py::TestR1Radix4096 walks these exact
+# pass structures to a fixpoint and asserts int32 headroom.
+
+R4_LIMBS = 22
+_R4_RADIX = 12
+_R4_MASK = 4095
+
+
+def _r4_int_to_limbs(x: int) -> np.ndarray:
+    return np.array(
+        [(x >> (_R4_RADIX * i)) & _R4_MASK for i in range(R4_LIMBS)],
+        dtype=np.int32,
+    )
+
+
+def _r4_digits(v: int, p: int) -> list[tuple[int, int]]:
+    """v mod p as sparse signed balanced radix-4096 digits
+    [(limb, coeff)], |coeff| ≤ 2048, choosing the sparser of the two
+    residue representatives v and v − p."""
+    def digs(x):
+        out = []
+        for i in range(R4_LIMBS):
+            d = x % 4096
+            if d > 2048:
+                d -= 4096
+            x = (x - d) >> _R4_RADIX
+            out.append(d)
+        if x != 0:
+            return None
+        return [(i, int(d)) for i, d in enumerate(out) if d]
+
+    cands = [c for c in (digs(v % p), digs(v % p - p)) if c is not None]
+    assert cands, "residue does not fit 22 balanced radix-4096 digits"
+    return min(cands, key=len)
+
+
+def _r4_segments(rows: list[list[tuple[int, int]]]):
+    """Fold rows → diagonal segments [(j0, n, dst, coeff)]: hi rows
+    j0..j0+n−1 contribute coeff·hi at limbs dst..dst+n−1 — one shifted
+    MAC per segment (contiguous (limb − j, coeff) runs merged)."""
+    by_key: dict[tuple[int, int], list[int]] = {}
+    for j, row in enumerate(rows):
+        for idx, coeff in row:
+            by_key.setdefault((idx - j, coeff), []).append(j)
+    segs = []
+    for (off, coeff), js in sorted(by_key.items()):
+        js.sort()
+        start = prev = js[0]
+        for j in js[1:] + [None]:
+            if j is not None and j == prev + 1:
+                prev = j
+                continue
+            segs.append((start, prev - start + 1, start + off, coeff))
+            if j is not None:
+                start = prev = j
+    assert all(0 <= dst and dst + n <= R4_LIMBS for _, n, dst, _ in segs)
+    return tuple(segs)
+
+
+def _r4_pos_multiple(p: int, base: int) -> np.ndarray:
+    """A multiple of p with every 12-bit limb in [base, base + 4095]."""
+    v = base * ((1 << 264) - 1) // _R4_MASK
+    fix = (-v) % p
+    limbs = _r4_int_to_limbs(fix).astype(np.int64) + base
+    assert (v + fix) % p == 0 and limbs.max() <= base + _R4_MASK
+    return limbs.astype(np.int32)
+
+
+class Field4096Host:
+    """Derived host-side constants for GF(p) at radix 4096 (static
+    python data consumed at trace time — nothing here ships to device
+    except through the consts matrix)."""
+
+    def __init__(self, p: int):
+        self.p = p
+        self.p_limbs = _r4_int_to_limbs(p)
+        self.wrap = tuple(_r4_digits(1 << 264, p))
+        self.fold_rows = [
+            _r4_digits(1 << (264 + _R4_RADIX * j), p)
+            for j in range(R4_LIMBS)
+        ]
+        self.fold_segments = _r4_segments(self.fold_rows)
+        self.fold_macs = sum(len(r) for r in self.fold_rows)
+        self.w256 = tuple(_r4_digits(1 << 256, p))
+        # positivity offsets: audited bounds keep lazy limbs in a band
+        # well inside ±2^14 (TestR1Radix4096 asserts the margin)
+        self.k_sub = _r4_pos_multiple(p, 1 << 14)
+        self.k_canon = _r4_pos_multiple(p, 1 << 14)
+
+
+@functools.lru_cache(maxsize=4)
+def _field4096_host(curve_name: str) -> Field4096Host:
+    return Field4096Host(_CURVES[curve_name].p)
+
+
+def _r4_inject(out, rows, digits, top, blk):
+    """out += Σ coeff·top at each digit's limb (top: (1, blk))."""
+    for idx, coeff in digits:
+        out = out + jnp.pad(
+            coeff * top, ((idx, rows - 1 - idx), (0, 0))
+        )
+    return out
+
+
+def _r4_carry_pass(env, c):
+    """One signed radix-4096 carry pass; the top carry wraps through the
+    derived digits of 2^264 mod p."""
+    q = c >> _R4_RADIX
+    r = c - (q << _R4_RADIX)
+    top = q[R4_LIMBS - 1 : R4_LIMBS, :]
+    out = r + jnp.concatenate(
+        [jnp.zeros_like(top), q[: R4_LIMBS - 1]], axis=0
+    )
+    return _r4_inject(out, R4_LIMBS, env.wrap, top, c.shape[1])
+
+
+def _r4_carry(env, c, passes):
+    for _ in range(passes):
+        c = _r4_carry_pass(env, c)
+    return c
+
+
+def _r4_fold_cols(env, c, blk):
+    """(44, blk) schoolbook columns → (22, blk) lazy limbs: raw carry
+    pass, then the derived residue fold (one shifted MAC per diagonal
+    segment), then two wrap passes."""
+    q = c >> _R4_RADIX
+    r = c - (q << _R4_RADIX)
+    c = r + jnp.concatenate([jnp.zeros((1, blk), jnp.int32), q[:-1]], axis=0)
+    lo, hi = c[:R4_LIMBS], c[R4_LIMBS:]
+    out = lo
+    for j0, n, dst, coeff in env.fold_segments:
+        out = out + jnp.pad(
+            coeff * hi[j0 : j0 + n],
+            ((dst, R4_LIMBS - dst - n), (0, 0)),
+        )
+    return _r4_carry(env, out, 2)
+
+
+def r4_mul(env, a, b):
+    blk = a.shape[1]
+    c = jnp.zeros((2 * R4_LIMBS, blk), dtype=jnp.int32)
+    for i in range(R4_LIMBS):
+        c = c + jnp.pad(a[i : i + 1, :] * b, ((i, R4_LIMBS - i), (0, 0)))
+    return _r4_fold_cols(env, c, blk)
+
+
+def r4_sq(env, a):
+    """Dedicated squaring (253 MACs vs 484): identical column values to
+    r4_mul(a, a) — same argument as the k1/ed25519 fast squares, so the
+    audited signed column bounds carry over verbatim."""
+    blk = a.shape[1]
+    a2 = a + a
+    c = jnp.zeros((2 * R4_LIMBS, blk), dtype=jnp.int32)
+    for i in range(R4_LIMBS):
+        row = a[i : i + 1, :] if i == R4_LIMBS - 1 else jnp.concatenate(
+            [a[i : i + 1, :], a2[i + 1 :, :]], axis=0
+        )
+        c = c + jnp.pad(a[i : i + 1, :] * row, ((2 * i, R4_LIMBS - i), (0, 0)))
+    return _r4_fold_cols(env, c, blk)
+
+
+def _r4_canonical(env, a):
+    """Exact reduction: limbs in [0, 4095], value in [0, p). k_canon
+    restores positivity (signed lazy limbs), two exact carry rounds,
+    two folds of bits ≥ 2^256 through the derived w256 digits, two
+    conditional subtracts of p."""
+    blk = a.shape[1]
+    c = a + env.k_canon
+
+    def exact_carry(c):
+        rows = []
+        carry = jnp.zeros((1, blk), jnp.int32)
+        for i in range(R4_LIMBS):
+            v = c[i : i + 1, :] + carry
+            rows.append(v & _R4_MASK)
+            carry = v >> _R4_RADIX
+        out = jnp.concatenate(rows, axis=0)
+        return _r4_inject(out, R4_LIMBS, env.wrap, carry, blk)
+
+    def fold_256(c):
+        # bits ≥ 2^256 live in limb 21 >> 4
+        t = c[R4_LIMBS - 1 :, :] >> 4
+        out = jnp.concatenate(
+            [c[: R4_LIMBS - 1], c[R4_LIMBS - 1 :] & 15], axis=0
+        )
+        return _r4_inject(out, R4_LIMBS, env.w256, t, blk)
+
+    c = exact_carry(exact_carry(c))
+    c = exact_carry(fold_256(c))
+    c = exact_carry(fold_256(c))
+
+    def sub_p(v):
+        rows = []
+        borrow = jnp.zeros((1, blk), jnp.int32)
+        for i in range(R4_LIMBS):
+            d = v[i : i + 1, :] - env.p_limbs[i : i + 1, :] - borrow
+            rows.append(d & _R4_MASK)
+            borrow = (d < 0).astype(jnp.int32)
+        diff = jnp.concatenate(rows, axis=0)
+        return jnp.where(borrow == 0, diff, v)
+
+    return sub_p(sub_p(c))
+
+
+class Env4096:
+    """Derived radix-4096 field/curve env — same method surface as
+    ``Env``/``K1Env4096``, for ANY short-Weierstrass 256-bit prime
+    (production use: secp256r1). Consts rows: 0 k_sub, 2 k_canon, 3 p,
+    4 a, 5 b, 6 b3, 8+3k G-table, 56+3v comb — 12-bit limbs."""
+
+    __slots__ = ("k_sub", "k_canon", "p_limbs", "a", "b", "b3",
+                 "g_table", "g_comb", "wrap", "fold_segments", "w256",
+                 "a_is_zero")
+
+    LIMBS = R4_LIMBS
+
+    def __init__(self, consts, blk, cv: CurveCtx, fixed_win: int = 4):
+        ctx = _field4096_host(cv.name)
+
+        def cfull(i):
+            return jnp.broadcast_to(
+                consts[i, :R4_LIMBS][:, None], (R4_LIMBS, blk)
+            )
+
+        self.k_sub = cfull(0)
+        self.k_canon = cfull(2)
+        self.p_limbs = cfull(3)
+        self.a = cfull(4)
+        self.b = cfull(5)
+        self.b3 = cfull(6)
+        self.g_table = tuple(
+            (cfull(8 + 3 * k), cfull(9 + 3 * k), cfull(10 + 3 * k))
+            for k in range(16)
+        )
+        self.g_comb = tuple(
+            (cfull(56 + 3 * v), cfull(57 + 3 * v), cfull(58 + 3 * v))
+            for v in range(256)
+        ) if fixed_win == 8 else None
+        self.wrap = ctx.wrap               # static python data
+        self.fold_segments = ctx.fold_segments
+        self.w256 = ctx.w256
+        self.a_is_zero = cv.a_is_zero
+
+    def mul(self, a, b):
+        return r4_mul(self, a, b)
+
+    def sq(self, a):
+        return r4_sq(self, a)
+
+    def add(self, a, b):
+        return _r4_carry_pass(self, a + b)
+
+    def sub(self, a, b):
+        return _r4_carry(self, a - b + self.k_sub, 2)
+
+    def mul_small(self, a, k):
+        return _r4_carry(self, a * np.int32(k), 1 if k == 2 else 2)
+
+    def canonical(self, a):
+        return _r4_canonical(self, a)
+
+    def eq(self, a, b):
+        return jnp.all(self.canonical(a) == self.canonical(b), axis=0)
+
+    def is_zero(self, a):
+        return jnp.all(self.canonical(a) == 0, axis=0)
+
+    def one_hot(self, blk):
+        return jnp.concatenate(
+            [jnp.ones((1, blk), jnp.int32),
+             jnp.zeros((R4_LIMBS - 1, blk), jnp.int32)],
+            axis=0,
+        )
+
+
+@functools.lru_cache(maxsize=4)
+def _consts_host_4096(curve_name: str) -> np.ndarray:
+    cv = _CURVES[curve_name]
+    ctx = _field4096_host(curve_name)
+    m = np.zeros((824, 128), dtype=np.int32)
+    m[0, :R4_LIMBS] = ctx.k_sub
+    m[2, :R4_LIMBS] = ctx.k_canon
+    m[3, :R4_LIMBS] = ctx.p_limbs
+    m[4, :R4_LIMBS] = _r4_int_to_limbs(cv.a % cv.p)
+    m[5, :R4_LIMBS] = _r4_int_to_limbs(cv.b % cv.p)
+    m[6, :R4_LIMBS] = _r4_int_to_limbs(3 * cv.b % cv.p)
+    for v, (x, y, z) in enumerate(_g_comb_host(curve_name)):
+        if v < 16:
+            m[8 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(x)
+            m[9 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(y)
+            m[10 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(z)
+        m[56 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(x)
+        m[57 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(y)
+        m[58 + 3 * v, :R4_LIMBS] = _r4_int_to_limbs(z)
     return m
 
 
@@ -617,12 +1000,14 @@ def on_curve(env, x, y):
     return env.eq(env.sq(y), rhs)
 
 
-def _select16(idx_row, entries):
-    """Branch-free 16-way select over projective triples (binary tree of
-    wheres on the index bits — same cost profile as the ed25519 kernel's
-    table select, ~7% of one field mul)."""
-    level = entries
-    for bit in range(4):
+def _select_table(idx_row, entries):
+    """Branch-free 2^k-way select over projective triples (binary tree
+    of wheres on the index bits). 2^k − 1 entry-selects: small for the
+    16-entry tables; the 256-entry comb trades ~16x the select work for
+    HALF the fixed-base point adds (see the ed25519 kernel's select
+    docstring for the A/B framing)."""
+    level = list(entries)
+    for bit in range((len(entries) - 1).bit_length()):
         b_mask = ((idx_row >> bit) & 1) == 1
         level = [
             tuple(
@@ -632,6 +1017,10 @@ def _select16(idx_row, entries):
             for lo, hi in zip(level[0::2], level[1::2])
         ]
     return level[0]
+
+
+# 16-way alias: the name the component tests bind
+_select16 = _select_table
 
 
 # --------------------------------------------------------------- kernel
@@ -663,7 +1052,16 @@ def _verify_block(env: Env, qx, qy, read_windows, ra, rb, rb_ok, precheck):
         for k in range(7, -1, -1):
             for _ in range(4):
                 acc = point_double(env, acc)
-            acc = point_add(env, acc, _select16(u1r[k, :], env.g_table))
+            if env.g_comb is not None:
+                # 8-bit comb: the fixed-base (G) add lands on EVEN
+                # windows only, carrying the odd window's digit ×16
+                # (pairs never straddle a chunk — chunks are 8-aligned)
+                if k % 2 == 0:
+                    acc = point_add(env, acc, _select_table(
+                        u1r[k, :] + 16 * u1r[k + 1, :], env.g_comb
+                    ))
+            else:
+                acc = point_add(env, acc, _select16(u1r[k, :], env.g_table))
             acc = point_add(env, acc, _select16(u2r[k, :], q_table))
         return acc
 
@@ -676,35 +1074,61 @@ def _verify_block(env: Env, qx, qy, read_windows, ra, rb, rb_ok, precheck):
     return precheck & q_ok & nonzero & match
 
 
-def _env_class(curve_name: str):
-    """Field tier per curve. The r5 on-chip A/B measured the secp256k1
-    radix-4096 tier at 47.6k sigs/s vs the generic radix-256 tier's
-    68.4k under identical conditions — the widening halves the MACs but
-    its reduction machinery (carry-on-add passes, multi-piece wrap
-    concatenates, single-row overflow substitutions) costs more on
-    Mosaic than the MACs it saves. Default therefore stays radix-256;
-    CORDA_TPU_K1_RADIX=4096 opts k1 into the widened tier (kept as a
-    correct, interval-audited alternative for re-evaluation on future
-    toolchains/hardware)."""
+def _env_class(curve_name: str, radix: int | None = None):
+    """Field tier per curve (radix 256 or 4096; ``radix=None`` reads the
+    env at trace time). DEFAULT: radix 4096 for BOTH curves — 22-limb
+    schoolbook, 484 MACs/mul (253/square) vs the 32-limb tier's
+    1024/528. History: the r5 on-chip A/B measured the ORIGINAL k1
+    radix-4096 tier slower than radix-256 (47.6k vs 68.4k sigs/s) — its
+    reduction machinery cost more on Mosaic than the MACs it saved —
+    so r5 shipped radix-256 by default. This cycle re-arbitrates: the
+    r1 tier's derived single-level residue fold replaces the overflow-
+    substitution cascade, and the 8-bit fixed-base comb removes a
+    quarter of the point adds, so the widened tiers are the default
+    again pending the next capture's A/B. CORDA_TPU_K1_RADIX=256 /
+    CORDA_TPU_R1_RADIX=256 pin the proven radix-256 tier per curve."""
     import os
 
-    if curve_name == "secp256k1" and os.environ.get(
-        "CORDA_TPU_K1_RADIX", "256"
-    ).strip() == "4096":
-        return K1Env4096
+    if radix is None:
+        var = ("CORDA_TPU_K1_RADIX" if curve_name == "secp256k1"
+               else "CORDA_TPU_R1_RADIX")
+        radix = 256 if os.environ.get(var, "4096").strip() == "256" else 4096
+    if radix == 4096:
+        return K1Env4096 if curve_name == "secp256k1" else Env4096
     return Env
 
 
-def _make_kernel(curve_name: str):
+def _fixed_base_win() -> int:
+    """Fixed-base table shape (read at trace time): 8 = 256-entry comb
+    (32 G-adds per verify, production default), 4 = the r5 16-entry
+    window tier (64 G-adds; CORDA_TPU_ECDSA_FIXED_WIN=4 pins it)."""
+    import os
+
+    return 4 if os.environ.get(
+        "CORDA_TPU_ECDSA_FIXED_WIN", "8"
+    ).strip() == "4" else 8
+
+
+def _consts_for(curve_name: str, env_cls) -> np.ndarray:
+    if env_cls is K1Env4096:
+        return _consts_host_k1()
+    if env_cls is Env4096:
+        return _consts_host_4096(curve_name)
+    return _consts_host(curve_name)
+
+
+def _make_kernel(curve_name: str, radix: int | None = None,
+                 fixed_win: int | None = None):
     cv = _CURVES[curve_name]
-    env_cls = _env_class(curve_name)
+    env_cls = _env_class(curve_name, radix)
+    fixed_win = fixed_win or _fixed_base_win()
 
     def kernel(consts_ref, qx_ref, qy_ref, u1w_ref, u2w_ref,
                ra_ref, rb_ref, flags_ref, out_ref):
         from jax.experimental import pallas as pl
 
         blk = qx_ref.shape[1]
-        env = env_cls(consts_ref[:, :], blk, cv)
+        env = env_cls(consts_ref[:, :], blk, cv, fixed_win=fixed_win)
         lm = env.LIMBS
 
         def read_windows(base_row):
@@ -724,29 +1148,34 @@ def _make_kernel(curve_name: str):
     return kernel
 
 
-@functools.partial(jax.jit, static_argnames=("curve_name",))
+@functools.partial(
+    jax.jit, static_argnames=("curve_name", "radix", "fixed_win")
+)
 def ecdsa_verify_shadow(
     curve_name: str,
     qx_bytes: jax.Array, qy_bytes: jax.Array,
     u1_bytes: jax.Array, u2_bytes: jax.Array,
     ra_bytes: jax.Array, rb_bytes: jax.Array,
     rb_ok: jax.Array, precheck: jax.Array,
+    radix: int | None = None, fixed_win: int | None = None,
 ) -> jax.Array:
     """Pure-jnp entry over the SAME block body as the pallas kernel — the
     CPU differential-test tier (interpret-mode execution of the full
     ladder is impractically slow; this compiles once and runs the
-    identical math). Curve routing matches the kernel: secp256k1 runs
-    the radix-4096 field here too, so the CPU tier differentially tests
-    the widened math."""
+    identical math). Tier routing matches the kernel: both curves run
+    their radix-4096 field here too, so the CPU tier differentially
+    tests the widened math and the active fixed-base table shape."""
     from .ed25519_pallas import bytes_to_windows_t
 
     cv = _CURVES[curve_name]
     blk = qx_bytes.shape[0]
-    if _env_class(curve_name) is K1Env4096:
-        env = K1Env4096(jnp.asarray(_consts_host_k1()), blk, cv)
-    else:
-        env = Env(jnp.asarray(_consts_host(curve_name)), blk, cv)
-    limbs_t = _limbs_t_for(curve_name)
+    env_cls = _env_class(curve_name, radix)
+    fixed_win = fixed_win or _fixed_base_win()
+    env = env_cls(
+        jnp.asarray(_consts_for(curve_name, env_cls)), blk, cv,
+        fixed_win=fixed_win,
+    )
+    limbs_t = _limbs_t_for(curve_name, radix)
     lm = env.LIMBS
     u1w = bytes_to_windows_t(u1_bytes)
     u2w = bytes_to_windows_t(u2_bytes)
@@ -770,19 +1199,20 @@ def _bytes_to_limbs_t(x_bytes: jax.Array) -> jax.Array:
     return x_bytes.astype(jnp.int32).T
 
 
-def _limbs_t_for(curve_name: str):
-    """Byte-plane → limb-plane repack for the curve's field tier: k1 packs
-    to 12-bit limbs ((24, B), rows 22/23 zero — the ed25519 kernel's
-    repack, 8-aligned for sublane reads); others transpose to bytes."""
-    if _env_class(curve_name) is K1Env4096:
+def _limbs_t_for(curve_name: str, radix: int | None = None):
+    """Byte-plane → limb-plane repack for the curve's field tier: the
+    radix-4096 tiers pack to 12-bit limbs ((24, B), rows 22/23 zero —
+    the ed25519 kernel's repack, 8-aligned for sublane reads); the
+    radix-256 tier transposes to bytes."""
+    if _env_class(curve_name, radix) is not Env:
         from .ed25519_pallas import bytes_to_limb12_t
 
         return bytes_to_limb12_t
     return _bytes_to_limbs_t
 
 
-def _in_rows(curve_name: str) -> int:
-    return 24 if _env_class(curve_name) is K1Env4096 else 32
+def _in_rows(curve_name: str, radix: int | None = None) -> int:
+    return 32 if _env_class(curve_name, radix) is Env else 24
 
 
 def _flags(precheck: jax.Array, rb_ok: jax.Array) -> jax.Array:
@@ -794,7 +1224,9 @@ def _flags(precheck: jax.Array, rb_ok: jax.Array) -> jax.Array:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("curve_name", "interpret", "block")
+    jax.jit,
+    static_argnames=("curve_name", "interpret", "block", "radix",
+                     "fixed_win"),
 )
 def ecdsa_verify_pallas(
     curve_name: str,
@@ -808,10 +1240,14 @@ def ecdsa_verify_pallas(
     precheck: jax.Array,   # (B,) bool host-side validity
     interpret: bool = False,
     block: int | None = None,
+    radix: int | None = None,
+    fixed_win: int | None = None,
 ) -> jax.Array:
     """Launch the windowed ECDSA kernel; device-side prep (transpose +
     window extraction) fuses into this jit so the host ships compact
-    uint8 planes — one upload per plane, like the ed25519 path."""
+    uint8 planes — one upload per plane, like the ed25519 path.
+    ``radix``/``fixed_win`` pin a tier explicitly (the block sweep's
+    A/B axis); None reads the env switches at trace time."""
     from jax.experimental import pallas as pl
 
     from ._blockpack import ECDSA_BLOCK
@@ -821,22 +1257,24 @@ def ecdsa_verify_pallas(
     b = qx_bytes.shape[0]
     assert b % block == 0, (b, block)
     grid = (b // block,)
-    limbs_t = _limbs_t_for(curve_name)
-    rows = _in_rows(curve_name)
-    consts = (
-        _consts_host_k1() if _env_class(curve_name) is K1Env4096
-        else _consts_host(curve_name)
-    )
+    limbs_t = _limbs_t_for(curve_name, radix)
+    rows = _in_rows(curve_name, radix)
+    fixed_win = fixed_win or _fixed_base_win()
+    consts = _consts_for(curve_name, _env_class(curve_name, radix))
+    if fixed_win != 8:
+        # win4 ships only the first 64 consts rows (the r5 shape — the
+        # comb's unused rows must not ride along in VMEM on this leg)
+        consts = consts[:64]
 
     def col_spec(nrows):
         return pl.BlockSpec((nrows, block), lambda i: (0, i))
 
     mask = pl.pallas_call(
-        _make_kernel(curve_name),
+        _make_kernel(curve_name, radix, fixed_win),
         out_shape=jax.ShapeDtypeStruct((8, b), jnp.int32),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((64, 128), lambda i: (0, 0)),
+            pl.BlockSpec(consts.shape, lambda i: (0, 0)),
             col_spec(rows), col_spec(rows), col_spec(64), col_spec(64),
             col_spec(rows), col_spec(rows), col_spec(8),
         ],

@@ -1,0 +1,345 @@
+"""Kernel-arithmetic suites that need no OpenSSL oracle.
+
+The differential suites in test_ops_ed25519.py skip entirely on minimal
+containers (their oracle IS the `cryptography` wheel), but the addition
+chains, the fixed-base comb tables, and the ladder schedule are proven
+against Python-int arithmetic — no oracle required — so they live here
+and run everywhere tier-1 runs.  Covers the PR-8 arithmetic: the shared
+exponent chains (ops/addchain.py), Montgomery batch inversion, and the
+Wycheproof-style edge-vector walks through BOTH ed25519 radix tiers x
+BOTH fixed-base table shapes (docs/KERNEL_ARITHMETIC.md).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from corda_tpu.ops.ed25519 import L, P
+
+class TestAdditionChains:
+    """ops/addchain.py: the shared fixed-exponent chains (field inversion
+    a^(p−2), decompression sqrt a^((p−5)/8)) vs pow() over Python ints,
+    their op counts vs the exported schedule constants the op model
+    (ops/opcount.py) consumes, and Montgomery batch inversion."""
+
+    def test_chains_match_pow_over_random_ints(self):
+        from corda_tpu.ops import addchain as ac
+
+        rng = random.Random(41)
+        sq = lambda a: a * a % P                          # noqa: E731
+        mul = lambda a, b: a * b % P                      # noqa: E731
+        xs = [0, 1, 2, P - 1, P - 2] + [
+            rng.getrandbits(255) % P for _ in range(8)
+        ]
+        for x in xs:
+            assert ac.pow_p_minus_2(x, sq, mul) == pow(x, P - 2, P)
+            assert ac.pow_p_minus_5_over_8(x, sq, mul) == pow(
+                x, (P - 5) // 8, P
+            )
+
+    def test_chain_op_counts_match_exported_schedule(self):
+        """INV_CHAIN_OPS / SQRT_CHAIN_OPS are what opcount.py charges per
+        exponentiation — count the real calls so the model can't drift
+        from the schedule actually shipped."""
+        from corda_tpu.ops import addchain as ac
+
+        counts = {"sq": 0, "mul": 0}
+
+        def sq(a):
+            counts["sq"] += 1
+            return a * a % P
+
+        def mul(a, b):
+            counts["mul"] += 1
+            return a * b % P
+
+        def sq_n(a, n):
+            for _ in range(n):
+                a = sq(a)
+            return a
+
+        ac.pow_p_minus_2(3, sq, mul, sq_n)
+        assert (counts["sq"], counts["mul"]) == ac.INV_CHAIN_OPS
+        counts["sq"] = counts["mul"] = 0
+        ac.pow_p_minus_5_over_8(3, sq, mul, sq_n)
+        assert (counts["sq"], counts["mul"]) == ac.SQRT_CHAIN_OPS
+
+    def test_xla_tier_chain_inversion_and_sqrt(self):
+        """fe25519.fe_inv / fe_pow_sqrt (now chain-backed) vs pow()
+        through the radix-256 limb codec, including the 0 → 0 contract."""
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import fe25519 as fe
+
+        rng = random.Random(43)
+        vals = [0, 1, 2, P - 1] + [rng.getrandbits(255) % P for _ in range(4)]
+        arr = jnp.asarray(np.stack([fe.int_to_limbs(v) for v in vals]))
+        inv = np.asarray(fe.fe_canonical(fe.fe_inv(arr)))
+        srt = np.asarray(fe.fe_canonical(fe.fe_pow_sqrt(arr)))
+        for i, v in enumerate(vals):
+            assert fe.limbs_to_int(inv[i]) == pow(v, P - 2, P)
+            assert fe.limbs_to_int(srt[i]) == pow(v, (P - 5) // 8, P)
+
+    def test_pallas_tier_chains_match_pow(self):
+        """The unrolled pallas chains (both radix tiers) vs pow() through
+        each tier's limb codec — the exponentiations the kernels actually
+        inline. (The old square-and-multiply fe_pow_const is ~2x the
+        eager ops; pow() over ints is the stronger oracle anyway.)"""
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as edp
+        from corda_tpu.ops import ed25519_pallas13 as e13
+
+        rng = random.Random(47)
+        vals = [1, P - 1] + [rng.getrandbits(255) % P for _ in range(2)]
+        a12 = jnp.asarray(np.stack([edp.int_to_limbs12(v) for v in vals]).T)
+        a13 = jnp.asarray(np.stack([e13.int_to_limbs13(v) for v in vals]).T)
+        for chain, exp, arr, rad in (
+            (edp.fe_inv_chain, P - 2, a12, 12),
+            (edp.fe_pow_sqrt_chain, (P - 5) // 8, a12, 12),
+            (e13.fe_inv_chain, P - 2, a13, 13),
+            (e13.fe_pow_sqrt_chain, (P - 5) // 8, a13, 13),
+        ):
+            got = np.asarray(chain(arr))   # lazy form; compare mod p
+            for i, v in enumerate(vals):
+                g = sum(int(x) << (rad * j) for j, x in enumerate(got[:, i]))
+                assert g % P == pow(v, exp, P)
+
+    def test_batch_modinv(self):
+        from corda_tpu.ops.addchain import batch_modinv
+
+        rng = random.Random(53)
+        for m in (P, L, 97):
+            vals = [rng.randrange(1, m) for _ in range(9)]
+            got = batch_modinv(vals, m)
+            assert got == [pow(v, m - 2, m) for v in vals]
+        assert batch_modinv([], P) == []
+        assert batch_modinv([5], 97) == [pow(5, 95, 97)]
+
+
+class TestFixedBaseComb:
+    """Satellite: Wycheproof-style edge vectors through BOTH radix tiers
+    and BOTH fixed-base table shapes.
+
+    The exact kernel ladder schedule (64 MSB-first windows × 4 doubles,
+    var-base add every window, fixed-base add every window at win4 /
+    even windows with paired digits at the 8-bit comb) is driven in
+    eager mode on boundary scalars (0, 1, L−1, the 2^252 straddle) and
+    random lanes, differentially against Python-int affine arithmetic.
+    Table entries are read from the SAME consts-matrix rows the compiled
+    kernel reads, so a wrong comb entry, a wrong pairing, or a wrong row
+    offset fails here rather than on a customer's chip. (Table selects
+    are covered by their own unit below — host-side gather keeps this
+    walk affordable on CPU.)"""
+
+    # (s, h) scalar pairs: identities, boundaries, straddles, random
+    def _scalar_lanes(self, seed=61):
+        rng = random.Random(seed)
+        return [
+            (0, 0), (1, 0), (L - 1, 0), (0, L - 1),
+            (2**252, 1), (L - 1, L - 1),
+            (rng.getrandbits(252) % L, rng.getrandbits(252) % L),
+            (rng.getrandbits(252) % L, rng.getrandbits(252) % L),
+        ]
+
+    def _tier(self, radix):
+        if radix == 4096:
+            from corda_tpu.ops import ed25519_pallas as m
+
+            return m, 12, m.int_to_limbs12
+        from corda_tpu.ops import ed25519_pallas13 as m
+
+        return m, 13, m.int_to_limbs13
+
+    def _env(self, m, b, fixed_win):
+        import jax.numpy as jnp
+
+        def cfull(row):
+            return jnp.broadcast_to(
+                jnp.asarray(m._CONSTS_HOST[row, : m.LIMBS])[:, None],
+                (m.LIMBS, b),
+            )
+
+        return m.Env(
+            k2=cfull(0), p_limbs=cfull(1), d=cfull(2), d2=cfull(3),
+            sqrt_m1=cfull(4),
+            b_table=tuple(
+                (cfull(8 + 3 * i), cfull(9 + 3 * i), cfull(10 + 3 * i))
+                for i in range(16)
+            ) if fixed_win == 4 else None,
+            b_comb=None,   # comb entries gathered host-side per window
+        )
+
+    def _b_entry_planes(self, m, digits, base):
+        """Per-lane fixed-base table rows from the kernel's consts
+        matrix: digit d → rows base+3d..base+3d+2 (base 8 = win4 table,
+        56 = the 8-bit comb)."""
+        import jax.numpy as jnp
+
+        return tuple(
+            jnp.asarray(np.stack(
+                [m._CONSTS_HOST[base + 3 * int(d) + c, : m.LIMBS]
+                 for d in digits], axis=1,
+            ))
+            for c in range(3)
+        )
+
+    def _windows(self, vals):
+        w = np.zeros((64, len(vals)), np.int32)
+        for i, v in enumerate(vals):
+            for k in range(64):
+                w[k, i] = (v >> (4 * k)) & 0xF
+        return w
+
+    @pytest.mark.parametrize("radix", [4096, 8192])
+    @pytest.mark.parametrize("fixed_win", [4, 8])
+    def test_ladder_edge_vectors(self, radix, fixed_win):
+        import jax.numpy as jnp
+
+        from corda_tpu.ops.ed25519 import _BX, _BY
+
+        m, rad_bits, to_limbs = self._tier(radix)
+        lanes = self._scalar_lanes()
+        b = len(lanes)
+        env = self._env(m, b, fixed_win)
+
+        # variable base A = t·B for a known t, same for every lane, fed
+        # through the tier's own decompress (sqrt chain included)
+        t = random.Random(67).getrandbits(250) % L or 1
+        ax, ay = _affine_scalar_mul(t, (_BX, _BY))
+        y_bytes = np.frombuffer(
+            ay.to_bytes(32, "little"), np.uint8
+        ).reshape(1, 32).repeat(b, axis=0).copy()
+        sign = np.full(b, ax & 1, np.int32)
+        if radix == 4096:
+            y_l = m.bytes_to_limb12_t(jnp.asarray(y_bytes))[: m.LIMBS]
+        else:
+            y_l = m.bytes_to_limb13_t(jnp.asarray(y_bytes))[: m.LIMBS]
+        a_pt, a_ok = m.decompress(env, y_l, jnp.asarray(sign))
+        assert np.asarray(a_ok).all()
+        minus_a = m.point_neg(env, a_pt)
+
+        # per-lane var table exactly as the kernel builds it
+        pts = [m.identity_point(b), minus_a]
+        for k in range(2, 16):
+            if k % 2 == 0:
+                pts.append(m.point_double(env, pts[k // 2]))
+            else:
+                pts.append(m.point_add(env, pts[k - 1], minus_a))
+        a_table = [
+            tuple(np.asarray(p) for p in m.to_planes(env, pt)) for pt in pts
+        ]
+
+        def q_planes(digits):
+            return tuple(
+                jnp.asarray(np.stack(
+                    [a_table[int(d)][c][:, lane]
+                     for lane, d in enumerate(digits)], axis=1,
+                ))
+                for c in range(4)
+            )
+
+        s_win = self._windows([s for s, _ in lanes])
+        h_win = self._windows([h for _, h in lanes])
+
+        acc = m.identity_point(b)
+        for w in range(63, -1, -1):
+            for i in range(4):
+                acc = m.point_double(env, acc, want_t=(i == 3))
+            if fixed_win == 8:
+                if w % 2 == 0:
+                    acc = m._add_b_entry(env, acc, self._b_entry_planes(
+                        m, s_win[w] + 16 * s_win[w + 1], 56
+                    ))
+            else:
+                acc = m._add_b_entry(
+                    env, acc, self._b_entry_planes(m, s_win[w], 8)
+                )
+            acc = m._add_q_planes(env, acc, q_planes(h_win[w]))
+
+        enc_y, parity = m.compress_y_parity(env, acc)
+        enc_y, parity = np.asarray(enc_y), np.asarray(parity)
+        for i, (s, h) in enumerate(lanes):
+            # ladder computes [s]B + [h]·(−A) = [(s − h·t) mod L]·B
+            want = _affine_scalar_mul((s - h * t) % L, (_BX, _BY))
+            got_y = sum(
+                int(x) << (rad_bits * j) for j, x in enumerate(enc_y[:, i])
+            )
+            assert got_y == want[1] % P, (radix, fixed_win, i)
+            assert int(parity[i]) == want[0] & 1, (radix, fixed_win, i)
+
+    def test_comb_table_is_vB_and_prefix_of_window_table(self):
+        """256-entry comb rows are v·B in (y−x, y+x, 2dxy) form; the
+        win4 table IS its 16-entry prefix (both consts layouts)."""
+        from corda_tpu.ops import ed25519_pallas as edp
+        from corda_tpu.ops.ed25519 import _BX, _BY, _D
+
+        comb = edp._b_comb_host(256)
+        assert comb[:16] == edp._b_table_host()
+        x, y = 0, 1
+        for v, (ymx, ypx, t2d) in enumerate(comb):
+            assert ymx == (y - x) % P and ypx == (y + x) % P
+            assert t2d == 2 * _D * x % P * y % P
+            x, y = edp._affine_add((x, y), (_BX, _BY))
+
+    def test_comb_consts_rows_encode_table_both_tiers(self):
+        """Rows 56+3v..58+3v of BOTH tiers' consts matrices hold the comb
+        entries in that tier's limb radix — the rows _make_verify_kernel
+        broadcasts from."""
+        from corda_tpu.ops import ed25519_pallas as edp
+        from corda_tpu.ops import ed25519_pallas13 as e13
+
+        comb = edp._b_comb_host(256)
+        for v in (0, 1, 15, 16, 17, 128, 255):
+            for c in range(3):
+                assert edp.limbs12_to_int(
+                    edp._CONSTS_HOST[56 + 3 * v + c, :22]
+                ) == comb[v][c]
+                assert e13.limbs13_to_int(
+                    e13._CONSTS_HOST[56 + 3 * v + c, :20]
+                ) == comb[v][c]
+
+    def test_comb_digit_recomposition(self):
+        """Σ over even k of (s_k + 16·s_{k+1})·16^k == s — the pairing
+        the even-window comb add relies on."""
+        rng = random.Random(71)
+        for s in (0, 1, L - 1, 2**253 - 1, rng.getrandbits(253)):
+            wins = [(s >> (4 * k)) & 0xF for k in range(64)]
+            assert sum(
+                (wins[k] + 16 * wins[k + 1]) << (4 * k)
+                for k in range(0, 64, 2)
+            ) == s
+
+    def test_select_table_256(self):
+        """The widened branch-free select over a 256-entry table."""
+        import jax
+        import jax.numpy as jnp
+
+        from corda_tpu.ops import ed25519_pallas as edp
+
+        n = 256
+        entries = [
+            tuple(jnp.full((2, 8), 1000 * k + c, jnp.int32)
+                  for c in range(2))
+            for k in range(n)
+        ]
+        idx = jnp.asarray(
+            np.array([0, 1, 15, 16, 127, 128, 254, 255], np.int32))
+        sel = jax.jit(lambda i: edp._select_table(i, entries))(idx)
+        for c in range(2):
+            got = np.asarray(sel[c])
+            for lane, k in enumerate([0, 1, 15, 16, 127, 128, 254, 255]):
+                assert (got[:, lane] == 1000 * k + c).all()
+
+
+def _affine_scalar_mul(k, pt):
+    """k·pt over Python ints on the Edwards curve (identity = (0, 1))."""
+    from corda_tpu.ops import ed25519_pallas as edp
+
+    acc = (0, 1)
+    for bit in reversed(range(max(k.bit_length(), 1))):
+        acc = edp._affine_add(acc, acc)
+        if (k >> bit) & 1:
+            acc = edp._affine_add(acc, pt)
+    return acc

@@ -105,6 +105,20 @@ def sweep() -> dict:
             traceback.print_exc()
         print(key, out[key], flush=True)
 
+    # comb-vs-window A/B at the production block: the 8-bit fixed-base
+    # comb trades wider constant-table selects for half the fixed-base
+    # adds — this column is what arbitrates the CORDA_TPU_*_FIXED_WIN
+    # default on real hardware (ab_* keys never feed shape selection)
+    key = "ab_ed25519_fixedwin4_block_128"
+    try:
+        out[key] = _time_config(lambda: ed25519_verify_pallas(
+            y, r, s, h, sign, pre, block=128, fixed_win=4
+        ))
+    except Exception as e:
+        out[key] = {"error": f"{type(e).__name__}: {e}"[:500]}
+        traceback.print_exc()
+    print(key, out[key], flush=True)
+
     # ECDSA: one valid signature replicated across the batch (prep cost
     # off the timed path)
     from cryptography.hazmat.primitives import hashes, serialization
@@ -116,36 +130,59 @@ def sweep() -> dict:
     from corda_tpu.ops import secp256 as sp
     from corda_tpu.ops.secp256_pallas import ecdsa_verify_pallas
 
-    cv = sp.SECP256K1
-    priv = ec.generate_private_key(ec.SECP256K1())
-    msg = b"sweep"
-    der = priv.sign(msg, ec.ECDSA(hashes.SHA256()))
-    rr, ss = decode_dss_signature(der)
-    if ss > cv.n // 2:
-        ss = cv.n - ss
-    pk = priv.public_key().public_bytes(
-        serialization.Encoding.X962,
-        serialization.PublicFormat.CompressedPoint,
-    )
-    sig = rr.to_bytes(32, "big") + ss.to_bytes(32, "big")
-    planes = sp._prep_byte_planes(
-        cv.name, [pk] * BATCH, [sig] * BATCH, [msg] * BATCH, BATCH
-    )
-    qx, qy, u1b, u2b, ra, rb, rb_ok, pree = planes
     import jax.numpy as jnp
 
-    rb_ok = jnp.asarray(rb_ok)
-    pree = jnp.asarray(pree)
-    for blk in ECDSA_BLOCKS:
-        key = f"ecdsa_k1_block_{blk}"
-        try:
-            out[key] = _time_config(lambda: ecdsa_verify_pallas(
-                cv.name, qx, qy, u1b, u2b, ra, rb, rb_ok, pree, block=blk
-            ))
-        except Exception as e:
-            out[key] = {"error": f"{type(e).__name__}: {e}"[:500]}
-            traceback.print_exc()
-        print(key, out[key], flush=True)
+    def ecdsa_planes(cv, curve_cls):
+        priv = ec.generate_private_key(curve_cls())
+        msg = b"sweep"
+        der = priv.sign(msg, ec.ECDSA(hashes.SHA256()))
+        rr, ss = decode_dss_signature(der)
+        if ss > cv.n // 2:
+            ss = cv.n - ss
+        pk = priv.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.CompressedPoint,
+        )
+        sig = rr.to_bytes(32, "big") + ss.to_bytes(32, "big")
+        planes = sp._prep_byte_planes(
+            cv.name, [pk] * BATCH, [sig] * BATCH, [msg] * BATCH, BATCH
+        )
+        qx, qy, u1b, u2b, ra, rb, rb_ok, pree = planes
+        return (qx, qy, u1b, u2b, ra, rb,
+                jnp.asarray(rb_ok), jnp.asarray(pree))
+
+    def ecdsa_sweep(tag, cv, curve_cls, blocks, ab_configs):
+        """Block sweep at the production tier config, plus A/B columns
+        at block 128 pinning (radix, fixed_win) explicitly — the data
+        that re-arbitrates the CORDA_TPU_*_RADIX / _FIXED_WIN defaults
+        (r5's radix A/B predates the derived fold and the comb)."""
+        args = ecdsa_planes(cv, curve_cls)
+        for blk in blocks:
+            key = f"ecdsa_{tag}_block_{blk}"
+            try:
+                out[key] = _time_config(lambda: ecdsa_verify_pallas(
+                    cv.name, *args, block=blk
+                ))
+            except Exception as e:
+                out[key] = {"error": f"{type(e).__name__}: {e}"[:500]}
+                traceback.print_exc()
+            print(key, out[key], flush=True)
+        for ab_tag, radix, fixed_win in ab_configs:
+            key = f"ab_ecdsa_{tag}_{ab_tag}_block_128"
+            try:
+                out[key] = _time_config(lambda: ecdsa_verify_pallas(
+                    cv.name, *args, block=128,
+                    radix=radix, fixed_win=fixed_win,
+                ))
+            except Exception as e:
+                out[key] = {"error": f"{type(e).__name__}: {e}"[:500]}
+                traceback.print_exc()
+            print(key, out[key], flush=True)
+
+    ecdsa_sweep("k1", sp.SECP256K1, ec.SECP256K1, ECDSA_BLOCKS,
+                [("radix256", 256, None), ("fixedwin4", None, 4)])
+    ecdsa_sweep("r1", sp.SECP256R1, ec.SECP256R1, (128,),
+                [("radix256", 256, None), ("fixedwin4", None, 4)])
     return out
 
 

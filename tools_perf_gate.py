@@ -94,6 +94,12 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     "profile/ecdsa.verify/rows_per_sec": ("higher", 0.50),
     "profile/txid/rows_per_sec": ("higher", 0.50),
     "profile/sha256/rows_per_sec": ("higher", 0.50),
+    # MFU: achieved VPU utilization per signature kernel (bench.py's mfu
+    # section, ops-per-verify derived from the live kernel parameters by
+    # corda_tpu/ops/opcount.py). First-class gated so an arithmetic
+    # regression (or a model/tier mismatch) fails CI, not a human read.
+    "mfu/ed25519/utilization_pct": ("higher", 0.25),
+    "mfu/ecdsa/utilization_pct": ("higher", 0.25),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -163,6 +169,46 @@ def check_schema(result: dict) -> list[str]:
                         f"profile/{kernel}: batch_efficiency {eff} "
                         "outside (0, 1]"
                     )
+    mfu = result.get("mfu")
+    if mfu is not None:
+        if not isinstance(mfu, dict):
+            problems.append("mfu: expected an object of per-scheme entries")
+        else:
+            for scheme, entry in mfu.items():
+                if scheme == "peak_assumption":
+                    continue
+                if not isinstance(entry, dict):
+                    problems.append(f"mfu/{scheme}: expected an object")
+                    continue
+                for key in ("ops_per_verify_millions",
+                            "achieved_int32_gops", "utilization_pct"):
+                    v = entry.get(key)
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool) or v <= 0:
+                        problems.append(
+                            f"mfu/{scheme}: missing positive numeric "
+                            f"{key!r}"
+                        )
+                pct = entry.get("utilization_pct")
+                if isinstance(pct, (int, float)) and pct > 100:
+                    problems.append(
+                        f"mfu/{scheme}: utilization_pct {pct} exceeds 100"
+                    )
+                # internal consistency: achieved == rate × ops/verify
+                # (the cross-check that catches a stale model riding a
+                # fresh capture)
+                rate = resolve_path(result, f"{scheme}_sigs_per_sec")
+                opm = entry.get("ops_per_verify_millions")
+                ach = entry.get("achieved_int32_gops")
+                if (rate and isinstance(opm, (int, float))
+                        and isinstance(ach, (int, float)) and ach > 0):
+                    want = rate * opm * 1e6 / 1e9
+                    if abs(want - ach) > 0.05 * max(want, ach):
+                        problems.append(
+                            f"mfu/{scheme}: achieved_int32_gops {ach} "
+                            f"inconsistent with {scheme}_sigs_per_sec × "
+                            f"ops_per_verify ({want:.1f})"
+                        )
     devices = result.get("devices")
     if devices is not None:
         if not isinstance(devices, dict):
