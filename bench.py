@@ -1255,6 +1255,135 @@ def run_smoke_devicemon() -> dict:
     }
 
 
+def run_smoke_resilience() -> dict:
+    """The smoke's resilience leg (docs/SERVING.md §Self-healing
+    dispatch): one injected STALL (the batch must be hedged to host,
+    first result winning) and one injected CRASH (the batch must be
+    re-dispatched while the strike quarantines the ordinal) through a
+    fresh resilient scheduler, then a REAL canary probe readmits the
+    device. Asserts verdict parity against the expected mask on every
+    path and that the new ``serving.hedge.*`` / ``serving.quarantine.*``
+    counters reconcile with the scenario exactly; emits them as the
+    ``resilience`` section ``tools_perf_gate.py --check-schema``
+    validates. Runs LAST and on a private scheduler, so the injected
+    faults cannot touch any measured number above."""
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.faultinject import FaultInjector, FaultPlan
+    from corda_tpu.faultinject import clear as clear_injector
+    from corda_tpu.faultinject import install as install_injector
+    from corda_tpu.node.monitoring import node_metrics
+    from corda_tpu.serving import (
+        HEALTHY,
+        DeviceScheduler,
+        ResiliencePolicy,
+        ShapeTable,
+    )
+
+    m = node_metrics()
+    names = (
+        "serving.hedge.fired", "serving.hedge.won_host",
+        "serving.hedge.won_device", "serving.hedge.discarded",
+        "serving.quarantine.entered", "serving.quarantine.readmitted",
+        "serving.quarantine.probes", "serving.quarantine.host_routed",
+        "serving.redispatch",
+    )
+    before = {n: m.counter(n).count for n in names}
+    pol = ResiliencePolicy(
+        strikes=2, hedge_min_s=0.15, hedge_max_s=0.5,
+        probe_backoff_s=0.1, breaker_threshold=10,
+        flight_dump_on_quarantine=False,
+    )
+    sched = DeviceScheduler(
+        use_device_default=True,
+        shapes=ShapeTable({"buckets": [8, 16, 32, 64, 128],
+                           "source": "smoke-resilience"}),
+        resilience=pol,
+    )
+    inj = None
+    try:
+        kp = generate_keypair()
+        rows, expected = [], []
+        for i in range(5):
+            msg = b"resilience-%d" % i
+            sig = sign(kp.private, msg)
+            if i == 3:
+                sig = b"\x00" * len(sig)
+            rows.append((kp.public, sig, msg))
+            expected.append(i != 3)
+        # warmup: seeds the latency EWMA that derives the hedge deadline
+        # (no deadline is armed before the first settle — a cold compile
+        # must never be hedged)
+        rr = sched.submit_rows(rows, use_device=True).result(timeout=300)
+        assert rr.mask.tolist() == expected, "resilience warmup verdicts"
+        assert rr.n_device == len(rows), "warmup did not settle on device"
+        ordinal = rr.device
+        # injected stall (site call #1) then crash (#2); the crash's
+        # re-dispatch routes host (the ordinal is quarantined by then:
+        # stall strike + crash strike = 2 = the policy's limit), so no
+        # third device dispatch consults the site
+        inj = install_injector(FaultInjector(FaultPlan(
+            seed=2026,
+            stall_sites=(("serving.dispatch", 1, 2.0),),
+            fail_sites=(("serving.dispatch", 2),),
+        )))
+        t0 = time.perf_counter()
+        rr_stall = sched.submit_rows(rows, use_device=True).result(timeout=60)
+        hedge_ms = (time.perf_counter() - t0) * 1e3
+        assert rr_stall.mask.tolist() == expected, "hedged verdicts diverged"
+        assert rr_stall.n_device == 0, "hedge winner must be the host path"
+        assert hedge_ms < 1800, f"hedge did not beat the stall: {hedge_ms}ms"
+        rr_crash = sched.submit_rows(rows, use_device=True).result(timeout=60)
+        assert rr_crash.mask.tolist() == expected, "re-dispatch verdicts"
+        assert rr_crash.n_device == 0, "quarantined ordinal saw traffic"
+        clear_injector()
+        inj = None
+        # the canary probe (a REAL known-answer device dispatch) must
+        # readmit the ordinal, after which traffic runs on device again
+        deadline = time.monotonic() + 120
+        while (pol.quarantine.state(ordinal) != HEALTHY
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert pol.quarantine.state(ordinal) == HEALTHY, (
+            f"canary probe never readmitted: {pol.quarantine.snapshot()}"
+        )
+        rr_back = sched.submit_rows(rows, use_device=True).result(timeout=300)
+        assert rr_back.mask.tolist() == expected
+        assert rr_back.n_device == len(rows), "readmitted device unused"
+        breaker_state = pol.breaker.state
+    finally:
+        if inj is not None:
+            clear_injector()
+        sched.shutdown()
+    delta = {n: m.counter(n).count - before[n] for n in names}
+    # counters reconcile with the scenario: one stall → one fired hedge
+    # won by host, whose late readback was discarded at drain; one crash
+    # → one re-dispatch; one quarantine episode entered and exited
+    assert delta["serving.hedge.fired"] == 1, delta
+    assert delta["serving.hedge.won_host"] == 1, delta
+    assert delta["serving.hedge.won_device"] == 0, delta
+    assert delta["serving.hedge.discarded"] == 1, delta
+    assert delta["serving.quarantine.entered"] == 1, delta
+    assert delta["serving.quarantine.readmitted"] == 1, delta
+    assert delta["serving.quarantine.probes"] >= 1, delta
+    assert delta["serving.redispatch"] == 1, delta
+    assert delta["serving.quarantine.host_routed"] >= 1, delta
+    assert breaker_state == 0, "the breaker must not trip in this leg"
+    return {
+        "resilience": {
+            "hedge_fired": delta["serving.hedge.fired"],
+            "hedge_won_host": delta["serving.hedge.won_host"],
+            "hedge_won_device": delta["serving.hedge.won_device"],
+            "hedge_discarded": delta["serving.hedge.discarded"],
+            "quarantine_entered": delta["serving.quarantine.entered"],
+            "quarantine_readmitted": delta["serving.quarantine.readmitted"],
+            "quarantine_probes": delta["serving.quarantine.probes"],
+            "redispatched": delta["serving.redispatch"],
+            "breaker_state": breaker_state,
+            "hedge_ms": round(hedge_ms, 1),
+        }
+    }
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1371,6 +1500,13 @@ def run_smoke() -> int:
         # scheduler's counters, in both the snapshot and the Prometheus
         # device.* families. Reuses the profile pass's compiled bucket.
         out.update(run_smoke_devicemon())
+
+        # 9. resilience pass (docs/SERVING.md §Self-healing dispatch):
+        # one injected stall (hedged to host, first result wins) and one
+        # injected crash (re-dispatched, ordinal quarantined, readmitted
+        # by a real canary probe) on a private scheduler, run LAST so
+        # the faults cannot touch any measured number above.
+        out.update(run_smoke_resilience())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -89,6 +89,14 @@ class FaultPlan:
     # ---- named-site op failures (device dispatch, fabric control ops)
     op_fail_p: float = 0.0
     fail_sites: tuple = ()             # ((site, nth_call), ...) — explicit
+    # ---- named-site op STALLS: the op succeeds but only after an
+    # injected delay — a sick device that computes without failing. The
+    # delay is returned by check_site and applied by the site's owner at
+    # its stall point (the serving scheduler / verifier bucket inject it
+    # into the pending's readiness, so the batch is genuinely in flight
+    # and not-ready for the whole delay — the shape the hedge path must
+    # survive). ((site, nth_call, delay_s), ...).
+    stall_sites: tuple = ()
     # ---- topology faults
     partitions: tuple = ()             # Partition entries
     crashes: tuple = ()                # CrashEvent entries
@@ -212,25 +220,52 @@ class FaultInjector:
         return False
 
     # ---------------------------------------------------------- op sites
+    def _next_call(self, site: str) -> int:
+        """One shared per-site call counter: fail and stall schedules
+        address the same nth-call ordinal whichever mode fires."""
+        with self._lock:
+            nth = self._site_counts[site] = self._site_counts[site] + 1
+        return nth
+
+    def _fail_decision(self, site: str, nth: int) -> bool:
+        for want_site, want_nth in self.plan.fail_sites:
+            if want_site == site and want_nth == nth:
+                return True
+        return bool(
+            self.plan.op_fail_p
+            and self._u("op", site, nth) < self.plan.op_fail_p
+        )
+
+    def _stall_decision(self, site: str, nth: int) -> float:
+        for want_site, want_nth, delay_s in self.plan.stall_sites:
+            if want_site == site and want_nth == nth:
+                return max(float(delay_s), 0.0)
+        return 0.0
+
     def fail_op(self, site: str) -> bool:
         """Probabilistic / scheduled failure for a named op site; the
         caller turns True into its own error type (the fabric raises
         ConnectionError to drive its reconnect path)."""
-        with self._lock:
-            nth = self._site_counts[site] = self._site_counts[site] + 1
-        for want_site, want_nth in self.plan.fail_sites:
-            if want_site == site and want_nth == nth:
-                self._record("op-fail", site, str(nth))
-                return True
-        if self.plan.op_fail_p and self._u("op", site, nth) < self.plan.op_fail_p:
+        nth = self._next_call(site)
+        if self._fail_decision(site, nth):
             self._record("op-fail", site, str(nth))
             return True
         return False
 
-    def check_site(self, site: str) -> None:
-        """Raise InjectedFault when the plan fails this site's nth call."""
-        if self.fail_op(site):
+    def check_site(self, site: str) -> float:
+        """Raise InjectedFault when the plan fails this site's nth call;
+        otherwise return the injected STALL delay for it (0.0 when none).
+        The caller owns the stall semantics: the serving/verifier sites
+        graft the delay onto the dispatched pending's readiness so the
+        batch stalls in flight rather than blocking its dispatcher."""
+        nth = self._next_call(site)
+        delay = self._stall_decision(site, nth)
+        if delay > 0:
+            self._record("op-stall", site, str(nth))
+        if self._fail_decision(site, nth):
+            self._record("op-fail", site, str(nth))
             raise InjectedFault(f"injected fault at {site}")
+        return delay
 
 
 # -------------------------------------------------- module-level install
@@ -260,9 +295,11 @@ def active() -> FaultInjector | None:
     return _active
 
 
-def check_site(site: str) -> None:
+def check_site(site: str) -> float:
     """No-op unless a plan is installed — the production-path cost of the
-    hook is one global read."""
+    hook is one global read. Returns the injected stall delay (0.0 when
+    no plan, or the plan leaves this call alone)."""
     inj = _active
     if inj is not None:
-        inj.check_site(site)
+        return inj.check_site(site)
+    return 0.0

@@ -293,17 +293,21 @@ def monitoring_snapshot() -> dict:
     ``CordaRPCOps.profiler_snapshot()``), ``devices`` the per-device
     telemetry registry (observability/devicemon — ``{"enabled": false}``
     while off), ``slo`` the SLO monitor's evaluated objectives
-    (observability/slo, same off-marker contract), ``process`` the
+    (observability/slo, same off-marker contract), ``resilience`` the
+    self-healing dispatch policy's quarantine/breaker state machines
+    (serving/resilience — same off-marker contract), ``process`` the
     remaining cross-cutting metrics (e.g. the verifier's
     ``device_failover`` counters)."""
     from corda_tpu.observability.devicemon import devices_section
     from corda_tpu.observability.slo import slo_section
+    from corda_tpu.serving.resilience import resilience_section
 
     return {
         "serving": _process_registry.section("serving."),
         "profiler": _process_registry.section("profiler."),
         "devices": devices_section(),
         "slo": slo_section(),
+        "resilience": resilience_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler."))

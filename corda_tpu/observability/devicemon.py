@@ -125,6 +125,11 @@ class DeviceMonitor:
         self._platform = ""
         self._jax_devices: dict[int, object] = {}
         self.events: deque = deque(maxlen=max(16, event_ring))
+        # health-event subscribers (the serving resilience policy turns
+        # device.unhealthy into quarantine strikes); notified OUTSIDE the
+        # lock, exceptions swallowed — a listener must never break the
+        # watchdog sweep that fed it
+        self._listeners: list = []
 
     # ------------------------------------------------------------- config
     @property
@@ -231,24 +236,28 @@ class DeviceMonitor:
             )
 
     def record_settle(self, ordinal: int, wall_s: float,
-                      *, ok: bool = True) -> None:
+                      *, ok: bool = True, ewma: bool = True) -> None:
         """One tracked batch completed on ``ordinal`` after ``wall_s``
         (dispatch→settle wall): updates the execute EWMA, the completion
-        heartbeat, and releases the in-flight count."""
+        heartbeat, and releases the in-flight count. ``ewma=False``
+        records the heartbeat/in-flight release WITHOUT folding the wall
+        into the EWMA — a hedge-lost late readback's stall-inflated wall
+        would otherwise grow the very hedge deadline (EWMA × factor)
+        that exists to catch this device's stalls."""
         now = self._clock()
         with self._lock:
             slot = self._slot_locked(ordinal)
             slot.settles += 1
             slot.inflight = max(0, slot.inflight - 1)
             slot.last_settle_t = now
-            if ok:
+            if not ok:
+                slot.failures += 1
+            elif ewma:
                 w = max(float(wall_s), 0.0)
                 slot.exec_ewma_s = (
                     w if slot.exec_ewma_s == 0.0
                     else 0.7 * slot.exec_ewma_s + 0.3 * w
                 )
-            else:
-                slot.failures += 1
 
     def record_failure(self, ordinal: int) -> None:
         """A dispatch that never reached the device (failover before
@@ -260,7 +269,39 @@ class DeviceMonitor:
               padded_lanes: int = 0) -> DispatchProbe:
         return DispatchProbe(self, ordinal, rows, padded_lanes)
 
+    # ----------------------------------------------------- event listeners
+    def subscribe(self, fn) -> None:
+        """Register a health-event listener: called once per edge-
+        triggered ``device.unhealthy`` / ``device.recovered`` event dict,
+        outside the monitor lock, on the thread that ran the watchdog
+        sweep. Idempotent per callable."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def unsubscribe(self, fn) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _notify(self, events: list) -> None:
+        """Fan events out to subscribers — lock NOT held (a listener may
+        take its own locks, dispatch probes, or write a flight dump)."""
+        for fn in list(self._listeners):
+            for event in events:
+                try:
+                    fn(event)
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------- health
+    def execute_ewma(self, ordinal: int) -> float:
+        """The ordinal's dispatch→settle wall EWMA (0.0 before any ok
+        settle) — the resilience policy's hedge-deadline input."""
+        with self._lock:
+            slot = self._slots.get(ordinal)
+            return slot.exec_ewma_s if slot is not None else 0.0
+
     def unhealthy_ordinals(self) -> list[int]:
         """The ordinals currently flagged by the watchdog — the read the
         future mesh scheduler consults before striping a batch."""
@@ -483,6 +524,9 @@ class DeviceWatchdog:
                 node_metrics().counter(
                     "device.unhealthy_events"
                 ).inc(unhealthy)
+            # subscription hook (outside the monitor lock): the serving
+            # resilience policy turns evictions into quarantine strikes
+            mon._notify(emitted)
         return emitted
 
     # ----------------------------------------------------------- lifecycle

@@ -385,6 +385,17 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
     lines.append({"kind": "metrics", "snapshot": monitoring_snapshot()})
     lines.append({"kind": "devices", "snapshot": devices_section()})
     lines.append({"kind": "slo", "snapshot": slo_section()})
+    try:
+        # breaker/quarantine status (serving/resilience.py): the state a
+        # device-eviction dump exists to explain — {"enabled": false}
+        # when no policy is live
+        from corda_tpu.serving.resilience import resilience_section
+
+        lines.append({
+            "kind": "resilience", "snapshot": resilience_section(),
+        })
+    except Exception:
+        pass  # the dump must land even if the serving layer is broken
     for event in list(devicemon().events) + list(_global.events):
         lines.append({"kind": "event", "event": event})
     try:
@@ -413,10 +424,11 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
 def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
-    / ``slo`` (the snapshots), ``events`` (device + SLO health events),
-    ``faults`` (injected chaos events), ``header``."""
+    / ``slo`` / ``resilience`` (the snapshots), ``events`` (device + SLO
+    health events), ``faults`` (injected chaos events), ``header``."""
     out: dict = {"header": None, "spans": [], "metrics": None,
-                 "devices": None, "slo": None, "events": [], "faults": []}
+                 "devices": None, "slo": None, "resilience": None,
+                 "events": [], "faults": []}
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -428,7 +440,7 @@ def read_flight_dump(path: str) -> dict:
                 out["header"] = rec
             elif kind == "span":
                 out["spans"].append(rec["span"])
-            elif kind in ("metrics", "devices", "slo"):
+            elif kind in ("metrics", "devices", "slo", "resilience"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])

@@ -10,6 +10,20 @@ throughput, and the role the reference delegates to the Artemis verifier
 queue in front of OutOfProcessTransactionVerifierService.
 """
 
+from .resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    SUSPECT,
+    CircuitBreaker,
+    DeviceQuarantine,
+    ResiliencePolicy,
+    active_policy,
+    resilience_section,
+)
 from .scheduler import (
     BULK,
     INTERACTIVE,
@@ -28,9 +42,21 @@ from .scheduler import (
 from .shapes import DEFAULT_SHAPES, ShapeTable, load_shape_table, shape_table
 
 __all__ = [
+    "BREAKER_CLOSED",
+    "BREAKER_HALF_OPEN",
+    "BREAKER_OPEN",
     "BULK",
+    "CircuitBreaker",
+    "DeviceQuarantine",
+    "HEALTHY",
     "INTERACTIVE",
+    "PROBATION",
+    "QUARANTINED",
+    "ResiliencePolicy",
+    "SUSPECT",
     "SERVICE",
+    "active_policy",
+    "resilience_section",
     "DeadlineExceededError",
     "DeviceScheduler",
     "FuturePending",

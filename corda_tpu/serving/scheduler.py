@@ -48,6 +48,7 @@ live in the process registry (``node_metrics()``) under ``serving.*``.
 from __future__ import annotations
 
 import math
+import os
 import queue as _queue
 import threading
 import time
@@ -129,7 +130,7 @@ class RowResult:
 
 class _Request:
     __slots__ = ("rows", "future", "priority", "use_device", "min_bucket",
-                 "enqueued_at", "deadline", "queue_span")
+                 "enqueued_at", "deadline", "queue_span", "redispatches")
 
     def __init__(self, rows, future, priority, use_device, min_bucket,
                  enqueued_at, deadline, queue_span=NOOP_SPAN):
@@ -144,19 +145,32 @@ class _Request:
         # admission on the submitting thread, finishes on the dispatcher
         # thread when the request leaves the queue for a batch
         self.queue_span = queue_span
+        # times this request re-entered the queue after a failed device
+        # dispatch (the resilience re-dispatch path) — bounded by the
+        # policy's redispatch_limit, then it host-fails-over like before
+        self.redispatches = 0
 
 
 class _InFlight:
     """One dispatched DEVICE batch: the async pending (no readback yet)
     plus the bookkeeping to slice verdicts back per request at collect
     time. Host-routed requests never enter the in-flight pipeline — they
-    settle on the scheduler's host pool straight from dispatch."""
+    settle on the scheduler's host pool straight from dispatch.
+
+    With a resilience policy attached the entry also carries the hedge
+    state: an in-flight ``deadline``, whether the hedge ``fired``
+    (``hedged``), which side completed the futures first (``winner`` —
+    claimed exactly once under the scheduler lock; futures are completed
+    first-wins either way), and whether the device depth slot was already
+    released (``slot_freed`` — decremented exactly once whichever of the
+    hedge and the collector gets there first)."""
 
     __slots__ = ("requests", "pending", "n_rows", "dev_map", "seq", "t0",
-                 "span", "device")
+                 "span", "device", "deadline", "hedged", "winner",
+                 "slot_freed", "compile_keys")
 
     def __init__(self, requests, pending, n_rows, dev_map, seq, t0,
-                 span=NOOP_SPAN, device=None):
+                 span=NOOP_SPAN, device=None, compile_keys=frozenset()):
         self.requests = requests
         self.pending = pending
         self.n_rows = n_rows
@@ -165,6 +179,11 @@ class _InFlight:
         self.t0 = t0
         self.span = span            # serving.batch span, finished at settle
         self.device = device        # ordinal the dispatch ran on
+        self.compile_keys = compile_keys  # (scheme, bucket) shapes dispatched
+        self.deadline = None        # monotonic hedge deadline (None: unhedged)
+        self.hedged = False         # the hedge timer fired for this batch
+        self.winner = None          # None | "device" | "host"
+        self.slot_freed = False     # depth slot released exactly once
 
 
 def _metrics():
@@ -212,10 +231,23 @@ class DeviceScheduler:
         depth: int = 3,
         host_workers: int = 4,
         shapes=None,
+        resilience=None,
     ):
         # `shapes`: an explicit ShapeTable override (tests and the smoke
         # harness pin small pad buckets to reuse already-compiled shapes)
+        # `resilience`: a ResiliencePolicy the scheduler consults on every
+        # dispatch and settle (quarantine routing, hedge deadlines,
+        # circuit breaker, re-dispatch — docs/SERVING.md §Self-healing
+        # dispatch). None consults CORDA_TPU_RESILIENCE=1 for a default
+        # policy; False pins it off.
         self._shapes = shapes or shape_table()
+        if resilience is None and os.environ.get(
+            "CORDA_TPU_RESILIENCE", ""
+        ).strip().lower() in ("1", "true", "on", "yes"):
+            from .resilience import ResiliencePolicy
+
+            resilience = ResiliencePolicy()
+        self._resilience = resilience or None
         self._use_device_default = use_device_default
         self._max_batch_rows = max_batch_rows or self._shapes.max_bucket
         self._min_batch_rows = min_batch_rows
@@ -255,8 +287,32 @@ class DeviceScheduler:
         self._collector = threading.Thread(
             target=self._collect_loop, name="serving-collect", daemon=True
         )
+        # hedge monitor (resilience only): armed in-flight entries whose
+        # deadline may expire before the collector hears back — shares
+        # self._lock (condition) with the dispatcher/collector
+        self._hedge_entries: list[_InFlight] = []
+        # late-readback reaper threads (one per hedged batch): joined —
+        # with a BOUND — at shutdown so a drain still observes the
+        # discard counters, but a truly wedged readback cannot hang
+        # shutdown or park a host-pool worker forever
+        self._reapers: list[threading.Thread] = []
+        # (scheme, bucket) shapes that have settled on device at least
+        # once: a first-touch dispatch of a NEW shape may legally be a
+        # multi-second XLA compile (one compile per scheme × bucket), so
+        # only batches whose every shape is warm get a hedge deadline —
+        # without this, ramp-up across pad buckets reads as a stall and
+        # strikes/trips against a perfectly healthy device
+        self._warm_keys: set = set()
+        self._hedge: threading.Thread | None = None
+        if self._resilience is not None:
+            self._resilience.attach(self)
+            self._hedge = threading.Thread(
+                target=self._hedge_loop, name="serving-hedge", daemon=True
+            )
         self._dispatcher.start()
         self._collector.start()
+        if self._hedge is not None:
+            self._hedge.start()
 
     # ------------------------------------------------------------- submit
     @property
@@ -451,6 +507,10 @@ class DeviceScheduler:
                 continue  # host-only batch: settling on the host pool
             with self._lock:
                 self._inflight += 1
+            # hedge arming comes AFTER the slot accounting: a deadline
+            # that fired in between would otherwise release a slot that
+            # was never counted
+            self._arm_hedge(entry)
             self._inflight_q.put(entry)
         self._inflight_q.put(None)
 
@@ -471,6 +531,156 @@ class DeviceScheduler:
             r.queue_span.set_error(err)
             r.queue_span.finish()
             _complete(r.future, error=err)
+
+    def _requeue_failed(self, dev_reqs: list) -> list:
+        """Deterministic re-dispatch (resilience): put failed device
+        requests back at the FRONT of their priority queues with their
+        ORIGINAL arrival times — re-assembly orders them exactly where
+        they were, so a quarantine-triggering failure costs one retry,
+        not queue position. Verification is pure, so re-execution is
+        safe; the futures stay pending (completed exactly once by
+        whichever dispatch finally settles them). Returns the requests
+        that exhausted their redispatch budget — the caller host-fails
+        them over like the legacy path."""
+        pol = self._resilience
+        retry = [r for r in dev_reqs
+                 if r.redispatches < pol.redispatch_limit]
+        rest = [r for r in dev_reqs
+                if r.redispatches >= pol.redispatch_limit]
+        if retry:
+            _metrics().counter("serving.redispatch").inc(len(retry))
+            with self._lock:
+                for r in reversed(retry):
+                    r.redispatches += 1
+                    # its queue wait was already recorded at dispatch;
+                    # the retry must not double-finish the span
+                    r.queue_span = NOOP_SPAN
+                    self._queues[r.priority].appendleft(r)
+                    self._queued_rows += len(r.rows)
+                self._lock.notify_all()
+        return rest
+
+    # ------------------------------------------------------------- hedging
+    def _arm_hedge(self, entry: _InFlight) -> None:
+        """Give one dispatched device batch its in-flight deadline
+        (execute-wall EWMA × hedge factor, via the policy) and hand it to
+        the hedge monitor. No policy, no device, or no EWMA yet (a cold
+        first dispatch may legally be a multi-minute compile) leaves the
+        entry unarmed — the collector blocks on it like the legacy path."""
+        pol = self._resilience
+        if pol is None or entry.device is None:
+            return
+        with self._lock:
+            ewma = self._latency_ewma
+            # a batch touching any not-yet-settled (scheme, bucket) shape
+            # may be paying its one-off compile: never hedge it (an EWMA
+            # seeded by warm shapes says nothing about a cold compile)
+            if not entry.compile_keys <= self._warm_keys:
+                return
+        deadline_s = pol.hedge_deadline_s(entry.device, ewma)
+        if deadline_s is None:
+            return
+        with self._lock:
+            if entry.winner is not None:
+                return  # already settled: nothing left to hedge
+            entry.deadline = entry.t0 + deadline_s
+            self._hedge_entries.append(entry)
+            self._lock.notify_all()
+
+    def _hedge_loop(self) -> None:
+        """Resilience-only monitor thread: wakes for the earliest armed
+        in-flight deadline, hedges expired batches to the host pool, and
+        runs any due canary probe (quarantine readmission / breaker
+        half-open) — the scheduler's only periodic heartbeat."""
+        while True:
+            due: list[_InFlight] = []
+            with self._lock:
+                if self._closed and not self._hedge_entries:
+                    break
+                now = time.monotonic()
+                nxt = None
+                for e in list(self._hedge_entries):
+                    if e.winner is not None:
+                        self._hedge_entries.remove(e)
+                    elif now >= e.deadline:
+                        due.append(e)
+                        self._hedge_entries.remove(e)
+                    elif nxt is None or e.deadline < nxt:
+                        nxt = e.deadline
+                if not due:
+                    timeout = (
+                        0.2 if nxt is None
+                        else min(max(nxt - now, 0.001), 0.2)
+                    )
+                    self._lock.wait(timeout=timeout)
+            pol = self._resilience
+            if pol is not None:
+                pol.maybe_probe()
+            for e in due:
+                self._fire_hedge(e)
+
+    def _fire_hedge(self, entry: _InFlight) -> None:
+        """An in-flight batch blew its deadline with no settle: re-run it
+        on the host reference path (first result wins) and release the
+        device depth slot — a stalled dispatch must not park a pipeline
+        slot forever. The device's late readback, if it ever lands, is
+        discarded by the collector."""
+        with self._lock:
+            if entry.winner is not None:
+                return  # settled between dequeue and fire
+            entry.hedged = True
+            if not entry.slot_freed:
+                entry.slot_freed = True
+                self._inflight -= 1
+            self._lock.notify_all()
+        _metrics().counter("serving.hedge.fired").inc()
+        entry.span.set_attr("hedged", True)
+        pol = self._resilience
+        if pol is not None and entry.device is not None:
+            pol.on_hedge_fired(entry.device)
+        try:
+            self._host_pool.submit(self._settle_hedge_host, entry)
+        except RuntimeError:
+            self._settle_hedge_host(entry)  # pool closed: settle inline
+
+    def _settle_hedge_host(self, entry: _InFlight) -> None:
+        """The hedge's host leg: re-verify every request on the host
+        reference path, then claim the win — unless the device settled
+        while we verified, in which case its (identical, verification is
+        pure) verdicts already completed the futures and this result is
+        simply dropped."""
+        from corda_tpu.crypto import is_valid
+
+        outcomes: list = []
+        for r in entry.requests:
+            try:
+                outcomes.append((np.array(
+                    [is_valid(k, s, m) for k, s, m in r.rows], dtype=bool
+                ), None))
+            except Exception as e:
+                outcomes.append((None, e))
+        with self._lock:
+            if entry.winner is not None:
+                return  # the device landed first: it won the race
+            entry.winner = "host"
+        _metrics().counter("serving.hedge.won_host").inc()
+        entry.span.set_attr("hedge_winner", "host")
+        pol = self._resilience
+        if pol is not None and entry.device is not None:
+            pol.on_hedge_won_host(entry.device)
+        slo = active_slo()
+        now = time.monotonic()
+        for r, (mask, err) in zip(entry.requests, outcomes):
+            if err is None:
+                if slo is not None:
+                    slo.observe(r.priority, now - r.enqueued_at)
+                _complete(r.future, result=RowResult(mask, 0, entry.seq))
+            else:
+                if slo is not None:
+                    slo.observe(
+                        r.priority, now - r.enqueued_at, error=True
+                    )
+                _complete(r.future, error=err)
 
     def _assemble_locked(self) -> tuple[list, list]:
         """Shed over-deadline work, then assemble one batch under the
@@ -569,6 +779,17 @@ class DeviceScheduler:
         dev_rows: list = []
         dev_map: list = []
         ordinal = None
+        pol = self._resilience
+        if dev_reqs and pol is not None:
+            # the resilience gate, consulted on EVERY dispatch: an open
+            # breaker or a quarantined ordinal routes the whole device
+            # cohort to the host pool — zero device enqueues, the
+            # verdicts identical by the shared host reference path
+            ordinal = default_device_ordinal()
+            if not pol.admit_device(ordinal):
+                batch_span.set_attr("resilience_host_routed", True)
+                host_reqs = host_reqs + dev_reqs
+                dev_reqs = []
         if dev_reqs:
             floor = 0
             for i, r in enumerate(dev_reqs):
@@ -581,6 +802,12 @@ class DeviceScheduler:
             from corda_tpu.verifier.batch import dispatch_signature_rows
 
             bucket = self._shapes.bucket_for(len(dev_rows), floor=floor)
+            # each scheme bucket compiles independently: the shapes this
+            # dispatch may have to compile, checked warm before hedging
+            compile_keys = frozenset(
+                (getattr(k, "scheme_id", None), bucket)
+                for k, _s, _m in dev_rows
+            )
 
             def lanes_of(pending):
                 # ground truth from the dispatch itself: each scheme
@@ -600,7 +827,11 @@ class DeviceScheduler:
                 # this batch's span with their kernel/bucket (no-op unless
                 # the profiler is on AND the span is sampled)
                 with tracer().activate(batch_span), stamp_span(batch_span):
-                    check_site("serving.dispatch")
+                    # check_site returns an injected STALL delay (the
+                    # stall_sites fault mode): grafted onto the pending
+                    # below, so the batch dispatches normally and then
+                    # sits not-ready in flight — the hedge path's shape
+                    stall_s = check_site("serving.dispatch")
                     prof = active_profiler()
                     if prof is None:
                         pending = dispatch_signature_rows(
@@ -614,6 +845,10 @@ class DeviceScheduler:
                             ),
                             rows=len(dev_rows), bucket=lanes_of,
                         )
+                if stall_s:
+                    injector = getattr(pending, "inject_stall", None)
+                    if injector is not None:
+                        injector(stall_s)
                 # bucket-induced waste, visible with the profiler OFF:
                 # wasted lanes per dispatch (histogram) + the cumulative
                 # fill-ratio gauge registered in _register_process_gauges
@@ -636,12 +871,22 @@ class DeviceScheduler:
                         ordinal, rows=len(dev_rows), padded_lanes=padded
                     )
             except Exception:
-                m.counter("serving.device_failover").inc()
-                batch_span.set_attr("device_failover", True)
                 mon = active_devicemon()
                 if mon is not None:
                     mon.record_failure(default_device_ordinal())
-                host_reqs = host_reqs + dev_reqs
+                if pol is not None:
+                    # resilience path: strike the ordinal + breaker, then
+                    # RE-DISPATCH — the requests re-enter the queue with
+                    # their original arrival times and priority (no
+                    # starvation: they go back to the FRONT), and only a
+                    # request that exhausted its redispatch budget falls
+                    # over to host like the legacy path
+                    pol.on_dispatch_failure(default_device_ordinal())
+                    dev_reqs = self._requeue_failed(dev_reqs)
+                if dev_reqs:
+                    m.counter("serving.device_failover").inc()
+                    batch_span.set_attr("device_failover", True)
+                    host_reqs = host_reqs + dev_reqs
                 dev_reqs, pending = [], None
         device_entry = bool(dev_reqs and pending is not None)
         batch_span.set_attr(
@@ -659,7 +904,13 @@ class DeviceScheduler:
                 self._settle_host(host_reqs, seq, host_span)  # pool closed
         if device_entry:
             return _InFlight(dev_reqs, pending, len(dev_rows), dev_map,
-                             seq, t0, span=batch_span, device=ordinal)
+                             seq, t0, span=batch_span, device=ordinal,
+                             compile_keys=compile_keys)
+        if not host_reqs:
+            # the whole batch was re-dispatched: nobody else will finish
+            # this span (no host settle, no device entry)
+            batch_span.set_attr("redispatched", True)
+            batch_span.finish()
         return None
 
     # ------------------------------------------------------------ collect
@@ -718,21 +969,78 @@ class DeviceScheduler:
                 (e for e in live if _pending_ready(e.pending)), None
             )
             if entry is None:
-                entry = live[0]
+                head = live[0]
+                if head.hedged:
+                    # stall-proof: NEVER wedge the collector on a batch
+                    # whose hedge already fired — its futures are the
+                    # host leg's job, and a permanently stalled readback
+                    # would park every later batch's settle behind it.
+                    # The late readback is reaped (collected, discarded,
+                    # devicemon-settled) on the host pool instead.
+                    live.remove(head)
+                    self._reap_late(head)
+                    continue
+                if head.deadline is not None:
+                    # an armed (hedgeable) batch: bounded wait, so the
+                    # hedge firing mid-block cannot strand us — re-poll
+                    # readiness and the hedged flag on a short tick
+                    time.sleep(0.005)
+                    continue
+                entry = head  # legacy path: block on the oldest dispatch
             elif entry is not live[0]:
                 _metrics().counter("serving.settle_reorder").inc()
             live.remove(entry)
             self._settle_entry(entry)
 
+    def _reap_late(self, entry: "_InFlight") -> None:
+        """Settle a hedged batch off the collector thread: the blocking
+        readback (however late — possibly NEVER, for a truly wedged
+        device) runs on a dedicated daemon thread, and the shared settle
+        logic decides the race — the device may still win if the host
+        leg has not claimed yet, otherwise the readback is discarded.
+        NOT the host pool: a permanently stalled readback would park one
+        of its fixed workers forever, wedging the host fallback path the
+        hedge exists to provide (and shutdown's ``wait=True`` drain with
+        it). The collector stays live either way; shutdown joins reapers
+        with a BOUND, and a still-blocked one dies with the daemon flag
+        at process exit."""
+        t = threading.Thread(
+            target=self._settle_entry, args=(entry,),
+            name="serving-reap", daemon=True,
+        )
+        with self._lock:
+            # prune finished reapers as we go: a long-lived scheduler on
+            # a flapping device must not accumulate dead Thread objects
+            self._reapers = [r for r in self._reapers if r.is_alive()]
+            self._reapers.append(t)
+        t.start()
+
     def _settle_entry(self, entry: "_InFlight") -> None:
         try:
             self._settle(entry)
         except Exception as e:
+            with self._lock:
+                # a hedged batch's device-side ERROR never claims the
+                # win: the host leg is (or was) re-verifying and its good
+                # verdicts must complete the futures — hedging exists
+                # precisely to insure against this outcome
+                ceded = entry.hedged and entry.winner != "device"
+                if entry.winner is None and not entry.hedged:
+                    entry.winner = "device"
             mon = active_devicemon()
             if mon is not None and entry.device is not None:
                 mon.record_settle(
                     entry.device, time.monotonic() - entry.t0, ok=False
                 )
+            pol = self._resilience
+            if pol is not None and entry.device is not None:
+                pol.on_dispatch_failure(entry.device)
+            if ceded:
+                _metrics().counter("serving.hedge.discarded").inc()
+                entry.span.set_error(e)
+                entry.span.set_attr("hedge_winner", "host")
+                entry.span.finish()
+                return
             slo = active_slo()
             if slo is not None:
                 now = time.monotonic()
@@ -746,7 +1054,13 @@ class DeviceScheduler:
                 _complete(r.future, error=e)
         finally:
             with self._lock:
-                self._inflight -= 1
+                if not entry.slot_freed:
+                    entry.slot_freed = True
+                    self._inflight -= 1
+                try:
+                    self._hedge_entries.remove(entry)
+                except ValueError:
+                    pass
                 self._lock.notify_all()
 
     def _settle(self, entry: _InFlight) -> None:
@@ -763,12 +1077,40 @@ class DeviceScheduler:
                 n_device[i] += 1
         latency = time.monotonic() - entry.t0
         m = _metrics()
+        with self._lock:
+            lost = entry.winner == "host"
+            if entry.winner is None:
+                entry.winner = "device"
+            # the device completed this readback (even a hedge-lost late
+            # one): its shapes are compiled — hedgeable from here on
+            self._warm_keys |= entry.compile_keys
         m.timer("serving.batch_latency_s").update(latency)
         mon = active_devicemon()
         if mon is not None and entry.device is not None:
             # the per-device completion heartbeat + execute-wall EWMA the
-            # watchdog's straggler/stall rules evaluate
-            mon.record_settle(entry.device, latency)
+            # watchdog's straggler/stall rules evaluate — recorded even
+            # for a hedge-lost batch (the device really did complete
+            # now), but a lost readback's stall-inflated wall stays OUT
+            # of the EWMA: folding it would grow the hedge deadline
+            # (EWMA × factor) precisely on the device whose stalls it
+            # exists to catch
+            mon.record_settle(entry.device, latency, ewma=not lost)
+        pol = self._resilience
+        if lost:
+            # the hedge's host leg already completed every future: this
+            # is the loser's late readback, discarded by contract (the
+            # verdicts are identical — verification is pure — but the
+            # futures were completed exactly once, by the winner)
+            m.counter("serving.hedge.discarded").inc()
+            entry.span.set_attr("hedge_winner", "host")
+            entry.span.set_attr("n_rows", entry.n_rows)
+            entry.span.finish()
+            return
+        if pol is not None and entry.device is not None:
+            pol.on_settle_ok(entry.device)
+        if entry.hedged:
+            m.counter("serving.hedge.won_device").inc()
+            entry.span.set_attr("hedge_winner", "device")
         slo = active_slo()
         if slo is not None:
             now = time.monotonic()
@@ -807,6 +1149,18 @@ class DeviceScheduler:
         # settlements, then the collector drain the device pipeline
         self._host_pool.shutdown(wait=True)
         self._collector.join(timeout=timeout)
+        # bounded reaper drain: hedged batches' late readbacks usually
+        # land here (their discard counters visible after shutdown), but
+        # a truly wedged one cannot hang us — it is a daemon thread
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            reapers = list(self._reapers)
+        for t in reapers:
+            t.join(timeout=max(deadline - time.monotonic(), 0.0))
+        if self._hedge is not None:
+            self._hedge.join(timeout=timeout)
+        if self._resilience is not None:
+            self._resilience.detach(self)
 
 
 class FuturePending:

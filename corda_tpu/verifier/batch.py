@@ -22,6 +22,7 @@ compiles once per bucket.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -105,7 +106,7 @@ class PendingRows:
     """
 
     __slots__ = ("_n", "_deferred", "_out", "device_rows", "device_mask",
-                 "padded_lanes")
+                 "padded_lanes", "stall_until")
 
     def __init__(self, n: int):
         self._n = n
@@ -120,6 +121,21 @@ class PendingRows:
         # (each bucket pads independently) — the ground truth behind the
         # scheduler's pad-waste/fill-ratio accounting; 0 for host-only
         self.padded_lanes = 0
+        # injected-stall horizon (faultinject): until this monotonic time
+        # the batch reports not-ready and collect() waits it out — a sick
+        # device that computes, just far too slowly. None = no stall.
+        self.stall_until: float | None = None
+
+    def inject_stall(self, delay_s: float) -> None:
+        """Graft a deterministic stall onto this dispatch (the
+        ``stall_sites`` fault mode): the batch stays genuinely in flight
+        and not-ready for ``delay_s`` — the shape the scheduler's hedge
+        path must survive. Stalls from several sites compound to the
+        furthest horizon."""
+        if delay_s <= 0:
+            return
+        horizon = time.monotonic() + delay_s
+        self.stall_until = max(self.stall_until or 0.0, horizon)
 
     def ready(self) -> bool:
         """Non-blocking: True when every enqueued device bucket has
@@ -129,6 +145,9 @@ class PendingRows:
         first."""
         from corda_tpu.ops._blockpack import result_ready
 
+        if self.stall_until is not None and \
+                time.monotonic() < self.stall_until:
+            return False
         return all(result_ready(mask) for _idxs, mask, _fb in self._deferred)
 
     def collect(self) -> np.ndarray:
@@ -141,6 +160,11 @@ class PendingRows:
         # oldest dispatch (the FIFO degenerate case).
         from corda_tpu.ops._blockpack import result_ready
 
+        if self.stall_until is not None:
+            wait = self.stall_until - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)  # the injected device stall, served here
+            self.stall_until = None
         deferred, self._deferred = self._deferred, []
         while deferred:
             entry = next(
@@ -224,10 +248,13 @@ def _dispatch_device_bucket(
 ) -> None:
     """Enqueue one scheme bucket on device; raises on dispatch failure
     (the caller degrades to host). The faultinject site lets a seeded
-    chaos plan force exactly this failure."""
+    chaos plan force exactly this failure — or an injected STALL, which
+    grafts onto the pending so the bucket computes but stays not-ready
+    for the delay (the batch stalls in flight, the dispatcher does not
+    block)."""
     from corda_tpu.faultinject import check_site
 
-    check_site("verifier.device")
+    stall_s = check_site("verifier.device")
     keys = [rows[i][0].encoded for i in idxs]
     sigs = [rows[i][1] for i in idxs]
     msgs = [rows[i][2] for i in idxs]
@@ -298,6 +325,8 @@ def _dispatch_device_bucket(
     # count this scheme bucket really occupied on device
     shape = getattr(mask, "shape", None)
     pending.padded_lanes += int(shape[0]) if shape else len(idxs)
+    if stall_s:
+        pending.inject_stall(stall_s)
 
 
 def verify_signature_rows(

@@ -152,6 +152,32 @@ class TestPlanDeterminism:
         ]
         assert True in fates and False in fates
 
+    def test_stall_sites_deterministic_and_recorded(self):
+        """ISSUE 9 satellite: the stall fault mode — check_site returns
+        the scheduled delay for exactly the nth call (recorded as an
+        `op-stall` event, digest-stable), 0.0 everywhere else, and a
+        stall scheduled on the same nth as a fail records BEFORE the
+        fail raises (the op stalled, then died)."""
+        from corda_tpu.faultinject import InjectedFault
+
+        plan = FaultPlan(
+            seed=9, stall_sites=(("serving.dispatch", 2, 0.25),)
+        )
+        a, b = FaultInjector(plan), FaultInjector(plan)
+        for inj in (a, b):
+            assert inj.check_site("serving.dispatch") == 0.0
+            assert inj.check_site("serving.dispatch") == 0.25
+            assert inj.check_site("serving.dispatch") == 0.0
+        assert [e.kind for e in a.trace] == ["op-stall"]
+        assert a.trace_digest() == b.trace_digest()
+
+        both = FaultInjector(FaultPlan(
+            seed=9, stall_sites=(("x", 1, 0.1),), fail_sites=(("x", 1),),
+        ))
+        with pytest.raises(InjectedFault):
+            both.check_site("x")
+        assert [e.kind for e in both.trace] == ["op-stall", "op-fail"]
+
     def test_partition_severs_both_ways_then_heals(self):
         plan = FaultPlan(
             seed=4,

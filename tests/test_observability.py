@@ -331,11 +331,13 @@ class TestMonitoringSurface:
         node_metrics().counter("verifier.device_failover").inc()
         snap = monitoring_snapshot()
         assert set(snap) == {"serving", "profiler", "devices", "slo",
-                             "process"}
-        # devicemon/slo are off by default: bare disabled markers, no
-        # slots laid out, no metrics created (ISSUE 7 overhead contract)
+                             "resilience", "process"}
+        # devicemon/slo/resilience are off by default: bare disabled
+        # markers, no slots laid out, no metrics created (ISSUE 7
+        # overhead contract; ISSUE 9 extends it to the serving policy)
         assert snap["devices"] == {"enabled": False}
         assert snap["slo"] == {"enabled": False}
+        assert snap["resilience"] == {"enabled": False}
         assert "shed" in snap["serving"]
         assert "device_failover" not in snap["serving"]
         assert "verifier.device_failover" in snap["process"]

@@ -725,6 +725,318 @@ class TestServingFaultPlan:
         assert faulted.n_sigs == direct.n_sigs
 
 
+# -------------------------------------------- self-healing serving plane
+
+def _resilience_counters():
+    names = (
+        "serving.hedge.fired", "serving.hedge.won_host",
+        "serving.hedge.won_device", "serving.hedge.discarded",
+        "serving.quarantine.strikes", "serving.quarantine.entered",
+        "serving.quarantine.readmitted", "serving.quarantine.probes",
+        "serving.quarantine.probe_failures",
+        "serving.quarantine.host_routed", "serving.breaker.opened",
+        "serving.breaker.closed", "serving.breaker.host_routed",
+        "serving.redispatch", "serving.device_failover",
+    )
+    # read through the registry snapshot, NOT m.counter(name): a counter
+    # lookup CREATES the metric, and names like serving.device_failover
+    # must not exist until the production path really increments them
+    # (test_observability pins exactly that sectioning contract)
+    snap = node_metrics().snapshot()
+    return {n: snap.get(n, {}).get("count", 0) for n in names}
+
+
+def _delta(before):
+    after = _resilience_counters()
+    return {k: after[k] - before[k] for k in before}
+
+
+class TestResilience:
+    """ISSUE 9 acceptance: the self-healing serving plane — quarantine
+    state machine, hedged dispatch, circuit breaker, deterministic
+    re-dispatch — driven by injected stalls and crashes."""
+
+    def _rows(self, n=5, tamper=(3,)):
+        rows = make_rows(n, tamper=set(tamper))
+        expected = [i not in tamper for i in range(n)]
+        return rows, expected
+
+    def test_quarantine_state_machine_fake_clock(self):
+        """HEALTHY → SUSPECT → QUARANTINED → PROBATION → HEALTHY under a
+        fake clock: strikes accumulate (a clean settle heals a suspect),
+        K strikes evict, probes respect exponential backoff, a failed
+        canary doubles it, a passing one readmits."""
+        from corda_tpu.serving import (
+            HEALTHY,
+            PROBATION,
+            QUARANTINED,
+            SUSPECT,
+            ResiliencePolicy,
+        )
+
+        before = _resilience_counters()
+        now = [100.0]
+        seen: list = []
+        verdicts = [False, True]
+
+        def probe_runner(ordinal):
+            # the probe observes PROBATION: the canary is in flight
+            seen.append((ordinal, pol.quarantine.state(ordinal)))
+            return verdicts.pop(0)
+
+        pol = ResiliencePolicy(
+            strikes=2, probe_backoff_s=1.0, probe_backoff_max_s=8.0,
+            probe_runner=probe_runner, clock=lambda: now[0],
+            flight_dump_on_quarantine=False,
+        )
+        q = pol.quarantine
+        assert q.state(3) == HEALTHY
+        pol.on_hedge_fired(3)                  # stall evidence: strike 1
+        assert q.state(3) == SUSPECT
+        pol.on_settle_ok(3)                    # clean settle heals
+        assert q.state(3) == HEALTHY
+        pol.on_dispatch_failure(3)
+        assert q.state(3) == SUSPECT
+        assert pol.admit_device(3)             # suspects still serve
+        pol.on_dispatch_failure(3)             # strike 2: evicted
+        assert q.state(3) == QUARANTINED
+        assert not pol.admit_device(3)
+        pol.maybe_probe(sync=True)             # backoff not elapsed
+        assert seen == [] and q.state(3) == QUARANTINED
+        now[0] += 1.1
+        pol.maybe_probe(sync=True)             # canary FAILS
+        assert seen == [(3, PROBATION)]
+        assert q.state(3) == QUARANTINED
+        now[0] += 1.1                          # doubled backoff (2.0s)
+        pol.maybe_probe(sync=True)             # ... not elapsed yet
+        assert len(seen) == 1
+        now[0] += 1.0
+        pol.maybe_probe(sync=True)             # canary PASSES
+        assert seen[-1] == (3, PROBATION)
+        assert q.state(3) == HEALTHY
+        assert pol.admit_device(3)
+        d = _delta(before)
+        # 1 hedge strike (healed) + 2 dispatch-failure strikes
+        assert d["serving.quarantine.strikes"] == 3
+        assert d["serving.quarantine.entered"] == 1
+        assert d["serving.quarantine.probes"] == 2
+        assert d["serving.quarantine.probe_failures"] == 1
+        assert d["serving.quarantine.readmitted"] == 1
+
+    def test_stall_and_crash_full_cycle(self):
+        """The acceptance scenario end to end on real CPU device
+        dispatches: one injected STALL is hedged to host (every request
+        completed exactly once, verdicts identical to the host oracle,
+        the loser's late readback discarded), one injected CRASH is
+        re-dispatched while its strike quarantines the ordinal, a REAL
+        known-answer canary probe readmits it, and every new counter
+        reconciles with the scenario's dispatch/settle counts."""
+        from corda_tpu.serving import HEALTHY, ResiliencePolicy, ShapeTable
+
+        before = _resilience_counters()
+        pol = ResiliencePolicy(
+            strikes=2, hedge_min_s=0.15, hedge_max_s=0.5,
+            probe_backoff_s=0.1, breaker_threshold=10,
+            flight_dump_on_quarantine=False,
+        )
+        s = DeviceScheduler(
+            use_device_default=True,
+            shapes=ShapeTable({"buckets": [8, 16, 32],
+                               "source": "test-resilience"}),
+            resilience=pol,
+        )
+        rows, expected = self._rows()
+        inj = install_injector(FaultInjector(FaultPlan(
+            seed=7,
+            stall_sites=(("serving.dispatch", 2, 2.0),),
+            fail_sites=(("serving.dispatch", 3),),
+        )))
+        try:
+            # dispatch 1: clean warmup — seeds the EWMA the hedge
+            # deadline derives from (nothing hedges before it exists)
+            rr = s.submit_rows(rows, use_device=True).result(timeout=300)
+            assert rr.mask.tolist() == expected and rr.n_device == 5
+            ordinal = rr.device
+            # dispatch 2: stalled in flight → hedged; host-oracle
+            # verdicts, completed well before the 2 s stall expires
+            t0 = time.monotonic()
+            rr2 = s.submit_rows(rows, use_device=True).result(timeout=60)
+            assert rr2.mask.tolist() == expected
+            assert rr2.n_device == 0          # the host leg won
+            assert time.monotonic() - t0 < 1.8
+            assert pol.quarantine.state(ordinal) != HEALTHY  # strike 1
+            # dispatch 3: crashes → strike 2 quarantines the ordinal and
+            # the batch re-enters the queue; its retry host-routes
+            rr3 = s.submit_rows(rows, use_device=True).result(timeout=60)
+            assert rr3.mask.tolist() == expected and rr3.n_device == 0
+            clear_injector()
+            # the REAL canary probe (known-answer batch, must settle on
+            # device) readmits the ordinal...
+            deadline = time.monotonic() + 120
+            while (pol.quarantine.state(ordinal) != HEALTHY
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert pol.quarantine.state(ordinal) == HEALTHY, (
+                pol.quarantine.snapshot()
+            )
+            # ... after which traffic runs on device again
+            rr4 = s.submit_rows(rows, use_device=True).result(timeout=300)
+            assert rr4.mask.tolist() == expected and rr4.n_device == 5
+        finally:
+            clear_injector()
+            s.shutdown()
+        d = _delta(before)
+        # counters reconcile exactly with the scenario: 1 stall → 1
+        # fired hedge, won by host, late readback discarded at drain; 1
+        # crash → 1 re-dispatch (NOT a legacy failover), 1 quarantine
+        # episode entered + readmitted via ≥1 probe
+        assert d["serving.hedge.fired"] == 1, d
+        assert d["serving.hedge.won_host"] == 1, d
+        assert d["serving.hedge.won_device"] == 0, d
+        assert d["serving.hedge.discarded"] == 1, d
+        assert d["serving.quarantine.entered"] == 1, d
+        assert d["serving.quarantine.readmitted"] == 1, d
+        assert d["serving.quarantine.probes"] >= 1, d
+        assert d["serving.quarantine.host_routed"] >= 1, d
+        assert d["serving.redispatch"] == 1, d
+        assert d["serving.device_failover"] == 0, d
+        # hedge algebra: every fired hedge resolved exactly one winner
+        assert d["serving.hedge.won_host"] + d["serving.hedge.won_device"] \
+            == d["serving.hedge.fired"]
+
+    def test_breaker_trips_open_routes_host_and_recloses(self):
+        """K consecutive device failures trip the breaker; while open,
+        every batch host-routes with ZERO device enqueues (the fault
+        site is never consulted again); a half-open canary closes it
+        and traffic returns to the device."""
+        from corda_tpu.serving import (
+            BREAKER_CLOSED,
+            BREAKER_OPEN,
+            ResiliencePolicy,
+            ShapeTable,
+        )
+
+        before = _resilience_counters()
+        pol = ResiliencePolicy(
+            strikes=50,                      # isolate the breaker
+            breaker_threshold=2, breaker_backoff_s=0.3,
+            redispatch_limit=1, probe_runner=lambda o: True,
+            flight_dump_on_quarantine=False,
+        )
+        s = DeviceScheduler(
+            use_device_default=True,
+            shapes=ShapeTable({"buckets": [8, 16],
+                               "source": "test-breaker"}),
+            resilience=pol,
+        )
+        rows, expected = self._rows(3, tamper=())
+        inj = install_injector(FaultInjector(FaultPlan(seed=3,
+                                                       op_fail_p=1.0)))
+        try:
+            # dispatch fails, re-dispatch fails again → 2 consecutive
+            # failures → OPEN; the exhausted request host-fails-over
+            rr = s.submit_rows(rows, use_device=True).result(timeout=60)
+            assert rr.mask.tolist() == expected and rr.n_device == 0
+            assert pol.breaker.state == BREAKER_OPEN
+            site_calls = sum(
+                1 for e in inj.trace if e.site == "serving.dispatch"
+            )
+            assert site_calls == 2
+            # while open: host-routed, zero device enqueues
+            rr2 = s.submit_rows(rows, use_device=True).result(timeout=60)
+            assert rr2.mask.tolist() == expected and rr2.n_device == 0
+            assert sum(
+                1 for e in inj.trace if e.site == "serving.dispatch"
+            ) == site_calls
+            clear_injector()
+            # half-open canary (stubbed) closes it after the backoff
+            deadline = time.monotonic() + 30
+            while (pol.breaker.state != BREAKER_CLOSED
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert pol.breaker.state == BREAKER_CLOSED
+            rr3 = s.submit_rows(rows, use_device=True).result(timeout=300)
+            assert rr3.mask.tolist() == expected and rr3.n_device == 3
+        finally:
+            clear_injector()
+            s.shutdown()
+        d = _delta(before)
+        assert d["serving.breaker.opened"] == 1, d
+        assert d["serving.breaker.closed"] == 1, d
+        assert d["serving.breaker.host_routed"] >= 1, d
+        assert d["serving.redispatch"] == 1, d
+        assert d["serving.device_failover"] == 1, d  # budget exhausted
+
+    def test_watchdog_eviction_dumps_flight_record_once(self, tmp_path,
+                                                        monkeypatch):
+        """ISSUE 9 satellite: a watchdog device.unhealthy event strikes
+        the ordinal through the devicemon subscription hook, and the
+        quarantine entry writes EXACTLY ONE flight dump per episode —
+        carrying the breaker/quarantine status, parseable via the
+        existing read_flight_dump path."""
+        from corda_tpu.observability.devicemon import (
+            DeviceMonitor,
+            DeviceWatchdog,
+        )
+        from corda_tpu.observability.slo import read_flight_dump
+        from corda_tpu.serving import QUARANTINED, ResiliencePolicy
+        from corda_tpu.serving import resilience as resilience_mod
+
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_DIR", str(tmp_path))
+        now = [50.0]
+        mon = DeviceMonitor(n_devices=1, enabled=True,
+                            clock=lambda: now[0])
+        pol = ResiliencePolicy(
+            strikes=1, probe_backoff_s=60.0,
+            probe_runner=lambda o: True, clock=lambda: now[0],
+        )
+        mon.subscribe(pol.on_device_event)
+        resilience_mod.register_policy(pol)
+        try:
+            wd = DeviceWatchdog(mon, stall_s=2.0)
+            mon.record_dispatch(0, rows=4)   # in flight, then silence
+            now[0] += 5.0
+            events = wd.check_once()
+            assert any(e["kind"] == "device.unhealthy" for e in events)
+            assert pol.quarantine.state(0) == QUARANTINED
+            dumps = sorted(tmp_path.glob("corda_tpu_flight_*.jsonl"))
+            assert len(dumps) == 1, dumps
+            parsed = read_flight_dump(str(dumps[0]))
+            assert parsed["header"]["reason"] == "device-quarantine:0"
+            res = parsed["resilience"]
+            assert res["enabled"] is True
+            assert res["quarantine"]["ordinals"]["0"]["state"] \
+                == QUARANTINED
+            assert res["breaker"]["state_name"] == "closed"
+            # more strikes in the SAME episode: no second dump
+            pol.on_dispatch_failure(0)
+            wd.check_once()                  # edge-triggered: no re-flag
+            assert len(
+                sorted(tmp_path.glob("corda_tpu_flight_*.jsonl"))
+            ) == 1
+        finally:
+            mon.unsubscribe(pol.on_device_event)
+            resilience_mod.unregister_policy(pol)
+
+    def test_resilience_off_by_default(self):
+        """No policy → no hedge thread, no policy registration, and the
+        monitoring snapshot's resilience section is a bare disabled
+        marker (the devicemon/slo overhead contract, extended)."""
+        from corda_tpu.serving import active_policy
+
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            assert s._resilience is None and s._hedge is None
+            assert active_policy() is None
+            rr = s.submit_rows(make_rows(2)).result(timeout=30)
+            assert rr.mask.all()
+            assert monitoring_snapshot()["resilience"] == {
+                "enabled": False
+            }
+        finally:
+            s.shutdown()
+
+
 # ------------------------------------------------ monitoring + RPC surface
 
 class TestServingObservability:
@@ -821,6 +1133,17 @@ class TestBenchSmoke:
         for entry in out["devices"].values():
             assert entry["inflight"] == 0
             assert entry["rows"] <= entry["padded_rows"]
+        # acceptance (ISSUE 9): the resilience pass injected one stall
+        # (hedged, host won, late readback discarded) and one crash
+        # (re-dispatched; quarantine entered AND exited via a real
+        # canary probe) — the schema mode below validates the section
+        res = out["resilience"]
+        assert res["hedge_fired"] == 1
+        assert res["hedge_won_host"] == 1
+        assert res["quarantine_entered"] == 1
+        assert res["quarantine_readmitted"] == 1
+        assert res["redispatched"] == 1
+        assert res["breaker_state"] == 0
 
         # acceptance: a baseline generated from this same output gates
         # green; an injected profile regression gates red — and the

@@ -139,6 +139,163 @@ class TestSecureClusterSoak:
 
 
 @pytest.mark.slow
+class TestServingChaosSoak:
+    """Self-healing serving plane under seeded chaos (ISSUE 9
+    satellite): a storm of device batches runs against an enabled
+    devicemon watchdog + quarantine + breaker while the FaultPlan
+    schedules STALLS (one long enough for the watchdog's stall rule to
+    evict the ordinal mid-flight) and CRASHES at the serving.dispatch /
+    verifier.device sites. The whole run — including the hedge timer
+    thread — executes under the lock-order sanitizer.
+
+    Asserts: zero lost futures (every one resolves), zero
+    doubly-completed futures (each hedge resolved exactly one winner and
+    every late readback was discarded — the counter algebra that can
+    only hold if completion was single), every verdict identical to the
+    host oracle, and an empty lockwatch cycle report."""
+
+    def test_stall_crash_storm_keeps_every_future_exact(self):
+        from corda_tpu.observability import lockwatch
+
+        lockwatch.reset()
+        lockwatch.install()
+        try:
+            self._storm()
+        finally:
+            lockwatch.uninstall()
+            lockwatch.reset()
+
+    def _storm(self):
+        from corda_tpu.crypto import generate_keypair, is_valid, sign
+        from corda_tpu.faultinject import FaultInjector, FaultPlan
+        from corda_tpu.faultinject import clear as clear_injector
+        from corda_tpu.faultinject import install as install_injector
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability import configure_devicemon, lockwatch
+        from corda_tpu.observability.devicemon import devicemon
+        from corda_tpu.serving import (
+            DeviceScheduler,
+            ResiliencePolicy,
+            ShapeTable,
+        )
+
+        m = node_metrics()
+        names = (
+            "serving.hedge.fired", "serving.hedge.won_host",
+            "serving.hedge.won_device", "serving.hedge.discarded",
+            "serving.quarantine.entered",
+            "serving.quarantine.readmitted", "serving.redispatch",
+        )
+        before = {n: m.counter(n).count for n in names}
+        # watchdog fast enough to catch the long stall mid-flight: its
+        # eviction reaches the policy through the subscription hook
+        configure_devicemon(enabled=True, reset=True, watchdog=True,
+                            interval_s=0.05, stall_s=1.0)
+        pol = ResiliencePolicy(
+            strikes=2, hedge_min_s=0.1, hedge_max_s=0.4,
+            probe_backoff_s=0.2, breaker_threshold=8,
+            flight_dump_on_quarantine=False,
+        )
+        sched = DeviceScheduler(
+            use_device_default=True,
+            shapes=ShapeTable({"buckets": [8, 16, 32],
+                               "source": "soak-resilience"}),
+            resilience=pol,
+        )
+        # serving.dispatch nth accounting: b0=1, crash b1=2 (its
+        # re-dispatch retries as 3), long stall b2=4; the verifier.device
+        # stall lands on whichever bucket dispatch (batch or canary
+        # probe) draws nth 6 — chaos either way, both survivable
+        inj = install_injector(FaultInjector(FaultPlan(
+            seed=2026,
+            stall_sites=(
+                ("serving.dispatch", 4, 2.5),
+                ("verifier.device", 6, 0.5),
+            ),
+            fail_sites=(("serving.dispatch", 2),),
+        )))
+        kp = generate_keypair()
+        futures, oracles = [], []
+
+        def submit(b):
+            rows = []
+            for i in range(5):
+                msg = b"soak-%d-%d" % (b, i)
+                sig = sign(kp.private, msg)
+                if (b + i) % 4 == 0:
+                    sig = b"\x00" * len(sig)
+                rows.append((kp.public, sig, msg))
+            oracles.append([is_valid(k, s, mg) for k, s, mg in rows])
+            futures.append(sched.submit_rows(rows, use_device=True))
+            return futures[-1]
+
+        try:
+            # phase A: a clean batch, then a CRASHED one — struck,
+            # re-dispatched with original arrival time, its retry heals
+            # the suspect with a clean settle
+            submit(0).result(timeout=300)
+            submit(1).result(timeout=60)
+            # phase B: the long stall — hedged to host at ~0.4 s (strike
+            # 1), then a QUIET window with the readback still in flight:
+            # the watchdog's stall rule evicts the ordinal (strike 2 →
+            # quarantine) because nothing else refreshes the heartbeat
+            submit(2).result(timeout=60)
+            time.sleep(1.6)
+            # the eviction must have flowed devicemon → subscription
+            # hook → strike before traffic resumes
+            kinds = {e["kind"] for e in devicemon().events}
+            assert "device.unhealthy" in kinds, kinds
+            # phase C: storm on — batches ride host while quarantined,
+            # return to device after the canary readmits; verdicts are
+            # oracle-identical throughout
+            for b in range(3, 12):
+                submit(b)
+                time.sleep(0.05)
+            for fut, oracle in zip(futures, oracles):
+                rr = fut.result(timeout=180)
+                assert rr.mask.tolist() == oracle, (rr.mask, oracle)
+            # every quarantine episode closes: the real canary probes
+            # readmit each evicted ordinal
+            deadline = time.monotonic() + 90
+            while pol.quarantine.active_count() > 0:
+                assert time.monotonic() < deadline, (
+                    pol.quarantine.snapshot()
+                )
+                time.sleep(0.1)
+        finally:
+            clear_injector()
+            sched.shutdown()
+            configure_devicemon(enabled=False, reset=True,
+                                watchdog=False)
+        delta = {n: m.counter(n).count - before[n] for n in names}
+        # the plan actually exercised the plane
+        assert any(e.kind == "op-stall" for e in inj.trace), "no stall"
+        assert any(e.kind == "op-fail" for e in inj.trace), "no crash"
+        assert delta["serving.hedge.fired"] >= 1, delta
+        assert delta["serving.redispatch"] >= 1, delta
+        assert delta["serving.quarantine.entered"] >= 1, delta
+        # single-completion algebra (post-drain, no hedge unresolved):
+        # every fired hedge resolved exactly one winner, and every
+        # host-won batch's late readback was discarded exactly once —
+        # invariants that can only hold if futures completed once
+        assert (delta["serving.hedge.won_host"]
+                + delta["serving.hedge.won_device"]
+                == delta["serving.hedge.fired"]), delta
+        assert delta["serving.hedge.discarded"] \
+            == delta["serving.hedge.won_host"], delta
+        # quarantine episodes all closed via canary readmission
+        assert delta["serving.quarantine.entered"] \
+            == delta["serving.quarantine.readmitted"], delta
+        # the hedge timer thread passed the runtime lock-order pass: no
+        # A→B/B→A inversion anywhere chaos drove the plane
+        report = lockwatch.cycle_report()
+        assert report == [], (
+            "lock-order inversions under serving chaos: "
+            + "; ".join(" -> ".join(c["cycle"]) for c in report)
+        )
+
+
+@pytest.mark.slow
 class TestSeededChaosSoak:
     """Seeded chaos soak (ISSUE 1 tentpole acceptance): a FaultPlan drives
     drop + delay + duplicate + one scheduled replica crash/restart against

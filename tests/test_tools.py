@@ -258,6 +258,40 @@ class TestPerfGate:
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
 
+    def test_check_schema_validates_resilience_section(self, tmp_path):
+        """ISSUE 9 satellite: the `resilience` section the smoke's
+        self-healing pass emits is schema-validated — well-formed
+        passes; missing/negative counters, more hedge winners than
+        fired hedges, and an out-of-range breaker state fail."""
+        good = dict(self.SYNTHETIC)
+        good["resilience"] = {
+            "hedge_fired": 1, "hedge_won_host": 1, "hedge_won_device": 0,
+            "quarantine_entered": 1, "quarantine_readmitted": 1,
+            "breaker_state": 0,
+        }
+        ok = tmp_path / "res.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("hedge_fired"),
+             "missing numeric 'hedge_fired'"),
+            (lambda d: d.__setitem__("quarantine_entered", -1),
+             "negative quarantine_entered"),
+            (lambda d: d.__setitem__("hedge_won_device", 3),
+             "exceed fired hedges"),
+            (lambda d: d.__setitem__("breaker_state", 7),
+             "outside 0/1/2"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["resilience"])
+            bad = tmp_path / "res_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
     def test_gate_passes_in_tolerance_fails_on_20pct_regression(
         self, tmp_path
     ):

@@ -113,6 +113,13 @@ DEVICES_REQUIRED_KEYS = (
     "dispatches", "settles", "rows", "padded_rows",
 )
 
+# keys the smoke's resilience section must carry for --check-schema
+# (the self-healing serving plane pass — docs/SERVING.md)
+RESILIENCE_REQUIRED_KEYS = (
+    "hedge_fired", "hedge_won_host", "hedge_won_device",
+    "quarantine_entered", "quarantine_readmitted", "breaker_state",
+)
+
 
 def resolve_path(data: dict, path: str):
     """Walk a ``/``-separated path; None when any hop is missing or the
@@ -246,6 +253,34 @@ def check_schema(result: dict) -> list[str]:
                         f"devices/{ordinal}: rows {rows} exceed padded "
                         f"lanes {padded}"
                     )
+    resilience = result.get("resilience")
+    if resilience is not None:
+        if not isinstance(resilience, dict):
+            problems.append("resilience: expected an object")
+        else:
+            for key in RESILIENCE_REQUIRED_KEYS:
+                v = resilience.get(key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(
+                        f"resilience: missing numeric {key!r}"
+                    )
+                elif v < 0:
+                    problems.append(f"resilience: negative {key} {v}")
+            fired = resilience.get("hedge_fired")
+            won = (resilience.get("hedge_won_host"),
+                   resilience.get("hedge_won_device"))
+            if (isinstance(fired, (int, float))
+                    and all(isinstance(w, (int, float)) for w in won)
+                    and sum(won) > fired):
+                problems.append(
+                    f"resilience: hedge winners {sum(won)} exceed fired "
+                    f"hedges {fired} (a hedge resolves at most one winner)"
+                )
+            state = resilience.get("breaker_state")
+            if isinstance(state, (int, float)) and state not in (0, 1, 2):
+                problems.append(
+                    f"resilience: breaker_state {state} outside 0/1/2"
+                )
     return problems
 
 
