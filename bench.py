@@ -1384,6 +1384,88 @@ def run_smoke_resilience() -> dict:
     }
 
 
+def run_smoke_durability() -> dict:
+    """The smoke's durability leg (docs/DURABILITY.md): a
+    ``DurableUniquenessProvider`` commits a deterministic workload —
+    group-commit windows, a mid-stream snapshot + compaction, a
+    double-spend attempt — then is torn down and rebuilt from its
+    directory ALONE, asserting the recovered consumed-set digest is
+    bit-identical and the double-spend stays rejected. Emits the
+    ``durability`` section (recovery wall, group-commit fsync
+    quantiles, replayed/torn/snapshot record counts) that
+    ``tools_perf_gate.py --check-schema`` validates. Deviceless and
+    file-system-only, so it runs on minimal containers."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from corda_tpu.crypto import SecureHash
+    from corda_tpu.durability import DurableStore
+    from corda_tpu.ledger import StateRef
+    from corda_tpu.node.monitoring import node_metrics
+    from corda_tpu.notary import DurableUniquenessProvider
+
+    def tx(i: int) -> SecureHash:
+        return SecureHash(hashlib.sha256(b"smoke-dur-%d" % i).digest())
+
+    base = tempfile.mkdtemp(prefix="smoke-durability-")
+    try:
+        prov = DurableUniquenessProvider(
+            DurableStore(base, name="smoke-notary", snapshot_every=1 << 30)
+        )
+        n, half = 96, 48
+        for start in range(0, half, 8):
+            prov.commit_batch([
+                ([StateRef(tx(i), 0)], tx(1000 + i), "smoke")
+                for i in range(start, start + 8)
+            ])
+        prov.snapshot_now()
+        for start in range(half, n, 8):
+            prov.commit_batch([
+                ([StateRef(tx(i), 0)], tx(1000 + i), "smoke")
+                for i in range(start, start + 8)
+            ])
+        # double-spend attempt: ref 0 again under a different tx — must
+        # conflict now AND after recovery
+        conflict = prov.commit_batch([
+            ([StateRef(tx(0), 0)], tx(9999), "smoke-thief")
+        ])[0]
+        assert conflict is not None, "durability pass admitted a double-spend"
+        digest = prov.consumed_digest()
+        committed = prov.committed_txs()
+        prov.close()
+
+        # "restart": rebuild from the directory alone
+        prov2 = DurableUniquenessProvider(
+            DurableStore(base, name="smoke-notary", snapshot_every=1 << 30)
+        )
+        rep = prov2.last_recovery
+        assert prov2.consumed_digest() == digest, (
+            "recovered consumed-set diverged from the pre-crash state"
+        )
+        assert prov2.committed_txs() == committed
+        conflict = prov2.commit_batch([
+            ([StateRef(tx(0), 0)], tx(9999), "smoke-thief")
+        ])[0]
+        assert conflict is not None, "double-spend admitted after recovery"
+        assert rep.replayed == n - half, rep
+        prov2.close()
+
+        fsync = node_metrics().timer("durability.wal_fsync_s").snapshot()
+        return {
+            "durability": {
+                "recovery_wall_s": round(rep.wall_s, 6),
+                "wal_fsync_p50_ms": round(fsync["p50_s"] * 1e3, 3),
+                "wal_fsync_p99_ms": round(fsync["p99_s"] * 1e3, 3),
+                "replayed_records": rep.replayed,
+                "torn_records": rep.torn,
+                "snapshot_records": rep.snapshot_lsn + 1,
+            }
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1507,6 +1589,15 @@ def run_smoke() -> int:
         # by a real canary probe) on a private scheduler, run LAST so
         # the faults cannot touch any measured number above.
         out.update(run_smoke_resilience())
+
+        # 10. durability pass (docs/DURABILITY.md): a durable notary
+        # provider journals a commit workload (group commit + snapshot +
+        # compaction), restarts from its directory alone, and must land
+        # on a bit-identical consumed-set that still rejects the
+        # double-spend; emits recovery wall + fsync quantiles +
+        # replayed-record count. File-system-only, so it rides after
+        # the fault passes without touching any measured number.
+        out.update(run_smoke_durability())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -1,0 +1,141 @@
+"""durability-ack-order: no client-visible ack before the WAL is durable.
+
+The durability tier's one ordering rule (docs/DURABILITY.md): on any
+notary or flow commit path, the WAL ``append()``/``flush()`` carrying a
+state change must complete BEFORE the corresponding client-visible
+future/ack is completed. Reversing the two re-opens exactly the hole the
+tier closes — a crash between the ack and the fsync forgets an acked
+commit, and a restarted node can re-admit the spent state the client
+believes consumed.
+
+Heuristic (function-local, visitation order — the same simple shape the
+donation pass uses):
+
+- **ack calls**: ``<fut>.set_result(...)`` / ``<fut>.set_exception(...)``
+  (completing a ``concurrent.futures.Future``) and bare ``ack()`` calls
+  (the messaging layer's transport-ack callbacks).
+- **WAL calls**: ``.append(...)`` / ``.flush(...)`` / ``.snapshot(...)``
+  on a receiver whose dotted name mentions the durable tier — any part
+  containing ``wal``, ``durab``, ``journal``, or equal to ``store`` /
+  ``_store`` — so ``self._store.flush()`` and ``wal.append(...)`` match
+  while ``self._pending.append(...)`` (a list) does not.
+- a function is flagged when an ack call PRECEDES any later WAL call in
+  the same body: the ack fired while this very path still had durability
+  work outstanding. Functions doing only one of the two are untouched —
+  most ack sites have no WAL work on their path at all (the flush
+  happened layers below, before the result ever reached them).
+
+Scope: the notary and flow commit paths plus the durability package
+itself (``corda_tpu/notary/``, ``corda_tpu/flows/``,
+``corda_tpu/durability/``) — the layers that own client-visible
+outcomes backed by the WAL.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, Project, qualname_map
+
+PASS_ID = "durability-ack-order"
+
+_SCOPE_PREFIXES = (
+    "corda_tpu/notary/", "corda_tpu/flows/", "corda_tpu/durability/",
+)
+
+_ACK_ATTRS = {"set_result", "set_exception"}
+_WAL_ATTRS = {"append", "flush", "snapshot"}
+_WAL_RECEIVER_PARTS = ("wal", "durab", "journal")
+_WAL_RECEIVER_EXACT = {"store", "_store"}
+
+
+def _receiver_parts(node: ast.AST) -> list[str]:
+    """Dotted parts of a call receiver: ``self._store.flush`` →
+    ["self", "_store"]; dynamic receivers → []."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    parts.reverse()
+    return parts
+
+
+def _is_wal_call(call: ast.Call) -> bool:
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    if call.func.attr not in _WAL_ATTRS:
+        return False
+    recv = _receiver_parts(call.func.value)
+    for part in recv:
+        low = part.lower()
+        if low in _WAL_RECEIVER_EXACT:
+            return True
+        if any(tag in low for tag in _WAL_RECEIVER_PARTS):
+            return True
+    return False
+
+
+def _is_ack_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr in _ACK_ATTRS:
+        return True
+    return isinstance(f, ast.Name) and f.id == "ack"
+
+
+class AckOrderPass:
+    id = PASS_ID
+    doc = (
+        "notary/flow commit paths must not complete a client-visible "
+        "future/ack before the WAL append/flush on the same path"
+    )
+
+    def run(self, project: Project):
+        for sf in project.files:
+            if not sf.rel.startswith(_SCOPE_PREFIXES):
+                continue
+            qnames = qualname_map(sf.tree)
+            for node, qname in qnames.items():
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._scan_function(sf, node, qname)
+
+    def _scan_function(self, sf, fn, qname):
+        # visitation order over the body only — nested defs are scanned
+        # as their own functions (their execution time is not this path)
+        calls: list[tuple[str, ast.Call]] = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                ):
+                    continue  # nested scope: its own path, scanned alone
+                if isinstance(child, ast.Call):
+                    if _is_ack_call(child):
+                        calls.append(("ack", child))
+                    elif _is_wal_call(child):
+                        calls.append(("wal", child))
+                walk(child)
+
+        walk(fn)
+        pending_acks: list[ast.Call] = []
+        flagged: set[int] = set()
+        for kind, call in calls:
+            if kind == "ack":
+                pending_acks.append(call)
+            else:
+                for ack in pending_acks:
+                    if ack.lineno not in flagged:
+                        flagged.add(ack.lineno)
+                        yield Finding(
+                            PASS_ID, sf.rel, ack.lineno,
+                            f"{qname} completes a client-visible "
+                            "future/ack before the WAL "
+                            f"{ast.unparse(call.func)}() later on the "
+                            "same path — a crash in between forgets an "
+                            "acked commit; make the record durable "
+                            "first",
+                            key=f"{sf.rel}::{qname}::ack-before-wal",
+                        )
+                pending_acks.clear()

@@ -6,6 +6,7 @@ Order is report order; ids are the suppression vocabulary
 
 from __future__ import annotations
 
+from .ack_order import AckOrderPass
 from .donation import DonationSafetyPass
 from .hotpath import HotPathBlockingPass
 from .lock_discipline import LockDisciplinePass
@@ -19,6 +20,7 @@ ALL_PASSES = (
     HotPathBlockingPass(),
     ThreadLifecyclePass(),
     SwallowedRollbackPass(),
+    AckOrderPass(),
     MetricsDocPass(),
     FaultSitesPass(),
 )

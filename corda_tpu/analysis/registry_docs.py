@@ -9,9 +9,10 @@
   is a metric no operator will ever find.
 
 - ``fault-sites`` — the ISSUE 6 extension: every fault-site name
-  literal the tree passes to ``check_site("…")`` / ``fail_op("…")``
-  (the corda_tpu/faultinject hook surface) must appear backticked in
-  docs/FAULT_INJECTION.md, and every site documented in that file's
+  literal the tree passes to ``check_site("…")`` / ``fail_op("…")`` /
+  ``crash_point("…")`` (the corda_tpu/faultinject hook surface,
+  including the durability layer's crash sites) must appear backticked
+  in docs/FAULT_INJECTION.md, and every site documented in that file's
   "Fault sites" table must still exist in code — a chaos plan written
   against a renamed site silently injects nothing, which is worse than
   failing.
@@ -39,7 +40,7 @@ _KERNEL_CONST = re.compile(r"^KERNEL_[A-Z0-9_]+\s*=\s*[\"']([^\"']+)[\"']", re.M
 _TRACE_PY = "corda_tpu/observability/trace.py"
 _PROFILER_PY = "corda_tpu/observability/profiler.py"
 
-_SITE_CALLS = {"check_site", "fail_op"}
+_SITE_CALLS = {"check_site", "fail_op", "crash_point"}
 
 
 def _backticked(text: str) -> set[str]:

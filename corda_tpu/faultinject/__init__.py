@@ -6,13 +6,16 @@ from .plan import (
     DeliveryVerdict,
     FaultInjector,
     FaultPlan,
+    InjectedCrash,
     InjectedEvent,
     InjectedFault,
     Partition,
     active,
     check_site,
     clear,
+    crash_point,
     install,
+    truncate_wal_tail,
 )
 
 __all__ = [
@@ -21,11 +24,14 @@ __all__ = [
     "DeliveryVerdict",
     "FaultInjector",
     "FaultPlan",
+    "InjectedCrash",
     "InjectedEvent",
     "InjectedFault",
     "Partition",
     "active",
     "check_site",
     "clear",
+    "crash_point",
     "install",
+    "truncate_wal_tail",
 ]

@@ -40,6 +40,16 @@ class InjectedFault(Exception):
     special-cased rescue."""
 
 
+class InjectedCrash(BaseException):
+    """Raised by ``crash_point`` when the plan schedules a process death at
+    a durability crash site (pre-fsync, post-fsync-pre-ack, mid-snapshot-
+    rename, mid-compaction). A BaseException on purpose: nothing on the
+    dying "process"'s stack may catch and recover it — the kill-storm
+    harness catches it at the very top, discards every in-memory object
+    (that IS the crash) and rebuilds the component from its durability
+    directory alone."""
+
+
 @dataclasses.dataclass(frozen=True)
 class CrashEvent:
     """Scheduled crash of one named node at a pump round; the chaos
@@ -97,6 +107,11 @@ class FaultPlan:
     # and not-ready for the whole delay — the shape the hedge path must
     # survive). ((site, nth_call, delay_s), ...).
     stall_sites: tuple = ()
+    # ---- named-site process CRASHES: the nth call of a durability crash
+    # site raises InjectedCrash — simulated process death at exactly that
+    # instruction (the durability layer guards its fsync/rename/reclaim
+    # boundaries with crash_point). ((site, nth_call), ...).
+    crash_sites: tuple = ()
     # ---- topology faults
     partitions: tuple = ()             # Partition entries
     crashes: tuple = ()                # CrashEvent entries
@@ -267,6 +282,17 @@ class FaultInjector:
             raise InjectedFault(f"injected fault at {site}")
         return delay
 
+    def crash_point(self, site: str) -> None:
+        """Raise InjectedCrash when the plan schedules a crash at this
+        site's nth call. Recorded as an ``op-crash`` event carrying the
+        active trace id like every other injected fault, so a crash joins
+        against the request traces it killed."""
+        nth = self._next_call(site)
+        for want_site, want_nth in self.plan.crash_sites:
+            if want_site == site and want_nth == nth:
+                self._record("op-crash", site, str(nth))
+                raise InjectedCrash(f"injected crash at {site}#{nth}")
+
 
 # -------------------------------------------------- module-level install
 # The device-op hook point (verifier/batch.py) sits below every call
@@ -303,3 +329,41 @@ def check_site(site: str) -> float:
     if inj is not None:
         return inj.check_site(site)
     return 0.0
+
+
+def crash_point(site: str) -> None:
+    """No-op unless a plan is installed (one global read on the production
+    path). Raises InjectedCrash when the active plan schedules a crash at
+    this site's nth call — the durability layer's fsync/rename/reclaim
+    boundaries are guarded with exactly this."""
+    inj = _active
+    if inj is not None:
+        inj.crash_point(site)
+
+
+def truncate_wal_tail(wal_dir, nbytes: int) -> str | None:
+    """The torn-write injector: chop ``nbytes`` off the end of the newest
+    WAL segment under ``wal_dir`` — the on-disk shape a power cut leaves
+    when the kernel tore the final append mid-sector. Returns the path
+    truncated (None when the directory holds no segment, or the cut would
+    empty a file below its header). Recovery must discard exactly the torn
+    tail record and keep every record before it."""
+    import os
+
+    segs = sorted(
+        f for f in os.listdir(wal_dir)
+        if f.startswith("wal-") and f.endswith(".seg")
+    )
+    if not segs:
+        return None
+    path = os.path.join(wal_dir, segs[-1])
+    size = os.path.getsize(path)
+    # never cut into the 16-byte segment header: a headerless segment
+    # reads as a crash-mid-roll artifact and is discarded WHOLE on
+    # recovery — a shape a torn append cannot physically produce, which
+    # would fake "lost acked commits" the real crash model never loses
+    if nbytes <= 0 or size - nbytes < 16:
+        return None
+    with open(path, "r+b") as f:
+        f.truncate(size - nbytes)
+    return path

@@ -27,7 +27,7 @@ from .api import (
     ProgressTracker,
     UntrustworthyData,
 )
-from .checkpoints import CheckpointStorage
+from .checkpoints import CheckpointStorage, WalCheckpointStorage
 from .engine import FlowHandle, StateMachineManager
 from .protocols import (
     AbstractStateReplacementFlow,
@@ -57,6 +57,7 @@ __all__ = [
     "FlowException", "FlowLogic", "FlowSession", "InitiatedBy",
     "ProgressTracker", "UntrustworthyData",
     "CheckpointStorage",
+    "WalCheckpointStorage",
     "FlowHandle", "StateMachineManager",
     "AbstractStateReplacementFlow", "BroadcastTransactionFlow",
     "CollectSignaturesFlow", "ContractUpgradeFlow", "FetchRequest",

@@ -295,9 +295,13 @@ def monitoring_snapshot() -> dict:
     while off), ``slo`` the SLO monitor's evaluated objectives
     (observability/slo, same off-marker contract), ``resilience`` the
     self-healing dispatch policy's quarantine/breaker state machines
-    (serving/resilience — same off-marker contract), ``process`` the
-    remaining cross-cutting metrics (e.g. the verifier's
-    ``device_failover`` counters)."""
+    (serving/resilience — same off-marker contract), ``durability`` the
+    crash-consistent persistence tier's WAL/replay/recovery registries
+    (corda_tpu/durability — ``{"enabled": false}`` until the first
+    DurableStore exists in the process), ``process`` the remaining
+    cross-cutting metrics (e.g. the verifier's ``device_failover``
+    counters)."""
+    from corda_tpu.durability import durability_section
     from corda_tpu.observability.devicemon import devices_section
     from corda_tpu.observability.slo import slo_section
     from corda_tpu.serving.resilience import resilience_section
@@ -308,8 +312,12 @@ def monitoring_snapshot() -> dict:
         "devices": devices_section(),
         "slo": slo_section(),
         "resilience": resilience_section(),
+        "durability": durability_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
-            if not (k.startswith("serving.") or k.startswith("profiler."))
+            if not (k.startswith("serving.") or k.startswith("profiler.")
+                    or k.startswith("durability.")
+                    or k.startswith("replay.")
+                    or k.startswith("recovery."))
         },
     }

@@ -93,6 +93,7 @@ class Node:
         if persistent:
             base.mkdir(parents=True, exist_ok=True)
         db = (lambda f: str(base / f)) if persistent else (lambda f: ":memory:")
+        self._durable_store_for = self._make_durability_factory(base)
 
         network_map = network_map or NetworkMapCache()
         identity_service = IdentityService()
@@ -104,7 +105,8 @@ class Node:
             key_management_service=kms,
             identity_service=identity_service,
             vault_service=NodeVaultService(
-                db("vault.db"), my_keys=kms.keys
+                db("vault.db"), my_keys=kms.keys,
+                journal=self._durable_store_for("vault"),
             ),
             validated_transactions=DBTransactionStorage(db("transactions.db")),
             attachments=AttachmentStorage(db("attachments.db")),
@@ -131,9 +133,16 @@ class Node:
                     CordaX500Name.parse(sender_name)
                 )
                 return info.legal_identity if info else None
+        flow_store = self._durable_store_for("flows")
+        if flow_store is not None:
+            from corda_tpu.flows import WalCheckpointStorage
+
+            checkpoints = WalCheckpointStorage(flow_store)
+        else:
+            checkpoints = CheckpointStorage(db("checkpoints.db"))
         self.smm = StateMachineManager(
             messaging,
-            CheckpointStorage(db("checkpoints.db")),
+            checkpoints,
             self.party,
             party_resolver,
             services=self.services,
@@ -172,6 +181,30 @@ class Node:
         self._started = False
 
     # ------------------------------------------------------------ assembly
+    def _make_durability_factory(self, base: Path):
+        """Owner-name → DurableStore factory, or a None-returning stub
+        when durability is off (the default: nothing imported beyond the
+        cheap enabled() probe, no files opened, no metrics created —
+        docs/DURABILITY.md). Enabled with ``CORDA_TPU_DURABILITY=1``; the
+        base directory is ``CORDA_TPU_WAL_DIR`` (one subdirectory per
+        node name, so in-process ensembles sharing the env don't collide)
+        or the node's own base directory."""
+        from corda_tpu.durability import durability_enabled, store_for
+
+        if not durability_enabled():
+            return lambda owner: None
+        import os as _os
+        import re as _re
+
+        env_base = _os.environ.get("CORDA_TPU_WAL_DIR", "")
+        # per-node-name slug in BOTH branches: in-process ensembles whose
+        # configs share a base_directory (the default ".") must not share
+        # one WAL directory — two WriteAheadLogs on the same files would
+        # truncate each other's live tail segments
+        slug = _re.sub(r"[^A-Za-z0-9_.=,-]", "_", str(self.party.name))
+        root = _os.path.join(env_base or str(base / "durability"), slug)
+        return lambda owner: store_for(owner, base_dir=root)
+
     def _make_verifier_service(self):
         vt = self.config.verifier_type
         if vt is VerifierType.DeviceBatched:
@@ -258,7 +291,13 @@ class Node:
             # BFT clusters remain externally wired (they need the whole
             # replica set's keys up front); the container builds the
             # single-replica and Raft tiers
-            uniqueness = PersistentUniquenessProvider(db("notary.db"))
+            notary_store = self._durable_store_for("notary")
+            if notary_store is not None:
+                from corda_tpu.notary import DurableUniquenessProvider
+
+                uniqueness = DurableUniquenessProvider(notary_store)
+            else:
+                uniqueness = PersistentUniquenessProvider(db("notary.db"))
         self._notary_uniqueness = uniqueness
         cls = ValidatingNotaryService if cfg.validating else SimpleNotaryService
         return cls(self.party, self.keypair, uniqueness)
@@ -300,6 +339,15 @@ class Node:
         self.scheduler.stop()
         self.rpc_server.stop()
         self.smm.stop()
+        # the durable checkpoint tier owns an open WAL tail: release it
+        # on stop so an in-process restart (the chaos orchestrator's
+        # restart_fn shape) never has two handles appending to one
+        # segment. The legacy sqlite storage keeps its historical
+        # never-closed semantics.
+        from corda_tpu.flows import WalCheckpointStorage
+
+        if isinstance(self.smm.checkpoints, WalCheckpointStorage):
+            self.smm.checkpoints.close()
         self.services.shutdown()
         fabric_server = getattr(self, "fabric_server", None)
         if fabric_server is not None:

@@ -111,7 +111,8 @@ class NodeVaultService:
     its participants (or ``observe_all`` is set — observer-node mode).
     """
 
-    def __init__(self, path: str = ":memory:", my_keys=None, observe_all=False):
+    def __init__(self, path: str = ":memory:", my_keys=None, observe_all=False,
+                 journal=None):
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute(
@@ -142,6 +143,25 @@ class NodeVaultService:
         self._my_keys = set(my_keys or [])
         self._observe_all = observe_all
         self._subscribers: list = []
+        # crash-consistent journal (docs/DURABILITY.md): every recorded
+        # transaction lands in the durability WAL and is group-commit
+        # fsynced BEFORE the vault update reaches any subscriber; recovery
+        # rebuilds the consumed/unconsumed pages (newest snapshot + stx
+        # replay), which feeds the normal query/track snapshot path — the
+        # same Page the RPC monitor's accumulate_feed(seed=) consumes.
+        # Meant for the default ':memory:' backing store; a file-backed
+        # SQLite vault is already durable on its own.
+        self._journal = journal
+        self.last_recovery = None
+        # LSN of the last journal record whose SQL effect is known
+        # applied (appends happen strictly AFTER their _apply_stx, so a
+        # snapshot claiming coverage of this LSN can never lack it)
+        self._journal_lsn = -1
+        if journal is not None:
+            self.last_recovery = journal.recover(
+                self._apply_journal, self._load_pages
+            )
+            self._journal_lsn = journal.wal.durable_lsn
 
     # -- recording ------------------------------------------------------------
 
@@ -161,7 +181,31 @@ class NodeVaultService:
 
     def record_transaction(self, stx: SignedTransaction) -> VaultUpdate:
         """Consume inputs we track, record relevant outputs, emit an update
-        (reference: NodeVaultService.notifyAll)."""
+        (reference: NodeVaultService.notifyAll). With a journal, the WAL
+        record is durable before any subscriber sees the update."""
+        update, subs, lsn = self._apply_stx(stx, journal=True)
+        if self._journal is not None:
+            # the group-commit fsync (and the ack it gates — returning,
+            # and the subscriber callbacks below) stays OUTSIDE the lock
+            self._journal.flush()
+            if self._journal.snapshot_due():
+                # cover only OUR record: a rival recorder's later append
+                # may not be in the dump yet; its record replays
+                # idempotently over the snapshot instead
+                self._journal.snapshot(self._dump_pages(), covered_lsn=lsn)
+        if not update.is_empty:
+            for cb in subs:
+                cb(update)
+        return update
+
+    def _apply_stx(self, stx: SignedTransaction, journal: bool = False):
+        """The SQL half of recording one transaction — idempotent (replay
+        of an already-recorded stx changes nothing), shared by the live
+        path (``journal=True``: the WAL record is appended INSIDE the
+        same locked region as the SQL, so WAL order can never invert
+        apply order — a spend journaled before its issue would replay
+        into an unconsumed spent state) and journal recovery (which must
+        not re-append)."""
         wtx = stx.tx
         produced: list[StateAndRef] = []
         consumed: list[StateAndRef] = []
@@ -187,7 +231,7 @@ class NodeVaultService:
                 quantity = token = None
                 if isinstance(amount, Amount):
                     quantity, token = amount.quantity, _token_repr(amount.token)
-                self._db.execute(
+                cur = self._db.execute(
                     "INSERT OR IGNORE INTO vault_states"
                     " (tx_id, output_index, contract, state_class, notary_name,"
                     "  state_blob, quantity, token)"
@@ -198,20 +242,103 @@ class NodeVaultService:
                         serialize(tstate), quantity, token,
                     ),
                 )
-                for p in getattr(tstate.data, "participants", ()):
-                    key = getattr(p, "owning_key", p)
-                    self._db.execute(
-                        "INSERT INTO vault_participants VALUES (?,?,?)",
-                        (stx.id.bytes, idx, serialize(key)),
-                    )
+                if cur.rowcount == 1:
+                    # participants only for a NEWLY-inserted state row, so
+                    # an idempotent re-record (journal replay, client
+                    # retry) cannot duplicate participant rows
+                    for p in getattr(tstate.data, "participants", ()):
+                        key = getattr(p, "owning_key", p)
+                        self._db.execute(
+                            "INSERT INTO vault_participants VALUES (?,?,?)",
+                            (stx.id.bytes, idx, serialize(key)),
+                        )
                 produced.append(StateAndRef(tstate, ref))
             self._db.commit()
+            lsn = None
+            if journal and self._journal is not None:
+                lsn = self._journal.append(
+                    {"k": "stx", "blob": serialize(stx)}
+                )
+                self._journal_lsn = max(self._journal_lsn, lsn)
             subs = list(self._subscribers)
-        update = VaultUpdate(tuple(consumed), tuple(produced))
-        if not update.is_empty:
-            for cb in subs:
-                cb(update)
-        return update
+        return VaultUpdate(tuple(consumed), tuple(produced)), subs, lsn
+
+    # -- durability journal (docs/DURABILITY.md) -------------------------------
+
+    def _apply_journal(self, rec: dict) -> None:
+        if rec["k"] == "stx":
+            self._apply_stx(deserialize(rec["blob"]))
+
+    def _dump_pages(self) -> dict:
+        """Full-page snapshot payload: raw rows of both vault tables."""
+        with self._lock:
+            states = self._db.execute(
+                "SELECT tx_id, output_index, contract, state_class,"
+                " notary_name, state_blob, consumed, consumed_by, lock_id,"
+                " quantity, token FROM vault_states ORDER BY tx_id,"
+                " output_index"
+            ).fetchall()
+            parts = self._db.execute(
+                "SELECT tx_id, output_index, participant_key"
+                " FROM vault_participants ORDER BY tx_id, output_index"
+            ).fetchall()
+        return {"states": [list(r) for r in states],
+                "parts": [list(r) for r in parts]}
+
+    def _load_pages(self, snap: dict) -> None:
+        with self._lock:
+            fresh: set[tuple] = set()
+            for r in snap["states"]:
+                cur = self._db.execute(
+                    "INSERT OR IGNORE INTO vault_states"
+                    " (tx_id, output_index, contract, state_class,"
+                    "  notary_name, state_blob, consumed, consumed_by,"
+                    "  lock_id, quantity, token)"
+                    " VALUES (?,?,?,?,?,?,?,?,?,?,?)",
+                    tuple(r),
+                )
+                if cur.rowcount == 1:
+                    fresh.add((bytes(r[0]), r[1]))
+            # participants only for state rows this load actually added:
+            # a file-backed vault restarting with the journal enabled
+            # already holds them, and vault_participants has no unique
+            # key to dedupe on — a plain re-insert would duplicate the
+            # table on every restart
+            self._db.executemany(
+                "INSERT INTO vault_participants VALUES (?,?,?)",
+                [
+                    tuple(r) for r in snap["parts"]
+                    if (bytes(r[0]), r[1]) in fresh
+                ],
+            )
+            self._db.commit()
+
+    def pages_digest(self) -> str:
+        """One hash over the consumed/unconsumed pages (soft-lock ids
+        excluded — they are flow-lifetime scratch, released on restart) —
+        the kill-storm harness's bit-identical comparison against a
+        never-crashed oracle vault."""
+        import hashlib
+
+        h = hashlib.sha256()
+        with self._lock:
+            for row in self._db.execute(
+                "SELECT tx_id, output_index, contract, state_class,"
+                " notary_name, state_blob, consumed, consumed_by,"
+                " quantity, token FROM vault_states ORDER BY tx_id,"
+                " output_index"
+            ):
+                h.update(repr(row).encode())
+        return h.hexdigest()
+
+    def snapshot_now(self) -> None:
+        """Force a journal snapshot + WAL compaction (tests/operators)."""
+        if self._journal is not None:
+            # read the high-water mark BEFORE dumping: any record at or
+            # below it was fully applied before its append, so the dump
+            # taken after the read must include it
+            lsn = self._journal_lsn
+            self._journal.snapshot(self._dump_pages(), covered_lsn=lsn)
 
     # -- querying -------------------------------------------------------------
 
@@ -401,6 +528,9 @@ class NodeVaultService:
         return picked
 
     def close(self) -> None:
+        if self._journal is not None:
+            self._journal.flush()
+            self._journal.close()
         with self._lock:
             self._db.close()
 

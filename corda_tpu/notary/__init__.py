@@ -8,6 +8,7 @@ as device kernels — BASELINE config #5's target).
 """
 
 from .uniqueness import (
+    DurableUniquenessProvider,
     InMemoryUniquenessProvider,
     NotaryError,
     PersistentUniquenessProvider,
@@ -25,6 +26,7 @@ from .raft_storage import RaftStorage
 from .bft import BFTClusterClient, BFTReplica, BFTUniquenessProvider
 
 __all__ = [
+    "DurableUniquenessProvider",
     "InMemoryUniquenessProvider", "NotaryError", "PersistentUniquenessProvider",
     "UniquenessConflict", "UniquenessProvider",
     "BatchedNotaryService", "NotaryService", "SimpleNotaryService",

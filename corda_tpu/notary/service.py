@@ -79,6 +79,17 @@ class NotaryService:
         self._signed_cache: dict = {}
         self._signed_order: "list" = []
         self._signed_lock = threading.Lock()
+        # durable attestation journal (docs/DURABILITY.md): a provider
+        # offering recovered_signatures/record_signature (the durable
+        # tier) preloads the signed cache across restarts — a recovering
+        # notary answers pre-crash retries with the ORIGINAL attestation
+        # instead of re-running verification, and never double-attests
+        self._sig_journal = getattr(uniqueness, "record_signature", None)
+        recovered = getattr(uniqueness, "recovered_signatures", None)
+        if recovered is not None:
+            for tx_id, sig in recovered().items():
+                self._signed_cache[tx_id] = sig
+                self._signed_order.append(tx_id)
 
     def sign(self, tx_id: SecureHash) -> TransactionSignature:
         return sign_tx_id(self._keypair.private, self._keypair.public, tx_id)
@@ -103,6 +114,10 @@ class NotaryService:
                 del self._signed_order[: len(self._signed_order) // 2]
                 for t in evict:
                     self._signed_cache.pop(t, None)
+        if self._sig_journal is not None:
+            # outside the cache lock: the journal append takes the
+            # provider's own lock and rides the next group-commit flush
+            self._sig_journal(tx_id, sig)
 
     def check_time_window(self, tw: TimeWindow | None) -> None:
         """Reject if the notary's now (±tolerance) is outside the window

@@ -396,6 +396,16 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
         })
     except Exception:
         pass  # the dump must land even if the serving layer is broken
+    try:
+        # durability tier status (corda_tpu/durability): WAL/replay/
+        # recovery registries — {"enabled": false} while off
+        from corda_tpu.durability import durability_section
+
+        lines.append({
+            "kind": "durability", "snapshot": durability_section(),
+        })
+    except Exception:
+        pass
     for event in list(devicemon().events) + list(_global.events):
         lines.append({"kind": "event", "event": event})
     try:
@@ -424,11 +434,12 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
 def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
-    / ``slo`` / ``resilience`` (the snapshots), ``events`` (device + SLO
-    health events), ``faults`` (injected chaos events), ``header``."""
+    / ``slo`` / ``resilience`` / ``durability`` (the snapshots),
+    ``events`` (device + SLO health events), ``faults`` (injected chaos
+    events), ``header``."""
     out: dict = {"header": None, "spans": [], "metrics": None,
                  "devices": None, "slo": None, "resilience": None,
-                 "events": [], "faults": []}
+                 "durability": None, "events": [], "faults": []}
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -440,7 +451,8 @@ def read_flight_dump(path: str) -> dict:
                 out["header"] = rec
             elif kind == "span":
                 out["spans"].append(rec["span"])
-            elif kind in ("metrics", "devices", "slo", "resilience"):
+            elif kind in ("metrics", "devices", "slo", "resilience",
+                          "durability"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])

@@ -26,12 +26,20 @@ def make_test_party(name: str, city: str = "London", country: str = "GB"):
 
 class MockNode:
     """One full in-process node: ServiceHub + StateMachineManager over the
-    shared mock transport (reference: MockNode, MockNode.kt:177)."""
+    shared mock transport (reference: MockNode, MockNode.kt:177).
+
+    Restartable for crash/recovery scenarios (docs/DURABILITY.md): pass
+    ``keypair`` (the identity must survive the restart), ``endpoint``
+    (the transport handle ``net.restart_node(name)`` returned) and
+    ``checkpoints`` (the durable storage the previous incarnation wrote)
+    to rebuild a node from durable state alone — the kill-storm soak's
+    restart path."""
 
     def __init__(self, net: InMemoryMessagingNetwork, name: str,
                  network_map: NetworkMapCache, party_resolver,
-                 notary_service_factory=None, clock=None):
-        self.keypair = generate_keypair()
+                 notary_service_factory=None, clock=None,
+                 keypair=None, endpoint=None, checkpoints=None):
+        self.keypair = keypair or generate_keypair()
         self.party = Party(
             CordaX500Name(name, "London", "GB"), self.keypair.public
         )
@@ -49,8 +57,9 @@ class MockNode:
             notary_service=notary_service,
         )
         self.smm = StateMachineManager(
-            net.create_node(str(self.party.name)),
-            CheckpointStorage(),
+            endpoint if endpoint is not None
+            else net.create_node(str(self.party.name)),
+            checkpoints if checkpoints is not None else CheckpointStorage(),
             self.party,
             party_resolver,
             services=self.services,
@@ -97,14 +106,17 @@ class MockNetworkNodes:
 
     def create_node(self, name: str, notary_service_factory=None,
                     validating_notary: bool | None = None,
-                    clock=None) -> MockNode:
+                    clock=None, keypair=None, endpoint=None,
+                    checkpoints=None) -> MockNode:
         node = MockNode(
             self.net, name, self.nmap, self.parties.get,
             notary_service_factory, clock=clock,
+            keypair=keypair, endpoint=endpoint, checkpoints=checkpoints,
         )
         self.parties[str(node.party.name)] = node.party
-        self.nmap.add_node(node.info)
-        if notary_service_factory is not None:
+        if endpoint is None:
+            self.nmap.add_node(node.info)
+        if notary_service_factory is not None and endpoint is None:
             self.nmap.add_notary(
                 node.party,
                 validating=True if validating_notary is None else validating_notary,

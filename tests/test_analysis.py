@@ -133,6 +133,30 @@ def walk_right(pending):
         raise
 """
 
+ACK_ORDER_FIXTURE = """\
+class Notary:
+    def bad_commit(self, fut, rec):
+        fut.set_result(rec)
+        self._wal.append(rec)
+        self._wal.flush()
+
+    def good_commit(self, fut, rec):
+        self._wal.append(rec)
+        self._wal.flush()
+        fut.set_result(rec)
+
+    def ack_without_wal_work(self, fut, rec):
+        fut.set_result(rec)
+
+    def list_append_is_not_wal(self, fut, rec):
+        fut.set_result(rec)
+        self._pending.append(rec)
+
+    def bare_ack_before_store_flush(self, ack, rec):
+        ack()
+        self._store.flush()
+"""
+
 
 class TestPasses:
     def test_lock_discipline_flags_outside_lock_write(self, tmp_path):
@@ -231,6 +255,49 @@ class TestPasses:
         # fire_and_forget suppressed inline; explicit_nondaemon still live
         assert len(inline) == 1
         assert [f.key.split("::")[1] for f in live] == ["explicit_nondaemon"]
+
+    def test_ack_order_flags_ack_before_wal_only(self, tmp_path):
+        live, _ = _findings(
+            tmp_path, "durability-ack-order",
+            {"corda_tpu/notary/svc.py": ACK_ORDER_FIXTURE},
+        )
+        # bad_commit (future before wal append) + the bare-ack-before-
+        # store-flush shape; good ordering, ack-only paths, and list
+        # .append receivers stay clean
+        assert len(live) == 2, [f.render() for f in live]
+        assert {"bad_commit" in f.message or
+                "bare_ack_before_store_flush" in f.message
+                for f in live} == {True}
+        assert all(f.pass_id == "durability-ack-order" for f in live)
+
+    def test_ack_order_out_of_scope_file_is_clean(self, tmp_path):
+        # same defect outside the notary/flows/durability commit paths:
+        # not this pass's business
+        live, _ = _findings(
+            tmp_path, "durability-ack-order",
+            {"corda_tpu/serving/svc.py": ACK_ORDER_FIXTURE},
+        )
+        assert live == []
+
+    def test_ack_order_respects_inline_suppression(self, tmp_path):
+        fixed = ACK_ORDER_FIXTURE.replace(
+            "    def bad_commit(self, fut, rec):\n        fut.set_result(rec)",
+            "    def bad_commit(self, fut, rec):\n"
+            "        # tpu-lint: allow=durability-ack-order legacy path\n"
+            "        fut.set_result(rec)",
+        ).replace(
+            "    def bare_ack_before_store_flush(self, ack, rec):\n"
+            "        ack()",
+            "    def bare_ack_before_store_flush(self, ack, rec):\n"
+            "        # tpu-lint: allow=durability-ack-order legacy path\n"
+            "        ack()",
+        )
+        live, inline = _findings(
+            tmp_path, "durability-ack-order",
+            {"corda_tpu/notary/svc.py": fixed},
+        )
+        assert live == []
+        assert len(inline) == 2
 
     def test_rollback_flags_narrow_catch(self, tmp_path):
         live, _ = _findings(
@@ -496,11 +563,12 @@ class TestAnalysisSelfCheck:
         ids = [p.id for p in ALL_PASSES]
         assert len(ids) == len(set(ids))
         assert all(p.doc for p in ALL_PASSES)
-        # the five tentpole passes + the two folded registry passes
+        # the five tentpole passes + the two folded registry passes +
+        # the durability ack-order pass (ISSUE 10)
         assert set(ids) == {
             "lock-discipline", "donation-safety", "hot-path-blocking",
             "thread-lifecycle", "swallowed-rollback", "metrics-doc",
-            "fault-sites",
+            "fault-sites", "durability-ack-order",
         }
 
     def test_unknown_pass_id_raises(self):

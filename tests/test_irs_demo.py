@@ -12,6 +12,7 @@ import hashlib
 import time
 
 import pytest
+from conftest import node_process_capability
 
 from corda_tpu.crypto import SecureHash, generate_keypair
 from corda_tpu.ledger import (
@@ -337,7 +338,17 @@ class TestIRSDriver:
     ensemble whose real node schedulers run every fixing to maturity,
     observed only via RPC (reference: IRSDemoTest.kt)."""
 
+    # multi-process tier: skip (with the reason) when the environment
+    # cannot bind sockets / spawn node subprocesses, instead of failing
+    pytestmark = pytest.mark.skipif(
+        bool(node_process_capability()),
+        reason=node_process_capability() or "",
+    )
+
     def test_scheduled_fixings_to_maturity(self, tmp_path):
+        from conftest import require_driver_ensemble
+
+        require_driver_ensemble()
         from corda_tpu.flows.api import class_path
         from corda_tpu.testing import driver
 

@@ -35,10 +35,27 @@ from corda_tpu.testing import driver
 
 from corda_tpu.messaging import SECURE_TRANSPORT_AVAILABLE
 
-pytestmark = pytest.mark.skipif(
-    not SECURE_TRANSPORT_AVAILABLE,
-    reason="secure transport needs the 'cryptography' package",
-)
+# gate on the ACTUAL capability, both halves: the secure transport must
+# be functional (importable cryptography + a working issue/verify probe —
+# a broken OpenSSL binding imports fine and fails every operation), and
+# the environment must be able to bind sockets / spawn processes for the
+# fabric broker tiers. Either gap skips with its reason instead of failing.
+from conftest import node_process_capability, secure_transport_capability
+
+pytestmark = [
+    pytest.mark.skipif(
+        not SECURE_TRANSPORT_AVAILABLE,
+        reason="secure transport needs the 'cryptography' package",
+    ),
+    pytest.mark.skipif(
+        bool(secure_transport_capability()),
+        reason=secure_transport_capability() or "",
+    ),
+    pytest.mark.skipif(
+        bool(node_process_capability()),
+        reason=node_process_capability() or "",
+    ),
+]
 
 
 class TestCertificates:

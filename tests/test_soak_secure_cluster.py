@@ -17,6 +17,7 @@ import time
 from concurrent.futures import TimeoutError as FutureTimeoutError
 
 import pytest
+from conftest import node_process_capability
 
 from corda_tpu.flows.api import class_path
 from corda_tpu.ledger import CordaX500Name
@@ -24,8 +25,20 @@ from corda_tpu.testing import driver
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(
+    bool(node_process_capability()),
+    reason=node_process_capability() or "",
+)
 class TestSecureClusterSoak:
     def test_storm_survives_replica_and_worker_crash(self, tmp_path):
+        from conftest import (
+            require_driver_ensemble,
+            secure_transport_capability,
+        )
+
+        if secure_transport_capability():
+            pytest.skip(secure_transport_capability())
+        require_driver_ensemble()
         from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
 
         raft_names = [

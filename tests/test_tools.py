@@ -292,6 +292,40 @@ class TestPerfGate:
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
 
+    def test_check_schema_validates_durability_section(self, tmp_path):
+        """ISSUE 10 satellite: the `durability` section the smoke's
+        crash-consistency pass emits is schema-validated — well-formed
+        passes; missing/negative fields and non-monotone fsync
+        quantiles (p99 below p50) fail."""
+        good = dict(self.SYNTHETIC)
+        good["durability"] = {
+            "recovery_wall_s": 0.004, "wal_fsync_p50_ms": 0.2,
+            "wal_fsync_p99_ms": 0.31, "replayed_records": 48,
+            "torn_records": 0, "snapshot_records": 48,
+        }
+        ok = tmp_path / "dur.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("recovery_wall_s"),
+             "missing numeric 'recovery_wall_s'"),
+            (lambda d: d.pop("wal_fsync_p99_ms"),
+             "missing numeric 'wal_fsync_p99_ms'"),
+            (lambda d: d.__setitem__("replayed_records", -3),
+             "negative replayed_records"),
+            (lambda d: d.__setitem__("wal_fsync_p99_ms", 0.1),
+             "below p50"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["durability"])
+            bad = tmp_path / "dur_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
     def test_gate_passes_in_tolerance_fails_on_20pct_regression(
         self, tmp_path
     ):

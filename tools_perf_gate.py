@@ -120,6 +120,15 @@ RESILIENCE_REQUIRED_KEYS = (
     "quarantine_entered", "quarantine_readmitted", "breaker_state",
 )
 
+# keys the smoke's durability section must carry for --check-schema
+# (the crash-consistent persistence pass — docs/DURABILITY.md):
+# recovery wall, the group-commit fsync quantiles, and the replayed /
+# torn record counts of the recovery the pass performed
+DURABILITY_REQUIRED_KEYS = (
+    "recovery_wall_s", "wal_fsync_p50_ms", "wal_fsync_p99_ms",
+    "replayed_records", "torn_records", "snapshot_records",
+)
+
 
 def resolve_path(data: dict, path: str):
     """Walk a ``/``-separated path; None when any hop is missing or the
@@ -280,6 +289,26 @@ def check_schema(result: dict) -> list[str]:
             if isinstance(state, (int, float)) and state not in (0, 1, 2):
                 problems.append(
                     f"resilience: breaker_state {state} outside 0/1/2"
+                )
+    durability = result.get("durability")
+    if durability is not None:
+        if not isinstance(durability, dict):
+            problems.append("durability: expected an object")
+        else:
+            for key in DURABILITY_REQUIRED_KEYS:
+                v = durability.get(key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(f"durability: missing numeric {key!r}")
+                elif v < 0:
+                    problems.append(f"durability: negative {key} {v}")
+            p50 = durability.get("wal_fsync_p50_ms")
+            p99 = durability.get("wal_fsync_p99_ms")
+            if (isinstance(p50, (int, float)) and isinstance(p99, (int, float))
+                    and not isinstance(p50, bool) and not isinstance(p99, bool)
+                    and p99 < p50):
+                problems.append(
+                    f"durability: wal_fsync_p99_ms {p99} below p50 {p50} "
+                    "(quantiles must be monotone)"
                 )
     return problems
 
