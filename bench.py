@@ -933,6 +933,10 @@ def _mfu_analysis(data: dict) -> None:
             "vpu_peak_assumed_gops": round(_VPU_PEAK_OPS / 1e9, 1),
             "utilization_pct": round(100 * achieved / _VPU_PEAK_OPS, 1),
         }
+    # the RLC batch model rides along unconditionally: it is pure op
+    # census (no measured rate), and gating mfu/ed25519_batch/
+    # ops_per_verify must work on every capture
+    out["ed25519_batch"] = dict(models["ed25519_batch"])
     if out:
         out["peak_assumption"] = _VPU_PEAK_ASSUMPTION
         data["mfu"] = out
@@ -1466,6 +1470,98 @@ def run_smoke_durability() -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_smoke_batchverify() -> dict:
+    """The smoke's batch-verification leg (docs/BATCH_VERIFY.md): the
+    RLC batch check must agree with per-signature verification on clean
+    N=16 and N=64 batches, bisect planted forgeries at the corner
+    positions (first/middle/last row) down to exactly those rows, and a
+    BLS12-381 aggregate quorum certificate must survive an
+    encode → decode → verify round trip. Pure host big-int arithmetic
+    (no device, no jax), so it runs on minimal containers. Emits the
+    ``batchverify`` section ``tools_perf_gate.py --check-schema``
+    validates, including the opcount model's batch-vs-per-sig ratio."""
+    import hashlib
+
+    from corda_tpu.batchverify import bls, verify_batch_rlc
+    from corda_tpu.batchverify.qc import QuorumCertificate, decode_attestation
+    from corda_tpu.crypto import (
+        EDDSA_ED25519_SHA512, derive_keypair_from_entropy, sign,
+    )
+    from corda_tpu.ops.opcount import active_models
+
+    def make_rows(n: int, tag: str):
+        rows = []
+        for i in range(n):
+            kp = derive_keypair_from_entropy(
+                EDDSA_ED25519_SHA512,
+                hashlib.sha256(b"smoke-bv-%s-%d" % (tag.encode(), i)).digest(),
+            )
+            msg = b"smoke-bv-%d" % i
+            rows.append((kp.public.encoded, sign(kp.private, msg), msg))
+        return rows
+
+    rows16, rows64 = make_rows(16, "a"), make_rows(64, "b")
+    t0 = time.perf_counter()
+    parity = (verify_batch_rlc(rows16) == [True] * 16
+              and verify_batch_rlc(rows64) == [True] * 64)
+    # plant forgeries at the bisection corner positions: altered message
+    # → wrong h_i, so decompression succeeds and only the RLC check (then
+    # the binary split) can isolate them
+    planted = (0, 31, 63)
+    forged = list(rows64)
+    for i in planted:
+        pub, sig, msg = forged[i]
+        forged[i] = (pub, sig, msg + b"!")
+    verdicts = verify_batch_rlc(forged)
+    found = tuple(i for i, ok in enumerate(verdicts) if not ok)
+    rlc_ms = (time.perf_counter() - t0) * 1e3
+
+    # BLS aggregate quorum certificate round trip: 4 members, 3 signers
+    t0 = time.perf_counter()
+    members = [
+        bls.derive_keypair_from_entropy(
+            hashlib.sha256(b"smoke-qc-%d" % i).digest()
+        )
+        for i in range(4)
+    ]
+    for pub, priv in members:
+        bls.register_pop(pub, bls.prove_possession(priv))
+    outcome = b"smoke-qc-outcome"
+    shares = [bls.sign(members[i][1], outcome) for i in (0, 2, 3)]
+    qc = QuorumCertificate(
+        message=outcome, agg_sig=bls.aggregate(shares),
+        bitmap=0b1101, n=4,
+    )
+    decoded = decode_attestation(qc.encode())
+    agg_ok = (
+        isinstance(decoded, QuorumCertificate)
+        and decoded == qc
+        and decoded.verify([pub for pub, _ in members])
+        and not decoded.verify([members[i][0] for i in (1, 0, 2, 3)])
+    )
+    bls_ms = (time.perf_counter() - t0) * 1e3
+
+    model = active_models()["ed25519_batch"]
+    return {
+        # the deterministic RLC op model rides in the mfu section so the
+        # perf gate's mfu/ed25519_batch/ops_per_verify pin works on
+        # smoke captures too (the model needs no device to evaluate)
+        "mfu": {"ed25519_batch": dict(model)},
+        "batchverify": {
+            "rlc_parity_ok": int(parity and found == planted),
+            "rlc_rows": len(rows16) + 2 * len(rows64),
+            "rlc_ms": round(rlc_ms, 1),
+            "offenders_expected": len(planted),
+            "offenders_found": len(found),
+            "bls_aggregate_ok": int(agg_ok),
+            "bls_signers": 3,
+            "bls_ms": round(bls_ms, 1),
+            "model_ops_per_verify": model["ops_per_verify"],
+            "model_savings_vs_per_sig": model["savings_vs_per_sig"],
+        }
+    }
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1598,6 +1694,12 @@ def run_smoke() -> int:
         # replayed-record count. File-system-only, so it rides after
         # the fault passes without touching any measured number.
         out.update(run_smoke_durability())
+
+        # 11. batchverify pass (docs/BATCH_VERIFY.md): RLC batch≡per-sig
+        # parity at N=16/64, offender bisection at the corner positions,
+        # and one BLS aggregate-QC encode/decode/verify round trip.
+        # Host big-int only, so it rides after the fault passes.
+        out.update(run_smoke_batchverify())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

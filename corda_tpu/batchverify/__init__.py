@@ -1,0 +1,23 @@
+"""Algebraic batch verification (docs/BATCH_VERIFY.md).
+
+Two halves, both host-side Python-int arithmetic (no jax import — the
+subsystem must run on the minimal containers the crypto fallback tier
+targets):
+
+- ``rlc``: random-linear-combination batch verification for ed25519 — N
+  signatures collapse into ONE multi-scalar multiplication built from the
+  PR 8 machinery (comb fixed-base table for the B term, the ref10
+  addition chains and Montgomery batch inversion from ``ops/addchain.py``
+  for the decompression batch, one doubling chain shared across every
+  variable base). Wired behind the serving scheduler as the default
+  settle path for full shape-bucketed ed25519 batches
+  (``CORDA_TPU_BATCH_RLC``).
+- ``bls`` + ``qc``: min-pk BLS12-381 aggregate signatures with
+  proof-of-possession registration, and the versioned quorum-certificate
+  wire format the BFT notary uses so a consensus round carries ONE
+  aggregate signature + signer bitmap (``CORDA_TPU_BLS_QC``).
+"""
+
+from .rlc import rlc_enabled, verify_batch_rlc, verify_single
+
+__all__ = ["rlc_enabled", "verify_batch_rlc", "verify_single"]

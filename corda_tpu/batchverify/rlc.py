@@ -1,0 +1,420 @@
+"""Random-linear-combination (RLC) batch verification for ed25519.
+
+N signatures (A_i, R_i, s_i, h_i) collapse into ONE cofactored check
+
+    8·( (Σ z_i·s_i mod L)·B  −  Σ z_i·R_i  −  Σ (z_i·h_i mod L)·A_i ) == O
+
+with fresh 128-bit scalars z_i drawn per check from the host CSPRNG
+(``secrets`` — never attacker-visible, never reused). If every signature
+satisfies the cofactored per-signature equation 8(s_i·B − R_i − h_i·A_i)
+= O, every term of the sum is 8-torsion and the combination accepts; if
+any signature fails it, the prime-order component of its term survives
+and a random z kills the check except with probability ≤ 2^-127 per bad
+row (the standard RLC soundness bound — see docs/BATCH_VERIFY.md).
+
+Cofactor policy (decided, test-pinned in tests/test_batchverify.py):
+
+- the batch equation is COFACTORED (final multiply-by-8), the
+  recommendation of the EdDSA batch-verification literature — a
+  cofactorless batch equation differs from cofactorless per-signature
+  verification on torsion-laden inputs with probability up to 7/8 per
+  check, so it cannot be made equivalent to anything;
+- small-order A or R points are REJECTED outright (the 8 points of
+  E[8](Fp), matched after decompression so every encoding of them is
+  caught) — honest keys and honest nonce commitments are never
+  small-order, and rejection closes the classic wildcard forgeries;
+- non-canonical encodings are rejected: y ≥ p, s ≥ L, and the x = 0
+  encoding with the sign bit set;
+- bisection leaves re-verify with the SAME cofactored single-signature
+  rule (``verify_single``), so batch accept ≡ per-signature accept by
+  construction. For honest and randomly-forged rows this verdict also
+  agrees with the host oracle (``crypto.is_valid``) — the 1k-batch
+  randomized pin; the two can differ only on hand-crafted mixed-order
+  inputs, where this module's cofactored-plus-rejection rule is the
+  documented semantics.
+
+The one multi-scalar multiplication reuses the PR 8 machinery: the B
+term rides a 256-entry 8-bit fixed-base comb (the host twin of
+``ops/ed25519_pallas._b_comb_host``), point decompression batches all
+its field inversions through ``ops/addchain.batch_modinv`` and takes
+square roots via the shipped ``pow_p_minus_5_over_8`` chain, and every
+variable base shares ONE 4-bit-window doubling chain (interleaved
+Straus) instead of paying ~253 doublings each.
+
+Everything here is Python-int host arithmetic — no jax, no OpenSSL — so
+the subsystem runs on minimal containers (same posture as
+``crypto/_ed25519_fallback.py``, whose constants it shares).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import secrets
+
+from corda_tpu.crypto._ed25519_fallback import _D, _I as _SQRT_M1, _recover_x
+from corda_tpu.crypto._ed25519_fallback import L, P, _B as _B_EXT
+from corda_tpu.ops.addchain import batch_modinv, pow_p_minus_5_over_8
+
+# ---------------------------------------------------------------- MSM shape
+# Exported so the op model (ops/opcount.py) reads the LIVE parameters and
+# can never drift from the implementation.
+MSM_WINDOW_BITS = 4     # shared-chain Straus window (signed digits ±1..±8)
+MSM_TABLE_SIZE = 8      # per-base odd+even multiples 1..8
+MSM_TABLE_BUILD = (1, 6)   # (doubles, adds) to build one 8-entry table
+COMB_WINDOW_BITS = 8    # fixed-base comb width for the B term
+COMB_ADDS = 32          # one mixed add per scalar byte
+Z_BITS = 128            # RLC coefficient width
+_NWIN = 65              # 4-bit windows covering a 253-bit signed recoding
+
+_NEUTRAL = (0, 1, 1, 0)
+_MASK255 = (1 << 255) - 1
+
+
+# ------------------------------------------------------------ point algebra
+
+def _add(p, q):
+    """Complete extended-coordinate Edwards add (9M)."""
+    x1, y1, z1, t1 = p
+    x2, y2, z2, t2 = q
+    a = (y1 - x1) * (y2 - x2) % P
+    b = (y1 + x1) * (y2 + x2) % P
+    c = 2 * t1 * t2 * _D % P
+    d = 2 * z1 * z2 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _dbl(p):
+    """Dedicated extended doubling (dbl-2008-hwcd, 4M + 4S) — the shared
+    MSM chain is doubling-dominated, so the 9M complete add would waste
+    more than half the chain's multiplies."""
+    x, y, z, _t = p
+    a = x * x % P
+    b = y * y % P
+    c = 2 * z * z % P
+    e = ((x + y) * (x + y) - a - b) % P
+    g = (b - a) % P
+    f = (g - c) % P
+    h = (-a - b) % P
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _madd(p, niels):
+    """Mixed add with a precomputed ((y−x), (y+x), 2d·x·y) comb entry
+    (7M) — the comb table's affine shape makes the B term's 32 adds the
+    cheapest adds in the MSM."""
+    x1, y1, z1, t1 = p
+    ymx, ypx, t2d = niels
+    a = (y1 - x1) * ymx % P
+    b = (y1 + x1) * ypx % P
+    c = t1 * t2d % P
+    d = 2 * z1 % P
+    e, f, g, h = b - a, d - c, d + c, b + a
+    return (e * f % P, g * h % P, f * g % P, e * h % P)
+
+
+def _neg(p):
+    x, y, z, t = p
+    return ((-x) % P, y, z, (-t) % P)
+
+
+def _is_identity(p) -> bool:
+    return p[0] % P == 0 and (p[1] - p[2]) % P == 0
+
+
+def _mul_ext(k: int, p):
+    q = _NEUTRAL
+    while k > 0:
+        if k & 1:
+            q = _add(q, p)
+        p = _dbl(p)
+        k >>= 1
+    return q
+
+
+def _to_affine(p) -> tuple[int, int]:
+    zi = pow(p[2], P - 2, P)
+    return (p[0] * zi % P, p[1] * zi % P)
+
+
+# --------------------------------------------------------------- precompute
+
+@functools.lru_cache(maxsize=1)
+def _b_comb() -> tuple:
+    """256-entry 8-bit fixed-base comb for B in precomputed-niels form —
+    the host twin of the PR 8 device comb (``_b_comb_host``): built
+    projectively, normalized with ONE Montgomery batch inversion
+    (``ops/addchain.batch_modinv``), not 256 per-entry inversions."""
+    pts = [_NEUTRAL]
+    for _ in range(255):
+        pts.append(_add(pts[-1], _B_EXT))
+    rows = []
+    for (px, py, _pz, _pt), zi in zip(
+        pts, batch_modinv([pt[2] for pt in pts], P)
+    ):
+        x, y = px * zi % P, py * zi % P
+        rows.append(((y - x) % P, (y + x) % P, 2 * _D * x % P * y % P))
+    return tuple(rows)
+
+
+@functools.lru_cache(maxsize=1)
+def _small_order_affine() -> frozenset:
+    """The full 8-torsion subgroup E[8](Fp) as affine pairs. Derived, not
+    hard-coded: L·(any curve point) lands in the torsion; the first one
+    of exact order 8 generates all 8 points. Matching after decompression
+    means every encoding of a small-order point is caught."""
+    gen = None
+    y = 2
+    while gen is None:
+        for sign in (0, 1):
+            x = _recover_x(y, sign)
+            if x is None:
+                continue
+            q = _mul_ext(L, (x, y, 1, x * y % P))
+            if not _is_identity(_dbl(_dbl(q))):
+                gen = q
+                break
+        y += 1
+    pts, cur = [], gen
+    for _ in range(8):
+        pts.append(_to_affine(cur))
+        cur = _add(cur, gen)
+    return frozenset(pts)
+
+
+def small_order_encodings() -> list[bytes]:
+    """Canonical compressed encodings of the 8 torsion points (adversarial
+    test vectors; the rejection itself matches decompressed coordinates,
+    not bytes)."""
+    return [
+        (y | ((x & 1) << 255)).to_bytes(32, "little")
+        for x, y in sorted(_small_order_affine())
+    ]
+
+
+# ------------------------------------------------------------- decompression
+
+def _finish_decompress(y: int, sign: int, v_inv: int):
+    """Second half of batched decompression: the caller batched 1/v for
+    v = d·y² + 1 across the whole check (one exponentiation total); the
+    square root rides the shipped ``pow_p_minus_5_over_8`` chain
+    (251 S + 11 M). Returns the extended point or None (not on curve /
+    non-canonical x = 0 encoding)."""
+    u = (y * y - 1) % P
+    x2 = u * v_inv % P
+    if x2 == 0:
+        return None if sign else (0, y, 1, 0)
+    sq = lambda a: a * a % P  # noqa: E731
+    mul = lambda a, b: a * b % P  # noqa: E731
+    x = x2 * pow_p_minus_5_over_8(x2, sq, mul) % P  # x2^((p+3)/8)
+    if (x * x - x2) % P:
+        x = x * _SQRT_M1 % P
+    if (x * x - x2) % P:
+        return None
+    if (x & 1) != sign:
+        x = P - x
+    return (x, y, 1, x * y % P)
+
+
+def _prepare(entries):
+    """Precheck + batch-decompress rows → (verdicts template, items).
+
+    Rows failing a canonicality or small-order check get their verdict
+    (False) here and are EXCLUDED from the linear combination — a single
+    undecodable point must not poison the algebraic check for the honest
+    rows sharing its batch. ``items`` = (row index, A, R, h, s)."""
+    verdicts = [False] * len(entries)
+    cand = []
+    for i, (pub, sig, msg) in enumerate(entries):
+        if len(pub) != 32 or len(sig) != 64:
+            continue
+        enc_a = int.from_bytes(pub, "little")
+        enc_r = int.from_bytes(sig[:32], "little")
+        y_a, sign_a = enc_a & _MASK255, enc_a >> 255
+        y_r, sign_r = enc_r & _MASK255, enc_r >> 255
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L or y_a >= P or y_r >= P:
+            continue  # non-canonical scalar / field encoding
+        h = int.from_bytes(
+            hashlib.sha512(sig[:32] + pub + msg).digest(), "little"
+        ) % L
+        cand.append((i, y_a, sign_a, y_r, sign_r, s, h))
+    # one Montgomery-batched inversion for every v = d·y² + 1 in the
+    # batch (v never vanishes: −1/d is a non-residue, so v is invertible)
+    vs = []
+    for _i, y_a, _sa, y_r, _sr, _s, _h in cand:
+        vs.append((_D * y_a % P * y_a + 1) % P)
+        vs.append((_D * y_r % P * y_r + 1) % P)
+    invs = batch_modinv(vs, P)
+    small = _small_order_affine()
+    items = []
+    for k, (i, y_a, sign_a, y_r, sign_r, s, h) in enumerate(cand):
+        a_pt = _finish_decompress(y_a, sign_a, invs[2 * k])
+        r_pt = _finish_decompress(y_r, sign_r, invs[2 * k + 1])
+        if a_pt is None or r_pt is None:
+            continue
+        if (a_pt[0], a_pt[1]) in small or (r_pt[0], r_pt[1]) in small:
+            continue  # small-order A or R: rejected by policy
+        items.append((i, a_pt, r_pt, h, s))
+    return verdicts, items
+
+
+# ----------------------------------------------------------------- the MSM
+
+def _signed_windows(k: int) -> list[int]:
+    """Fixed 4-bit signed-window recoding: digits in ±1..±8 (and 0), so a
+    per-base table of 8 multiples covers every window — half the build
+    cost of an unsigned 16-entry table (negation is free in Edwards
+    coordinates)."""
+    digits = []
+    while k:
+        d = k & 15
+        k >>= 4
+        if d > 8:
+            d -= 16
+            k += 1
+        digits.append(d)
+    return digits
+
+
+def _msm(c: int, bases) -> tuple:
+    """Interleaved windowed Straus: c·B + Σ k_j·P_j with ONE doubling
+    chain shared across every variable base (256 doublings total instead
+    of ~253 per base) and the B term folded in through the 8-bit comb at
+    byte boundaries (32 mixed adds). ``bases`` = (point, scalar) pairs;
+    128-bit scalars simply run out of digits and stop costing adds."""
+    tables = []
+    for pt, k in bases:
+        digits = _signed_windows(k)
+        tbl = [None, pt, _dbl(pt)]
+        for _ in range(3, MSM_TABLE_SIZE + 1):
+            tbl.append(_add(tbl[-1], pt))
+        tables.append((digits, tbl))
+    comb = _b_comb()
+    acc = _NEUTRAL
+    for w in range(_NWIN - 1, -1, -1):
+        if w != _NWIN - 1:
+            acc = _dbl(_dbl(_dbl(_dbl(acc))))
+        for digits, tbl in tables:
+            if w < len(digits):
+                d = digits[w]
+                if d > 0:
+                    acc = _add(acc, tbl[d])
+                elif d < 0:
+                    acc = _add(acc, _neg(tbl[-d]))
+        if w < 64 and not w & 1:
+            b = (c >> (4 * w)) & 0xFF
+            if b:
+                acc = _madd(acc, comb[b])
+    return acc
+
+
+def _nonzero_z(randbits) -> int:
+    """One RLC coefficient. z = 0 would drop its row from the combination
+    entirely — a forged row with z = 0 would batch-accept — so zero is
+    excluded by construction (test-pinned)."""
+    while True:
+        z = randbits(Z_BITS)
+        if z:
+            return z
+
+
+def _rlc_check(items, randbits) -> bool:
+    """One cofactored RLC evaluation over ``items``; fresh z every call
+    (a bisection re-check must not reuse coefficients the failing batch
+    already saw). The faultinject site lets a seeded chaos plan kill
+    exactly this MSM — callers degrade to per-signature verification."""
+    from corda_tpu.faultinject import check_site
+
+    check_site("batchverify.msm")
+    zs = [_nonzero_z(randbits) for _ in items]
+    c = 0
+    bases = []
+    for z, (_i, a_pt, r_pt, h, s) in zip(zs, items):
+        c += z * s
+        bases.append((_neg(r_pt), z))
+        bases.append((_neg(a_pt), z * h % L))
+    acc = _msm(c % L, bases)
+    acc = _dbl(_dbl(_dbl(acc)))  # cofactored: kill any 8-torsion residue
+    return _is_identity(acc)
+
+
+def _verify_item(item) -> bool:
+    """Cofactored single-signature check on already-decompressed points —
+    the bisection leaf rule, deliberately the SAME equation the batch
+    aggregates so batch accept ≡ per-signature accept."""
+    _i, a_pt, r_pt, h, s = item
+    p = _add(
+        _mul_ext(s, _B_EXT), _add(_neg(r_pt), _neg(_mul_ext(h, a_pt)))
+    )
+    return _is_identity(_dbl(_dbl(_dbl(p))))
+
+
+def _bisect(items, randbits, verdicts, metrics) -> int:
+    """Binary-split offender isolation after a failed batch check: each
+    half re-checks with fresh z; a passing half settles wholesale, a
+    failing half splits again, leaves fall through to ``_verify_item``.
+    Returns the offender count."""
+    if len(items) == 1:
+        ok = _verify_item(items[0])
+        verdicts[items[0][0]] = ok
+        return 0 if ok else 1
+    mid = len(items) // 2
+    offenders = 0
+    for half in (items[:mid], items[mid:]):
+        metrics.counter("batchverify.bisect_steps").inc()
+        if _rlc_check(half, randbits):
+            for it in half:
+                verdicts[it[0]] = True
+        else:
+            offenders += _bisect(half, randbits, verdicts, metrics)
+    return offenders
+
+
+# ------------------------------------------------------------------- API
+
+def rlc_enabled() -> bool:
+    """The CORDA_TPU_BATCH_RLC knob (default ON): routes full
+    shape-bucketed ed25519 batches through the RLC settle path. Any of
+    0/off/false/host pins the pre-RLC behavior."""
+    import os
+
+    v = os.environ.get("CORDA_TPU_BATCH_RLC", "1").strip().lower()
+    return v not in ("0", "off", "false", "host")
+
+
+def verify_batch_rlc(entries, *, randbits=secrets.randbits) -> list[bool]:
+    """Verify (pub32, sig64, msg) rows with one RLC check → per-row bools.
+
+    One accepted check settles every decodable row; a failed check falls
+    back to binary-split bisection and per-signature leaves
+    (``batchverify.fallback`` / ``batchverify.offenders`` counters).
+    ``randbits`` is injectable for the deterministic adversarial tests
+    only — production callers always use the ``secrets`` CSPRNG."""
+    from corda_tpu.node.monitoring import node_metrics
+
+    m = node_metrics()
+    verdicts, items = _prepare(entries)
+    m.counter("batchverify.batches").inc()
+    m.counter("batchverify.rows").inc(len(entries))
+    if not items:
+        return verdicts
+    if _rlc_check(items, randbits):
+        for it in items:
+            verdicts[it[0]] = True
+        return verdicts
+    m.counter("batchverify.fallback").inc()
+    offenders = _bisect(items, randbits, verdicts, m)
+    m.counter("batchverify.offenders").inc(offenders)
+    return verdicts
+
+
+def verify_single(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    """The cofactored per-signature rule (decompression, canonicality and
+    small-order policy identical to the batch path) — the semantics
+    ``verify_batch_rlc`` is provably equivalent to."""
+    verdicts, items = _prepare([(pub, sig, msg)])
+    if not items:
+        return False
+    return _verify_item(items[0])

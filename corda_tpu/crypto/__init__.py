@@ -9,6 +9,7 @@ from .hashing import (
 from .keys import KeyPair, PrivateKey, PublicKey
 from .merkle import MerkleTree, MerkleTreeError, PartialMerkleTree, merkle_root_host
 from .schemes import (
+    BLS_BLS12381,
     COMPOSITE_KEY,
     DEFAULT_SIGNATURE_SCHEME,
     ECDSA_SECP256K1_SHA256,
@@ -48,7 +49,7 @@ __all__ = [
     "ALL_ONES_HASH", "ZERO_HASH", "SecureHash", "sha256", "sha256_twice", "sha512",
     "KeyPair", "PrivateKey", "PublicKey",
     "MerkleTree", "MerkleTreeError", "PartialMerkleTree", "merkle_root_host",
-    "COMPOSITE_KEY", "DEFAULT_SIGNATURE_SCHEME", "ECDSA_SECP256K1_SHA256",
+    "BLS_BLS12381", "COMPOSITE_KEY", "DEFAULT_SIGNATURE_SCHEME", "ECDSA_SECP256K1_SHA256",
     "ECDSA_SECP256R1_SHA256", "EDDSA_ED25519_SHA512", "RSA_SHA256", "SCHEMES",
     "SPHINCS256_SHA256", "CryptoError", "SignatureScheme", "derive_keypair",
     "derive_keypair_from_entropy", "find_scheme", "generate_keypair", "is_valid",

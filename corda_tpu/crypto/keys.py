@@ -10,6 +10,7 @@ is (scheme_id, canonical encoded bytes):
   scheme 4  EDDSA_ED25519_SHA512    pub = raw (32B), priv = seed (32B)
   scheme 5  SPHINCS256_SHA256       pub = root||params, priv = seed||params (hash-based)
   scheme 6  COMPOSITE_KEY           pub = CBE-encoded weighted threshold tree
+  scheme 7  BLS_BLS12381            pub = compressed G1 (48B), priv = scalar (32B BE)
 
 The fixed-width encodings are what the device kernels consume directly — an
 ed25519 batch is just a (B, 32)-byte array of compressed points.

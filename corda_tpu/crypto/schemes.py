@@ -48,6 +48,10 @@ ECDSA_SECP256R1_SHA256 = 3
 EDDSA_ED25519_SHA512 = 4
 SPHINCS256_SHA256 = 5
 COMPOSITE_KEY = 6
+# min-pk BLS12-381 (corda_tpu.batchverify.bls): the aggregatable scheme
+# behind the BFT notary's quorum certificates — pure-Python host engine,
+# lazily imported so minimal containers only pay for it when used
+BLS_BLS12381 = 7
 
 # secp256k1 / secp256r1 group orders (for scalar derivation + low-S checks)
 SECP256K1_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
@@ -77,6 +81,9 @@ SCHEMES: dict[int, SignatureScheme] = {
         SPHINCS256_SHA256, "SPHINCS-256_SHA256", "SHA256withSPHINCS256"
     ),
     COMPOSITE_KEY: SignatureScheme(COMPOSITE_KEY, "COMPOSITE", "COMPOSITE"),
+    BLS_BLS12381: SignatureScheme(
+        BLS_BLS12381, "BLS_BLS12381", "BLSwithBLS12381"
+    ),
 }
 
 DEFAULT_SIGNATURE_SCHEME = EDDSA_ED25519_SHA512
@@ -157,6 +164,8 @@ def generate_keypair(scheme_id: int = DEFAULT_SIGNATURE_SCHEME) -> KeyPair:
         return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
     if scheme_id == SPHINCS256_SHA256:
         return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
+    if scheme_id == BLS_BLS12381:
+        return derive_keypair_from_entropy(scheme_id, secrets.token_bytes(32))
     if scheme_id == RSA_SHA256:
         _require_openssl("RSA key generation")
         priv = rsa.generate_private_key(public_exponent=65537, key_size=2048)
@@ -200,6 +209,11 @@ def derive_keypair_from_entropy(scheme_id: int, entropy: bytes) -> KeyPair:
         seed = hashlib.sha256(b"ctpu.sphincs" + entropy).digest()
         pub, priv = sphincs.generate(seed)
         return KeyPair(PublicKey(scheme_id, pub), PrivateKey(scheme_id, priv))
+    if scheme_id == BLS_BLS12381:
+        from corda_tpu.batchverify import bls
+
+        pub, priv = bls.derive_keypair_from_entropy(entropy)
+        return KeyPair(PublicKey(scheme_id, pub), PrivateKey(scheme_id, priv))
     raise CryptoError(f"cannot derive key pairs for scheme {scheme_id}")
 
 
@@ -238,6 +252,10 @@ def sign(private: PrivateKey, data: bytes) -> bytes:
         return priv.sign(data, padding.PKCS1v15(), hashes.SHA256())
     if sid == SPHINCS256_SHA256:
         return sphincs.sign(private.encoded, data)
+    if sid == BLS_BLS12381:
+        from corda_tpu.batchverify import bls
+
+        return bls.sign(private.encoded, data)
     raise CryptoError(f"cannot sign with scheme {sid}")
 
 
@@ -286,6 +304,10 @@ def is_valid(public: PublicKey, signature: bytes, data: bytes) -> bool:
             return True
         if sid == SPHINCS256_SHA256:
             return sphincs.verify(public.encoded, signature, data)
+        if sid == BLS_BLS12381:
+            from corda_tpu.batchverify import bls
+
+            return bls.verify(public.encoded, data, signature)
         if sid == COMPOSITE_KEY:
             raise CryptoError(
                 "composite keys verify signature *sets*; use "
@@ -319,6 +341,10 @@ def public_key_on_curve(public: PublicKey) -> bool:
             return True
         if public.scheme_id == SPHINCS256_SHA256:
             return len(public.encoded) == 33
+        if public.scheme_id == BLS_BLS12381:
+            from corda_tpu.batchverify import bls
+
+            return bls.public_key_on_curve(public.encoded)
         return False
     except Exception:
         return False

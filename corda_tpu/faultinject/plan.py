@@ -19,9 +19,11 @@ mapping from key to outcome is fixed by the seed.
 
 Hook points live in ``messaging/network.py`` (delivery faults),
 ``messaging/queue.py`` (broker publish loss + forced redelivery),
-``messaging/fabric.py`` (connection-drop injection on control ops), and
+``messaging/fabric.py`` (connection-drop injection on control ops),
 ``verifier/batch.py`` (device-op failures via the module-level
-``check_site``). Crash schedules are driven by
+``check_site``), ``batchverify/rlc.py`` (the RLC batch MSM at
+``batchverify.msm``), and ``notary/bft.py`` (quorum-certificate
+aggregation at ``notary.aggregate``). Crash schedules are driven by
 ``faultinject.chaos.ChaosOrchestrator``.
 """
 

@@ -80,13 +80,21 @@ class BFTReplica:
     def __init__(self, name: str, names: list[str], messaging, keypair: KeyPair,
                  base: UniquenessProvider | None = None,
                  replica_keys: dict[str, PublicKey] | None = None,
-                 view_timeout_s: float = 1.0):
+                 view_timeout_s: float = 1.0,
+                 bls_keypair: tuple[bytes, bytes] | None = None):
         self.name = name
         self.names = list(names)
         self.n = len(names)
         self.f = (self.n - 1) // 3
         self._messaging = messaging
         self._keypair = keypair
+        # optional (public 48B, private 32B) BLS share key: when present,
+        # every reply additionally carries a BLS12-381 signature share
+        # over the outcome so the client can settle the round with ONE
+        # aggregate quorum certificate (docs/BATCH_VERIFY.md). The
+        # ed25519 reply signature stays — it is the per-reply
+        # authenticity check and the QC-less fallback.
+        self._bls_keypair = bls_keypair
         self._replica_keys = dict(replica_keys or {})
         self.base = base or InMemoryUniquenessProvider()
         self._lock = threading.RLock()
@@ -321,9 +329,7 @@ class BFTReplica:
             outcome = serialize({"batch": True, "conflicts": conflicts})
             sig = host_sign(self._keypair.private, outcome)
             client = client or (requests[0][2] if requests else None)
-            reply = serialize({"digest": d, "replica": self.name,
-                               "outcome": outcome, "sig": sig,
-                               "key": self._keypair.public})
+            reply = self._make_reply(d, outcome, sig)
             # _execute runs OUTSIDE _check_committed's locked region (it
             # does slow work: commit + sign + send); the reply cache it
             # feeds is read/evicted under the lock, so the write takes it
@@ -340,12 +346,26 @@ class BFTReplica:
         outcome = serialize({"tx_id": tx_id, "conflict": conflict})
         sig = host_sign(self._keypair.private, outcome)
         client = client or caller
-        reply = serialize({"digest": d, "replica": self.name,
-                           "outcome": outcome, "sig": sig,
-                           "key": self._keypair.public})
+        reply = self._make_reply(d, outcome, sig)
         with self._lock:
             self._executed_replies[d] = reply
         self._messaging.send(client, T_REPLY, reply)
+
+    def _make_reply(self, d: bytes, outcome: bytes, sig) -> bytes:
+        """The signed reply payload. A BLS-keyed replica adds its
+        aggregate-signature SHARE over the outcome; the reply stays a
+        dict on the wire, so decoders predating quorum certificates
+        simply ignore the extra keys — old and new replicas interoperate
+        in place and per-signer attestation blobs keep decoding
+        (``batchverify.qc.decode_attestation``)."""
+        reply = {"digest": d, "replica": self.name, "outcome": outcome,
+                 "sig": sig, "key": self._keypair.public}
+        if self._bls_keypair is not None:
+            from corda_tpu.batchverify import bls
+
+            reply["bls_sig"] = bls.sign(self._bls_keypair[1], outcome)
+            reply["bls_v"] = 2
+        return serialize(reply)
 
     # ------------------------------------------------------- view change
 
@@ -557,7 +577,8 @@ class BFTClusterClient:
 
     def __init__(self, name: str, messaging, replica_names: list[str],
                  replica_keys: dict[str, PublicKey], timeout_s: float = 5.0,
-                 retry_every_s: float = 1.5):
+                 retry_every_s: float = 1.5,
+                 bls_keys: dict[str, bytes] | None = None):
         self.name = name
         self._messaging = messaging
         self._replicas = list(replica_names)
@@ -569,7 +590,28 @@ class BFTClusterClient:
         # digest -> {outcome_bytes: {replica: sig}}
         self._replies: dict[bytes, dict[bytes, dict[str, bytes]]] = {}
         self._futures: dict[bytes, Future] = {}
+        # quorum certificates (docs/BATCH_VERIFY.md): with the cluster's
+        # BLS membership known (name -> 48B public key, PoP-registered)
+        # and the knob on, a settled round carries ONE aggregate
+        # signature + signer bitmap instead of f+1 ed25519 attestations.
+        # The bitmap indexes ``replica_names`` order — the cluster's
+        # canonical membership ordering.
+        self._bls_keys = dict(bls_keys or {})
+        from corda_tpu.batchverify.qc import qc_enabled
+
+        self._use_qc = bool(self._bls_keys) and qc_enabled()
+        # digest -> {outcome_bytes: {replica: bls share}}
+        self._bls_shares: dict[bytes, dict[bytes, dict[str, bytes]]] = {}
+        self._qc_building: set[bytes] = set()
         messaging.add_handler(T_REPLY, auto_ack(self._on_reply))
+
+    @property
+    def bls_member_keys(self) -> list:
+        """The cluster's BLS public keys in canonical (bitmap) order —
+        what ``QuorumCertificate.verify`` consumes downstream."""
+        if not self._bls_keys:
+            return []
+        return [self._bls_keys.get(r) for r in self._replicas]
 
     def _settle_locked(self, d: bytes, fut: Future | None = None) -> None:
         """Drop all per-digest state. Runs when the quorum resolves the
@@ -585,6 +627,8 @@ class BFTClusterClient:
             return
         self._futures.pop(d, None)
         self._replies.pop(d, None)
+        self._bls_shares.pop(d, None)
+        self._qc_building.discard(d)
 
     def _on_reply(self, msg) -> None:
         rep = deserialize(msg.payload)
@@ -598,6 +642,7 @@ class BFTClusterClient:
         except Exception:
             return
         d = rep["digest"]
+        build = None
         with self._lock:
             fut = self._futures.get(d)
             if fut is None:
@@ -606,15 +651,82 @@ class BFTClusterClient:
                 return
             bucket = self._replies.setdefault(d, {}).setdefault(outcome, {})
             bucket[replica] = sig
-            if not fut.done() and len(bucket) >= self.f + 1:
-                fut.set_result((outcome, dict(bucket)))
+            share = rep.get("bls_sig")
+            if share is not None and replica in self._bls_keys:
+                self._bls_shares.setdefault(d, {}).setdefault(
+                    outcome, {}
+                )[replica] = share
+            if fut.done() or len(bucket) < self.f + 1:
+                return
+            if d in self._qc_building:
+                # another reply thread is already assembling this
+                # round's certificate; it will resolve the future
+                return
+            if self._use_qc:
+                shares = dict(self._bls_shares.get(d, {}).get(outcome, {}))
+                if len(shares) >= self.f + 1:
+                    self._qc_building.add(d)
+                    build = (dict(bucket), shares)
+            if build is None:
+                fut.set_result((outcome, dict(bucket), None))
                 # quorum reached: state cleanup rides the resolution, not
                 # a collect() that may never come
                 self._settle_locked(d)
+                return
+        # certificate assembly runs OUTSIDE the lock: one aggregation +
+        # ONE pairing-priced aggregate verify — far too slow for the
+        # reply handler's locked region
+        qc = self._try_build_qc(outcome, build[1])
+        with self._lock:
+            if self._futures.get(d) is fut and not fut.done():
+                fut.set_result((outcome, build[0], qc))
+                self._settle_locked(d)
+            else:
+                self._qc_building.discard(d)
+
+    def _try_build_qc(self, outcome: bytes, shares: dict):
+        """Aggregate f+1 BLS shares into a verified quorum certificate,
+        or None — a garbage share (Byzantine replica) or an injected
+        fault at ``notary.aggregate`` degrades the round to the legacy
+        per-signer ed25519 attestations, never to a lost future."""
+        from corda_tpu.batchverify import bls
+        from corda_tpu.batchverify.qc import QuorumCertificate
+        from corda_tpu.faultinject import check_site
+        from corda_tpu.node.monitoring import node_metrics
+
+        try:
+            check_site("notary.aggregate")
+            picked = sorted(
+                (self._replicas.index(name), share)
+                for name, share in shares.items()
+            )
+            bitmap = 0
+            for i, _share in picked:
+                bitmap |= 1 << i
+            cert = QuorumCertificate(
+                message=outcome,
+                agg_sig=bls.aggregate([share for _i, share in picked]),
+                bitmap=bitmap,
+                n=len(self._replicas),
+            )
+            node_metrics().counter("notary.qc.aggregated").inc()
+            if not cert.verify(self.bls_member_keys):
+                raise ValueError("aggregate quorum signature rejected")
+            node_metrics().counter("notary.qc.verified").inc()
+            return cert
+        except Exception:
+            import logging
+
+            node_metrics().counter("notary.qc.fallback").inc()
+            logging.getLogger(__name__).warning(
+                "quorum-certificate aggregation failed; round falls back "
+                "to per-signer attestations"
+            )
+            return None
 
     def submit(self, states, tx_id, caller: str):
         """Returns (conflict_or_None, {replica: sig}) after quorum."""
-        outcome, sigs = self._submit_command(
+        outcome, sigs, _qc = self._submit_command(
             serialize((list(states), tx_id, caller))
         )
         return outcome["conflict"], sigs
@@ -624,7 +736,7 @@ class BFTClusterClient:
         where conflicts is the per-request list, after f+1 matching
         replies (matching = identical serialized conflict list, so the
         quorum certifies the whole batch outcome)."""
-        outcome, sigs = self._submit_command(serialize(
+        outcome, sigs, _qc = self._submit_command(serialize(
             ("batch", [(list(s), t, c) for (s, t, c) in requests])
         ))
         return list(outcome["conflicts"]), sigs
@@ -657,7 +769,7 @@ class BFTClusterClient:
                 try:
                     while True:
                         try:
-                            outcome_bytes, sigs = fut.result(
+                            outcome_bytes, sigs, qc = fut.result(
                                 timeout=min(
                                     client._retry_every_s,
                                     max(0.01, deadline - time.monotonic()),
@@ -675,7 +787,7 @@ class BFTClusterClient:
                 finally:
                     with client._lock:
                         client._settle_locked(d, fut)
-                return deserialize(outcome_bytes), sigs
+                return deserialize(outcome_bytes), sigs, qc
 
         pending = _PendingSubmit()
         # lifecycle-tied cleanup: a pending abandoned WITHOUT collect()
@@ -698,9 +810,28 @@ class BFTUniquenessProvider(UniquenessProvider):
 
     def __init__(self, client: BFTClusterClient):
         self.client = client
+        # the quorum certificate of the most recently COLLECTED round,
+        # consumed exactly once by the notary service's take_qc() —
+        # windows collect strictly in order (the pipeline settles window
+        # N before window N+1), so one slot is enough
+        self._last_qc = None
+
+    @property
+    def bls_member_keys(self) -> list:
+        return self.client.bls_member_keys
+
+    def take_qc(self):
+        """Hand over (and clear) the last collected round's quorum
+        certificate, or None for a QC-less round."""
+        qc, self._last_qc = self._last_qc, None
+        return qc
 
     def commit(self, states, tx_id, caller_name) -> None:
-        conflict, _sigs = self.client.submit(states, tx_id, caller_name)
+        outcome, _sigs, qc = self.client._submit_command(
+            serialize((list(states), tx_id, caller_name))
+        )
+        self._last_qc = qc
+        conflict = outcome["conflict"]
         if conflict is not None:
             raise NotaryError(
                 f"input states of {tx_id} already consumed", conflict
@@ -723,32 +854,56 @@ class BFTUniquenessProvider(UniquenessProvider):
         pending = self.client._submit_command_async(serialize(
             ("batch", [(list(s), t, c) for (s, t, c) in requests])
         ))
+        provider = self
 
         class _PendingBFTCommit:
             def collect(_self):
-                outcome, _sigs = pending.collect()
+                outcome, _sigs, qc = pending.collect()
+                provider._last_qc = qc
                 return list(outcome["conflicts"])
 
         return _PendingBFTCommit()
 
     @staticmethod
     def make_cluster(n: int, network, prefix: str = "bft-replica",
-                     view_timeout_s: float = 1.0):
-        """n = 3f+1 co-located replicas + a client factory."""
+                     view_timeout_s: float = 1.0, bls_qc: bool = True):
+        """n = 3f+1 co-located replicas + a client factory.
+
+        With ``bls_qc`` (and the CORDA_TPU_BLS_QC knob on), each replica
+        also gets a BLS share key so rounds settle with one aggregate
+        quorum certificate. The BLS keys derive DETERMINISTICALLY from
+        the replica names: proof-of-possession verification is
+        pairing-priced, and the deterministic derivation lets the
+        process-wide PoP registry memoize it across the many in-process
+        clusters a test session builds."""
+        from corda_tpu.batchverify.qc import qc_enabled
         from corda_tpu.crypto import generate_keypair
 
         names = [f"{prefix}-{i}" for i in range(n)]
         keypairs = {name: generate_keypair() for name in names}
         keys = {name: kp.public for name, kp in keypairs.items()}
+        bls_keypairs: dict = {}
+        bls_keys: dict = {}
+        if bls_qc and qc_enabled():
+            from corda_tpu.batchverify import bls
+
+            for name in names:
+                pk, sk = bls.derive_keypair_from_entropy(name.encode())
+                if not bls.is_registered(pk):
+                    bls.register_pop(pk, bls.prove_possession(sk))
+                bls_keypairs[name] = (pk, sk)
+                bls_keys[name] = pk
         replicas = [
             BFTReplica(name, names, network.create_node(name), keypairs[name],
-                       replica_keys=keys, view_timeout_s=view_timeout_s)
+                       replica_keys=keys, view_timeout_s=view_timeout_s,
+                       bls_keypair=bls_keypairs.get(name))
             for name in names
         ]
 
         def make_client(client_name: str) -> BFTUniquenessProvider:
             client = BFTClusterClient(
-                client_name, network.create_node(client_name), names, keys
+                client_name, network.create_node(client_name), names, keys,
+                bls_keys=bls_keys or None,
             )
             return BFTUniquenessProvider(client)
 

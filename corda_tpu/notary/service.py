@@ -77,6 +77,12 @@ class NotaryService:
         self.uniqueness = uniqueness
         self._clock = clock
         self._signed_cache: dict = {}
+        # tx id -> aggregate quorum certificate for the consensus round
+        # that committed it (BFT/BLS clusters only; docs/BATCH_VERIFY.md).
+        # Rides the signed cache's lock, ordering and eviction so a
+        # retry answered from cache can return the ORIGINAL aggregate —
+        # never a re-signed one — alongside the original attestation.
+        self._qc_cache: dict = {}
         self._signed_order: "list" = []
         self._signed_lock = threading.Lock()
         # durable attestation journal (docs/DURABILITY.md): a provider
@@ -101,19 +107,31 @@ class NotaryService:
         with self._signed_lock:
             return self._signed_cache.get(tx_id)
 
+    def cached_qc(self, tx_id: SecureHash):
+        """The aggregate quorum certificate attached to a cached
+        attestation, if any — what lets a recovering BFT-clustered
+        notary answer a retry with the round's original aggregate."""
+        with self._signed_lock:
+            return self._qc_cache.get(tx_id)
+
     def remember_signature(
-        self, tx_id: SecureHash, sig: TransactionSignature
+        self, tx_id: SecureHash, sig: TransactionSignature, qc=None
     ) -> None:
         with self._signed_lock:
             if tx_id in self._signed_cache:
+                if qc is not None:
+                    self._qc_cache.setdefault(tx_id, qc)
                 return
             self._signed_cache[tx_id] = sig
+            if qc is not None:
+                self._qc_cache[tx_id] = qc
             self._signed_order.append(tx_id)
             if len(self._signed_order) > self.SIGNED_CACHE_MAX:
                 evict = self._signed_order[: len(self._signed_order) // 2]
                 del self._signed_order[: len(self._signed_order) // 2]
                 for t in evict:
                     self._signed_cache.pop(t, None)
+                    self._qc_cache.pop(t, None)
         if self._sig_journal is not None:
             # outside the cache lock: the journal append takes the
             # provider's own lock and rides the next group-commit flush
@@ -482,6 +500,7 @@ class BatchedNotaryService(NotaryService):
         """Resolve the (possibly in-flight) uniqueness commit and enqueue
         response signing; ``finalize_batch`` fills in the signatures."""
         conflicts = pending_commit.collect()
+        qc = self._collect_qc()
         accepted: list[int] = []
         for i, conflict in zip(live, conflicts):
             if conflict is not None:
@@ -497,10 +516,30 @@ class BatchedNotaryService(NotaryService):
         # window rather than a second gate with different constants
         accepted_ids = [requests[i][0].id for i in accepted]
         pending_sigs = self._dispatch_sign(accepted_ids, on_device=on_device)
-        return results, accepted, pending_sigs, accepted_ids
+        return results, accepted, pending_sigs, accepted_ids, qc
+
+    def _collect_qc(self):
+        """Fetch (and independently verify) the quorum certificate of the
+        round just collected — only a BFT uniqueness provider with BLS
+        membership offers one. Verification is ONE aggregate pairing
+        check per consensus round, not per transaction; a certificate
+        that fails it is dropped (the round's ed25519 attestations
+        already carry correctness)."""
+        take = getattr(self.uniqueness, "take_qc", None)
+        qc = take() if take is not None else None
+        if qc is None:
+            return None
+        keys = getattr(self.uniqueness, "bls_member_keys", None) or []
+        if not qc.verify(keys):
+            if self._metrics is not None:
+                self._metrics.counter("notary.qc.rejected").inc()
+            return None
+        if self._metrics is not None:
+            self._metrics.counter("notary.qc.cached").inc()
+        return qc
 
     def finalize_batch(
-        self, results, accepted, pending_sigs, accepted_ids=None
+        self, results, accepted, pending_sigs, accepted_ids=None, qc=None
     ) -> list[TransactionSignature | Exception]:
         """Fill in the (possibly device-batched) response signatures."""
         for slot, (i, sig) in enumerate(zip(accepted, pending_sigs.collect())):
@@ -508,7 +547,7 @@ class BatchedNotaryService(NotaryService):
             if accepted_ids is not None:
                 # remember attestations so duplicate resubmissions (client
                 # retry after a lost response) return the original success
-                self.remember_signature(accepted_ids[slot], sig)
+                self.remember_signature(accepted_ids[slot], sig, qc=qc)
         if self._metrics is not None:
             self._metrics.meter("notary.requests").mark(len(results))
             self._metrics.meter("notary.committed").mark(

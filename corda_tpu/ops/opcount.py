@@ -130,6 +130,79 @@ def ecdsa_config(
             "fixed_win": fixed_win}
 
 
+def rlc_config(n: int = 64) -> dict:
+    """Active RLC batch-verify configuration (corda_tpu/batchverify/rlc.py)
+    at batch size ``n`` — window/comb/chain parameters are read from the
+    LIVE module constants so the model cannot drift from the MSM that
+    actually runs."""
+    from corda_tpu.batchverify import rlc
+
+    return {
+        "scheme": "ed25519_batch",
+        "n": n,
+        "window_bits": rlc.MSM_WINDOW_BITS,
+        "table_build": rlc.MSM_TABLE_BUILD,
+        "comb_adds": rlc.COMB_ADDS,
+        "z_bits": rlc.Z_BITS,
+    }
+
+
+def rlc_ops_per_batch(cfg: dict) -> dict:
+    """Field-op census (muls/sqs) for ONE N-row RLC batch check.
+
+    The RLC path is host Python-int arithmetic, so its natural unit is
+    FIELD multiplies+squarings — there is no device MAC/carry structure
+    to weight by. The batch-vs-per-sig comparison therefore uses the
+    per-sig model's ``field_muls_per_verify`` (same unit) as the floor.
+    """
+    from .addchain import INV_CHAIN_OPS, SQRT_CHAIN_OPS
+
+    n, w = cfg["n"], cfg["window_bits"]
+    sqrt_s, sqrt_m = SQRT_CHAIN_OPS
+    inv_s, inv_m = INV_CHAIN_OPS
+    # ---- batched strict decompression: 2N points (A_i and R_i per row).
+    # Per point: v build (2M), u (1S), u·v⁻¹ (1M), the shipped sqrt
+    # chain, x·chain (1M), the root check (1S) and the conditional √-1
+    # twist (counted 1M); ONE Montgomery batch inversion covers every v.
+    pts = 2 * n
+    muls = pts * (2 + 1 + sqrt_m + 1 + 1)
+    sqs = pts * (1 + sqrt_s + 1)
+    muls += inv_m + 3 * (pts - 1)
+    sqs += inv_s
+    # ---- the interleaved-Straus MSM: one doubling chain shared across
+    # every base (plus the 3 cofactor doublings), 8-entry signed tables
+    # per base, probabilistic window adds, and the B-term comb.
+    nw_full = -(-253 // w)               # (z_i·h_i mod L) scalar windows
+    nw_z = -(-(cfg["z_bits"] + 1) // w)  # raw z_i windows (carry digit)
+    dbl_m = dbl_s = 4                    # dbl-2008-hwcd
+    add_m, madd_m = 9, 7                 # complete ext add / niels madd
+    doubles = (nw_full - 1) * w + 3
+    muls += doubles * dbl_m
+    sqs += doubles * dbl_s
+    tb_dbl, tb_add = cfg["table_build"]
+    muls += pts * (tb_dbl * dbl_m + tb_add * add_m)
+    sqs += pts * tb_dbl * dbl_s
+    nz = (2**w - 1) / 2**w               # nonzero signed-digit rate
+    muls += int(n * nw_full * nz) * add_m
+    muls += int(n * nw_z * nz) * add_m
+    muls += cfg["comb_adds"] * madd_m
+    return {"muls": muls, "sqs": sqs, "field_ops": muls + sqs}
+
+
+def rlc_ops_per_verify(cfg: dict | None = None) -> dict:
+    """Amortized per-signature cost of the RLC batch check at the
+    config's batch size — the deviceless-checkable number behind the
+    ``mfu/ed25519_batch/ops_per_verify`` perf-gate pin."""
+    cfg = cfg or rlc_config()
+    batch = rlc_ops_per_batch(cfg)
+    n = cfg["n"]
+    return {
+        "muls": batch["muls"] / n,
+        "sqs": batch["sqs"] / n,
+        "field_ops": batch["field_ops"] / n,
+    }
+
+
 def ops_per_verify(cfg: dict) -> dict:
     """Field-op census for one verify under ``cfg`` → dict with
     ``muls``/``sqs`` (field multiply/square counts), ``macs`` (multiplier
@@ -197,4 +270,17 @@ def active_models() -> dict:
             "macs_per_verify": census["macs"],
             "field_muls_per_verify": census["muls"] + census["sqs"],
         }
+    # The RLC batch model is host-algebraic (no MAC structure): its
+    # ops_per_verify is FIELD muls+sqs amortized over the batch, compared
+    # against the per-sig model's field_muls_per_verify floor.
+    rcfg = rlc_config()
+    amortized = rlc_ops_per_verify(rcfg)["field_ops"]
+    floor = out["ed25519"]["field_muls_per_verify"]
+    out["ed25519_batch"] = {
+        "config": {k: v for k, v in rcfg.items() if k != "scheme"},
+        "ops_per_verify": round(amortized, 2),
+        "per_sig_field_ops": floor,
+        "savings_vs_per_sig": round(floor / amortized, 3),
+        "model_only": True,
+    }
     return out

@@ -8,7 +8,11 @@ flattened, bucketed by scheme id — mirroring the dispatch switch of
 Crypto.findSignatureScheme (Crypto.kt:236-267) — and each bucket goes to its
 best engine in one shot:
 
-  scheme 4 (ed25519)  → one batched device kernel (ops/ed25519.py)
+  scheme 4 (ed25519)  → full shape-bucketed batches: ONE algebraic
+                        RLC batch check (batchverify/rlc.py, default —
+                        CORDA_TPU_BATCH_RLC, docs/BATCH_VERIFY.md);
+                        partial batches: the batched device kernel
+                        (ops/ed25519.py)
   schemes 2/3 (ECDSA) → batched windowed ladder (ops/secp256.py / _pallas)
   scheme 5 (SPHINCS)  → batched hash-chain sweep (ops/sphincs_batch.py)
                         on accelerator backends; host loop on CPU
@@ -219,7 +223,15 @@ def dispatch_signature_rows(
 
     device_schemes = _effective_device_schemes(use_device)
     for scheme_id, idxs in buckets.items():
-        if scheme_id in device_schemes:
+        if scheme_id == EDDSA_ED25519_SHA512 and \
+                _rlc_bucket_eligible(idxs, min_bucket):
+            # full shape-bucketed ed25519 batches settle algebraically:
+            # one RLC multi-scalar multiplication instead of len(idxs)
+            # independent verifies (docs/BATCH_VERIFY.md). Resolved
+            # eagerly like any host bucket; the device hedge/per-sig
+            # resilience paths in the scheduler are untouched.
+            _rlc_verify_bucket(pending, rows, idxs)
+        elif scheme_id in device_schemes:
             try:
                 _dispatch_device_bucket(
                     pending, rows, scheme_id, idxs, min_bucket
@@ -241,6 +253,46 @@ def _host_verify_bucket(pending: PendingRows, rows, idxs) -> None:
     for i in idxs:
         key, sig, msg = rows[i]
         pending._out[i] = is_valid(key, sig, msg)
+
+
+def _rlc_bucket_eligible(idxs, min_bucket) -> bool:
+    """RLC settles FULL shape-bucketed ed25519 batches only: a
+    ``min_bucket`` floor marks a scheduler-shaped dispatch, and a bucket
+    at or above the floor amortizes the MSM's fixed doubling chain.
+    Partial batches keep the pre-RLC engines (device kernel or host
+    loop), as do opted-out deployments (CORDA_TPU_BATCH_RLC=0)."""
+    if min_bucket is None or len(idxs) < min_bucket:
+        return False
+    from corda_tpu.batchverify import rlc_enabled
+
+    return rlc_enabled()
+
+
+def _rlc_verify_bucket(pending: PendingRows, rows, idxs) -> None:
+    """Settle one ed25519 bucket through the RLC batch check. Degradation
+    contract matches the device buckets: ANY failure of the algebraic
+    path — including an injected fault at ``batchverify.msm`` — lands
+    every row on the host per-signature reference path, so no future is
+    ever lost to the optimization."""
+    from corda_tpu.batchverify import verify_batch_rlc
+
+    entries = [(rows[i][0].encoded, rows[i][1], rows[i][2]) for i in idxs]
+    try:
+        verdicts = verify_batch_rlc(entries)
+    except Exception:
+        import logging
+
+        from corda_tpu.node.monitoring import node_metrics
+
+        node_metrics().counter("batchverify.msm_faults").inc()
+        logging.getLogger(__name__).warning(
+            "RLC batch verification failed; %d rows fell back to the "
+            "host per-signature path", len(idxs),
+        )
+        _host_verify_bucket(pending, rows, idxs)
+        return
+    for i, ok in zip(idxs, verdicts):
+        pending._out[i] = ok
 
 
 def _dispatch_device_bucket(

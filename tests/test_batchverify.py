@@ -1,0 +1,724 @@
+"""Algebraic batch verification (ISSUE 12, docs/BATCH_VERIFY.md).
+
+The adversarial RLC suite: batch accept must be EXACTLY per-signature
+accept — forgeries at every bisection position, duplicate (tx, key)
+pairs, small- and mixed-order points, non-canonical encodings, z = 0
+exclusion, and a 1k-row randomized batch≡per-sig pin. Oracle-free on
+purpose (pure Python-int arithmetic, like test_ops_kernel_arith.py), so
+it runs everywhere tier-1 runs: the reference semantics are
+``verify_single``'s cofactored rule, itself cross-pinned here against
+the cofactorless ``crypto.is_valid`` on the rows where the two rules
+agree (honest and plainly-forged); the documented divergence (mixed-
+order torsion components, which only the cofactored rule absorbs) is
+pinned explicitly. Also covers the BLS12-381 min-pk scheme, the
+aggregate quorum certificate wire format, scheme 7 registration, and
+the chaos contracts at ``batchverify.msm`` / ``notary.aggregate``.
+"""
+
+import hashlib
+import random
+
+import pytest
+
+from corda_tpu.batchverify import rlc
+from corda_tpu.batchverify.rlc import (
+    small_order_encodings,
+    verify_batch_rlc,
+    verify_single,
+)
+
+L, P = rlc.L, rlc.P
+
+
+def _det_randbits(seed=1234):
+    return random.Random(seed).getrandbits
+
+
+def _enc(pt) -> bytes:
+    x, y = rlc._to_affine(pt)
+    return (y | ((x & 1) << 255)).to_bytes(32, "little")
+
+
+def _torsion_ext():
+    """A non-identity 8-torsion point in extended coordinates."""
+    for x, y in sorted(rlc._small_order_affine()):
+        if (x, y) != (0, 1):
+            return (x, y, 1, x * y % P)
+    raise AssertionError("torsion subgroup lost its non-identity points")
+
+
+def _scalar_row(a, r, msg, a_extra=None, r_extra=None, s_delta=0):
+    """Build a row directly from known scalars: A = aB (+ optional
+    torsion), R = rB (+ optional torsion), s = r + h·a (+ optional
+    forgery delta). Building from scalars instead of the signing API
+    lets the suite plant algebraically-precise adversarial structure."""
+    A = rlc._mul_ext(a % L, rlc._B_EXT)
+    if a_extra is not None:
+        A = rlc._add(A, a_extra)
+    R = rlc._mul_ext(r % L, rlc._B_EXT)
+    if r_extra is not None:
+        R = rlc._add(R, r_extra)
+    pub, r_enc = _enc(A), _enc(R)
+    h = int.from_bytes(
+        hashlib.sha512(r_enc + pub + msg).digest(), "little"
+    ) % L
+    s = (r + h * a + s_delta) % L
+    return pub, r_enc + s.to_bytes(32, "little"), msg
+
+
+def _rows(n, seed=7, tag=b"row"):
+    rng = random.Random(seed)
+    return [
+        _scalar_row(
+            rng.randrange(1, L), rng.randrange(1, L), tag + b"-%d" % i
+        )
+        for i in range(n)
+    ]
+
+
+def _is_valid_host(pub: bytes, sig: bytes, msg: bytes) -> bool:
+    from corda_tpu.crypto import EDDSA_ED25519_SHA512, PublicKey, is_valid
+
+    return is_valid(PublicKey(EDDSA_ED25519_SHA512, pub), sig, msg)
+
+
+class TestRLCBatch:
+    def test_all_good_batches_accept_and_match_per_sig(self):
+        for n in (1, 2, 3, 5, 8, 16, 64):
+            rows = _rows(n, seed=100 + n)
+            got = verify_batch_rlc(rows, randbits=_det_randbits(n))
+            assert got == [True] * n
+            assert got == [verify_single(*row) for row in rows]
+
+    def test_forged_sig_at_every_bisection_position(self):
+        """A single forged row at EVERY index of a 16-row batch: the
+        bisection must isolate exactly that row — first, last, middle,
+        and every split boundary in between."""
+        rows = _rows(16, seed=9)
+        for pos in range(16):
+            forged = list(rows)
+            pub, sig, msg = forged[pos]
+            s = (int.from_bytes(sig[32:], "little") + 1) % L
+            forged[pos] = (pub, sig[:32] + s.to_bytes(32, "little"), msg)
+            got = verify_batch_rlc(forged, randbits=_det_randbits(pos))
+            want = [i != pos for i in range(16)]
+            assert got == want, f"offender at {pos} not isolated"
+
+    def test_multiple_offenders_and_metrics(self):
+        from corda_tpu.node.monitoring import node_metrics
+
+        m = node_metrics()
+        base_fb = m.counter("batchverify.fallback").count
+        base_off = m.counter("batchverify.offenders").count
+        rows = _rows(16, seed=11)
+        planted = {0, 7, 15}
+        for pos in planted:
+            pub, sig, msg = rows[pos]
+            rows[pos] = (pub, sig, msg + b"?")
+        got = verify_batch_rlc(rows, randbits=_det_randbits(3))
+        assert got == [i not in planted for i in range(16)]
+        assert m.counter("batchverify.fallback").count == base_fb + 1
+        assert m.counter("batchverify.offenders").count == base_off + 3
+
+    def test_duplicate_tx_key_pairs(self):
+        """The SAME (key, message) row repeated through a batch — the
+        z_i coefficients keep duplicates independent, honest duplicates
+        all accept, and a forged duplicate pair fails as a pair."""
+        rows = _rows(4, seed=21)
+        batch = rows + rows + rows + rows          # 16 rows, 4 distinct
+        got = verify_batch_rlc(batch, randbits=_det_randbits(5))
+        assert got == [True] * 16
+        pub, sig, msg = rows[0]
+        bad = (pub, sig, msg + b"!")
+        batch = [bad, *rows, bad, *rows]
+        got = verify_batch_rlc(batch, randbits=_det_randbits(6))
+        assert got == [False, *([True] * 4), False, *([True] * 4)]
+
+    def test_small_order_a_and_r_rejected(self):
+        """Every canonical encoding of the 8-torsion subgroup is
+        rejected by policy, as A and as R — in the batch AND in
+        verify_single (batch ≡ per-sig on the rejection too)."""
+        encs = small_order_encodings()
+        assert len(encs) == 8
+        good = _rows(1, seed=31)[0]
+        for enc in encs:
+            as_a = (enc, good[1], good[2])
+            as_r = (good[0], enc + good[1][32:], good[2])
+            batch = [good, as_a, as_r]
+            got = verify_batch_rlc(batch, randbits=_det_randbits(8))
+            assert got == [True, False, False]
+            assert not verify_single(*as_a)
+            assert not verify_single(*as_r)
+
+    def test_mixed_order_points_follow_cofactored_rule(self):
+        """Mixed-order A or R (prime-order point + torsion) is NOT
+        small-order, so policy admits it and the cofactored equation
+        decides: with s built against the binding h both rows accept,
+        identically in batch and per-sig. This is the documented
+        divergence from the cofactorless host rule, which rejects the
+        torsioned-R row (docs/BATCH_VERIFY.md §Cofactor policy)."""
+        t = _torsion_ext()
+        rng = random.Random(41)
+        mixed_r = _scalar_row(
+            rng.randrange(1, L), rng.randrange(1, L), b"mixed-r", r_extra=t
+        )
+        mixed_a = _scalar_row(
+            rng.randrange(1, L), rng.randrange(1, L), b"mixed-a", a_extra=t
+        )
+        batch = [mixed_r, mixed_a, *_rows(2, seed=42)]
+        got = verify_batch_rlc(batch, randbits=_det_randbits(9))
+        assert got == [True] * 4
+        assert verify_single(*mixed_r) and verify_single(*mixed_a)
+        # the cofactorless reference rejects the same torsioned-R row
+        assert not _is_valid_host(*mixed_r)
+
+    def test_non_canonical_encodings_rejected(self):
+        good = _rows(1, seed=51)[0]
+        pub, sig, msg = good
+        s = int.from_bytes(sig[32:], "little")
+        bad_rows = [
+            # s >= L (valid signature lifted by the group order)
+            (pub, sig[:32] + (s + L).to_bytes(32, "little"), msg),
+            # pub y >= P and R y >= P (non-canonical field encodings)
+            ((P + 3).to_bytes(32, "little"), sig, msg),
+            (pub, (P + 5).to_bytes(32, "little") + sig[32:], msg),
+            # wrong lengths
+            (pub[:31], sig, msg),
+            (pub, sig[:63], msg),
+        ]
+        got = verify_batch_rlc(
+            [good, *bad_rows], randbits=_det_randbits(10)
+        )
+        assert got == [True] + [False] * len(bad_rows)
+        for row in bad_rows:
+            assert not verify_single(*row)
+        # and the honest row's canonical forms agree with the host rule
+        assert _is_valid_host(*good)
+        for row in bad_rows[:1]:
+            assert not _is_valid_host(*row)
+
+    def test_zero_z_is_excluded_by_construction(self):
+        """z = 0 would drop a row from the combination (a forged row
+        with z = 0 would batch-accept): the sampler must reject zero
+        draws, and a batch driven by a zero-spamming CSPRNG stub still
+        isolates its forgery."""
+        calls = {"n": 0}
+
+        def rb(bits):
+            calls["n"] += 1
+            return 0 if calls["n"] <= 3 else 5
+
+        assert rlc._nonzero_z(rb) == 5
+        assert calls["n"] == 4
+
+        rng = random.Random(61)
+        zeros = {"left": 8}
+
+        def adversarial_rb(bits):
+            if zeros["left"]:
+                zeros["left"] -= 1
+                return 0
+            return rng.getrandbits(bits)
+
+        rows = _rows(8, seed=61)
+        pub, sig, msg = rows[3]
+        rows[3] = (pub, sig, msg + b"!")
+        got = verify_batch_rlc(rows, randbits=adversarial_rb)
+        assert got == [i != 3 for i in range(8)]
+
+    def test_batch_of_one_and_empty_batch(self):
+        row = _rows(1, seed=71)[0]
+        assert verify_batch_rlc([row]) == [verify_single(*row)] == [True]
+        forged = (row[0], row[1], row[2] + b"x")
+        assert verify_batch_rlc([forged]) == [False]
+        assert verify_batch_rlc([]) == []
+
+    def test_randomized_1k_batch_equals_per_sig(self):
+        """The 1k-row randomized equivalence pin: 16 batches x 64 rows
+        of mixed honest/forged/non-canonical/small-order/duplicate rows
+        — verify_batch_rlc must agree with verify_single on every row,
+        bit for bit."""
+        rng = random.Random(0xC0FFEE)
+        encs = small_order_encodings()
+        total = 0
+        for b in range(16):
+            rows = []
+            for i in range(64):
+                kind = rng.randrange(10)
+                base = _scalar_row(
+                    rng.randrange(1, L), rng.randrange(1, L),
+                    b"rand-%d-%d" % (b, i),
+                )
+                if kind == 0:       # forged scalar
+                    pub, sig, msg = base
+                    s = (int.from_bytes(sig[32:], "little")
+                         + rng.randrange(1, L)) % L
+                    rows.append(
+                        (pub, sig[:32] + s.to_bytes(32, "little"), msg)
+                    )
+                elif kind == 1:     # tampered message
+                    rows.append((base[0], base[1], base[2] + b"!"))
+                elif kind == 2:     # non-canonical s
+                    pub, sig, msg = base
+                    s = int.from_bytes(sig[32:], "little")
+                    rows.append(
+                        (pub, sig[:32] + (s + L).to_bytes(32, "little"), msg)
+                    )
+                elif kind == 3:     # small-order A or R
+                    enc = encs[rng.randrange(8)]
+                    if rng.randrange(2):
+                        rows.append((enc, base[1], base[2]))
+                    else:
+                        rows.append((base[0], enc + base[1][32:], base[2]))
+                elif kind == 4 and rows:  # duplicate of an earlier row
+                    rows.append(rows[rng.randrange(len(rows))])
+                else:               # honest
+                    rows.append(base)
+            total += len(rows)
+            got = verify_batch_rlc(rows, randbits=rng.getrandbits)
+            want = [verify_single(*row) for row in rows]
+            assert got == want, f"batch {b} diverged from per-sig"
+        assert total == 1024
+
+
+class TestRLCDispatchRouting:
+    """verifier/batch.py: full shape-bucketed ed25519 buckets settle via
+    RLC; partial buckets, opted-out deployments, and injected MSM faults
+    keep/fall back to the per-signature engines — zero lost futures."""
+
+    def _rows(self, n, seed=7):
+        from corda_tpu.crypto import EDDSA_ED25519_SHA512, PublicKey
+
+        return [
+            (PublicKey(EDDSA_ED25519_SHA512, pub), sig, msg)
+            for pub, sig, msg in _rows(n, seed=seed)
+        ]
+
+    def test_full_bucket_routes_to_rlc(self):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.verifier.batch import dispatch_signature_rows
+
+        m = node_metrics()
+        base = m.counter("batchverify.batches").count
+        rows = self._rows(16, seed=81)
+        mask = dispatch_signature_rows(
+            rows, use_device=False, min_bucket=16
+        ).collect()
+        assert mask.tolist() == [True] * 16
+        assert m.counter("batchverify.batches").count == base + 1
+
+    def test_partial_bucket_stays_per_sig(self):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.verifier.batch import dispatch_signature_rows
+
+        m = node_metrics()
+        base = m.counter("batchverify.batches").count
+        rows = self._rows(10, seed=82)
+        mask = dispatch_signature_rows(
+            rows, use_device=False, min_bucket=16
+        ).collect()
+        assert mask.tolist() == [True] * 10
+        assert m.counter("batchverify.batches").count == base
+
+    def test_knob_off_pins_host_path(self, monkeypatch):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.verifier.batch import dispatch_signature_rows
+
+        monkeypatch.setenv("CORDA_TPU_BATCH_RLC", "0")
+        m = node_metrics()
+        base = m.counter("batchverify.batches").count
+        rows = self._rows(16, seed=83)
+        mask = dispatch_signature_rows(
+            rows, use_device=False, min_bucket=16
+        ).collect()
+        assert mask.tolist() == [True] * 16
+        assert m.counter("batchverify.batches").count == base
+
+    def test_injected_msm_fault_falls_back_per_sig(self):
+        """ISSUE 12 satellite: a seeded plan kills the batch MSM — every
+        row (including a planted forgery) must still resolve through the
+        host per-signature path, with the fault counted."""
+        from corda_tpu import faultinject as fi
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.verifier.batch import dispatch_signature_rows
+
+        m = node_metrics()
+        base = m.counter("batchverify.msm_faults").count
+        rows = self._rows(16, seed=84)
+        pub, sig, msg = rows[5]
+        rows[5] = (pub, sig, msg + b"!")
+        fi.install(fi.FaultInjector(fi.FaultPlan(
+            seed=7, fail_sites=(("batchverify.msm", 1),)
+        )))
+        try:
+            mask = dispatch_signature_rows(
+                rows, use_device=False, min_bucket=16
+            ).collect()
+        finally:
+            fi.clear()
+        assert mask.tolist() == [i != 5 for i in range(16)]
+        assert m.counter("batchverify.msm_faults").count == base + 1
+
+
+class TestBLS:
+    def test_keypair_derivation_is_deterministic(self):
+        from corda_tpu.batchverify import bls
+
+        pk1, sk1 = bls.derive_keypair_from_entropy(b"ent-1")
+        pk2, sk2 = bls.derive_keypair_from_entropy(b"ent-1")
+        pk3, _ = bls.derive_keypair_from_entropy(b"ent-2")
+        assert (pk1, sk1) == (pk2, sk2)
+        assert pk1 != pk3
+        assert len(pk1) == bls.PUBLIC_KEY_BYTES == 48
+        assert bls.public_key(sk1) == pk1
+        assert bls.public_key_on_curve(pk1)
+        assert not bls.public_key_on_curve(b"\x00" * 48)
+
+    def test_sign_verify_and_negatives(self):
+        from corda_tpu.batchverify import bls
+
+        pk, sk = bls.derive_keypair_from_entropy(b"sv")
+        pk2, _ = bls.derive_keypair_from_entropy(b"sv-2")
+        sig = bls.sign(sk, b"msg")
+        assert len(sig) == bls.SIGNATURE_BYTES == 96
+        assert bls.sign(sk, b"msg") == sig       # deterministic
+        assert bls.verify(pk, b"msg", sig)
+        assert not bls.verify(pk, b"msg2", sig)
+        assert not bls.verify(pk2, b"msg", sig)
+        assert not bls.verify(pk, b"msg", b"\x00" * 96)
+
+    def test_hash_to_g2_lands_in_r_order_subgroup(self):
+        """The subgroup pin: r·H(m) == O for the cofactor-cleared hash
+        (an out-of-subgroup hash would break aggregate soundness)."""
+        from corda_tpu.batchverify import bls
+
+        for msg in (b"", b"pin", b"quorum-outcome"):
+            pt = bls.hash_to_g2(msg)
+            assert not bls._jac_is_inf(pt, bls._F2)
+            assert bls._jac_is_inf(
+                bls._jac_mul(pt, bls.R, bls._F2), bls._F2
+            )
+
+    def test_compression_round_trips_and_rejects_garbage(self):
+        from corda_tpu.batchverify import bls
+
+        pk, sk = bls.derive_keypair_from_entropy(b"compress")
+        pt = bls.g1_decompress(pk)
+        assert bls.g1_compress(pt) == pk
+        sig = bls.sign(sk, b"m")
+        assert bls.g2_compress(bls.g2_decompress(sig)) == sig
+        with pytest.raises(bls.BLSError):
+            bls.g1_decompress(bytes([pk[0] & 0x7F]) + pk[1:])  # no flag
+        with pytest.raises(bls.BLSError):
+            bls.g1_decompress(pk[:47])
+        with pytest.raises(bls.BLSError):
+            bls.g2_decompress(b"\xff" * 96)
+
+    def test_aggregate_verify_with_pop_and_rogue_key_defense(self):
+        from corda_tpu.batchverify import bls
+
+        members = [
+            bls.derive_keypair_from_entropy(b"agg-%d" % i) for i in range(3)
+        ]
+        for pk, sk in members:
+            assert bls.register_pop(pk, bls.prove_possession(sk))
+            assert bls.is_registered(pk)
+        msg = b"round-outcome"
+        agg = bls.aggregate([bls.sign(sk, msg) for _pk, sk in members])
+        pks = [pk for pk, _sk in members]
+        assert bls.fast_aggregate_verify(pks, msg, agg)
+        assert not bls.fast_aggregate_verify(pks[:2], msg, agg)
+        assert not bls.fast_aggregate_verify(pks, b"other", agg)
+        # an unregistered key poisons the subset under the PoP default —
+        # the rogue-key defense: Σpk aggregation is only sound for keys
+        # that proved possession, so the registry gate is load-bearing
+        rogue_pk, rogue_sk = bls.derive_keypair_from_entropy(b"rogue")
+        assert not bls.is_registered(rogue_pk)
+        agg2 = bls.aggregate(
+            [bls.sign(sk, msg) for _pk, sk in members]
+            + [bls.sign(rogue_sk, msg)]
+        )
+        assert not bls.fast_aggregate_verify(pks + [rogue_pk], msg, agg2)
+        assert bls.fast_aggregate_verify(
+            pks + [rogue_pk], msg, agg2, require_pop=False
+        )
+        # possession proofs do not transfer between keys
+        assert not bls.verify_possession(
+            rogue_pk, bls.prove_possession(members[0][1])
+        )
+
+
+class TestQuorumCertificate:
+    def _qc(self):
+        from corda_tpu.batchverify import bls
+        from corda_tpu.batchverify.qc import QuorumCertificate
+
+        members = [
+            bls.derive_keypair_from_entropy(b"qc-%d" % i) for i in range(4)
+        ]
+        for pk, sk in members:
+            bls.register_pop(pk, bls.prove_possession(sk))
+        msg = b"qc-outcome"
+        shares = [bls.sign(members[i][1], msg) for i in (0, 2, 3)]
+        qc = QuorumCertificate(
+            message=msg, agg_sig=bls.aggregate(shares), bitmap=0b1101, n=4
+        )
+        return qc, [pk for pk, _sk in members]
+
+    def test_encode_decode_round_trip_and_verify(self):
+        from corda_tpu.batchverify.qc import (
+            QuorumCertificate, decode_attestation,
+        )
+
+        qc, member_keys = self._qc()
+        assert qc.signers() == [0, 2, 3]
+        assert qc.signer_count() == 3
+        blob = qc.encode()
+        # the wire pin: ONE 96-byte aggregate signature, nothing per-
+        # signer — magic + version + n + 1 bitmap byte + length + message
+        assert len(blob) == 3 + 2 + 1 + 4 + len(qc.message) + 96
+        back = decode_attestation(blob)
+        assert isinstance(back, QuorumCertificate)
+        assert back == qc
+        assert back.verify(member_keys)
+        assert not back.verify(member_keys[:3])          # wrong n
+        assert not back.verify(list(reversed(member_keys)))  # wrong order
+
+    def test_legacy_attestations_still_decode(self):
+        from corda_tpu.batchverify.qc import decode_attestation
+        from corda_tpu.serialization import serialize
+
+        legacy = {"replica-0": b"sig-bytes", "replica-1": b"more-bytes"}
+        assert decode_attestation(serialize(legacy)) == legacy
+
+    def test_malformed_certificates_reject(self):
+        from corda_tpu.batchverify.qc import QCError, QuorumCertificate
+
+        qc, _keys = self._qc()
+        blob = qc.encode()
+        with pytest.raises(QCError):
+            QuorumCertificate.decode(b"XXX" + blob[3:])      # magic
+        with pytest.raises(QCError):
+            QuorumCertificate.decode(blob[:3] + b"\x09" + blob[4:])  # version
+        with pytest.raises(QCError):
+            QuorumCertificate.decode(blob[:-1])              # truncated
+        with pytest.raises(QCError):
+            QuorumCertificate(
+                message=b"m", agg_sig=b"\x00" * 96, bitmap=0, n=4
+            )
+        with pytest.raises(QCError):
+            QuorumCertificate(
+                message=b"m", agg_sig=b"\x00" * 96, bitmap=1 << 4, n=4
+            )
+        with pytest.raises(QCError):
+            QuorumCertificate(
+                message=b"m", agg_sig=b"\x00" * 95, bitmap=1, n=4
+            )
+
+
+class TestBLSScheme:
+    """Scheme 7 (BLS_BLS12381) through the uniform crypto facade."""
+
+    def test_registered_and_round_trips(self):
+        from corda_tpu import crypto
+
+        scheme = crypto.find_scheme(crypto.BLS_BLS12381)
+        assert scheme.code_name == "BLS_BLS12381"
+        kp = crypto.derive_keypair_from_entropy(
+            crypto.BLS_BLS12381, b"scheme7-entropy"
+        )
+        kp2 = crypto.derive_keypair_from_entropy(
+            crypto.BLS_BLS12381, b"scheme7-entropy"
+        )
+        assert kp.public == kp2.public
+        assert kp.public.scheme_id == crypto.BLS_BLS12381
+        assert len(kp.public.encoded) == 48
+        sig = crypto.sign(kp.private, b"payload")
+        assert crypto.is_valid(kp.public, sig, b"payload")
+        assert not crypto.is_valid(kp.public, sig, b"payload2")
+        assert crypto.public_key_on_curve(kp.public)
+        assert not crypto.public_key_on_curve(
+            crypto.PublicKey(crypto.BLS_BLS12381, b"\x01" * 48)
+        )
+
+    def test_generate_is_distinct(self):
+        from corda_tpu import crypto
+
+        a = crypto.generate_keypair(crypto.BLS_BLS12381)
+        b = crypto.generate_keypair(crypto.BLS_BLS12381)
+        assert a.public != b.public
+
+
+class TestBFTQuorumRounds:
+    """notary/bft.py: a BLS-keyed cluster settles each round with ONE
+    aggregate quorum certificate; an injected aggregation fault degrades
+    to the legacy per-signer attestations without losing the round."""
+
+    def _refs(self, *tags):
+        from corda_tpu.crypto import sha256
+        from corda_tpu.ledger import StateRef
+
+        return [StateRef(sha256(t.encode()), 0) for t in tags]
+
+    def test_round_carries_one_aggregate_qc(self):
+        from corda_tpu.batchverify.qc import QuorumCertificate
+        from corda_tpu.crypto import sha256
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.notary import BFTUniquenessProvider
+
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            _replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix="qc-replica"
+            )
+            provider = make_client("qc-client")
+            provider.commit(
+                self._refs("qa", "qb"), sha256(b"qc-tx1"), "alice"
+            )
+            qc = provider.take_qc()
+            assert isinstance(qc, QuorumCertificate)
+            assert qc.signer_count() >= 2          # f+1 of n=4
+            assert qc.n == 4
+            assert qc.verify(provider.bls_member_keys)
+            # take-once: the certificate belongs to exactly one round
+            assert provider.take_qc() is None
+            # round trip over the wire stays ONE aggregate signature
+            assert qc.encode().count(qc.agg_sig) == 1
+        finally:
+            net.stop_pumping()
+
+    def test_injected_aggregate_fault_degrades_to_legacy(self):
+        from corda_tpu import faultinject as fi
+        from corda_tpu.crypto import sha256
+        from corda_tpu.messaging import InMemoryMessagingNetwork
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.notary import BFTUniquenessProvider
+
+        m = node_metrics()
+        base_fb = m.counter("notary.qc.fallback").count
+        net = InMemoryMessagingNetwork()
+        net.start_pumping()
+        try:
+            _replicas, make_client = BFTUniquenessProvider.make_cluster(
+                4, net, prefix="qcf-replica"
+            )
+            provider = make_client("qcf-client")
+            fi.install(fi.FaultInjector(fi.FaultPlan(
+                seed=7, fail_sites=(("notary.aggregate", 1),)
+            )))
+            try:
+                provider.commit(
+                    self._refs("fa"), sha256(b"qcf-tx1"), "alice"
+                )
+            finally:
+                fi.clear()
+            # the round COMMITTED on the legacy ed25519 attestations;
+            # only the aggregate certificate is missing
+            assert provider.take_qc() is None
+            assert m.counter("notary.qc.fallback").count == base_fb + 1
+            # next round (no fault) certifies again
+            provider.commit(self._refs("fb"), sha256(b"qcf-tx2"), "bob")
+            assert provider.take_qc() is not None
+        finally:
+            net.stop_pumping()
+
+
+class TestServiceQCCache:
+    """notary/service.py: the per-tx attestation cache is QC-aware —
+    certificates ride (and evict) with their signatures, and
+    _collect_qc independently verifies one aggregate per round."""
+
+    def test_remember_and_cached_qc_with_eviction(self, monkeypatch):
+        from corda_tpu.crypto import generate_keypair, sha256, sign_tx_id
+        from corda_tpu.ledger import CordaX500Name, Party
+        from corda_tpu.notary import (
+            InMemoryUniquenessProvider, SimpleNotaryService,
+        )
+
+        kp = generate_keypair()
+        party = Party(
+            CordaX500Name("QC Notary", "London", "GB"), kp.public
+        )
+        svc = SimpleNotaryService(
+            party, kp, InMemoryUniquenessProvider()
+        )
+        monkeypatch.setattr(type(svc), "SIGNED_CACHE_MAX", 4)
+        qc_like = object()
+        ids = [sha256(b"qc-cache-%d" % i) for i in range(6)]
+        for i, tx_id in enumerate(ids):
+            sig = sign_tx_id(kp.private, kp.public, tx_id)
+            svc.remember_signature(
+                tx_id, sig, qc=qc_like if i % 2 == 0 else None
+            )
+        # eviction halves the cache; QC entries die with their sigs
+        assert svc.cached_signature(ids[0]) is None
+        assert svc.cached_qc(ids[0]) is None
+        assert svc.cached_signature(ids[-1]) is not None
+        assert svc.cached_qc(ids[4]) is qc_like
+        assert svc.cached_qc(ids[5]) is None
+        # idempotent re-remember attaches a late-arriving QC only once
+        late = object()
+        svc.remember_signature(
+            ids[-1], svc.cached_signature(ids[-1]), qc=late
+        )
+        assert svc.cached_qc(ids[-1]) is late
+        svc.remember_signature(
+            ids[-1], svc.cached_signature(ids[-1]), qc=object()
+        )
+        assert svc.cached_qc(ids[-1]) is late
+
+    def test_collect_qc_verifies_once_and_drops_garbage(self):
+        from corda_tpu.batchverify import bls
+        from corda_tpu.batchverify.qc import QuorumCertificate
+        from corda_tpu.crypto import generate_keypair
+        from corda_tpu.ledger import CordaX500Name, Party
+        from corda_tpu.notary import (
+            BatchedNotaryService, InMemoryUniquenessProvider,
+        )
+
+        members = [
+            bls.derive_keypair_from_entropy(b"svc-%d" % i) for i in range(4)
+        ]
+        for pk, sk in members:
+            bls.register_pop(pk, bls.prove_possession(sk))
+        outcome = b"svc-outcome"
+        shares = [bls.sign(members[i][1], outcome) for i in (0, 1)]
+        qc = QuorumCertificate(
+            message=outcome, agg_sig=bls.aggregate(shares),
+            bitmap=0b0011, n=4,
+        )
+        bad = QuorumCertificate(
+            message=b"other", agg_sig=qc.agg_sig, bitmap=0b0011, n=4
+        )
+
+        class _Provider(InMemoryUniquenessProvider):
+            def __init__(self, qc):
+                super().__init__()
+                self._q = qc
+                self.bls_member_keys = [pk for pk, _sk in members]
+
+            def take_qc(self):
+                q, self._q = self._q, None
+                return q
+
+        kp = generate_keypair()
+        party = Party(CordaX500Name("QC Svc", "London", "GB"), kp.public)
+        svc = BatchedNotaryService(
+            party, kp, _Provider(qc),
+            use_device=False, use_scheduler=False,
+        )
+        try:
+            got = svc._collect_qc()
+            assert got is qc
+            assert svc._collect_qc() is None      # take-once drained
+        finally:
+            svc.shutdown()
+        svc2 = BatchedNotaryService(
+            party, kp, _Provider(bad),
+            use_device=False, use_scheduler=False,
+        )
+        try:
+            assert svc2._collect_qc() is None     # failed verify dropped
+        finally:
+            svc2.shutdown()

@@ -490,6 +490,102 @@ class TestPerfGate:
                 "--result", str(rpath), "--baseline", str(bpath))
             assert proc.returncode == want_rc, (pct, proc.stdout)
 
+    def test_check_schema_validates_batchverify_section(self, tmp_path):
+        """ISSUE 12 satellite: the `batchverify` section the smoke's
+        algebraic pass emits is schema-validated — well-formed passes;
+        a missing field, a parity flag that is not a proof (0), and a
+        bisection that found fewer offenders than were planted fail."""
+        good = dict(self.SYNTHETIC)
+        good["batchverify"] = {
+            "rlc_parity_ok": 1, "rlc_rows": 144, "rlc_ms": 260.0,
+            "offenders_expected": 3, "offenders_found": 3,
+            "bls_aggregate_ok": 1, "bls_signers": 3, "bls_ms": 1300.0,
+            "model_ops_per_verify": 1525.91,
+            "model_savings_vs_per_sig": 2.142,
+        }
+        ok = tmp_path / "bv.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("rlc_parity_ok"),
+             "missing numeric 'rlc_parity_ok'"),
+            (lambda d: d.__setitem__("rlc_parity_ok", 0),
+             "must prove parity"),
+            (lambda d: d.__setitem__("bls_aggregate_ok", 0),
+             "must prove parity"),
+            (lambda d: d.__setitem__("offenders_found", 2),
+             "found 2 offenders, planted 3"),
+            (lambda d: d.__setitem__("bls_signers", -1),
+             "negative bls_signers"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["batchverify"])
+            bad = tmp_path / "bv_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_check_schema_validates_model_only_mfu_entry(self, tmp_path):
+        """The ed25519_batch mfu entry is model-only (no achieved rate or
+        utilization): schema mode accepts it without those keys, but
+        fails a savings ratio below the 2x acceptance floor or a missing
+        ops_per_verify."""
+        good = dict(self.SYNTHETIC)
+        good["mfu"] = {
+            "ed25519_batch": {
+                "model_only": True, "ops_per_verify": 1525.91,
+                "per_sig_field_ops": 3269, "savings_vs_per_sig": 2.142,
+            },
+        }
+        ok = tmp_path / "mo.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda m: m["ed25519_batch"].pop("ops_per_verify"),
+             "missing positive numeric 'ops_per_verify'"),
+            (lambda m: m["ed25519_batch"].__setitem__(
+                "savings_vs_per_sig", 1.4),
+             "below the 2x batch-verify acceptance floor"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["mfu"])
+            bad = tmp_path / "mo_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_gate_covers_batchverify_model_metric(self, tmp_path):
+        """mfu/ed25519_batch/ops_per_verify is a first-class gated metric
+        (lower is better): a result whose modeled batch cost grew beyond
+        the rounding tolerance fails the gate."""
+        baseline = {
+            "schema": 1,
+            "metrics": {
+                "mfu/ed25519_batch/ops_per_verify":
+                    {"baseline": 1525.91, "rel_tol": 0.02,
+                     "direction": "lower"},
+            },
+        }
+        bpath = tmp_path / "base.json"
+        bpath.write_text(json.dumps(baseline))
+        for ops, want_rc in ((1525.91, 0), (1490.0, 0), (1600.0, 1)):
+            res = dict(self.SYNTHETIC)
+            res["mfu"] = {"ed25519_batch": {
+                "model_only": True, "ops_per_verify": ops,
+                "savings_vs_per_sig": 2.1,
+            }}
+            rpath = tmp_path / "res.json"
+            rpath.write_text(json.dumps(res))
+            proc = self._run(
+                "--result", str(rpath), "--baseline", str(bpath))
+            assert proc.returncode == want_rc, (ops, proc.stdout)
+
 
 class TestOpCount:
     """ISSUE 8: ops/opcount.py — the parameterized per-verify op model
@@ -602,12 +698,51 @@ class TestOpCount:
         from corda_tpu.ops import opcount as oc
 
         models = oc.active_models()
-        assert set(models) == {"ed25519", "ecdsa"}
-        for name, m in models.items():
+        assert set(models) == {"ed25519", "ecdsa", "ed25519_batch"}
+        for name in ("ed25519", "ecdsa"):
+            m = models[name]
             assert m["ops_per_verify"] > 0
             assert m["macs_per_verify"] <= m["ops_per_verify"]
             assert m["field_muls_per_verify"] > 0
             assert "config" in m
+        batch = models["ed25519_batch"]
+        assert batch["model_only"] is True
+        assert batch["ops_per_verify"] > 0
+        assert batch["per_sig_field_ops"] == (
+            models["ed25519"]["field_muls_per_verify"]
+        )
+
+    def test_rlc_model_reads_live_msm_params(self):
+        """ISSUE 12 satellite: rlc_config() reads the batchverify module's
+        exported window/table/comb constants — not copies — so an MSM
+        parameter change moves the model (and trips the perf-gate pin)."""
+        from corda_tpu.batchverify import rlc
+        from corda_tpu.ops import opcount as oc
+
+        cfg = oc.rlc_config(n=64)
+        assert cfg["window_bits"] == rlc.MSM_WINDOW_BITS == 4
+        assert cfg["table_build"] == rlc.MSM_TABLE_BUILD == (1, 6)
+        assert cfg["comb_adds"] == rlc.COMB_ADDS == 32
+        assert cfg["z_bits"] == rlc.Z_BITS == 128
+        # the census is monotone in batch size per batch, amortizes down
+        # per verify, and is deterministic (the gate tolerance is only
+        # rounding slack)
+        per16 = oc.rlc_ops_per_verify(oc.rlc_config(n=16))["field_ops"]
+        per64 = oc.rlc_ops_per_verify(oc.rlc_config(n=64))["field_ops"]
+        assert per64 < per16
+        assert per64 == oc.rlc_ops_per_verify(oc.rlc_config(n=64))["field_ops"]
+
+    def test_rlc_batch_halves_per_sig_field_ops(self):
+        """The ISSUE 12 acceptance pin, deviceless: modeled field ops per
+        verify at N=64 is <= 0.5x the PR 8 per-signature floor (same
+        muls+sqs unit on both sides)."""
+        from corda_tpu.ops import opcount as oc
+
+        models = oc.active_models()
+        amortized = models["ed25519_batch"]["ops_per_verify"]
+        floor = models["ed25519"]["field_muls_per_verify"]
+        assert amortized <= 0.5 * floor, (amortized, floor)
+        assert models["ed25519_batch"]["savings_vs_per_sig"] >= 2.0
 
 
 class TestAnalyze:

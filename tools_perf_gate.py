@@ -100,6 +100,12 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # regression (or a model/tier mismatch) fails CI, not a human read.
     "mfu/ed25519/utilization_pct": ("higher", 0.25),
     "mfu/ecdsa/utilization_pct": ("higher", 0.25),
+    # RLC batch-verify op model (docs/BATCH_VERIFY.md): amortized field
+    # muls+sqs per signature at the model's batch size, straight from
+    # corda_tpu/ops/opcount.py — fully deterministic (no device, no
+    # timer), so the tolerance is only rounding slack. Lower is better;
+    # a regression here means someone made the MSM do more work per row.
+    "mfu/ed25519_batch/ops_per_verify": ("lower", 0.02),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -127,6 +133,14 @@ RESILIENCE_REQUIRED_KEYS = (
 DURABILITY_REQUIRED_KEYS = (
     "recovery_wall_s", "wal_fsync_p50_ms", "wal_fsync_p99_ms",
     "replayed_records", "torn_records", "snapshot_records",
+)
+
+# keys the smoke's batchverify section must carry for --check-schema
+# (the algebraic batch-verification pass — docs/BATCH_VERIFY.md):
+# RLC batch≡per-sig parity, offender bisection, BLS aggregate round-trip
+BATCHVERIFY_REQUIRED_KEYS = (
+    "rlc_parity_ok", "rlc_rows", "offenders_expected", "offenders_found",
+    "bls_aggregate_ok", "bls_signers",
 )
 
 
@@ -195,6 +209,26 @@ def check_schema(result: dict) -> list[str]:
                     continue
                 if not isinstance(entry, dict):
                     problems.append(f"mfu/{scheme}: expected an object")
+                    continue
+                if entry.get("model_only"):
+                    # model-only entries (ed25519_batch): pure op-census
+                    # numbers with no achieved-rate or utilization — the
+                    # deviceless RLC acceptance pin lives here instead.
+                    for key in ("ops_per_verify", "savings_vs_per_sig"):
+                        v = entry.get(key)
+                        if not isinstance(v, (int, float)) \
+                                or isinstance(v, bool) or v <= 0:
+                            problems.append(
+                                f"mfu/{scheme}: missing positive numeric "
+                                f"{key!r}"
+                            )
+                    sav = entry.get("savings_vs_per_sig")
+                    if isinstance(sav, (int, float)) \
+                            and not isinstance(sav, bool) and sav < 2.0:
+                        problems.append(
+                            f"mfu/{scheme}: savings_vs_per_sig {sav} below "
+                            "the 2x batch-verify acceptance floor"
+                        )
                     continue
                 for key in ("ops_per_verify_millions",
                             "achieved_int32_gops", "utilization_pct"):
@@ -309,6 +343,34 @@ def check_schema(result: dict) -> list[str]:
                 problems.append(
                     f"durability: wal_fsync_p99_ms {p99} below p50 {p50} "
                     "(quantiles must be monotone)"
+                )
+    batchverify = result.get("batchverify")
+    if batchverify is not None:
+        if not isinstance(batchverify, dict):
+            problems.append("batchverify: expected an object")
+        else:
+            for key in BATCHVERIFY_REQUIRED_KEYS:
+                v = batchverify.get(key)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(f"batchverify: missing numeric {key!r}")
+                elif v < 0:
+                    problems.append(f"batchverify: negative {key} {v}")
+            for flag in ("rlc_parity_ok", "bls_aggregate_ok"):
+                v = batchverify.get(flag)
+                if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                        and v != 1:
+                    problems.append(
+                        f"batchverify: {flag} is {v} (the pass must prove "
+                        "parity, not merely run)"
+                    )
+            exp = batchverify.get("offenders_expected")
+            got = batchverify.get("offenders_found")
+            if (isinstance(exp, (int, float)) and isinstance(got, (int, float))
+                    and not isinstance(exp, bool) and not isinstance(got, bool)
+                    and exp != got):
+                problems.append(
+                    f"batchverify: bisection found {got} offenders, "
+                    f"planted {exp}"
                 )
     return problems
 
