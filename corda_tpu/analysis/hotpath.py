@@ -10,9 +10,10 @@ the perf gate measures quietly dies.
 
 The pass is scoped to the files where that contract holds
 (``_HOT_FILES``) and allowlists the designated readback scopes
-(``PendingRows.collect`` — the ONE place a batch is supposed to
-materialize; the profiler lives outside these files and is the only
-legal ``block_until_ready`` caller in the tree).
+(``PendingRows.collect`` and the scheduler's ``_MeshPending.collect``
+— the only places a batch is supposed to materialize; the profiler
+lives outside these files and is the only legal ``block_until_ready``
+caller in the tree).
 
 Flagged forms:
 
@@ -41,6 +42,9 @@ _HOT_FILES = {
 # (file, scope qualname) pairs where readback is the scope's JOB
 _ALLOWED_SCOPES = {
     ("corda_tpu/verifier/batch.py", "PendingRows.collect"),
+    # the mega-batch's collect point: materializes the shard_map mask
+    # (and all-gathered consumed set) on the collector thread only
+    ("corda_tpu/serving/scheduler.py", "_MeshPending.collect"),
 }
 
 _HANDLE_ARG = (ast.Name, ast.Attribute, ast.Subscript)

@@ -379,7 +379,7 @@ class BatchedNotaryService(NotaryService):
         return self.settle_batch(requests, self.dispatch_batch(requests))
 
     def process_stream(
-        self, batches, *, depth: int = 3
+        self, batches, *, depth: int | None = None
     ) -> list[list[TransactionSignature | Exception]]:
         """Pipelined notarisation over an iterable of request batches.
 
@@ -388,6 +388,11 @@ class BatchedNotaryService(NotaryService):
         batches — the steady-state shape of the ≥10k-tx/sec target, where
         per-batch device latency (dominated by the tunneled link's ~100 ms
         round trip) must overlap host work rather than serialize with it.
+        ``depth=None`` self-sizes: 3 on a single chip, widening to the
+        serving scheduler's mesh stripe width when windows route through
+        a striped scheduler — 3 in-flight windows feed at most 3 of 8
+        chips, so a mesh pipeline must carry at least one window per
+        stripe member to saturate it.
 
         The uniqueness commit is its own pipeline stage: for a CLUSTERED
         notary (Raft/BFT) ``commit_batch_async`` puts window N's consensus
@@ -400,6 +405,15 @@ class BatchedNotaryService(NotaryService):
         """
         from collections import deque
 
+        if depth is None:
+            depth = 3
+            if self._use_scheduler and self._use_device:
+                from corda_tpu.serving import device_scheduler
+
+                try:
+                    depth = max(depth, device_scheduler().mesh_stripe_width())
+                except Exception:
+                    pass  # scheduler unavailable: single-chip default
         priming: deque = deque()     # (batch, pending id sweep)
         verifying: deque = deque()   # (batch, pending sig-check)
         committing: deque = deque()  # (batch, staged validate+commit)

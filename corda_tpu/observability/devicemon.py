@@ -236,19 +236,24 @@ class DeviceMonitor:
             )
 
     def record_settle(self, ordinal: int, wall_s: float,
-                      *, ok: bool = True, ewma: bool = True) -> None:
+                      *, ok: bool = True, ewma: bool = True,
+                      track_inflight: bool = True) -> None:
         """One tracked batch completed on ``ordinal`` after ``wall_s``
         (dispatch→settle wall): updates the execute EWMA, the completion
         heartbeat, and releases the in-flight count. ``ewma=False``
         records the heartbeat/in-flight release WITHOUT folding the wall
         into the EWMA — a hedge-lost late readback's stall-inflated wall
         would otherwise grow the very hedge deadline (EWMA × factor)
-        that exists to catch this device's stalls."""
+        that exists to catch this device's stalls.
+        ``track_inflight=False`` pairs with an untracked dispatch (the
+        sharded-attribution path): the settle must not release an
+        in-flight slot some OTHER tracked batch on this ordinal owns."""
         now = self._clock()
         with self._lock:
             slot = self._slot_locked(ordinal)
             slot.settles += 1
-            slot.inflight = max(0, slot.inflight - 1)
+            if track_inflight:
+                slot.inflight = max(0, slot.inflight - 1)
             slot.last_settle_t = now
             if not ok:
                 slot.failures += 1
@@ -258,6 +263,21 @@ class DeviceMonitor:
                     w if slot.exec_ewma_s == 0.0
                     else 0.7 * slot.exec_ewma_s + 0.3 * w
                 )
+
+    def record_sharded_settle(self, ordinals: list[int], wall_s: float,
+                              *, ok: bool = True,
+                              ewma: bool = True) -> None:
+        """Settle counterpart of :meth:`record_sharded_dispatch`: one
+        mega-batch that was sharded over ``ordinals`` completed after
+        ``wall_s``. Every shard shares the batch's wall (the collective
+        synchronizes the mesh, so per-shard walls are indistinguishable
+        from the host) and none touches the in-flight count — the
+        sharded dispatch never incremented it. Keeps per-ordinal
+        dispatches == settles reconciling exactly under mega-batching."""
+        for o in ordinals:
+            self.record_settle(
+                int(o), wall_s, ok=ok, ewma=ewma, track_inflight=False
+            )
 
     def record_failure(self, ordinal: int) -> None:
         """A dispatch that never reached the device (failover before

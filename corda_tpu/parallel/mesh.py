@@ -214,6 +214,19 @@ def service_mesh_active() -> bool:
     return jax.default_backend() != "cpu" and len(jax.devices()) > 1
 
 
+def device_for_ordinal(ordinal: int):
+    """Resolve a device ordinal (``jax.Device.id``) back to its device
+    object, for explicit placement (`jax.default_device` pinning). The
+    striped scheduler and per-ordinal canary probes track devices by
+    ordinal everywhere else (devicemon, quarantine, breaker), so this is
+    the one translation point. Raises ``KeyError`` for an unknown
+    ordinal — callers treat that as a dead chip."""
+    for d in jax.devices():
+        if int(d.id) == int(ordinal):
+            return d
+    raise KeyError(f"no visible device with ordinal {ordinal}")
+
+
 _mesh_verifier_singleton = None
 
 

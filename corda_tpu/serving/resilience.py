@@ -284,9 +284,11 @@ class DeviceQuarantine:
 
 
 class CircuitBreaker:
-    """Whole-device-tier breaker: K consecutive device failures or hedge
-    losses trip it OPEN (the scheduler host-routes everything), an
-    exponential-backoff HALF_OPEN canary closes it. State is the
+    """One ordinal's breaker: K consecutive device failures or hedge
+    losses trip it OPEN (the scheduler drops the ordinal from the stripe
+    set; with every ordinal open the whole tier host-routes), an
+    exponential-backoff HALF_OPEN canary closes it. The policy keeps one
+    instance per ordinal (``breaker_for``); the mesh rollup is the
     ``serving.breaker.state`` gauge (0 closed / 1 open / 2 half-open)."""
 
     def __init__(self, *, threshold: int = 3, backoff_s: float = 1.0,
@@ -422,10 +424,14 @@ class ResiliencePolicy:
             strikes=strikes, probe_backoff_s=probe_backoff_s,
             probe_backoff_max_s=probe_backoff_max_s, clock=clock,
         )
-        self.breaker = CircuitBreaker(
+        # one breaker per ordinal, created on first contact (PR 13:
+        # per-device breaker scope — one sick chip must not evict the
+        # other seven from the stripe set)
+        self._breaker_kwargs = dict(
             threshold=breaker_threshold, backoff_s=breaker_backoff_s,
             backoff_max_s=breaker_backoff_max_s, clock=clock,
         )
+        self._breakers: dict[int, CircuitBreaker] = {}
         self._clock = clock
         self._probe_runner = probe_runner
         self._lock = threading.Lock()
@@ -433,6 +439,46 @@ class ResiliencePolicy:
         self._canary = None            # lazily built known-answer rows
         self._shapes = None            # ShapeTable from the attached scheduler
         self._monitor = None           # the devicemon we subscribed to
+
+    # ---------------------------------------------------------- breakers
+    def breaker_for(self, ordinal: int) -> CircuitBreaker:
+        """The given ordinal's breaker, created on first use."""
+        o = int(ordinal)
+        with self._lock:
+            br = self._breakers.get(o)
+            if br is None:
+                br = self._breakers[o] = CircuitBreaker(
+                    **self._breaker_kwargs
+                )
+            return br
+
+    @property
+    def breaker(self) -> CircuitBreaker:
+        """Single-chip compatibility view: the DEFAULT ordinal's breaker
+        (PR 9 callers and drills read ``policy.breaker.state``; on one
+        chip the default ordinal IS the device tier)."""
+        from corda_tpu.observability.devicemon import (
+            default_device_ordinal,
+        )
+
+        return self.breaker_for(default_device_ordinal())
+
+    def breaker_state_mesh(self) -> int:
+        """Whole-mesh breaker rollup: OPEN only when EVERY known
+        ordinal's breaker is open (the stripe set is empty — the tier is
+        down), HALF_OPEN while any ordinal is probing, else CLOSED.
+        Reads existing breakers only (no creation side effect — the
+        gauge calls this from under the registry lock)."""
+        with self._lock:
+            breakers = list(self._breakers.values())
+        if not breakers:
+            return BREAKER_CLOSED
+        states = [br.state for br in breakers]
+        if all(s == BREAKER_OPEN for s in states):
+            return BREAKER_OPEN
+        if any(s == BREAKER_HALF_OPEN for s in states):
+            return BREAKER_HALF_OPEN
+        return BREAKER_CLOSED
 
     # --------------------------------------------------------- lifecycle
     def attach(self, scheduler) -> None:
@@ -461,14 +507,24 @@ class ResiliencePolicy:
     # ----------------------------------------------------------- routing
     def admit_device(self, ordinal: int) -> bool:
         """The per-dispatch gate: False routes the whole batch to host.
-        Breaker first (tier-wide), then the ordinal's quarantine."""
-        if not self.breaker.allow_device():
+        The ordinal's breaker first, then its quarantine."""
+        if not self.breaker_for(ordinal).allow_device():
             _metrics().counter("serving.breaker.host_routed").inc()
             return False
         if self.quarantine.blocked(ordinal):
             _metrics().counter("serving.quarantine.host_routed").inc()
             return False
         return True
+
+    def admit_ordinal(self, ordinal: int) -> bool:
+        """Counter-free eligibility read for stripe-set membership:
+        True while the ordinal's breaker is closed and it is not
+        quarantined. ``admit_device`` remains the per-dispatch gate
+        that counts host-routes; this one is consulted once per
+        placement decision for EVERY ordinal, so it must not inflate
+        those counters."""
+        return (self.breaker_for(ordinal).allow_device()
+                and not self.quarantine.blocked(ordinal))
 
     def hedge_deadline_s(self, ordinal: int,
                          fallback_ewma_s: float) -> float | None:
@@ -498,9 +554,10 @@ class ResiliencePolicy:
     # ------------------------------------------------------ feed points
     def on_dispatch_failure(self, ordinal: int) -> None:
         """A device dispatch raised (real or injected): one strike, one
-        breaker failure."""
+        breaker failure — both against the ordinal the batch was placed
+        on."""
         self._strike(ordinal, "dispatch-failure")
-        self.breaker.record_failure()
+        self.breaker_for(ordinal).record_failure()
 
     def on_hedge_fired(self, ordinal: int) -> None:
         """A batch blew its in-flight deadline: stall evidence — a
@@ -509,13 +566,20 @@ class ResiliencePolicy:
         self._strike(ordinal, "hedge-stall")
 
     def on_hedge_won_host(self, ordinal: int) -> None:
-        """The hedge completed on host before the device: a device-tier
-        loss toward the breaker."""
-        self.breaker.record_failure()
+        """The hedge completed on host before the device: a loss toward
+        the stalled ordinal's breaker."""
+        self.breaker_for(ordinal).record_failure()
+
+    def on_hedge_won_sibling(self, ordinal: int) -> None:
+        """A SIBLING chip finished the hedged batch before the original
+        device: same per-ordinal evidence as a host win — the loss lands
+        on the ORIGINAL ordinal's breaker, while the sibling's own
+        clean settle speaks for itself."""
+        self.breaker_for(ordinal).record_failure()
 
     def on_settle_ok(self, ordinal: int) -> None:
         self.quarantine.healthy_settle(ordinal)
-        self.breaker.record_success()
+        self.breaker_for(ordinal).record_success()
 
     def on_device_event(self, event: dict) -> None:
         """The devicemon subscription hook: a watchdog ``device.unhealthy``
@@ -557,8 +621,11 @@ class ResiliencePolicy:
         ordinal = self.quarantine.due_probe(now)
         if ordinal is not None:
             self._launch_probe(("quarantine", ordinal), sync)
-        if self.breaker.probe_due(now):
-            self._launch_probe(("breaker", None), sync)
+        with self._lock:
+            breakers = list(self._breakers.items())
+        for o, br in breakers:
+            if br.probe_due(now):
+                self._launch_probe(("breaker", o), sync)
 
     def _launch_probe(self, key: tuple, sync: bool) -> None:
         with self._lock:
@@ -590,7 +657,9 @@ class ResiliencePolicy:
         if kind == "quarantine":
             self.quarantine.probe_result(ordinal, ok)
         else:
-            self.breaker.probe_result(ok)
+            self.breaker_for(
+                0 if ordinal is None else ordinal
+            ).probe_result(ok)
 
     def _canary_rows(self):
         """The known-answer batch: valid signatures plus one tampered —
@@ -622,8 +691,17 @@ class ResiliencePolicy:
             self._shapes.bucket_for(len(rows))
             if self._shapes is not None else None
         )
+        # the canary must exercise the SPECIFIC ordinal it readmits —
+        # an unpinned probe would land on the backend default and could
+        # readmit a still-sick chip on a healthy sibling's evidence
+        try:
+            from corda_tpu.parallel.mesh import device_for_ordinal
+
+            device = device_for_ordinal(ordinal)
+        except Exception:
+            device = None
         pending = dispatch_signature_rows(
-            rows, use_device=True, min_bucket=bucket
+            rows, use_device=True, min_bucket=bucket, device=device,
         )
         # bounded wait on the readback: a probe against a wedged device
         # must FAIL (backoff doubles, a later probe retries) rather than
@@ -650,7 +728,27 @@ class ResiliencePolicy:
                 "max_s": self.hedge_max_s,
             },
             "quarantine": self.quarantine.snapshot(),
-            "breaker": self.breaker.snapshot(),
+            "breaker": self._breaker_snapshot(),
+        }
+
+    def _breaker_snapshot(self) -> dict:
+        """Mesh rollup plus per-ordinal detail, shape-compatible with
+        the PR 9 single-breaker snapshot (``state``/``state_name``/
+        ``threshold`` at the top level) so flight-dump consumers keep
+        parsing."""
+        with self._lock:
+            items = sorted(self._breakers.items())
+        state = self.breaker_state_mesh()
+        return {
+            "state": state,
+            "state_name": {
+                BREAKER_CLOSED: "closed", BREAKER_OPEN: "open",
+                BREAKER_HALF_OPEN: "half-open",
+            }[state],
+            "threshold": max(1, int(self._breaker_kwargs["threshold"])),
+            "per_ordinal": {
+                str(o): br.snapshot() for o, br in items
+            },
         }
 
 
@@ -699,9 +797,11 @@ def _register_gauges() -> None:
     m = _metrics()
 
     def breaker_state():
+        # mesh rollup, and deliberately NOT the `breaker` property: a
+        # gauge read must not create breaker slots as a side effect
         p = _active_policy
         try:
-            return p.breaker.state if p is not None else 0
+            return p.breaker_state_mesh() if p is not None else 0
         except Exception:
             return 0
 

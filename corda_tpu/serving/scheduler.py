@@ -37,12 +37,29 @@ Core loop (``_dispatch_loop``):
   counts out-of-order settles), preserving the round-trip overlap the
   notary and wavefront pipelines rely on.
 
+Mesh scheduling: with more than one visible accelerator (or
+``CORDA_TPU_MESH=1``) the scheduler stripes batches across a **stripe
+set** of eligible ordinals — every ``jax.devices()`` ordinal minus
+watchdog-evicted (devicemon ``unhealthy_ordinals``), quarantined and
+breaker-open ones — placing each batch by power-of-two-choices over
+(per-ordinal in-flight depth, execute-wall EWMA). When fill is high, a
+full homogeneous ed25519 bucket fuses into ONE ``shard_map`` mega-batch
+over the whole mesh, with the consumed-set delta all-gathered over ICI
+(``parallel/mesh.py``'s ``distributed_verify_step`` — the notary-commit
+collective). The PR 9 resilience machinery runs per-ordinal here:
+hedges re-route to a *sibling chip* before conceding to the host leg,
+canary probes pin the specific ordinal they readmit, and the breaker
+opens mesh-wide only when every ordinal is down. See docs/SERVING.md
+§Mesh scheduling.
+
 Degradation contract: the ``serving.dispatch`` faultinject site sits in
-front of every device dispatch; an injected (or real) dispatch failure
-fails over the whole batch to the host reference path — identical
-verdicts, ``serving.device_failover`` counted — and the per-bucket
-``verifier.device`` site below still covers partial failures. Metrics
-live in the process registry (``node_metrics()``) under ``serving.*``.
+front of every per-ordinal device dispatch (``serving.mesh_dispatch``
+in front of every fused mega-batch); an injected (or real) dispatch
+failure fails over the whole batch to the host reference path —
+identical verdicts, ``serving.device_failover`` counted — and the
+per-bucket ``verifier.device`` site below still covers partial
+failures. Metrics live in the process registry (``node_metrics()``)
+under ``serving.*``.
 """
 
 from __future__ import annotations
@@ -167,10 +184,11 @@ class _InFlight:
 
     __slots__ = ("requests", "pending", "n_rows", "dev_map", "seq", "t0",
                  "span", "device", "deadline", "hedged", "winner",
-                 "slot_freed", "compile_keys")
+                 "slot_freed", "compile_keys", "mesh_ordinals")
 
     def __init__(self, requests, pending, n_rows, dev_map, seq, t0,
-                 span=NOOP_SPAN, device=None, compile_keys=frozenset()):
+                 span=NOOP_SPAN, device=None, compile_keys=frozenset(),
+                 mesh_ordinals=()):
         self.requests = requests
         self.pending = pending
         self.n_rows = n_rows
@@ -179,10 +197,14 @@ class _InFlight:
         self.t0 = t0
         self.span = span            # serving.batch span, finished at settle
         self.device = device        # ordinal the dispatch ran on
-        self.compile_keys = compile_keys  # (scheme, bucket) shapes dispatched
+        self.compile_keys = compile_keys  # shape keys this dispatch touched
+        # a fused shard_map mega-batch runs on EVERY one of these ordinals
+        # (device stays None — no single chip owns it); settle attribution
+        # fans back out over them via record_sharded_settle
+        self.mesh_ordinals = tuple(mesh_ordinals)
         self.deadline = None        # monotonic hedge deadline (None: unhedged)
         self.hedged = False         # the hedge timer fired for this batch
-        self.winner = None          # None | "device" | "host"
+        self.winner = None          # None | "device" | "sibling" | "host"
         self.slot_freed = False     # depth slot released exactly once
 
 
@@ -216,6 +238,76 @@ def _complete(future: Future, result=None, error: Exception | None = None):
         pass
 
 
+def _consumed_rows(msgs: list[bytes]) -> np.ndarray:
+    """Per-row consumed-state digests for the mega-batch collective: the
+    (N, 8)-int32 view of each signed payload's SHA-256 — the row shape
+    ``distributed_verify_step``'s ``spent_hashes`` input shards and
+    all-gathers over ICI, so every chip (and the host readback) holds
+    the batch's full spent-set delta for a notary commit."""
+    import hashlib
+
+    out = np.zeros((len(msgs), 8), dtype=np.int32)
+    for i, msg in enumerate(msgs):
+        out[i] = np.frombuffer(hashlib.sha256(msg).digest(), dtype="<i4")
+    return out
+
+
+class _MeshPending:
+    """``PendingRows``-shaped adapter for one fused shard_map mega-batch:
+    the whole batch is ONE device value (the bucket-padded verdict mask)
+    plus the all-gathered consumed-set delta and the psum'd accept
+    count. Every row is a device row, in dispatch order. A readback
+    failure degrades to the host reference path at ``collect()`` —
+    the same never-lose-a-future contract as a PendingRows bucket
+    fallback."""
+
+    __slots__ = ("_rows", "_mask", "spent_all", "total_valid", "_n",
+                 "device_rows", "device_mask", "padded_lanes",
+                 "stall_until")
+
+    def __init__(self, rows: list, mask, spent_all, total, bucket: int):
+        self._rows = rows            # (PublicKey, sig, msg): host fallback
+        self._mask = mask
+        self.spent_all = spent_all   # (bucket, 8) int32, gathered over ICI
+        self.total_valid = total     # psum'd scalar accept count
+        self._n = len(rows)
+        self.device_rows = len(rows)
+        self.device_mask = np.ones(len(rows), dtype=bool)
+        self.padded_lanes = int(bucket)
+        self.stall_until = None      # injected-stall horizon (faultinject)
+
+    def inject_stall(self, delay_s: float) -> None:
+        if delay_s <= 0:
+            return
+        horizon = time.monotonic() + delay_s
+        self.stall_until = max(self.stall_until or 0.0, horizon)
+
+    def ready(self) -> bool:
+        from corda_tpu.ops._blockpack import result_ready
+
+        if self.stall_until is not None and \
+                time.monotonic() < self.stall_until:
+            return False
+        return result_ready(self._mask)
+
+    def collect(self) -> np.ndarray:
+        if self.stall_until is not None:
+            delay = self.stall_until - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        try:
+            return np.asarray(self._mask)[: self._n]
+        except Exception:
+            from corda_tpu.crypto import is_valid
+
+            _metrics().counter("serving.mesh.megabatch_failover").inc()
+            self.device_rows = 0
+            self.device_mask[:] = False
+            return np.array(
+                [is_valid(k, s, m) for k, s, m in self._rows], dtype=bool
+            )
+
+
 class DeviceScheduler:
     """One continuous-batching loop over the signature-verification
     kernels. Construct directly for tests; production code shares the
@@ -232,6 +324,8 @@ class DeviceScheduler:
         host_workers: int = 4,
         shapes=None,
         resilience=None,
+        mesh: bool | None = None,
+        megabatch_fill: float | None = None,
     ):
         # `shapes`: an explicit ShapeTable override (tests and the smoke
         # harness pin small pad buckets to reuse already-compiled shapes)
@@ -240,6 +334,14 @@ class DeviceScheduler:
         # circuit breaker, re-dispatch — docs/SERVING.md §Self-healing
         # dispatch). None consults CORDA_TPU_RESILIENCE=1 for a default
         # policy; False pins it off.
+        # `mesh`: stripe batches across all visible devices (docs/
+        # SERVING.md §Mesh scheduling). None consults CORDA_TPU_MESH,
+        # else defaults on exactly when >1 real accelerator is attached
+        # (the service-mesh activation rule); the probe is deferred to
+        # the first device dispatch so construction never touches jax.
+        # `megabatch_fill`: bucket-fill fraction at/above which a full
+        # homogeneous ed25519 batch fuses into one shard_map mega-batch
+        # (CORDA_TPU_MESH_MEGABATCH_FILL, default 0.85).
         self._shapes = shapes or shape_table()
         if resilience is None and os.environ.get(
             "CORDA_TPU_RESILIENCE", ""
@@ -275,6 +377,27 @@ class DeviceScheduler:
         # (dispatcher-thread-only writes; read racily by the gauge)
         self._real_rows = 0
         self._padded_rows = 0
+        # ---- mesh striping state (docs/SERVING.md §Mesh scheduling) ----
+        self._mesh = mesh               # None until lazily resolved
+        if megabatch_fill is None:
+            try:
+                megabatch_fill = float(os.environ.get(
+                    "CORDA_TPU_MESH_MEGABATCH_FILL", "0.85"
+                ))
+            except ValueError:
+                megabatch_fill = 0.85
+        self._megabatch_fill = max(0.0, megabatch_fill)
+        self._devices = None            # ordinal → jax.Device (lazy)
+        # per-ordinal placement state, all under self._lock: reserved
+        # in-flight depth (released at settle), the execute-wall EWMA the
+        # placement score reads, and per-ordinal dispatch counts (test/
+        # bench attribution, reconciled against devicemon)
+        self._ord_inflight: dict[int, int] = {}
+        self._ord_ewma: dict[int, float] = {}
+        self._ord_dispatches: dict[int, int] = {}
+        self._place_seq = 0             # rotating first placement choice
+        self._stripe_width = 0          # last stripe size (gauge)
+        self._mesh_spread_max = 0       # max observed depth spread (gauge)
         # EWMA state: arrival rate (rows/s, ~5 s horizon) and per-batch
         # device latency — their product is the expected arrivals during
         # one round trip, i.e. the natural adaptive batch size
@@ -560,6 +683,165 @@ class DeviceScheduler:
                 self._lock.notify_all()
         return rest
 
+    # ---------------------------------------------------- mesh placement
+    def _mesh_on(self) -> bool:
+        """Lazily resolve the striping switch: explicit constructor
+        value, else CORDA_TPU_MESH (1/0), else on exactly when more than
+        one REAL accelerator is attached (the service-mesh activation
+        rule — 8 virtual CPU devices stay single-chip unless a test
+        opts in). Resolved once; only dispatches with device work reach
+        here, so jax is about to be touched anyway."""
+        if self._mesh is None:
+            env = os.environ.get("CORDA_TPU_MESH", "").strip().lower()
+            if env in ("1", "true", "on", "yes"):
+                self._mesh = True
+            elif env in ("0", "false", "off", "no"):
+                self._mesh = False
+            else:
+                try:
+                    import jax
+
+                    self._mesh = (jax.default_backend() != "cpu"
+                                  and len(jax.devices()) > 1)
+                except Exception:
+                    self._mesh = False
+        return self._mesh
+
+    def _ensure_devices(self) -> dict:
+        if self._devices is None:
+            import jax
+
+            self._devices = {int(d.id): d for d in jax.devices()}
+        return self._devices
+
+    def _stripe_set(self) -> list[int]:
+        """The eligible ordinals a batch may be placed on: every visible
+        device minus devicemon's watchdog-evicted set minus ordinals the
+        resilience policy blocks (quarantined or breaker-open —
+        ``admit_ordinal`` is the counter-free read). Empty means the
+        whole mesh is down: the caller host-routes."""
+        try:
+            ordinals = sorted(self._ensure_devices())
+        except Exception:
+            return []
+        mon = active_devicemon()
+        if mon is not None:
+            try:
+                bad = mon.unhealthy_ordinals()
+                ordinals = [o for o in ordinals if o not in bad]
+            except Exception:
+                pass
+        pol = self._resilience
+        if pol is not None:
+            ordinals = [o for o in ordinals if pol.admit_ordinal(o)]
+        with self._lock:
+            self._stripe_width = len(ordinals)
+        return ordinals
+
+    def mesh_stripe_width(self) -> int:
+        """How many ordinals the scheduler is currently striping over
+        (0 when mesh scheduling is off). Pipelined callers size their
+        in-flight depth from this: a depth tuned for one chip underfills
+        an 8-chip stripe — the notary's ``process_stream`` keeps at
+        least one window in flight per stripe member."""
+        if not self._mesh_on():
+            return 0
+        return len(self._stripe_set())
+
+    def _place_locked(self, eligible: list[int]) -> int:
+        """Power-of-two-choices placement (lock held): a rotating
+        candidate (guaranteed coverage of the stripe) races the globally
+        least-loaded one, and the batch lands on the smaller
+        (in-flight depth, execute-wall EWMA) score. Reserves one depth
+        unit on the winner — released exactly once when the batch
+        settles (``_settle_entry``'s finally) or its dispatch raises."""
+        self._place_seq += 1
+        c1 = eligible[self._place_seq % len(eligible)]
+        c2 = min(eligible, key=lambda o: (
+            self._ord_inflight.get(o, 0), self._ord_ewma.get(o, 0.0),
+        ))
+
+        def score(o):
+            return (self._ord_inflight.get(o, 0),
+                    self._ord_ewma.get(o, 0.0))
+
+        pick = c1 if score(c1) <= score(c2) else c2
+        self._ord_inflight[pick] = self._ord_inflight.get(pick, 0) + 1
+        depths = [self._ord_inflight.get(o, 0) for o in eligible]
+        spread = max(depths) - min(depths)
+        if spread > self._mesh_spread_max:
+            self._mesh_spread_max = spread
+        return pick
+
+    def _ord_release(self, ordinal: int | None) -> None:
+        """Return one reserved per-ordinal depth unit (no-op for None or
+        a never-reserved ordinal — the single-chip path reserves
+        nothing)."""
+        if ordinal is None:
+            return
+        with self._lock:
+            d = self._ord_inflight.get(ordinal, 0)
+            if d > 0:
+                self._ord_inflight[ordinal] = d - 1
+
+    def _pick_sibling(self, exclude: int) -> int | None:
+        """Least-loaded healthy ordinal OTHER than the stalled one — the
+        hedge re-routes to a sibling chip before conceding to the host
+        reference path. Reserves a depth unit on the pick;
+        ``_settle_hedge_sibling`` releases it on every exit."""
+        stripe = [o for o in self._stripe_set() if o != exclude]
+        if not stripe:
+            return None
+        with self._lock:
+            pick = min(stripe, key=lambda o: (
+                self._ord_inflight.get(o, 0), self._ord_ewma.get(o, 0.0),
+            ))
+            self._ord_inflight[pick] = self._ord_inflight.get(pick, 0) + 1
+        return pick
+
+    def _mega_eligible(self, dev_rows, bucket, stripe) -> bool:
+        """A mega-batch fuses one high-fill homogeneous ed25519 bucket
+        over the WHOLE mesh: the shard_map step shards over every chip,
+        so a single quarantined/evicted ordinal vetoes fusion (striping
+        still covers the healthy remainder), and only the ed25519 shape
+        carries the notary-commit collective."""
+        if len(stripe) < 2:
+            return False
+        try:
+            if len(stripe) != len(self._ensure_devices()):
+                return False
+        except Exception:
+            return False
+        if len(dev_rows) < self._megabatch_fill * bucket:
+            return False
+        from corda_tpu.crypto import EDDSA_ED25519_SHA512
+
+        return all(
+            getattr(k, "scheme_id", None) == EDDSA_ED25519_SHA512
+            for k, _s, _m in dev_rows
+        )
+
+    def _dispatch_mega(self, dev_rows: list, bucket: int) -> _MeshPending:
+        """Fuse one full bucket into a single shard_map mega-batch: every
+        chip verifies its shard and the consumed-set delta (per-row tx
+        digests) comes back all-gathered over ICI — the notary-commit
+        collective built by ``distributed_verify_step``. Per-ordinal
+        telemetry attribution is recorded inside the mesh verifier
+        (``record_sharded_dispatch``), NOT here — recording both would
+        double-count."""
+        from corda_tpu.parallel.mesh import service_mesh_verifier
+
+        keys = [k.encoded for k, _s, _m in dev_rows]
+        sigs = [s for _k, s, _m in dev_rows]
+        msgs = [m for _k, _s, m in dev_rows]
+        mask, spent_all, total = service_mesh_verifier().dispatch_rows(
+            keys, sigs, msgs, min_bucket=bucket,
+            spent_hashes=_consumed_rows(msgs),
+        )
+        return _MeshPending(
+            dev_rows, mask, spent_all, total, bucket=int(mask.shape[0]),
+        )
+
     # ------------------------------------------------------------- hedging
     def _arm_hedge(self, entry: _InFlight) -> None:
         """Give one dispatched device batch its in-flight deadline
@@ -638,10 +920,113 @@ class DeviceScheduler:
         pol = self._resilience
         if pol is not None and entry.device is not None:
             pol.on_hedge_fired(entry.device)
+        # mesh mode: re-route to a SIBLING chip from the stripe set
+        # before conceding to the host reference path — the mesh is
+        # healthy even when one ordinal stalls. No sibling (single chip,
+        # or the rest of the stripe is down) falls through to the host
+        # leg exactly like PR 9.
+        sibling = None
+        if entry.device is not None and self._mesh_on():
+            sibling = self._pick_sibling(entry.device)
         try:
-            self._host_pool.submit(self._settle_hedge_host, entry)
+            if sibling is not None:
+                self._host_pool.submit(
+                    self._settle_hedge_sibling, entry, sibling
+                )
+            else:
+                self._host_pool.submit(self._settle_hedge_host, entry)
         except RuntimeError:
+            if sibling is not None:
+                self._ord_release(sibling)
             self._settle_hedge_host(entry)  # pool closed: settle inline
+
+    def _settle_hedge_sibling(self, entry: _InFlight, ordinal: int) -> None:
+        """The hedge's sibling leg: re-dispatch the stalled batch PINNED
+        to a healthy sibling chip — rows settle on device, not on the
+        host loop — before conceding to the host reference path. First
+        result wins exactly as for the host leg: the original device's
+        late readback may still claim first, in which case this result
+        is dropped. Any sibling failure (or a second stall past its own
+        hedge deadline) falls through to the host leg, so the batch is
+        never worse off than the plain host hedge. The caller reserved
+        one depth unit on ``ordinal``; every exit releases it."""
+        m = _metrics()
+        m.counter("serving.hedge.rerouted").inc()
+        mon = active_devicemon()
+        dispatched = False
+        t0 = time.monotonic()
+        try:
+            from corda_tpu.verifier.batch import dispatch_signature_rows
+
+            device = self._ensure_devices()[ordinal]
+            dev_rows = [row for r in entry.requests for row in r.rows]
+            floor = 0
+            for r in entry.requests:
+                if r.min_bucket:
+                    floor = max(floor, r.min_bucket)
+            bucket = self._shapes.bucket_for(len(dev_rows), floor=floor)
+            pending = dispatch_signature_rows(
+                dev_rows, use_device=True, min_bucket=bucket,
+                device=device,
+            )
+            padded = getattr(pending, "padded_lanes", 0) or len(dev_rows)
+            if mon is not None:
+                mon.record_dispatch(
+                    ordinal, rows=len(dev_rows), padded_lanes=padded
+                )
+            dispatched = True
+            pol = self._resilience
+            deadline_s = (
+                pol.hedge_deadline_s(ordinal, self._latency_ewma)
+                if pol is not None else None
+            )
+            deadline = None if deadline_s is None else t0 + deadline_s
+            while not _pending_ready(pending):
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise ServingError(
+                        f"sibling ordinal {ordinal} stalled too"
+                    )
+                time.sleep(0.005)
+            mask = pending.collect().astype(bool, copy=False)
+            wall = time.monotonic() - t0
+            if mon is not None:
+                mon.record_settle(ordinal, wall)
+        except Exception:
+            if mon is not None and dispatched:
+                mon.record_settle(
+                    ordinal, time.monotonic() - t0, ok=False, ewma=False
+                )
+            self._ord_release(ordinal)
+            self._settle_hedge_host(entry)
+            return
+        self._ord_release(ordinal)
+        with self._lock:
+            if entry.winner is not None:
+                return  # the original device landed first: it won
+            entry.winner = "sibling"
+        m.counter("serving.hedge.won_sibling").inc()
+        entry.span.set_attr("hedge_winner", "sibling")
+        pol = self._resilience
+        if pol is not None:
+            if entry.device is not None:
+                # the loss lands on the ORIGINAL ordinal's breaker; the
+                # sibling's clean settle is its own healthy evidence
+                pol.on_hedge_won_sibling(entry.device)
+            pol.on_settle_ok(ordinal)
+        on_device = getattr(pending, "device_mask", None)
+        slo = active_slo()
+        now = time.monotonic()
+        k = 0
+        for r in entry.requests:
+            n = len(r.rows)
+            nd = (int(on_device[k:k + n].sum())
+                  if on_device is not None else 0)
+            if slo is not None:
+                slo.observe(r.priority, now - r.enqueued_at)
+            _complete(r.future, result=RowResult(
+                mask[k:k + n], nd, entry.seq, device=ordinal,
+            ))
+            k += n
 
     def _settle_hedge_host(self, entry: _InFlight) -> None:
         """The hedge's host leg: re-verify every request on the host
@@ -779,12 +1164,32 @@ class DeviceScheduler:
         dev_rows: list = []
         dev_map: list = []
         ordinal = None
+        placed = False
+        mesh_on = False
+        stripe: list = []
         pol = self._resilience
-        if dev_reqs and pol is not None:
-            # the resilience gate, consulted on EVERY dispatch: an open
-            # breaker or a quarantined ordinal routes the whole device
-            # cohort to the host pool — zero device enqueues, the
-            # verdicts identical by the shared host reference path
+        if dev_reqs:
+            mesh_on = self._mesh_on()
+        if dev_reqs and mesh_on:
+            # mesh routing gate: the stripe set already excludes
+            # quarantined / breaker-open / watchdog-evicted ordinals, so
+            # placement below only ever picks admissible chips; an EMPTY
+            # stripe means every ordinal is down — whole-mesh host
+            # routing (the per-device breakers' collective OPEN)
+            stripe = self._stripe_set()
+            if not stripe:
+                m.counter("serving.mesh.no_eligible").inc()
+                batch_span.set_attr("resilience_host_routed", True)
+                host_reqs = host_reqs + dev_reqs
+                dev_reqs = []
+        elif dev_reqs and pol is not None:
+            # single-chip resilience gate, consulted on EVERY dispatch:
+            # an open breaker or a quarantined ordinal routes the whole
+            # device cohort to the host pool — zero device enqueues, the
+            # verdicts identical by the shared host reference path. The
+            # ordinal is resolved ONCE here and threaded through: the
+            # success attribution and the failure strike below must name
+            # the same ordinal this gate admitted.
             ordinal = default_device_ordinal()
             if not pol.admit_device(ordinal):
                 batch_span.set_attr("resilience_host_routed", True)
@@ -802,10 +1207,30 @@ class DeviceScheduler:
             from corda_tpu.verifier.batch import dispatch_signature_rows
 
             bucket = self._shapes.bucket_for(len(dev_rows), floor=floor)
-            # each scheme bucket compiles independently: the shapes this
-            # dispatch may have to compile, checked warm before hedging
+            mega = False
+            mesh_ordinals: tuple = ()
+            device = None
+            if mesh_on:
+                mega = self._mega_eligible(dev_rows, bucket, stripe)
+                if mega:
+                    mesh_ordinals = tuple(sorted(self._ensure_devices()))
+                else:
+                    with self._lock:
+                        ordinal = self._place_locked(stripe)
+                    placed = True
+                    device = self._ensure_devices().get(ordinal)
+            elif ordinal is None:
+                # no resilience gate ran: resolve the attribution ordinal
+                # once, up front (single-chip dispatch runs on the
+                # backend default)
+                ordinal = default_device_ordinal()
+            # each scheme bucket compiles independently — AND device
+            # placement is part of the executable (pinning a warm shape
+            # to a new ordinal recompiles): the shape keys this dispatch
+            # may have to compile, checked warm before hedging
             compile_keys = frozenset(
-                (getattr(k, "scheme_id", None), bucket)
+                (getattr(k, "scheme_id", None), bucket,
+                 "mesh" if mega else ordinal)
                 for k, _s, _m in dev_rows
             )
 
@@ -830,18 +1255,31 @@ class DeviceScheduler:
                     # check_site returns an injected STALL delay (the
                     # stall_sites fault mode): grafted onto the pending
                     # below, so the batch dispatches normally and then
-                    # sits not-ready in flight — the hedge path's shape
-                    stall_s = check_site("serving.dispatch")
+                    # sits not-ready in flight — the hedge path's shape.
+                    # A fused mega-batch has its own site: an injected
+                    # failure there is a WHOLE-STRIPE failure.
+                    if mega:
+                        stall_s = check_site("serving.mesh_dispatch")
+                    else:
+                        stall_s = check_site("serving.dispatch")
                     prof = active_profiler()
-                    if prof is None:
+                    # the device kwarg only travels when placement pinned
+                    # an ordinal: the single-chip path keeps the original
+                    # call shape (monkeypatched fakes predate the kwarg)
+                    kw = {"min_bucket": bucket}
+                    if device is not None:
+                        kw["device"] = device
+                    if mega:
+                        pending = self._dispatch_mega(dev_rows, bucket)
+                    elif prof is None:
                         pending = dispatch_signature_rows(
-                            dev_rows, use_device=True, min_bucket=bucket
+                            dev_rows, use_device=True, **kw
                         )
                     else:
                         pending = prof.profile(
                             KERNEL_SERVING_DISPATCH,
                             lambda: dispatch_signature_rows(
-                                dev_rows, use_device=True, min_bucket=bucket
+                                dev_rows, use_device=True, **kw
                             ),
                             rows=len(dev_rows), bucket=lanes_of,
                         )
@@ -858,31 +1296,58 @@ class DeviceScheduler:
                 )
                 self._real_rows += len(dev_rows)
                 self._padded_rows += padded
-                # per-chip attribution: single-chip dispatch runs on the
-                # default ordinal (jax is up — the dispatch succeeded);
-                # stamped on the span + result even before the mesh
-                # scheduler lands, and fed to the per-device telemetry
-                # registry when it is on
-                ordinal = default_device_ordinal()
-                batch_span.set_attr("device", ordinal)
-                mon = active_devicemon()
-                if mon is not None:
-                    mon.record_dispatch(
-                        ordinal, rows=len(dev_rows), padded_lanes=padded
+                if mega:
+                    m.counter("serving.mesh.megabatch").inc()
+                    m.counter("serving.mesh.megabatch_rows").inc(
+                        len(dev_rows)
                     )
+                    batch_span.set_attr("mesh_megabatch", True)
+                    # per-ordinal attribution already recorded by the
+                    # mesh verifier's sharded-dispatch helper
+                else:
+                    # per-chip attribution, on the ordinal resolved once
+                    # above (placement, the resilience gate, or the
+                    # backend default) — stamped on the span + result and
+                    # fed to the per-device telemetry registry
+                    if mesh_on:
+                        m.counter("serving.mesh.striped").inc()
+                    batch_span.set_attr("device", ordinal)
+                    mon = active_devicemon()
+                    if mon is not None:
+                        mon.record_dispatch(
+                            ordinal, rows=len(dev_rows), padded_lanes=padded
+                        )
+                    with self._lock:
+                        self._ord_dispatches[ordinal] = (
+                            self._ord_dispatches.get(ordinal, 0) + 1
+                        )
             except Exception:
-                mon = active_devicemon()
-                if mon is not None:
-                    mon.record_failure(default_device_ordinal())
-                if pol is not None:
-                    # resilience path: strike the ordinal + breaker, then
-                    # RE-DISPATCH — the requests re-enter the queue with
-                    # their original arrival times and priority (no
-                    # starvation: they go back to the FRONT), and only a
-                    # request that exhausted its redispatch budget falls
-                    # over to host like the legacy path
-                    pol.on_dispatch_failure(default_device_ordinal())
-                    dev_reqs = self._requeue_failed(dev_reqs)
+                if placed:
+                    self._ord_release(ordinal)
+                if mega:
+                    # a whole-stripe failure has no single ordinal to
+                    # blame: no strike, no requeue — the cohort fails
+                    # over to the host reference path (identical
+                    # verdicts), and the breakers learn per-ordinal from
+                    # the striped traffic that follows
+                    m.counter("serving.mesh.megabatch_failover").inc()
+                    batch_span.set_attr("mesh_megabatch", True)
+                else:
+                    fail_ord = (ordinal if ordinal is not None
+                                else default_device_ordinal())
+                    mon = active_devicemon()
+                    if mon is not None:
+                        mon.record_failure(fail_ord)
+                    if pol is not None:
+                        # resilience path: strike the ordinal + breaker,
+                        # then RE-DISPATCH — the requests re-enter the
+                        # queue with their original arrival times and
+                        # priority (no starvation: they go back to the
+                        # FRONT), and only a request that exhausted its
+                        # redispatch budget falls over to host like the
+                        # legacy path
+                        pol.on_dispatch_failure(fail_ord)
+                        dev_reqs = self._requeue_failed(dev_reqs)
                 if dev_reqs:
                     m.counter("serving.device_failover").inc()
                     batch_span.set_attr("device_failover", True)
@@ -905,7 +1370,8 @@ class DeviceScheduler:
         if device_entry:
             return _InFlight(dev_reqs, pending, len(dev_rows), dev_map,
                              seq, t0, span=batch_span, device=ordinal,
-                             compile_keys=compile_keys)
+                             compile_keys=compile_keys,
+                             mesh_ordinals=mesh_ordinals)
         if not host_reqs:
             # the whole batch was re-dispatched: nobody else will finish
             # this span (no host settle, no device entry)
@@ -1028,10 +1494,17 @@ class DeviceScheduler:
                 if entry.winner is None and not entry.hedged:
                     entry.winner = "device"
             mon = active_devicemon()
-            if mon is not None and entry.device is not None:
-                mon.record_settle(
-                    entry.device, time.monotonic() - entry.t0, ok=False
-                )
+            if mon is not None:
+                if entry.device is not None:
+                    mon.record_settle(
+                        entry.device, time.monotonic() - entry.t0,
+                        ok=False,
+                    )
+                elif entry.mesh_ordinals:
+                    mon.record_sharded_settle(
+                        entry.mesh_ordinals,
+                        time.monotonic() - entry.t0, ok=False,
+                    )
             pol = self._resilience
             if pol is not None and entry.device is not None:
                 pol.on_dispatch_failure(entry.device)
@@ -1057,6 +1530,13 @@ class DeviceScheduler:
                 if not entry.slot_freed:
                     entry.slot_freed = True
                     self._inflight -= 1
+                # return the per-ordinal depth unit the placement
+                # reserved (no-op for unplaced single-chip/mega entries:
+                # their count was never incremented)
+                if entry.device is not None:
+                    d = self._ord_inflight.get(entry.device, 0)
+                    if d > 0:
+                        self._ord_inflight[entry.device] = d - 1
                 try:
                     self._hedge_entries.remove(entry)
                 except ValueError:
@@ -1078,7 +1558,7 @@ class DeviceScheduler:
         latency = time.monotonic() - entry.t0
         m = _metrics()
         with self._lock:
-            lost = entry.winner == "host"
+            lost = entry.winner not in (None, "device")
             if entry.winner is None:
                 entry.winner = "device"
             # the device completed this readback (even a hedge-lost late
@@ -1086,7 +1566,7 @@ class DeviceScheduler:
             self._warm_keys |= entry.compile_keys
         m.timer("serving.batch_latency_s").update(latency)
         mon = active_devicemon()
-        if mon is not None and entry.device is not None:
+        if mon is not None:
             # the per-device completion heartbeat + execute-wall EWMA the
             # watchdog's straggler/stall rules evaluate — recorded even
             # for a hedge-lost batch (the device really did complete
@@ -1094,20 +1574,32 @@ class DeviceScheduler:
             # of the EWMA: folding it would grow the hedge deadline
             # (EWMA × factor) precisely on the device whose stalls it
             # exists to catch
-            mon.record_settle(entry.device, latency, ewma=not lost)
+            if entry.device is not None:
+                mon.record_settle(entry.device, latency, ewma=not lost)
+            elif entry.mesh_ordinals:
+                # every shard shares the mega-batch's wall: the
+                # collective synchronizes the mesh at the all-gather
+                mon.record_sharded_settle(
+                    entry.mesh_ordinals, latency, ewma=not lost
+                )
         pol = self._resilience
         if lost:
-            # the hedge's host leg already completed every future: this
-            # is the loser's late readback, discarded by contract (the
-            # verdicts are identical — verification is pure — but the
-            # futures were completed exactly once, by the winner)
+            # the hedge's winning leg (host or sibling chip) already
+            # completed every future: this is the loser's late readback,
+            # discarded by contract (the verdicts are identical —
+            # verification is pure — but the futures were completed
+            # exactly once, by the winner)
             m.counter("serving.hedge.discarded").inc()
-            entry.span.set_attr("hedge_winner", "host")
+            entry.span.set_attr("hedge_winner", entry.winner)
             entry.span.set_attr("n_rows", entry.n_rows)
             entry.span.finish()
             return
-        if pol is not None and entry.device is not None:
-            pol.on_settle_ok(entry.device)
+        if pol is not None:
+            if entry.device is not None:
+                pol.on_settle_ok(entry.device)
+            else:
+                for o in entry.mesh_ordinals:
+                    pol.on_settle_ok(o)
         if entry.hedged:
             m.counter("serving.hedge.won_device").inc()
             entry.span.set_attr("hedge_winner", "device")
@@ -1126,6 +1618,15 @@ class DeviceScheduler:
                 latency if self._latency_ewma == 0.0
                 else 0.7 * self._latency_ewma + 0.3 * latency
             )
+            if entry.device is not None:
+                # per-ordinal execute-wall EWMA feeding the placement
+                # score — only clean settles reach this point (hedge-lost
+                # readbacks returned above), so a stalling chip's
+                # inflated walls never shrink its apparent cost
+                prev = self._ord_ewma.get(entry.device, 0.0)
+                self._ord_ewma[entry.device] = (
+                    latency if prev == 0.0 else 0.7 * prev + 0.3 * latency
+                )
         for r, mask, nd in zip(entry.requests, masks, n_device):
             _complete(r.future, result=RowResult(
                 mask, nd, entry.seq, device=entry.device,
@@ -1251,6 +1752,11 @@ def _register_process_gauges() -> None:
         len(q) for q in s._queues.values()
     )))
     m.gauge("serving.inflight", live(lambda s: s._inflight))
+    # mesh stripe health: how many ordinals the last stripe computation
+    # found eligible, and the worst depth imbalance placement has seen
+    # (acceptance bound: spread stays <= 2 under saturation)
+    m.gauge("serving.mesh.stripe_width", live(lambda s: s._stripe_width))
+    m.gauge("serving.mesh.depth_spread", live(lambda s: s._mesh_spread_max))
     # cumulative device-batch fill ratio (real rows / padded lanes): the
     # bucket-waste health read next to batch_occupancy — 1.0 before any
     # device dispatch (nothing padded means nothing wasted)

@@ -203,7 +203,7 @@ def _note_device_failover(n_rows: int, stage: str) -> None:
 
 def dispatch_signature_rows(
     rows: list[tuple], *, use_device: bool = True,
-    min_bucket: int | None = None,
+    min_bucket: int | None = None, device=None,
 ) -> PendingRows:
     """Enqueue verification of (PublicKey, signature, message) rows.
 
@@ -211,6 +211,10 @@ def dispatch_signature_rows(
     (resolved immediately) for the rest. Row order is preserved in the
     collected mask. ``min_bucket`` pins the device pad-bucket floor (one
     compiled kernel shape for services with ragged batch sizes).
+    ``device`` pins every device bucket to one specific ``jax.Device``
+    (the mesh-striped scheduler and per-ordinal canary probes place work
+    explicitly); ``None`` keeps the backend default / service-mesh
+    routing.
     """
     n = len(rows)
     pending = PendingRows(n)
@@ -234,7 +238,8 @@ def dispatch_signature_rows(
         elif scheme_id in device_schemes:
             try:
                 _dispatch_device_bucket(
-                    pending, rows, scheme_id, idxs, min_bucket
+                    pending, rows, scheme_id, idxs, min_bucket,
+                    device=device,
                 )
             except Exception:
                 # graceful degradation: a device bucket that fails to
@@ -296,14 +301,19 @@ def _rlc_verify_bucket(pending: PendingRows, rows, idxs) -> None:
 
 
 def _dispatch_device_bucket(
-    pending: PendingRows, rows, scheme_id: int, idxs, min_bucket
+    pending: PendingRows, rows, scheme_id: int, idxs, min_bucket,
+    device=None,
 ) -> None:
     """Enqueue one scheme bucket on device; raises on dispatch failure
     (the caller degrades to host). The faultinject site lets a seeded
     chaos plan force exactly this failure — or an injected STALL, which
     grafts onto the pending so the bucket computes but stays not-ready
     for the delay (the batch stalls in flight, the dispatcher does not
-    block)."""
+    block). An explicit ``device`` pins the bucket to that chip and
+    bypasses service-mesh routing — the striped scheduler has already
+    made the placement decision."""
+    import contextlib
+
     from corda_tpu.faultinject import check_site
 
     stall_s = check_site("verifier.device")
@@ -317,56 +327,65 @@ def _dispatch_device_bucket(
     # the device mesh (SURVEY §2.9 P3) — the reference's fan-out
     # load-balances all verification work across workers
     # (Verifier.kt:66-84), not one scheme. Single chip degrades
-    # transparently to the plain batched dispatches below.
-    on_mesh = service_mesh_active()
+    # transparently to the plain batched dispatches below. A pinned
+    # ``device`` means the scheduler already striped this bucket onto
+    # one chip: no second fan-out.
+    on_mesh = device is None and service_mesh_active()
     if on_mesh:
         from corda_tpu.parallel.mesh import service_mesh_verifier
 
         mesh_v = service_mesh_verifier()
-    if scheme_id == EDDSA_ED25519_SHA512:
-        if on_mesh:
-            mask, _spent, _total = mesh_v.dispatch_rows(
-                keys, sigs, msgs, min_bucket=min_bucket
-            )
-        else:
-            from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
+    if device is not None:
+        import jax
 
-            mask = ed25519_verify_dispatch(
-                keys, sigs, msgs, min_bucket=min_bucket
-            )
-    elif scheme_id == SPHINCS256_SHA256:
-        if on_mesh:
-            mask = mesh_v.dispatch_sphincs_rows(
-                keys, sigs, msgs, min_bucket=min_bucket
-            )
-        else:
-            from corda_tpu.ops.sphincs_batch import (
-                sphincs_verify_dispatch,
-            )
-
-            mask = sphincs_verify_dispatch(
-                keys, sigs, msgs, min_bucket=min_bucket
-            )
+        pin = jax.default_device(device)
     else:
-        # async like the ed25519 bucket: the ECDSA ladder queues on
-        # device and collects later, so mixed-scheme batches overlap
-        # both ladders instead of serializing on this one (r2
-        # VERDICT weak #2)
-        curve = (
-            "secp256k1"
-            if scheme_id == ECDSA_SECP256K1_SHA256
-            else "secp256r1"
-        )
-        if on_mesh:
-            mask = mesh_v.dispatch_ecdsa_rows(
-                curve, keys, sigs, msgs, min_bucket=min_bucket
-            )
-        else:
-            from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+        pin = contextlib.nullcontext()
+    with pin:
+        if scheme_id == EDDSA_ED25519_SHA512:
+            if on_mesh:
+                mask, _spent, _total = mesh_v.dispatch_rows(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
+            else:
+                from corda_tpu.ops.ed25519 import ed25519_verify_dispatch
 
-            mask = ecdsa_verify_dispatch(
-                curve, keys, sigs, msgs, min_bucket=min_bucket
+                mask = ed25519_verify_dispatch(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
+        elif scheme_id == SPHINCS256_SHA256:
+            if on_mesh:
+                mask = mesh_v.dispatch_sphincs_rows(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
+            else:
+                from corda_tpu.ops.sphincs_batch import (
+                    sphincs_verify_dispatch,
+                )
+
+                mask = sphincs_verify_dispatch(
+                    keys, sigs, msgs, min_bucket=min_bucket
+                )
+        else:
+            # async like the ed25519 bucket: the ECDSA ladder queues on
+            # device and collects later, so mixed-scheme batches overlap
+            # both ladders instead of serializing on this one (r2
+            # VERDICT weak #2)
+            curve = (
+                "secp256k1"
+                if scheme_id == ECDSA_SECP256K1_SHA256
+                else "secp256r1"
             )
+            if on_mesh:
+                mask = mesh_v.dispatch_ecdsa_rows(
+                    curve, keys, sigs, msgs, min_bucket=min_bucket
+                )
+            else:
+                from corda_tpu.ops.secp256 import ecdsa_verify_dispatch
+
+                mask = ecdsa_verify_dispatch(
+                    curve, keys, sigs, msgs, min_bucket=min_bucket
+                )
     start_host_copy(mask)
     pending._deferred.append(
         (idxs, mask, lambda: _host_verify_bucket(pending, rows, idxs))

@@ -528,6 +528,52 @@ class TestPerfGate:
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
 
+    def test_check_schema_validates_multichip_section(self, tmp_path):
+        """ISSUE 13 satellite: the `multichip` section the smoke's mesh
+        pass emits is schema-validated — well-formed passes; a missing
+        field, a stripe imbalance below the 0.8 efficiency floor, an
+        efficiency inconsistent with rows/(n_devices × max_ordinal_rows),
+        more ordinals hit than devices exist, and a parity flag that is
+        not a proof (0) all fail."""
+        good = dict(self.SYNTHETIC)
+        good["multichip"] = {
+            "n_devices": 8, "ordinals_hit": 8, "dispatches": 8,
+            "rows": 104, "max_ordinal_rows": 13,
+            "scaling_efficiency": 1.0, "stripe_spread_max": 1,
+            "megabatch_rows": 64, "allgather_parity_ok": 1,
+            "mega_parity_ok": 1, "sigs_per_sec": 16.2,
+        }
+        ok = tmp_path / "mc.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("scaling_efficiency"),
+             "missing numeric 'scaling_efficiency'"),
+            (lambda d: (d.__setitem__("scaling_efficiency", 0.545),
+                        d.__setitem__("max_ordinal_rows", 33),
+                        d.__setitem__("rows", 144)),
+             "outside [0.8, 1.0]"),
+            (lambda d: d.__setitem__("scaling_efficiency", 0.9),
+             "inconsistent with rows/(n_devices"),
+            (lambda d: d.__setitem__("ordinals_hit", 9),
+             "ordinals_hit 9 exceed n_devices 8"),
+            (lambda d: d.__setitem__("allgather_parity_ok", 0),
+             "must prove parity"),
+            (lambda d: d.__setitem__("mega_parity_ok", 0),
+             "must prove parity"),
+            (lambda d: d.__setitem__("megabatch_rows", -64),
+             "negative megabatch_rows"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["multichip"])
+            bad = tmp_path / "mc_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
     def test_check_schema_validates_model_only_mfu_entry(self, tmp_path):
         """The ed25519_batch mfu entry is model-only (no achieved rate or
         utilization): schema mode accepts it without those keys, but

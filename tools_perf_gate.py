@@ -106,6 +106,13 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # timer), so the tolerance is only rounding slack. Lower is better;
     # a regression here means someone made the MSM do more work per row.
     "mfu/ed25519_batch/ops_per_verify": ("lower", 0.02),
+    # mesh scheduling (docs/SERVING.md §Mesh scheduling): placement
+    # balance over the stripe — rows_total / (n_devices × the busiest
+    # ordinal's rows). Deterministic (no wall clock), 1.0 iff placement
+    # spread the storm evenly, and the quantity wall-clock scaling on a
+    # real multi-chip mesh is bounded by. Tight tolerance: imbalance is
+    # a scheduler bug, not timer noise.
+    "multichip/scaling_efficiency": ("higher", 0.05),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -141,6 +148,16 @@ DURABILITY_REQUIRED_KEYS = (
 BATCHVERIFY_REQUIRED_KEYS = (
     "rlc_parity_ok", "rlc_rows", "offenders_expected", "offenders_found",
     "bls_aggregate_ok", "bls_signers",
+)
+
+# keys the smoke's multichip section must carry for --check-schema
+# (the mesh-striped scheduler pass — docs/SERVING.md §Mesh scheduling):
+# stripe coverage, load-balance scaling efficiency, whole-stripe
+# mega-batch fusion and the consumed-set all-gather parity flags
+MULTICHIP_REQUIRED_KEYS = (
+    "n_devices", "ordinals_hit", "dispatches", "rows",
+    "max_ordinal_rows", "scaling_efficiency", "stripe_spread_max",
+    "megabatch_rows", "allgather_parity_ok", "mega_parity_ok",
 )
 
 
@@ -372,6 +389,50 @@ def check_schema(result: dict) -> list[str]:
                     f"batchverify: bisection found {got} offenders, "
                     f"planted {exp}"
                 )
+    multichip = result.get("multichip")
+    if multichip is not None:
+        if not isinstance(multichip, dict):
+            problems.append("multichip: expected an object")
+        else:
+            def num(key):
+                v = multichip.get(key)
+                return v if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) else None
+
+            for key in MULTICHIP_REQUIRED_KEYS:
+                if num(key) is None:
+                    problems.append(f"multichip: missing numeric {key!r}")
+                elif num(key) < 0:
+                    problems.append(
+                        f"multichip: negative {key} {num(key)}"
+                    )
+            se = num("scaling_efficiency")
+            if se is not None and not (0.8 <= se <= 1.0):
+                problems.append(
+                    f"multichip: scaling_efficiency {se} outside "
+                    "[0.8, 1.0] (the stripe must stay balanced)"
+                )
+            n, hit = num("n_devices"), num("ordinals_hit")
+            if n is not None and hit is not None and hit > n:
+                problems.append(
+                    f"multichip: ordinals_hit {hit} exceed n_devices {n}"
+                )
+            rows, mx = num("rows"), num("max_ordinal_rows")
+            if (se is not None and n is not None and rows is not None
+                    and mx is not None and n * mx > 0
+                    and abs(se - rows / (n * mx)) > 0.01):
+                problems.append(
+                    f"multichip: scaling_efficiency {se} inconsistent "
+                    f"with rows/(n_devices × max_ordinal_rows) "
+                    f"({rows / (n * mx):.3f})"
+                )
+            for flag in ("allgather_parity_ok", "mega_parity_ok"):
+                v = num(flag)
+                if v is not None and v != 1:
+                    problems.append(
+                        f"multichip: {flag} is {v} (the pass must prove "
+                        "parity, not merely run)"
+                    )
     return problems
 
 
