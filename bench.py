@@ -1720,6 +1720,55 @@ def run_smoke_batchverify() -> dict:
     }
 
 
+def run_smoke_loadharness() -> dict:
+    """The smoke's open-loop load leg (docs/LOAD_HARNESS.md): a fast
+    two-step Poisson ramp over a fresh mocknet payment workload, each
+    step scored through a private SLO monitor, with per-step flowprof
+    waterfalls. Asserts a knee exists (the smoke's rates are far below
+    any healthy knee), that the knee waterfall's phases sum to the
+    flow-class wall within 5% (conservation — the tentpole's structural
+    claim), and that the waterfall actually attributed wall to phases
+    beyond the residual. Emits the ``loadtest`` section
+    ``tools_perf_gate.py --check-schema`` validates."""
+    from corda_tpu.tools.loadharness import HarnessConfig, run_harness
+
+    result = run_harness(HarnessConfig(
+        qps_steps=(6.0, 14.0),
+        step_duration_s=1.5,
+        drain_timeout_s=30.0,
+        p99_slo_s=5.0,
+        min_samples=3,
+        workload="payment",
+    ))
+    assert result.get("knee") is not None, (
+        "smoke load ramp found no knee: every step breached "
+        f"{[s['slo'] for s in result['steps']]}"
+    )
+    knee = result["knee"]
+    wf = knee["waterfall"]
+    total = sum(wf["phases"].values())
+    assert wf["wall_s"] > 0 and abs(total - wf["wall_s"]) <= 0.05 * wf["wall_s"], (
+        f"knee waterfall conservation broken: phases sum {total} vs wall "
+        f"{wf['wall_s']}"
+    )
+    attributed = total - wf["phases"].get("engine_other", 0.0)
+    assert attributed > 0, "waterfall attributed nothing beyond the residual"
+    return {
+        "loadtest": {
+            "mode": result["mode"],
+            "knee_qps": knee["qps"],
+            "steps": [
+                {k: s[k] for k in (
+                    "qps", "offered", "completed", "errors", "shed",
+                    "shed_rate", "p50_s", "p99_s", "slo_ok", "waterfall",
+                )}
+                for s in result["steps"]
+            ],
+            "knee": knee,
+        }
+    }
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -1866,6 +1915,14 @@ def run_smoke() -> int:
         # and one BLS aggregate-QC encode/decode/verify round trip.
         # Host big-int only, so it rides after the fault passes.
         out.update(run_smoke_batchverify())
+
+        # 12. open-loop load pass (docs/LOAD_HARNESS.md): two Poisson
+        # qps steps over a fresh mocknet scored through the SLO monitor
+        # — emits the ``loadtest`` section (knee qps + the flowprof
+        # waterfall at the knee, phases summing to wall) the perf gate's
+        # --check-schema validates. Runs on its own mocknet AFTER the
+        # fault passes, with flowprof turned off again at exit.
+        out.update(run_smoke_loadharness())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

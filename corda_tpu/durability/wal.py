@@ -325,6 +325,12 @@ class WriteAheadLog:
         fsync covers all waiters — a thread arriving while an fsync is in
         flight waits for a *subsequent* fsync only if its records were
         appended after that fsync started."""
+        from corda_tpu.observability.flowprof import flowprof_frame
+
+        with flowprof_frame("wal_fsync_wait"):
+            self._flush_inner()
+
+    def _flush_inner(self) -> None:
         with self._cv:
             want = self._written_lsn
             while self.durable_lsn < want:

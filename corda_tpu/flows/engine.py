@@ -53,6 +53,7 @@ from corda_tpu.observability import (
     TraceContext,
     tracer,
 )
+from corda_tpu.observability.flowprof import active_flowprof, flowprof_frame
 from corda_tpu.serialization import deserialize, serialize
 
 from .api import (
@@ -178,6 +179,11 @@ class _FlowExecutor:
         # flow lifetime across park/replay — a resumed flow's fresh
         # executor rebinds the SAME span from the SMM's span table
         self.trace_span = smm.span_of(flow_id)
+        # the flow's phase-accounting ledger (flowprof), same lifetime
+        # contract as the span: opened at flow start, rebound across
+        # park/replay, closed in flow_finished
+        fp = active_flowprof()
+        self.prof_acct = fp.acct_of(flow_id) if fp is not None else None
 
     # ------------------------------------------------------------ op core
     def _do_op(self, effect, replay=None):
@@ -189,7 +195,8 @@ class _FlowExecutor:
                 replay(idx, rec)
             return rec
         rec = effect(idx)
-        self.smm.checkpoints.record_op(self.flow_id, idx, rec)
+        with flowprof_frame("checkpoint"):
+            self.smm.checkpoints.record_op(self.flow_id, idx, rec)
         return rec
 
     # ------------------------------------------------------------ ops
@@ -216,7 +223,8 @@ class _FlowExecutor:
             )
 
     def op_send(self, local_sid: int, obj) -> None:
-        payload = serialize(obj)
+        with flowprof_frame("serialize"):
+            payload = serialize(obj)
 
         def effect(idx):
             # publish-then-record: a crash in between replays this op live
@@ -265,7 +273,8 @@ class _FlowExecutor:
             else:
                 rec = {"payload": body, "msg_id": msg_id}
             # record BEFORE ack: consumed-and-durable, then delete from queue
-            self.smm.checkpoints.record_op(self.flow_id, idx, rec)
+            with flowprof_frame("checkpoint"):
+                self.smm.checkpoints.record_op(self.flow_id, idx, rec)
             if msg_id:
                 # session-level ack: the peer's retransmit buffer settles;
                 # a lost ack just means one more (deduped) retransmit
@@ -283,7 +292,8 @@ class _FlowExecutor:
             # effect already recorded (pre-ack); skip double record
         if "end" in rec:
             raise rehydrate_flow_exception(rec["end"])
-        return deserialize(rec["payload"])
+        with flowprof_frame("serialize"):
+            return deserialize(rec["payload"])
 
     def open_session(self, flow: FlowLogic, party: Party) -> FlowSession:
         def effect(idx):
@@ -370,6 +380,18 @@ class _FlowExecutor:
     def run_once(self) -> str:
         """Execute on the calling worker thread until the flow finishes,
         parks, or dies → "finished" | "parked"."""
+        acct = self.prof_acct
+        if acct is not None:
+            fp = active_flowprof()
+            if fp is not None:
+                # activate the phase account for this execution segment:
+                # frames/hints the flow body opens on this thread (and the
+                # scheduler's submit-time capture) book to this flow
+                with fp.activate(acct):
+                    return self._run_traced()
+        return self._run_traced()
+
+    def _run_traced(self) -> str:
         span = self.trace_span
         if not span.sampled:
             return self._run_body()
@@ -489,7 +511,15 @@ class StateMachineManager:
             # parked waiting on a commit slept forever)
             services.add_commit_listener(self.notify_ledger_commit)
         self._party_resolver = party_resolver or (lambda name: None)
-        self._lock = threading.Condition()
+        # with flowprof on at construction, the SMM monitor sits over a
+        # timed-acquire RLock so blocked acquisition books to lock_wait
+        # (enabling flowprof later leaves an existing SMM untimed — the
+        # hook costs a lock-construction decision, never a per-acquire
+        # check while off)
+        _fp = active_flowprof()
+        self._lock = threading.Condition(
+            _fp.timed_rlock() if _fp is not None else None
+        )
         self._sessions: dict[int, _SessionState] = {}
         self._flows: dict[str, _FlowExecutor] = {}
         self._consumed_msg_ids: set[str] = set()
@@ -560,6 +590,9 @@ class StateMachineManager:
     def start_flow(self, flow: FlowLogic, flow_id: str | None = None) -> FlowHandle:
         flow_id = flow_id or secrets.token_hex(16)
         self._open_flow_span(flow_id, class_path(type(flow)))
+        fp = active_flowprof()
+        if fp is not None:
+            fp.open(flow_id, class_path(type(flow)))
         blob = serialize({
             "cls": class_path(type(flow)),
             "fields": flow.flow_fields(),
@@ -687,6 +720,9 @@ class StateMachineManager:
 
     def _fail_unrunnable(self, flow_id: str, error: Exception) -> None:
         self._close_flow_span(flow_id, error=error)
+        fp = active_flowprof()
+        if fp is not None:
+            fp.close(flow_id)
         with self._lock:
             fut = self._results.pop(flow_id, None)
             self._flows.pop(flow_id, None)
@@ -756,6 +792,11 @@ class StateMachineManager:
         key = self._park_key_of.pop(flow_id, "absent")
         if key == "absent":
             return
+        fp = active_flowprof()
+        if fp is not None:
+            # close the hinted-wait window (opened at wait_or_killed
+            # entry): the park wall books to the hinted phase
+            fp.note_unpark(fp.acct_of(flow_id))
         if key is not None:
             group = self._parked.get(key)
             if group is not None:
@@ -885,7 +926,17 @@ class StateMachineManager:
     def send_to(self, party: Party, obj, *, msg_id: str,
                 track_kind: str | None = None, track_sid: int = 0,
                 deadline_s: float | None = None) -> None:
-        payload = serialize(obj)
+        with flowprof_frame("serialize"):
+            payload = serialize(obj)
+        if track_kind == "data":
+            # transit accounting (flowprof): stamp Data/End sends by their
+            # LOGICAL id — _buffer on the receiving SMM books send→delivery
+            # as message_transit for the consuming flow. Retransmits reuse
+            # the first send's stamp, so transit honestly includes the
+            # loss-recovery wall.
+            fp = active_flowprof()
+            if fp is not None:
+                fp.note_sent(_logical_id(msg_id))
         # register BEFORE transmitting: a fast peer's reply (Confirm/Ack)
         # can be processed in the window after send — it must find the
         # entry to settle, not race past an empty map and leave a zombie
@@ -1024,15 +1075,30 @@ class StateMachineManager:
         grace = (
             time.monotonic() + self._parking_grace_s if parkable else None
         )
+        # hinted-wait window (flowprof): with a park hint set on the
+        # calling flow (the notary client's notary_rtt scope), the wall
+        # from here to satisfaction — whether the wait stays on-thread or
+        # parks — books to the hinted phase; cross-thread attributions
+        # landing inside the window (the response's message_transit) are
+        # subtracted by note_unpark so the window is never double-booked.
+        # The park path leaves the window OPEN: _unpark_locked closes it.
+        fp = active_flowprof()
+        acct = fp.current() if fp is not None else None
+        if acct is not None:
+            fp.note_park(acct)
         with self._lock:
             while True:
                 if self._closed or (executor is not None and executor.killed):
                     raise FlowKilledException()
                 val = predicate()
                 if val not in (None, False):
+                    if acct is not None:
+                        fp.note_unpark(acct)
                     return val
                 now = time.monotonic()
                 if deadline is not None and now >= deadline:
+                    if acct is not None:
+                        fp.note_unpark(acct)
                     return None
                 if grace is not None and now >= grace:
                     self._park_locked(
@@ -1048,6 +1114,9 @@ class StateMachineManager:
 
     def flow_finished(self, ex: _FlowExecutor) -> None:
         self._close_flow_span(ex.flow_id)
+        fp = active_flowprof()
+        if fp is not None:
+            fp.close(ex.flow_id)
         self.checkpoints.remove_flow(ex.flow_id)
         with self._lock:
             self._flows.pop(ex.flow_id, None)
@@ -1155,6 +1224,15 @@ class StateMachineManager:
                 sess = None
             else:
                 sess.inbound.append((kind, body, msg_id, ack))
+                if msg_id:
+                    fp = active_flowprof()
+                    if fp is not None:
+                        ex = sess.executor
+                        fp.take_transit(
+                            msg_id,
+                            fp.acct_of(ex.flow_id) if ex is not None
+                            else None,
+                        )
                 self._wake_key_locked(("sid", sid))
                 self._lock.notify_all()
                 return
@@ -1239,6 +1317,9 @@ class StateMachineManager:
             return
         self._open_flow_span(flow_id, class_path(responder),
                              responder=True, parent_wire=init.trace)
+        fp = active_flowprof()
+        if fp is not None:
+            fp.open(flow_id, class_path(responder))
         blob = serialize({
             "cls": class_path(responder),
             "fields": {},

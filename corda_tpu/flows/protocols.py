@@ -332,26 +332,36 @@ class NotaryFlowClient(FlowLogic):
         return cls(fields["stx"])
 
     def call(self) -> list:
+        from corda_tpu.observability.flowprof import flowprof_hint
+
         stx = self.stx
         notary = stx.notary
         if notary is None:
             raise NotaryException("transaction names no notary")
         _verify_sigs(self, stx, {notary.owning_key})
-        session = self.initiate_flow(notary)
-        validating = self.services.network_map_cache.is_validating_notary(notary)
-        if validating:
-            self.sub_flow(SendTransactionFlow(session, stx))
-            sigs = session.receive(list).unwrap(lambda s: s)
-        else:
-            groups = {
-                ComponentGroupType.INPUTS,
-                ComponentGroupType.TIMEWINDOW,
-                ComponentGroupType.NOTARY,
-            }
-            ftx = FilteredTransaction.build(
-                stx.tx, lambda comp, group: group in groups
+        # flowprof park hint: every wait this request/response exchange
+        # parks or blocks on books to notary_rtt — the notarisation
+        # round-trip is the one counterparty wait with a name
+        with flowprof_hint("notary_rtt"):
+            session = self.initiate_flow(notary)
+            validating = self.services.network_map_cache.is_validating_notary(
+                notary
             )
-            sigs = session.send_and_receive(list, ftx).unwrap(lambda s: s)
+            if validating:
+                self.sub_flow(SendTransactionFlow(session, stx))
+                sigs = session.receive(list).unwrap(lambda s: s)
+            else:
+                groups = {
+                    ComponentGroupType.INPUTS,
+                    ComponentGroupType.TIMEWINDOW,
+                    ComponentGroupType.NOTARY,
+                }
+                ftx = FilteredTransaction.build(
+                    stx.tx, lambda comp, group: group in groups
+                )
+                sigs = session.send_and_receive(list, ftx).unwrap(
+                    lambda s: s
+                )
         self._validate_response(sigs, notary, stx.id)
         return sigs
 

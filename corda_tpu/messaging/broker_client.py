@@ -43,12 +43,20 @@ class BrokerMessagingClient(MessagingClient):
 
     def send(self, recipient, topic, payload, *, msg_id=None) -> str:
         name = recipient.name if isinstance(recipient, PeerHandle) else recipient
-        # envelope carries the topic + sender; payload stays opaque bytes
-        header = json.dumps({"topic": topic, "sender": self._name}).encode()
-        framed = len(header).to_bytes(4, "big") + header + payload
-        return self._broker.publish(
-            p2p_queue(name), framed, msg_id=msg_id, sender=self._name
-        )
+        # the durable publish blocks the CALLING (flow) thread — envelope
+        # framing plus a broker write (sqlite insert, or a secure-fabric
+        # round trip across hosts). flowprof books that wall as
+        # ``serialize``: it is transport handoff cost, not transit (the
+        # receiver-side clock), and would otherwise hide in engine_other.
+        from corda_tpu.observability.flowprof import flowprof_frame
+
+        with flowprof_frame("serialize"):
+            # envelope carries the topic + sender; payload stays opaque bytes
+            header = json.dumps({"topic": topic, "sender": self._name}).encode()
+            framed = len(header).to_bytes(4, "big") + header + payload
+            return self._broker.publish(
+                p2p_queue(name), framed, msg_id=msg_id, sender=self._name
+            )
 
     def add_handler(self, topic, callback) -> None:
         # ack-unaware (single-parameter) handlers get auto-ack-on-return

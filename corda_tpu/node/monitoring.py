@@ -298,11 +298,16 @@ def monitoring_snapshot() -> dict:
     (serving/resilience — same off-marker contract), ``durability`` the
     crash-consistent persistence tier's WAL/replay/recovery registries
     (corda_tpu/durability — ``{"enabled": false}`` until the first
-    DurableStore exists in the process), ``process`` the remaining
-    cross-cutting metrics (e.g. the verifier's ``device_failover``
-    counters)."""
+    DurableStore exists in the process), ``flowprof`` the per-flow
+    critical-path phase accounting waterfall (observability/flowprof —
+    ``{"enabled": false}`` while off), ``sampler`` the wall-clock stack
+    sampler's folded-stack dump (observability/sampler, same off-marker
+    contract), ``process`` the remaining cross-cutting metrics (e.g. the
+    verifier's ``device_failover`` counters)."""
     from corda_tpu.durability import durability_section
     from corda_tpu.observability.devicemon import devices_section
+    from corda_tpu.observability.flowprof import flowprof_section
+    from corda_tpu.observability.sampler import sampler_section
     from corda_tpu.observability.slo import slo_section
     from corda_tpu.serving.resilience import resilience_section
 
@@ -313,11 +318,15 @@ def monitoring_snapshot() -> dict:
         "slo": slo_section(),
         "resilience": resilience_section(),
         "durability": durability_section(),
+        "flowprof": flowprof_section(),
+        "sampler": sampler_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
                     or k.startswith("durability.")
                     or k.startswith("replay.")
-                    or k.startswith("recovery."))
+                    or k.startswith("recovery.")
+                    or k.startswith("flowprof.")
+                    or k.startswith("sampler."))
         },
     }

@@ -157,7 +157,10 @@ class ServiceHub:
                 else:
                     report.raise_first()
                     return
-            stx.verify_signatures_except(allowed)
+            from corda_tpu.observability.flowprof import flowprof_frame
+
+            with flowprof_frame("host_verify"):
+                stx.verify_signatures_except(allowed)
 
     # -- signing (reference: ServiceHub.signInitialTransaction :187-209) ------
 

@@ -26,6 +26,14 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
   classes (windowed p99 + error/shed rate, edge-triggered breaches) and
   the black-box flight recorder (``flight_dump``/``read_flight_dump``)
   a breach — or an operator RPC, or an opt-in crash hook — snapshots.
+- ``flowprof`` — off-by-default per-flow critical-path phase accounting:
+  every flow's wall decomposed into a CLOSED set of phases (queue wait,
+  device execute, host verify, fsync wait, lock wait, serialize,
+  message transit, checkpoint, notary RTT, residual) that provably sum
+  to the flow's wall, aggregated per flow class into a waterfall.
+- ``sampler`` — off-by-default wall-clock sampling profiler over
+  ``sys._current_frames()``: folded flamegraph stacks per thread role,
+  self-measured duty cycle pinned under a 3% overhead budget.
 """
 
 from .devicemon import (
@@ -38,12 +46,30 @@ from .devicemon import (
     devicemon,
 )
 from .exposition import metrics_text, parse_prometheus, render_prometheus
+from .flowprof import (
+    PHASES,
+    FlowProfiler,
+    TimedRLock,
+    active_flowprof,
+    configure_flowprof,
+    flowprof,
+    flowprof_frame,
+    flowprof_hint,
+    flowprof_section,
+)
 from .profiler import (
     DeviceProfiler,
     active_profiler,
     configure_profiler,
     profiler,
     stamp_span,
+)
+from .sampler import (
+    StackSampler,
+    active_sampler,
+    configure_sampler,
+    sampler,
+    sampler_section,
 )
 from .slo import (
     SLOMonitor,
@@ -79,7 +105,9 @@ __all__ = [
     "DeviceMonitor",
     "DeviceProfiler",
     "DeviceWatchdog",
+    "FlowProfiler",
     "NOOP_SPAN",
+    "PHASES",
     "SLOMonitor",
     "SLOObjective",
     "SPAN_FLOW",
@@ -92,13 +120,19 @@ __all__ = [
     "SPAN_VERIFIER_REQUEST",
     "SPAN_WAVEFRONT_WINDOW",
     "Span",
+    "StackSampler",
+    "TimedRLock",
     "TraceContext",
     "Tracer",
     "active_devicemon",
+    "active_flowprof",
     "active_profiler",
+    "active_sampler",
     "active_slo",
     "configure_devicemon",
+    "configure_flowprof",
     "configure_profiler",
+    "configure_sampler",
     "configure_slo",
     "configure_tracing",
     "current_trace_id",
@@ -106,12 +140,18 @@ __all__ = [
     "device_watchdog",
     "devicemon",
     "flight_dump",
+    "flowprof",
+    "flowprof_frame",
+    "flowprof_hint",
+    "flowprof_section",
     "install_crash_dump",
     "metrics_text",
     "parse_prometheus",
     "profiler",
     "read_flight_dump",
     "render_prometheus",
+    "sampler",
+    "sampler_section",
     "slo_monitor",
     "stamp_span",
     "tracer",

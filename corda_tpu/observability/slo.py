@@ -406,6 +406,31 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
         })
     except Exception:
         pass
+    try:
+        # critical-path waterfall (observability/flowprof): where flow
+        # wall went, per phase and flow class — the first thing a
+        # latency-breach dump gets read for. {"enabled": false} when off.
+        from corda_tpu.observability.flowprof import flowprof_section
+
+        lines.append({
+            "kind": "flowprof", "snapshot": flowprof_section(),
+        })
+    except Exception:
+        pass
+    try:
+        # sampling profiler (observability/sampler): top-N folded stacks
+        # per thread role, the "what code was running" companion to the
+        # waterfall — {"enabled": false} unless the sampler is on.
+        from corda_tpu.observability.sampler import active_sampler
+
+        s = active_sampler()
+        lines.append({
+            "kind": "sampler",
+            "snapshot": s.dump(top_n=20) if s is not None
+            else {"enabled": False},
+        })
+    except Exception:
+        pass
     for event in list(devicemon().events) + list(_global.events):
         lines.append({"kind": "event", "event": event})
     try:
@@ -434,12 +459,13 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
 def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
-    / ``slo`` / ``resilience`` / ``durability`` (the snapshots),
-    ``events`` (device + SLO health events), ``faults`` (injected chaos
-    events), ``header``."""
+    / ``slo`` / ``resilience`` / ``durability`` / ``flowprof`` /
+    ``sampler`` (the snapshots), ``events`` (device + SLO health
+    events), ``faults`` (injected chaos events), ``header``."""
     out: dict = {"header": None, "spans": [], "metrics": None,
                  "devices": None, "slo": None, "resilience": None,
-                 "durability": None, "events": [], "faults": []}
+                 "durability": None, "flowprof": None, "sampler": None,
+                 "events": [], "faults": []}
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -452,7 +478,7 @@ def read_flight_dump(path: str) -> dict:
             elif kind == "span":
                 out["spans"].append(rec["span"])
             elif kind in ("metrics", "devices", "slo", "resilience",
-                          "durability"):
+                          "durability", "flowprof", "sampler"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])

@@ -603,6 +603,14 @@ def slo_status_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.slo_status())
 
 
+def flowprof_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the critical-path phase-accounting waterfall
+    (``CordaRPCOps.flowprof_snapshot``): per-phase p50/p99 and per-class
+    phase shares — refresh under load to watch where flow wall is going
+    as the knee approaches."""
+    return PolledValue(lambda: proxy.flowprof_snapshot())
+
+
 def metrics_text_value(proxy) -> PolledValue:
     """Read binding over the Prometheus text exposition
     (``CordaRPCOps.metrics_text``) — the scrape body as a live value the

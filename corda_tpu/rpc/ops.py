@@ -225,6 +225,30 @@ class CordaRPCOps:
 
         return slo_section()
 
+    def flowprof_snapshot(self) -> dict:
+        """Per-flow critical-path phase accounting (docs/OBSERVABILITY.md
+        §Critical-path accounting): p50/p99 per phase over closed flows,
+        the per-flow-class waterfall (each phase's share of the class's
+        total wall — phases sum to wall by construction), and the most
+        recent per-flow breakdowns. ``{"enabled": false}`` while phase
+        accounting is off (the default)."""
+        from corda_tpu.observability.flowprof import flowprof_section
+
+        return flowprof_section()
+
+    def sampler_dump(self, top_n: int = 50) -> dict:
+        """The wall-clock sampling profiler's folded flamegraph stacks
+        per thread role (docs/OBSERVABILITY.md §Critical-path
+        accounting), heaviest first, plus the sampler's measured duty
+        cycle. ``{"enabled": false}`` while the sampler is off (the
+        default)."""
+        from corda_tpu.observability.sampler import active_sampler
+
+        s = active_sampler()
+        if s is None:
+            return {"enabled": False}
+        return s.dump(top_n=top_n)
+
     def flight_dump(self, path: str | None = None,
                     reason: str = "rpc") -> str:
         """Write a black-box flight-recorder dump (docs/OBSERVABILITY.md

@@ -90,6 +90,7 @@ from corda_tpu.observability.profiler import (
     active_profiler,
     stamp_span,
 )
+from corda_tpu.observability.flowprof import active_flowprof
 from corda_tpu.observability.slo import active_slo
 
 from .shapes import shape_table
@@ -147,10 +148,11 @@ class RowResult:
 
 class _Request:
     __slots__ = ("rows", "future", "priority", "use_device", "min_bucket",
-                 "enqueued_at", "deadline", "queue_span", "redispatches")
+                 "enqueued_at", "deadline", "queue_span", "redispatches",
+                 "acct")
 
     def __init__(self, rows, future, priority, use_device, min_bucket,
-                 enqueued_at, deadline, queue_span=NOOP_SPAN):
+                 enqueued_at, deadline, queue_span=NOOP_SPAN, acct=None):
         self.rows = rows
         self.future = future
         self.priority = priority
@@ -166,6 +168,10 @@ class _Request:
         # dispatch (the resilience re-dispatch path) — bounded by the
         # policy's redispatch_limit, then it host-fails-over like before
         self.redispatches = 0
+        # flowprof account of the submitting flow (None for untracked
+        # callers): dispatch/settle attribute queue_wait/device_execute/
+        # host_verify to the flow that asked, across threads
+        self.acct = acct
 
 
 class _InFlight:
@@ -476,12 +482,14 @@ class DeviceScheduler:
             attrs={"priority": priority, "rows": len(rows)},
         )
         now = time.monotonic()
+        fp = active_flowprof()
         req = _Request(
             rows, fut, priority,
             self._use_device_default if use_device is None else use_device,
             min_bucket, now,
             None if deadline_s is None else now + deadline_s,
             queue_span=queue_span,
+            acct=fp.current() if fp is not None else None,
         )
         with self._lock:
             if self._closed:
@@ -1015,6 +1023,7 @@ class DeviceScheduler:
             pol.on_settle_ok(ordinal)
         on_device = getattr(pending, "device_mask", None)
         slo = active_slo()
+        fp = active_flowprof()
         now = time.monotonic()
         k = 0
         for r in entry.requests:
@@ -1023,6 +1032,10 @@ class DeviceScheduler:
                   if on_device is not None else 0)
             if slo is not None:
                 slo.observe(r.priority, now - r.enqueued_at)
+            if fp is not None:
+                # the hedge's sibling leg won: device wall = the re-
+                # dispatch's wall (the stalled original lost the race)
+                fp.add(r.acct, "device_execute", wall)
             _complete(r.future, result=RowResult(
                 mask[k:k + n], nd, entry.seq, device=ordinal,
             ))
@@ -1036,14 +1049,15 @@ class DeviceScheduler:
         simply dropped."""
         from corda_tpu.crypto import is_valid
 
-        outcomes: list = []
+        outcomes: list = []  # (mask, error, host-verify wall) per request
         for r in entry.requests:
+            t_verify = time.monotonic()
             try:
                 outcomes.append((np.array(
                     [is_valid(k, s, m) for k, s, m in r.rows], dtype=bool
-                ), None))
+                ), None, time.monotonic() - t_verify))
             except Exception as e:
-                outcomes.append((None, e))
+                outcomes.append((None, e, time.monotonic() - t_verify))
         with self._lock:
             if entry.winner is not None:
                 return  # the device landed first: it won the race
@@ -1054,8 +1068,13 @@ class DeviceScheduler:
         if pol is not None and entry.device is not None:
             pol.on_hedge_won_host(entry.device)
         slo = active_slo()
+        fp = active_flowprof()
         now = time.monotonic()
-        for r, (mask, err) in zip(entry.requests, outcomes):
+        for r, (mask, err, verify_wall) in zip(entry.requests, outcomes):
+            if fp is not None:
+                # the hedge's host leg won: the member flows' requests
+                # settled on host verification, not device execute
+                fp.add(r.acct, "host_verify", verify_wall)
             if err is None:
                 if slo is not None:
                     slo.observe(r.priority, now - r.enqueued_at)
@@ -1135,8 +1154,11 @@ class DeviceScheduler:
             self._seq += 1
             seq = self._seq
         wait_t = m.timer("serving.wait_s")
+        fp = active_flowprof()
         for r in batch:
             wait_t.update(t0 - r.enqueued_at)
+            if fp is not None:
+                fp.add(r.acct, "queue_wait", t0 - r.enqueued_at)
         m.meter("serving.batches").mark()
         # occupancy histogram: requests coalesced per batch (the Timer is
         # a generic histogram; values are counts, not seconds)
@@ -1388,11 +1410,18 @@ class DeviceScheduler:
         from corda_tpu.crypto import is_valid
 
         slo = active_slo()
+        fp = active_flowprof()
         for r in requests:
             try:
+                t_verify = time.monotonic()
                 mask = np.array(
                     [is_valid(k, s, m) for k, s, m in r.rows], dtype=bool
                 )
+                if fp is not None:
+                    fp.add(
+                        r.acct, "host_verify",
+                        time.monotonic() - t_verify,
+                    )
                 if slo is not None:
                     slo.observe(
                         r.priority, time.monotonic() - r.enqueued_at
@@ -1610,6 +1639,12 @@ class DeviceScheduler:
                 # end-to-end (admission→settle) latency per priority
                 # class — the windowed p99 the SLO objectives bound
                 slo.observe(r.priority, now - r.enqueued_at)
+        fp = active_flowprof()
+        if fp is not None:
+            # winner-only attribution (hedge-lost readbacks returned
+            # above): each member flow waited the full batch wall
+            for r in entry.requests:
+                fp.add(r.acct, "device_execute", latency)
         entry.span.set_attr("n_rows", entry.n_rows)
         entry.span.set_attr("device_rows", int(sum(n_device)))
         entry.span.finish()

@@ -1,0 +1,408 @@
+"""Open-loop SLO-attainment harness.
+
+The closed-loop harness (``loadtest.py``) measures what N injector
+threads can push: each thread waits for its command to finish before
+issuing the next, so the moment the system slows down the offered load
+*politely backs off* — latency quantiles flatten exactly when they
+should explode, and the measured "capacity" is really "capacity at the
+concurrency the harness happened to pick" (coordinated omission).
+
+This harness is OPEN-LOOP: arrivals are a seeded Poisson process at a
+fixed target rate, and the arrival clock NEVER waits for completions.
+A flow's latency is measured from its *scheduled arrival time* — if the
+system (or the submitting thread) falls behind, the backlog shows up in
+p99 instead of silently stretching the inter-arrival gaps. Offered load
+the system cannot absorb accumulates as in-flight backlog until the
+``max_inflight`` bound, past which arrivals are SHED and counted (the
+open-loop analogue of an admission reject — the arrival still happened).
+
+A run is a stepped qps ramp. Each step is scored through a private
+``SLOMonitor`` (PR 7's attainment machinery, breach-latch only): the
+windowed p99 and the error+shed rate are checked against the configured
+objective, and the KNEE is the highest step whose SLO held. Per-step
+flowprof waterfalls (``configure_flowprof(reset=True)`` between steps)
+say where the wall went as the knee approaches — queue wait and lock
+wait grow, device execute does not. Results land in ``LOADTEST.json``
+(schema checked by ``tools_perf_gate.py --check-schema``); the CLI is
+``tools_loadgen.py``. Knobs and method: docs/LOAD_HARNESS.md. Metric
+names (``loadharness.*``): docs/OBSERVABILITY.md §"Critical-path
+accounting".
+
+Toggles compose with the chaos/durability/resilience tiers: a
+``FaultPlan`` runs the ramp under injected message loss, ``durable=True``
+puts every node on WAL-backed checkpoints (fsync wait appears in the
+waterfall), ``resilience=True`` serves verification through a
+self-healing scheduler.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import tempfile
+import threading
+import time
+
+LOADTEST_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class HarnessConfig:
+    """One ramp's knobs (docs/LOAD_HARNESS.md has the full table)."""
+
+    qps_steps: tuple = (4.0, 8.0, 16.0)
+    step_duration_s: float = 5.0
+    drain_timeout_s: float = 30.0
+    seed: int = 2026
+    # the SLO each step is scored against
+    p99_slo_s: float = 2.0
+    max_error_rate: float = 0.05
+    min_samples: int = 5
+    # open-loop shed bound: arrivals past this in-flight depth are shed
+    max_inflight: int = 256
+    # workload: "payment" (issue setup + CashPaymentFlow arrivals, full
+    # flow→verify→notary path) or "issue" (CashIssueFlow arrivals only,
+    # no notary leg — cheaper, for pure engine saturation)
+    workload: str = "payment"
+    use_device: bool = False        # device-batched signature verify
+    # toggles
+    chaos: object | None = None     # a faultinject.FaultPlan, or None
+    durable: bool = False           # WAL-backed checkpoints on every node
+    resilience: bool = False        # self-healing serving policy
+    flowprof: bool = True           # per-step waterfalls
+    sampler: bool = False           # attach folded stacks to the result
+
+
+def _quantile(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(q * len(sorted_vals)))]
+
+
+class _StepStats:
+    """One step's outcome ledger (thread-safe: completions land from
+    flow-worker callback threads while the arrival clock runs)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies: list[float] = []
+        self.errors = 0
+        self.shed = 0
+        self.offered = 0
+
+    def complete(self, latency_s: float, error: bool) -> None:
+        with self.lock:
+            if error:
+                self.errors += 1
+            else:
+                self.latencies.append(latency_s)
+
+
+class LoadHarness:
+    """Builds the mocknet fixture, runs the ramp, scores the steps."""
+
+    def __init__(self, config: HarnessConfig | None = None):
+        self.config = config or HarnessConfig()
+        self._rng = random.Random(self.config.seed)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Condition(self._inflight_lock)
+
+    # ------------------------------------------------------------ fixture
+    def _build(self, stack):
+        """Create the 3-node mocknet (+ optional durability/resilience/
+        chaos tiers) on ``stack`` (an ExitStack owning teardown)."""
+        from corda_tpu.testing.mocknet import MockNetworkNodes
+        from corda_tpu.verifier import BatchedVerifierService
+
+        cfg = self.config
+        if cfg.chaos is not None:
+            from corda_tpu.faultinject import FaultInjector
+            from corda_tpu.faultinject import clear as clear_injector
+            from corda_tpu.faultinject import install as install_injector
+
+            install_injector(FaultInjector(cfg.chaos))
+            stack.callback(clear_injector)
+        if cfg.resilience:
+            from corda_tpu.serving import ResiliencePolicy, configure_scheduler
+
+            configure_scheduler(
+                use_device_default=cfg.use_device,
+                resilience=ResiliencePolicy(flight_dump_on_quarantine=False),
+            )
+        net = stack.enter_context(MockNetworkNodes())
+        checkpoints = None
+        if cfg.durable:
+            from corda_tpu.durability import DurableStore
+            from corda_tpu.flows.checkpoints import WalCheckpointStorage
+
+            base = stack.enter_context(
+                tempfile.TemporaryDirectory(prefix="loadharness-")
+            )
+
+            def checkpoints(name):
+                return WalCheckpointStorage(
+                    DurableStore(os.path.join(base, name), name="flows")
+                )
+        sender = net.create_node(
+            "HarnessA",
+            checkpoints=None if checkpoints is None else checkpoints("a"),
+        )
+        receiver = net.create_node(
+            "HarnessB",
+            checkpoints=None if checkpoints is None else checkpoints("b"),
+        )
+        notary = net.create_notary_node("HarnessNotary")
+        vsvc = BatchedVerifierService(use_device=cfg.use_device)
+        sender.services.transaction_verifier_service = vsvc
+        stack.callback(vsvc.shutdown)
+        return net, sender, receiver, notary
+
+    # ------------------------------------------------------------- arrival
+    def _start_request(self, sender, receiver, notary, stats: _StepStats,
+                       scheduled_t: float) -> None:
+        """Submit one arrival (non-blocking) and wire its completion back
+        into ``stats``. Latency runs from the SCHEDULED arrival time."""
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+
+        cfg = self.config
+        if cfg.workload == "payment":
+            flow = CashPaymentFlow(1, "GBP", receiver.party)
+        else:
+            flow = CashIssueFlow(1, "GBP", b"\x77", notary.party)
+        with self._inflight_lock:
+            if self._inflight >= cfg.max_inflight:
+                stats.shed += 1
+                return
+            self._inflight += 1
+        try:
+            handle = sender.smm.start_flow(flow)
+        except Exception:
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+            stats.complete(0.0, error=True)
+            return
+
+        def done(fut, _t0=scheduled_t):
+            latency = time.monotonic() - _t0
+            err = fut.exception() is not None
+            stats.complete(latency, error=err)
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+        handle.result.add_done_callback(done)
+
+    def _drain(self, deadline_s: float) -> bool:
+        with self._inflight_lock:
+            return self._idle.wait_for(
+                lambda: self._inflight == 0, timeout=deadline_s
+            )
+
+    # ---------------------------------------------------------------- run
+    def _run_step(self, qps: float, fixture) -> dict:
+        """One open-loop step: Poisson arrivals at ``qps`` for
+        ``step_duration_s``, drain, score through a private SLOMonitor."""
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability.slo import SLOMonitor, SLOObjective
+
+        net, sender, receiver, notary = fixture
+        cfg = self.config
+        stats = _StepStats()
+        monitor = SLOMonitor(
+            objectives=(SLOObjective(
+                name=f"loadharness@{qps:g}qps", priority="harness",
+                p99_s=cfg.p99_slo_s, max_error_rate=cfg.max_error_rate,
+                window_s=cfg.step_duration_s + cfg.drain_timeout_s + 60.0,
+                min_samples=cfg.min_samples,
+            ),),
+            breach_handler=None,  # latch only: scoring, not paging
+        )
+        if cfg.flowprof:
+            from corda_tpu.observability.flowprof import configure_flowprof
+
+            configure_flowprof(enabled=True, reset=True)
+        t_start = time.monotonic()
+        next_arrival = t_start
+        end = t_start + cfg.step_duration_s
+        offered = 0
+        while next_arrival < end:
+            now = time.monotonic()
+            if next_arrival > now:
+                time.sleep(next_arrival - now)
+            # the arrival HAPPENS at its scheduled instant even when the
+            # clock thread woke late — open-loop latency runs from here
+            self._start_request(sender, receiver, notary, stats,
+                                next_arrival)
+            offered += 1
+            next_arrival += self._rng.expovariate(qps)
+        stats.offered = offered
+        drained = self._drain(cfg.drain_timeout_s)
+        step_wall = time.monotonic() - t_start
+        if not drained:
+            # whatever is still in flight timed out the drain: score each
+            # as an error with the drain-bounded latency (open-loop: they
+            # were offered, so they count)
+            with self._inflight_lock:
+                stuck = self._inflight
+            for _ in range(stuck):
+                stats.complete(step_wall, error=True)
+        # feed + evaluate the private SLO monitor
+        with stats.lock:
+            lats = sorted(stats.latencies)
+            errors = stats.errors
+            shed = stats.shed
+        for lat in lats:
+            monitor.observe("harness", lat)
+        for _ in range(errors):
+            monitor.observe("harness", None, error=True)
+        for _ in range(shed):
+            monitor.observe("harness", None, error=True)
+        statuses = monitor.evaluate()
+        slo_ok = bool(statuses) and not any(s["breached"] for s in statuses)
+        completed = len(lats)
+        denom = completed + errors + shed
+        step = {
+            "qps": qps,
+            "offered": offered,
+            "completed": completed,
+            "errors": errors,
+            "shed": shed,
+            "shed_rate": (shed / denom) if denom else 0.0,
+            "error_rate": ((errors + shed) / denom) if denom else 0.0,
+            "p50_s": _quantile(lats, 0.5),
+            "p99_s": _quantile(lats, 0.99),
+            "drained": drained,
+            "wall_s": step_wall,
+            "slo_ok": slo_ok,
+            "slo": statuses,
+        }
+        if cfg.flowprof:
+            step["waterfall"] = self._waterfall()
+        m = node_metrics()
+        m.timer("loadharness.step_p99_s").update(step["p99_s"])
+        m.counter("loadharness.offered").inc(offered)
+        m.counter("loadharness.shed").inc(shed)
+        return step
+
+    def _waterfall(self) -> dict:
+        """The step's flowprof aggregate for the workload's flow class:
+        phase seconds + each phase's share of the class's total wall
+        (phases sum to wall by construction — the schema gate checks)."""
+        from corda_tpu.observability.flowprof import flowprof_section
+
+        section = flowprof_section()
+        classes = section.get("classes", {})
+        want = ("CashPaymentFlow" if self.config.workload == "payment"
+                else "CashIssueFlow")
+        for cls, agg in classes.items():
+            if cls.endswith(want):
+                return {
+                    "flow_class": cls,
+                    "flows": agg["flows"],
+                    "wall_s": agg["wall_s"],
+                    "phases": agg["phases"],
+                    "shares": agg["shares"],
+                }
+        return {"flow_class": want, "flows": 0, "wall_s": 0.0,
+                "phases": {}, "shares": {}}
+
+    def run(self) -> dict:
+        """The full ramp. Returns the LOADTEST payload (see
+        ``write_loadtest`` for the file half)."""
+        import contextlib
+
+        from corda_tpu.finance import CashIssueFlow
+
+        cfg = self.config
+        sampler_obj = None
+        if cfg.sampler:
+            from corda_tpu.observability.sampler import configure_sampler
+
+            sampler_obj = configure_sampler(enabled=True, reset=True)
+        try:
+            with contextlib.ExitStack() as stack:
+                fixture = self._build(stack)
+                net, sender, receiver, notary = fixture
+                # ---- setup (UNMEASURED): pre-issue one 1-GBP state per
+                # expected payment so arrivals never contend on selection
+                # and never run out of cash mid-step
+                if cfg.workload == "payment":
+                    expected = sum(
+                        int(q * cfg.step_duration_s * 1.5) + 8
+                        for q in cfg.qps_steps
+                    )
+                    for _ in range(expected):
+                        sender.run_flow(
+                            CashIssueFlow(1, "GBP", b"\x77", notary.party)
+                        )
+                steps = [self._run_step(q, fixture) for q in cfg.qps_steps]
+        finally:
+            if cfg.flowprof:
+                from corda_tpu.observability.flowprof import (
+                    configure_flowprof,
+                )
+
+                configure_flowprof(enabled=False, reset=True)
+            if sampler_obj is not None:
+                from corda_tpu.observability.sampler import configure_sampler
+
+                configure_sampler(enabled=False)
+            if cfg.resilience:
+                from corda_tpu.serving.scheduler import shutdown_scheduler
+
+                shutdown_scheduler()
+        knee = None
+        for step in steps:
+            if step["slo_ok"]:
+                knee = step
+        result = {
+            "schema": LOADTEST_SCHEMA,
+            "mode": "open-loop-poisson",
+            "config": {
+                "qps_steps": list(cfg.qps_steps),
+                "step_duration_s": cfg.step_duration_s,
+                "seed": cfg.seed,
+                "p99_slo_s": cfg.p99_slo_s,
+                "max_error_rate": cfg.max_error_rate,
+                "max_inflight": cfg.max_inflight,
+                "workload": cfg.workload,
+                "use_device": cfg.use_device,
+                "chaos": cfg.chaos is not None,
+                "durable": cfg.durable,
+                "resilience": cfg.resilience,
+            },
+            "steps": steps,
+            # the headline (and the perf gate's knob): the highest step
+            # that met the SLO. Absent when NO step did — a knee-less
+            # artifact is a failed run, and the schema gate says so.
+            **({} if knee is None else {"knee_qps": knee["qps"]}),
+            "knee": None if knee is None else {
+                "qps": knee["qps"],
+                "p50_s": knee["p50_s"],
+                "p99_s": knee["p99_s"],
+                "shed_rate": knee["shed_rate"],
+                "waterfall": knee.get("waterfall", {}),
+            },
+        }
+        if sampler_obj is not None:
+            result["sampler"] = sampler_obj.dump(top_n=20)
+        return result
+
+
+def run_harness(config: HarnessConfig | None = None) -> dict:
+    return LoadHarness(config).run()
+
+
+def write_loadtest(result: dict, path: str = "LOADTEST.json") -> str:
+    """Atomic write of the LOADTEST payload (tmp+rename, the BASELINE/
+    BENCH idiom) — ``tools_perf_gate.py --check-schema`` reads this."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(result, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

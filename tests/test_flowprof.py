@@ -1,0 +1,491 @@
+"""Flow critical-path accounting tests — the flowprof phase ledger
+(frames / cross-thread adds / park hints, conservation to the flow
+wall), the timed SMM lock, the wall-clock stack sampler's overhead
+budget, the off-by-default zero-overhead pin (fresh subprocess), and
+the flight-dump round-trip of both new sections."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.observability.flowprof import (
+    PHASES,
+    FlowProfiler,
+    configure_flowprof,
+    flowprof,
+    flowprof_frame,
+    flowprof_section,
+)
+from corda_tpu.observability.sampler import (
+    StackSampler,
+    configure_sampler,
+    _role_of,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def fp():
+    clock = FakeClock()
+    prof = FlowProfiler(clock=clock)
+    prof.enable()
+    prof.clock = clock  # test handle
+    return prof
+
+
+# ------------------------------------------------------------ the ledger
+
+class TestPhaseLedger:
+    def test_phase_set_is_closed_and_residual_last(self):
+        assert len(PHASES) == 10
+        assert len(set(PHASES)) == 10
+        assert PHASES[-1] == "engine_other"
+
+    def test_frame_exclusive_time_nesting(self, fp):
+        """A nested frame's wall is subtracted from its parent: a
+        checkpoint that spends most of its time inside wal_fsync_wait
+        books only its exclusive share."""
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("checkpoint"):
+                fp.clock.advance(1.0)
+                with fp.frame("wal_fsync_wait"):
+                    fp.clock.advance(3.0)
+                fp.clock.advance(1.0)
+        assert acct.phases["checkpoint"] == pytest.approx(2.0)
+        assert acct.phases["wal_fsync_wait"] == pytest.approx(3.0)
+
+    def test_same_phase_nesting_sums_once(self, fp):
+        """Engine serialize wrapping a broker serialize (same phase,
+        nested) must book the outer elapsed exactly once."""
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("serialize"):
+                fp.clock.advance(0.5)
+                with fp.frame("serialize"):
+                    fp.clock.advance(2.0)
+                fp.clock.advance(0.5)
+        assert acct.phases["serialize"] == pytest.approx(3.0)
+
+    def test_frames_are_noops_without_activation(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.frame("serialize"):   # no activate() on this thread
+            fp.clock.advance(1.0)
+        assert acct.phases["serialize"] == 0.0
+
+    def test_close_residual_conserves_wall(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("serialize"):
+                fp.clock.advance(2.0)
+        fp.add(acct, "queue_wait", 1.5)
+        fp.clock.advance(6.5)
+        out = fp.close("f1")
+        assert out is not None and out["wall_s"] == pytest.approx(8.5)
+        assert set(out["phases"]) == set(PHASES)
+        assert out["phases"]["engine_other"] == pytest.approx(5.0)
+        assert sum(out["phases"].values()) == pytest.approx(out["wall_s"])
+
+    def test_overattribution_clamps_residual_to_zero(self, fp):
+        """Cross adds can overshoot the wall (overlapping attributions
+        are a bug the conservation tests exist to catch); the residual
+        clamps at zero so the overshoot stays visible in the sum."""
+        acct = fp.open("f1", "test.Flow")
+        fp.add(acct, "device_execute", 99.0)
+        fp.clock.advance(1.0)
+        out = fp.close("f1")
+        assert out["phases"]["engine_other"] == 0.0
+        assert sum(out["phases"].values()) > out["wall_s"]
+
+    def test_hint_park_attribution_subtracts_cross_adds(self, fp):
+        """A hinted park books (park wall - cross adds inside the
+        window) to the hinted phase: the notary response's transit is
+        never double-booked under notary_rtt."""
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.hint("notary_rtt"):
+                fp.note_park(acct)
+                fp.clock.advance(5.0)
+                fp.add(acct, "message_transit", 2.0)  # response transit
+                fp.note_unpark(acct)
+        assert acct.phases["notary_rtt"] == pytest.approx(3.0)
+        assert acct.phases["message_transit"] == pytest.approx(2.0)
+        assert acct.hint is None  # scope restored
+
+    def test_unhinted_park_falls_into_residual(self, fp):
+        """No hint → no park window: 'waiting on a counterparty we
+        cannot see into' is honestly engine_other."""
+        acct = fp.open("f1", "test.Flow")
+        fp.note_park(acct)
+        assert acct.park_t0 is None
+        fp.clock.advance(4.0)
+        fp.note_unpark(acct)
+        out = fp.close("f1")
+        assert out["phases"]["engine_other"] == pytest.approx(4.0)
+        assert out["phases"]["notary_rtt"] == 0.0
+
+    def test_add_after_close_is_dropped(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        fp.close("f1")
+        fp.add(acct, "queue_wait", 3.0)
+        assert acct.phases["queue_wait"] == 0.0
+
+    def test_transit_stamp_roundtrip(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        fp.note_sent("m1")
+        fp.clock.advance(0.25)
+        fp.take_transit("m1", acct)
+        fp.take_transit("m1", acct)        # stamp consumed: second no-op
+        fp.take_transit("never-sent", acct)
+        assert acct.phases["message_transit"] == pytest.approx(0.25)
+
+    def test_live_cap_bounds_leaked_flows(self, fp):
+        for i in range(fp.LIVE_CAP + 5):
+            fp.open(f"f{i}", "test.Flow")
+        assert fp.acct_of("f0") is None           # oldest evicted
+        assert fp.acct_of(f"f{fp.LIVE_CAP + 4}") is not None
+
+    def test_snapshot_classes_and_shares(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("serialize"):
+                fp.clock.advance(1.0)
+        fp.clock.advance(1.0)
+        fp.close("f1")
+        snap = fp.snapshot()
+        assert snap["enabled"] and snap["flows"] == 1
+        agg = snap["classes"]["test.Flow"]
+        assert agg["flows"] == 1
+        assert agg["wall_s"] == pytest.approx(2.0)
+        assert set(agg["phases"]) == set(PHASES)
+        assert sum(agg["shares"].values()) == pytest.approx(1.0)
+        assert agg["shares"]["serialize"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------ timed lock
+
+class TestTimedRLock:
+    def test_contended_acquire_books_lock_wait(self):
+        prof = FlowProfiler()
+        prof.enable()
+        lock = prof.timed_rlock()
+        acct = prof.open("f1", "test.Flow")
+        lock.acquire()
+
+        def holder_release():
+            time.sleep(0.15)
+            lock.release()
+
+        # hold from main, release from a timer-ish thread while a second
+        # thread (with the account active) blocks on acquire
+        waited = {}
+
+        def waiter():
+            with prof.activate(acct):
+                t0 = time.monotonic()
+                lock2_ok = False
+                # a fresh thread cannot release main's RLock; it blocks
+                # until holder_release fires
+                lock.acquire()
+                lock2_ok = True
+                lock.release()
+                waited["wall"] = time.monotonic() - t0
+                waited["ok"] = lock2_ok
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.15)
+        lock.release()
+        t.join(timeout=5)
+        assert waited["ok"]
+        assert acct.phases["lock_wait"] >= 0.1
+        assert acct.phases["lock_wait"] <= waited["wall"] + 0.05
+
+    def test_uncontended_acquire_books_nothing(self):
+        prof = FlowProfiler()
+        prof.enable()
+        lock = prof.timed_rlock()
+        acct = prof.open("f1", "test.Flow")
+        with prof.activate(acct):
+            with lock:
+                with lock:   # reentrant
+                    pass
+        assert acct.phases["lock_wait"] == 0.0
+
+    def test_condition_wait_notify_roundtrip(self):
+        """The SMM wraps the timed lock in a Condition — wait/notify
+        must work through the _release_save/_acquire_restore hooks, and
+        the woken waiter's monitor reacquire must NOT book lock_wait
+        (scheduling, not contention)."""
+        prof = FlowProfiler()
+        prof.enable()
+        cv = threading.Condition(prof.timed_rlock())
+        acct = prof.open("f1", "test.Flow")
+        state = {"go": False}
+
+        def waiter():
+            with prof.activate(acct):
+                with cv:
+                    cv.wait_for(lambda: state["go"], timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        with cv:
+            state["go"] = True
+            cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        # the only acquire the waiter timed was its (uncontended) entry
+        assert acct.phases["lock_wait"] < 0.05
+
+
+# ---------------------------------------------- traced flow conservation
+
+class TestTracedPaymentFlow:
+    def test_payment_waterfall_conserves_wall(self):
+        """The ISSUE's acceptance path: a profiled mocknet payment's
+        phases are drawn from the closed set and sum to the flow wall
+        within 5% (the engine's residual makes conservation structural;
+        the tolerance absorbs cross-thread adds racing close)."""
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+        from corda_tpu.flows.api import class_path
+        from corda_tpu.testing import MockNetworkNodes
+
+        configure_flowprof(enabled=True, reset=True)
+        try:
+            with MockNetworkNodes() as net:
+                alice = net.create_node("ProfAlice")
+                bob = net.create_node("ProfBob")
+                notary = net.create_notary_node("ProfNotary")
+                alice.run_flow(
+                    CashIssueFlow(100, "GBP", b"\x01", notary.party)
+                )
+                alice.run_flow(CashPaymentFlow(40, "GBP", bob.party))
+            snap = flowprof().snapshot()
+            pay_cls = class_path(CashPaymentFlow)
+            assert pay_cls in snap["classes"], list(snap["classes"])
+            for rec in snap["recent"]:
+                assert set(rec["phases"]) == set(PHASES)
+                assert all(v >= 0 for v in rec["phases"].values())
+                total = sum(rec["phases"].values())
+                assert abs(total - rec["wall_s"]) <= 0.05 * rec["wall_s"], (
+                    rec["flow_class"], total, rec["wall_s"])
+            pay = next(
+                r for r in snap["recent"] if r["flow_class"] == pay_cls
+            )
+            # the phases the payment's critical path must traverse
+            assert pay["phases"]["checkpoint"] > 0
+            assert pay["phases"]["serialize"] > 0
+            assert pay["phases"]["notary_rtt"] > 0
+            # the ledger fed the registry timers
+            from corda_tpu.node.monitoring import (
+                monitoring_snapshot, node_metrics,
+            )
+            names = list(node_metrics().snapshot())
+            assert "flowprof.phase.notary_rtt" in names
+            assert "flowprof.wall_s" in names
+            msnap = monitoring_snapshot()
+            assert msnap["flowprof"]["enabled"]
+            assert msnap["flowprof"]["flows"] >= snap["flows"] - 1
+        finally:
+            configure_flowprof(enabled=False, reset=True)
+
+
+# ----------------------------------------------------------- the sampler
+
+class TestSampler:
+    def test_role_mapping(self):
+        assert _role_of("flow-worker-3") == "flow_worker"
+        assert _role_of("serving-dispatch") == "dispatcher"
+        assert _role_of("serving-collect-1") == "collector"
+        assert _role_of("wal-writer") == "fsync"
+        assert _role_of("MainThread") == "main"
+        assert _role_of("weird-thread") == "other"
+
+    def test_overhead_ratio_math_fake_clock(self):
+        """overhead_ratio = busy / elapsed, against the injected clock —
+        the <3% budget's measured side, pinned arithmetically."""
+        clock = FakeClock()
+        s = StackSampler(hz=100, clock=clock)
+        s.reset()                      # started_at = clock()
+        clock.advance(10.0)
+        s._busy_s = 0.2                # what the loop would have booked
+        assert s.overhead_ratio() == pytest.approx(0.02)
+        s.reset()
+        assert s.overhead_ratio() == 0.0
+
+    def test_sample_once_folds_foreign_threads(self):
+        s = StackSampler(hz=100)
+        stop = threading.Event()
+
+        def parked_worker():
+            stop.wait(5)
+
+        t = threading.Thread(
+            target=parked_worker, name="flow-worker-9", daemon=True
+        )
+        t.start()
+        try:
+            time.sleep(0.05)
+            recorded = s.sample_once()
+            assert recorded >= 1       # at least the worker thread
+            dump = s.dump()
+            assert "flow_worker" in dump["roles"], list(dump["roles"])
+            folded, count = dump["roles"]["flow_worker"][0]
+            assert count >= 1
+            # root-first flamegraph line through the worker body
+            assert ";" in folded and "parked_worker" in folded
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_real_thread_overhead_under_budget(self):
+        """A live 100 Hz sampler over busy threads stays under the 3%
+        overhead budget (the loop self-throttles by sleeping the
+        remainder of each period)."""
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x = (x + 1) % 1000003
+
+        workers = [
+            threading.Thread(target=busy, name=f"flow-worker-{i}",
+                             daemon=True)
+            for i in range(3)
+        ]
+        for w in workers:
+            w.start()
+        s = StackSampler(hz=100)
+        s.start()
+        try:
+            time.sleep(0.8)
+            ratio = s.overhead_ratio()
+            dump = s.dump(top_n=10)
+        finally:
+            s.stop()
+            stop.set()
+            for w in workers:
+                w.join(timeout=5)
+        assert dump["samples"] >= 20, dump["samples"]
+        assert ratio < 0.03, f"sampler overhead {ratio:.4f} >= 3% budget"
+        assert "flow_worker" in dump["roles"]
+        assert all(
+            len(bucket) <= 10 for bucket in dump["roles"].values()
+        )
+
+
+# ------------------------------------------------- off-by-default pin
+
+class TestOffByDefaultPins:
+    def test_zero_overhead_when_off(self):
+        """flowprof + sampler OFF (the default) through a REAL mocknet
+        flow: no flowprof.*/sampler.* registry names, no sampler thread,
+        bare disabled markers in the snapshot, and the hook helper hands
+        back the shared no-op frame — pinned in a fresh subprocess so no
+        other test's configure_* latch can mask a regression."""
+        code = """
+import json, os, threading
+os.environ.pop("CORDA_TPU_FLOWPROF", None)
+os.environ.pop("CORDA_TPU_SAMPLER", None)
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.testing import MockNetworkNodes
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.observability.flowprof import flowprof_frame, flowprof
+with MockNetworkNodes() as net:
+    alice = net.create_node("OffAlice")
+    notary = net.create_notary_node("OffNotary")
+    alice.run_flow(CashIssueFlow(100, "GBP", b"\\x01", notary.party))
+snap = monitoring_snapshot()
+assert snap["flowprof"] == {"enabled": False}, snap["flowprof"]
+assert snap["sampler"] == {"enabled": False}, snap["sampler"]
+names = list(node_metrics().snapshot())
+assert not any(
+    n.startswith(("flowprof.", "sampler.")) for n in names
+), names
+assert not any(
+    t.name == "stack-sampler" for t in threading.enumerate()
+), [t.name for t in threading.enumerate()]
+# hooks hand back one shared no-op object — zero allocation per call
+assert flowprof_frame("serialize") is flowprof_frame("checkpoint")
+# nothing was ledgered while off
+assert flowprof().snapshot()["flows"] == 0
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]
+
+
+# ------------------------------------------------- flight-dump round-trip
+
+class TestFlightDumpRoundTrip:
+    def test_sections_disabled_round_trip(self, tmp_path):
+        from corda_tpu.observability.slo import flight_dump, read_flight_dump
+
+        configure_flowprof(enabled=False)
+        configure_sampler(enabled=False)
+        path = flight_dump(str(tmp_path / "flight.jsonl"), reason="test")
+        out = read_flight_dump(path)
+        assert out["flowprof"] == {"enabled": False}
+        assert out["sampler"] == {"enabled": False}
+
+    def test_sections_enabled_round_trip(self, tmp_path):
+        """With both knobs on, the dump carries the waterfall and the
+        folded stacks, and read_flight_dump hands them back typed."""
+        from corda_tpu.observability.slo import flight_dump, read_flight_dump
+
+        configure_flowprof(enabled=True, reset=True)
+        configure_sampler(enabled=True, hz=100, reset=True)
+        try:
+            prof = flowprof()
+            prof.open("f1", "test.DumpFlow")
+            time.sleep(0.05)
+            prof.close("f1")
+            deadline = time.monotonic() + 5
+            while (configure_sampler().dump()["samples"] < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            path = flight_dump(str(tmp_path / "flight.jsonl"),
+                               reason="test")
+            out = read_flight_dump(path)
+            assert out["flowprof"]["enabled"]
+            assert "test.DumpFlow" in out["flowprof"]["classes"]
+            rec = out["flowprof"]["recent"][-1]
+            assert set(rec["phases"]) == set(PHASES)
+            assert out["sampler"]["enabled"]
+            assert out["sampler"]["samples"] >= 3
+            assert isinstance(out["sampler"]["roles"], dict)
+            # the dump is JSON all the way down (no stray objects)
+            json.dumps(out["sampler"])
+            # monitoring_snapshot carries the same sections
+            assert flowprof_section()["flows"] >= 1
+        finally:
+            configure_flowprof(enabled=False, reset=True)
+            configure_sampler(enabled=False, reset=True)

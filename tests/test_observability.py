@@ -331,18 +331,25 @@ class TestMonitoringSurface:
         node_metrics().counter("verifier.device_failover").inc()
         snap = monitoring_snapshot()
         assert set(snap) == {"serving", "profiler", "devices", "slo",
-                             "resilience", "durability", "process"}
-        # devicemon/slo/resilience/durability are off by default: bare
-        # disabled markers, no slots laid out, no metrics created
-        # (ISSUE 7 overhead contract; ISSUEs 9/10 extend it to the
-        # serving policy and the persistence tier). NOTE: durability's
-        # marker latches on once ANY test in the process built a
-        # DurableStore, so only its shape is asserted here — the pristine
-        # off-state is pinned in a fresh subprocess by
-        # test_durability.py::TestDurabilityOffByDefault.
+                             "resilience", "durability", "flowprof",
+                             "sampler", "process"}
+        # devicemon/slo/resilience/durability/flowprof/sampler are off by
+        # default: bare disabled markers, no slots laid out, no metrics
+        # created (ISSUE 7 overhead contract; ISSUEs 9/10 extend it to
+        # the serving policy and the persistence tier, ISSUE 14 to phase
+        # accounting and the stack sampler). NOTE: durability's marker
+        # latches on once ANY test in the process built a DurableStore,
+        # so only its shape is asserted here — the pristine off-state is
+        # pinned in a fresh subprocess by
+        # test_durability.py::TestDurabilityOffByDefault; flowprof's and
+        # sampler's likewise may have been flipped by an earlier test in
+        # this process, so only the key's presence is pinned here and the
+        # pristine state in test_flowprof.py's fresh-subprocess test.
         assert snap["devices"] == {"enabled": False}
         assert snap["slo"] == {"enabled": False}
         assert snap["resilience"] == {"enabled": False}
+        assert "enabled" in snap["flowprof"]
+        assert "enabled" in snap["sampler"]
         assert snap["durability"] == {"enabled": False} \
             or snap["durability"]["enabled"] is True
         assert "shed" in snap["serving"]

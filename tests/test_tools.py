@@ -48,6 +48,28 @@ class TestLoadTest:
         assert result["executed"] == 12 and result["failed"] == 0
         assert sum(result["final_state"].values()) > 0
 
+    def test_walls_reported_separately(self, net):
+        """Closed-loop bias fix (ISSUE 14 satellite): the runner times
+        generate / execute / gather SEPARATELY and computes throughput
+        against the execute wall alone, so generator and checker time
+        no longer deflate the figure."""
+        nodes = {"Alice": net.nodes["Alice"]}
+        test = self_issue_test(nodes, net.nodes["Notary"].party)
+        result = LoadTestRunner(test, RunParameters(
+            parallelism=2, generate_count=2, execution_frequency_hz=None,
+        )).run()
+        walls = result["walls"]
+        assert set(walls) == {
+            "generate_s", "execute_s", "gather_s", "total_s",
+        }
+        assert all(v >= 0 for v in walls.values())
+        assert walls["total_s"] == pytest.approx(
+            walls["generate_s"] + walls["execute_s"] + walls["gather_s"]
+        )
+        assert result["executed_per_s"] == pytest.approx(
+            result["executed"] / walls["execute_s"]
+        )
+
     def test_notarisation_storm_with_disruption(self, net):
         """Kill and restart a (non-notary) node's flows mid-storm: the
         committed-tx model must still reconcile (reference:
@@ -91,6 +113,44 @@ class TestLoadTest:
                 parallelism=1, generate_count=2, gather_frequency=1,
                 execution_frequency_hz=None,
             )).run()
+
+
+class TestLoadHarness:
+    def test_open_loop_step_scores_and_conserves(self, tmp_path):
+        """ISSUE 14 tentpole (c), fast path: one short Poisson step over
+        mocknet — arrivals are open-loop (offered ≈ qps × duration, not
+        gated on completions), the step is SLO-scored, the knee carries
+        a flowprof waterfall whose phases sum to the class wall within
+        5%, and the artifact round-trips the perf-gate schema check."""
+        from corda_tpu.tools.loadharness import (
+            HarnessConfig, run_harness, write_loadtest,
+        )
+
+        result = run_harness(HarnessConfig(
+            qps_steps=(8.0,), step_duration_s=1.0, drain_timeout_s=30.0,
+            p99_slo_s=10.0, min_samples=3, seed=7,
+        ))
+        assert result["mode"] == "open-loop-poisson"
+        (step,) = result["steps"]
+        # open loop: the arrival process offered roughly qps × duration
+        # regardless of service time (seeded Poisson, wide tolerance)
+        assert 3 <= step["offered"] <= 20, step["offered"]
+        assert step["completed"] <= step["offered"]
+        assert step["drained"]
+        assert step["p99_s"] >= step["p50_s"]
+        assert result.get("knee_qps") == 8.0
+        wf = result["knee"]["waterfall"]
+        total = sum(wf["phases"].values())
+        assert abs(total - wf["wall_s"]) <= 0.05 * wf["wall_s"]
+        assert wf["phases"]["notary_rtt"] > 0
+        path = write_loadtest(result, str(tmp_path / "LOADTEST.json"))
+        gate = subprocess.run(
+            [sys.executable,
+             os.path.join(TestPerfGate.REPO, "tools_perf_gate.py"),
+             "--result", path, "--check-schema"],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert gate.returncode == 0, gate.stdout + gate.stderr
 
 
 class TestShell:
@@ -257,6 +317,87 @@ class TestPerfGate:
             proc = self._run("--result", str(bad), "--check-schema")
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
+
+    LOADTEST_WF = {
+        "flow_class": "corda_tpu.finance.cash.CashPaymentFlow",
+        "flows": 10, "wall_s": 4.0,
+        "phases": {
+            "queue_wait": 0.5, "device_execute": 0.0, "host_verify": 0.4,
+            "wal_fsync_wait": 0.0, "lock_wait": 0.1, "serialize": 0.6,
+            "message_transit": 0.8, "checkpoint": 0.4, "notary_rtt": 0.7,
+            "engine_other": 0.5,
+        },
+    }
+
+    def _synthetic_loadtest(self):
+        step = {
+            "qps": 8.0, "offered": 40, "completed": 39, "errors": 1,
+            "shed": 0, "p50_s": 0.05, "p99_s": 0.4,
+            "waterfall": json.loads(json.dumps(self.LOADTEST_WF)),
+        }
+        return {
+            "schema": 1, "mode": "open-loop-poisson",
+            "steps": [step], "knee_qps": 8.0,
+            "knee": {
+                "qps": 8.0, "p50_s": 0.05, "p99_s": 0.4, "shed_rate": 0.0,
+                "waterfall": json.loads(json.dumps(self.LOADTEST_WF)),
+            },
+        }
+
+    def test_check_schema_validates_standalone_loadtest(self, tmp_path):
+        """ISSUE 14: a standalone LOADTEST.json (tools_loadgen.py) is
+        schema-validated — well-formed passes; broken waterfall
+        conservation, inverted quantiles, completing more than offered,
+        a phase outside the closed set, and a missing step key fail."""
+        good = self._synthetic_loadtest()
+        ok = tmp_path / "LOADTEST.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d["knee"]["waterfall"]["phases"].__setitem__(
+                "engine_other", 99.0), "conservation broken"),
+            (lambda d: d["steps"][0].__setitem__("p99_s", 0.01),
+             "quantiles must be monotone"),
+            (lambda d: d["steps"][0].__setitem__("completed", 41),
+             "cannot complete more than it offered"),
+            (lambda d: d["steps"][0]["waterfall"]["phases"].__setitem__(
+                "gc_pause", 0.1), "unknown phase"),
+            (lambda d: d["steps"][0].pop("shed"),
+             "missing numeric 'shed'"),
+            (lambda d: d["steps"][0].__setitem__("errors", -1),
+             "negative errors"),
+            (lambda d: d.__setitem__("knee_qps", 0),
+             "not a positive number"),
+            (lambda d: d.__setitem__("steps", []),
+             "missing non-empty 'steps'"),
+        ):
+            broken = self._synthetic_loadtest()
+            doctor(broken)
+            bad = tmp_path / "LOADTEST_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_check_schema_validates_nested_loadtest_section(self, tmp_path):
+        """The smoke's bench JSON nests the same section under
+        ``loadtest`` — the gate must reach it there too."""
+        nested = dict(self.SYNTHETIC)
+        nested["loadtest"] = self._synthetic_loadtest()
+        ok = tmp_path / "bench.json"
+        ok.write_text(json.dumps(nested))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        nested["loadtest"]["steps"][0]["waterfall"]["phases"][
+            "engine_other"] = 99.0
+        bad = tmp_path / "bench_bad.json"
+        bad.write_text(json.dumps(nested))
+        proc = self._run("--result", str(bad), "--check-schema")
+        assert proc.returncode == 1, proc.stdout
+        assert "conservation broken" in proc.stdout
 
     def test_check_schema_validates_resilience_section(self, tmp_path):
         """ISSUE 9 satellite: the `resilience` section the smoke's

@@ -1,0 +1,141 @@
+"""Open-loop load generator CLI (docs/LOAD_HARNESS.md).
+
+Drives ``corda_tpu/tools/loadharness.py`` — Poisson arrivals over an
+in-process mocknet at a stepped qps ramp, each step scored through the
+SLO monitor — and writes ``LOADTEST.json`` (knee qps, per-step
+p50/p99/shed rate, the flowprof waterfall at the knee). The schema is
+validated by ``tools_perf_gate.py --result LOADTEST.json
+--check-schema``.
+
+    python tools_loadgen.py                            # default ramp
+    python tools_loadgen.py --qps 5,10,20 --duration 5
+    python tools_loadgen.py --chaos --durable          # under fault load
+    python tools_loadgen.py --workload issue --out /tmp/LOADTEST.json
+
+Knobs:
+
+    --qps A,B,C      arrival-rate steps (flows/sec; default 4,8,16)
+    --duration S     seconds of arrivals per step (default 5)
+    --p99 S          per-step p99 SLO bound (default 2.0)
+    --max-error-rate F  error+shed rate bound (default 0.05)
+    --max-inflight N open-loop shed bound (default 256)
+    --workload W     payment | issue (default payment)
+    --seed N         arrival-process seed (default 2026)
+    --chaos          inject message drop/delay while the ramp runs
+    --durable        WAL-backed checkpoints on every node
+    --resilience     self-healing serving policy
+    --device         device-batched signature verification
+    --sampler        attach the stack sampler's folded stacks
+    --out PATH       output path (default LOADTEST.json)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+sys.path.insert(0, str(ROOT))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--qps", default="4,8,16",
+                    help="comma-separated qps steps (default 4,8,16)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds of arrivals per step (default 5)")
+    ap.add_argument("--p99", type=float, default=2.0,
+                    help="per-step p99 SLO bound in seconds (default 2)")
+    ap.add_argument("--max-error-rate", type=float, default=0.05,
+                    help="error+shed rate bound (default 0.05)")
+    ap.add_argument("--max-inflight", type=int, default=256,
+                    help="open-loop shed bound (default 256)")
+    ap.add_argument("--workload", choices=("payment", "issue"),
+                    default="payment")
+    ap.add_argument("--seed", type=int, default=2026)
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the ramp under injected message drop/delay")
+    ap.add_argument("--durable", action="store_true",
+                    help="WAL-backed checkpoints on every node")
+    ap.add_argument("--resilience", action="store_true",
+                    help="self-healing serving policy")
+    ap.add_argument("--device", action="store_true",
+                    help="device-batched signature verification")
+    ap.add_argument("--sampler", action="store_true",
+                    help="attach the stack sampler's folded stacks")
+    ap.add_argument("--out", default="LOADTEST.json")
+    args = ap.parse_args(argv)
+
+    try:
+        qps_steps = tuple(float(q) for q in args.qps.split(",") if q)
+    except ValueError:
+        print(f"loadgen: bad --qps {args.qps!r} (want e.g. 4,8,16)")
+        return 2
+    if not qps_steps or any(q <= 0 for q in qps_steps):
+        print(f"loadgen: --qps steps must be positive: {args.qps!r}")
+        return 2
+
+    from corda_tpu.tools.loadharness import (
+        HarnessConfig,
+        run_harness,
+        write_loadtest,
+    )
+
+    chaos = None
+    if args.chaos:
+        from corda_tpu.faultinject import FaultPlan
+
+        chaos = FaultPlan(
+            seed=args.seed, drop_p=0.02, delay_p=0.05, delay_rounds=(1, 3),
+        )
+    cfg = HarnessConfig(
+        qps_steps=qps_steps,
+        step_duration_s=args.duration,
+        seed=args.seed,
+        p99_slo_s=args.p99,
+        max_error_rate=args.max_error_rate,
+        max_inflight=args.max_inflight,
+        workload=args.workload,
+        use_device=args.device,
+        chaos=chaos,
+        durable=args.durable,
+        resilience=args.resilience,
+        sampler=args.sampler,
+    )
+    result = run_harness(cfg)
+    path = write_loadtest(result, args.out)
+    knee = result.get("knee")
+    for step in result["steps"]:
+        print(
+            "loadgen: step {qps:g} qps — offered {offered}, completed "
+            "{completed}, errors {errors}, shed {shed}, p50 {p50:.3f}s, "
+            "p99 {p99:.3f}s, SLO {ok}".format(
+                qps=step["qps"], offered=step["offered"],
+                completed=step["completed"], errors=step["errors"],
+                shed=step["shed"], p50=step["p50_s"], p99=step["p99_s"],
+                ok="ok" if step["slo_ok"] else "BREACHED",
+            )
+        )
+    if knee is None:
+        print("loadgen: no step met the SLO — no knee "
+              f"(p99 bound {args.p99}s); wrote {path}")
+        return 1
+    wf = knee.get("waterfall", {})
+    top = sorted(
+        ((p, v) for p, v in wf.get("phases", {}).items() if v > 0),
+        key=lambda kv: -kv[1],
+    )[:4]
+    print(
+        f"loadgen: knee {knee['qps']:g} qps (p99 {knee['p99_s']:.3f}s); "
+        "top phases: "
+        + ", ".join(f"{p} {v:.2f}s" for p, v in top)
+    )
+    print(f"loadgen: wrote {path}")
+    print(json.dumps({"knee_qps": knee["qps"], "steps": len(result['steps'])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

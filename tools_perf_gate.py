@@ -113,6 +113,14 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # real multi-chip mesh is bounded by. Tight tolerance: imbalance is
     # a scheduler bug, not timer noise.
     "multichip/scaling_efficiency": ("higher", 0.05),
+    # open-loop SLO attainment (docs/LOAD_HARNESS.md): the knee — the
+    # highest Poisson arrival rate whose step met the SLO. Loose
+    # tolerance: the smoke's knee rides mocknet flow latency on a shared
+    # CI host. Two paths for the two artifacts: the smoke JSON nests a
+    # ``loadtest`` section; a standalone LOADTEST.json (tools_loadgen.py)
+    # IS the section, with ``knee_qps`` at top level.
+    "loadtest/knee_qps": ("higher", 0.50),
+    "knee_qps": ("higher", 0.50),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -159,6 +167,53 @@ MULTICHIP_REQUIRED_KEYS = (
     "max_ordinal_rows", "scaling_efficiency", "stripe_spread_max",
     "megabatch_rows", "allgather_parity_ok", "mega_parity_ok",
 )
+
+# keys every loadtest step must carry for --check-schema (the open-loop
+# SLO-attainment pass — docs/LOAD_HARNESS.md)
+LOADTEST_STEP_REQUIRED_KEYS = (
+    "qps", "offered", "completed", "errors", "shed", "p50_s", "p99_s",
+)
+
+# the flowprof closed phase set (corda_tpu/observability/flowprof.PHASES,
+# mirrored here because the gate is pure JSON arithmetic): a loadtest
+# waterfall may only contain these phases, and they must sum to the
+# flow-class wall within 5% — conservation is the waterfall's contract
+LOADTEST_PHASES = (
+    "queue_wait", "device_execute", "host_verify", "wal_fsync_wait",
+    "lock_wait", "serialize", "message_transit", "checkpoint",
+    "notary_rtt", "engine_other",
+)
+
+
+def _check_waterfall(wf, where: str, problems: list[str]) -> None:
+    if not isinstance(wf, dict):
+        problems.append(f"{where}: expected an object")
+        return
+    phases = wf.get("phases")
+    wall = wf.get("wall_s")
+    if not isinstance(phases, dict) or not isinstance(wall, (int, float)) \
+            or isinstance(wall, bool):
+        problems.append(f"{where}: missing 'phases' object / numeric "
+                        "'wall_s'")
+        return
+    for name, v in phases.items():
+        if name not in LOADTEST_PHASES:
+            problems.append(
+                f"{where}: unknown phase {name!r} (closed set: "
+                + ", ".join(LOADTEST_PHASES) + ")"
+            )
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            problems.append(f"{where}: phase {name!r} not a non-negative "
+                            "number")
+    total = sum(
+        v for v in phases.values()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    )
+    if wall > 0 and abs(total - wall) > 0.05 * wall:
+        problems.append(
+            f"{where}: phases sum {total:.6g} deviates from wall_s "
+            f"{wall:.6g} by more than 5% (conservation broken)"
+        )
 
 
 def resolve_path(data: dict, path: str):
@@ -433,6 +488,77 @@ def check_schema(result: dict) -> list[str]:
                         f"multichip: {flag} is {v} (the pass must prove "
                         "parity, not merely run)"
                     )
+    loadtest = result.get("loadtest")
+    if loadtest is None and result.get("mode") == "open-loop-poisson":
+        # a standalone LOADTEST.json (tools_loadgen.py) IS the section
+        loadtest = result
+    if loadtest is not None:
+        if not isinstance(loadtest, dict):
+            problems.append("loadtest: expected an object")
+        else:
+            steps = loadtest.get("steps")
+            if not isinstance(steps, list) or not steps:
+                problems.append("loadtest: missing non-empty 'steps' list")
+                steps = []
+            for i, step in enumerate(steps):
+                where = f"loadtest/steps[{i}]"
+                if not isinstance(step, dict):
+                    problems.append(f"{where}: expected an object")
+                    continue
+                for key in LOADTEST_STEP_REQUIRED_KEYS:
+                    v = step.get(key)
+                    if not isinstance(v, (int, float)) \
+                            or isinstance(v, bool):
+                        problems.append(f"{where}: missing numeric {key!r}")
+                    elif v < 0:
+                        problems.append(f"{where}: negative {key} {v}")
+                p50, p99 = step.get("p50_s"), step.get("p99_s")
+                if (isinstance(p50, (int, float))
+                        and isinstance(p99, (int, float))
+                        and not isinstance(p50, bool)
+                        and not isinstance(p99, bool) and p99 < p50):
+                    problems.append(
+                        f"{where}: p99_s {p99} below p50_s {p50} "
+                        "(quantiles must be monotone)"
+                    )
+                comp, off = step.get("completed"), step.get("offered")
+                if (isinstance(comp, (int, float))
+                        and isinstance(off, (int, float))
+                        and not isinstance(comp, bool)
+                        and not isinstance(off, bool) and comp > off):
+                    problems.append(
+                        f"{where}: completed {comp} exceeds offered {off} "
+                        "(an open-loop step cannot complete more than it "
+                        "offered)"
+                    )
+                if "waterfall" in step:
+                    _check_waterfall(step["waterfall"],
+                                     f"{where}/waterfall", problems)
+            knee = loadtest.get("knee")
+            kq = loadtest.get("knee_qps")
+            if kq is not None and (not isinstance(kq, (int, float))
+                                   or isinstance(kq, bool) or kq <= 0):
+                problems.append(
+                    f"loadtest: knee_qps {kq!r} is not a positive number"
+                )
+            if knee is not None:
+                if not isinstance(knee, dict):
+                    problems.append("loadtest/knee: expected an object")
+                else:
+                    if "waterfall" in knee:
+                        _check_waterfall(knee["waterfall"],
+                                         "loadtest/knee/waterfall",
+                                         problems)
+                    kp50, kp99 = knee.get("p50_s"), knee.get("p99_s")
+                    if (isinstance(kp50, (int, float))
+                            and isinstance(kp99, (int, float))
+                            and not isinstance(kp50, bool)
+                            and not isinstance(kp99, bool)
+                            and kp99 < kp50):
+                        problems.append(
+                            f"loadtest/knee: p99_s {kp99} below p50_s "
+                            f"{kp50} (quantiles must be monotone)"
+                        )
     return problems
 
 
