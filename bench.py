@@ -1760,11 +1760,112 @@ def run_smoke_loadharness() -> dict:
             "steps": [
                 {k: s[k] for k in (
                     "qps", "offered", "completed", "errors", "shed",
-                    "shed_rate", "p50_s", "p99_s", "slo_ok", "waterfall",
+                    "shed_rate", "p50_s", "p99_s", "retransmits",
+                    "net_transit_p99_s", "slo_ok", "waterfall",
                 )}
                 for s in result["steps"]
             ],
             "knee": knee,
+        }
+    }
+
+
+def run_smoke_cluster() -> dict:
+    """The smoke's cluster-observatory leg (docs/OBSERVABILITY.md
+    §Cluster observatory): tracing + flowprof + hop recording + edge
+    telemetry forced on around one notarised mocknet payment; the
+    TraceAssembler must join every node's spans into ONE distributed
+    trace with ≥ 2 synthetic ``net.transit`` hop spans and a NAMED
+    cross-node critical path, and ``federated_snapshot()``'s per-node
+    sections must reconcile exactly with each node's local monitoring
+    snapshot. Emits the ``cluster`` section ``tools_perf_gate.py
+    --check-schema`` validates."""
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+    from corda_tpu.messaging.netstats import configure_netstats
+    from corda_tpu.node.monitoring import monitoring_snapshot
+    from corda_tpu.observability import (
+        TraceAssembler, configure_tracing, federated_snapshot, tracer,
+    )
+    from corda_tpu.observability.cluster import configure_cluster
+    from corda_tpu.observability.flowprof import configure_flowprof
+    from corda_tpu.testing import MockNetworkNodes
+    from corda_tpu.verifier import BatchedVerifierService
+
+    configure_tracing(sample_rate=1.0)
+    configure_flowprof(enabled=True, reset=True)
+    configure_cluster(enabled=True, reset=True)
+    configure_netstats(enabled=True, reset=True)
+    try:
+        with MockNetworkNodes() as net:
+            alice = net.create_node("ClusterAlice")
+            bob = net.create_node("ClusterBob")
+            notary = net.create_notary_node("ClusterNotary")
+            vsvc = BatchedVerifierService(use_device=False)
+            alice.services.transaction_verifier_service = vsvc
+            alice.run_flow(CashIssueFlow(1000, "GBP", b"\x05", notary.party))
+            handle = alice.smm.start_flow(
+                CashPaymentFlow(250, "GBP", bob.party)
+            )
+            handle.result.result(timeout=120)
+            # responder spans (notary + broadcast recipient) close
+            # shortly after the initiator resolves
+            wait_for_complete_trace(
+                tracer(), handle.flow_id,
+                {"flow", "flow.responder", "flow.verify_stx",
+                 "notary.attest"},
+            )
+            # quiesce: the reconcile below compares two reads of shared
+            # process state, so wait for consecutive monitoring
+            # snapshots to agree (late responder teardown still ticks
+            # counters for a few ms after the spans close)
+            prev, deadline = None, time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                cur = monitoring_snapshot()
+                if cur == prev:
+                    break
+                prev = cur
+                time.sleep(0.05)
+            trace = TraceAssembler(net).assemble(flow_id=handle.flow_id)
+            doc = federated_snapshot(net)
+            reconcile_ok = True
+            for name, node in net.nodes.items():
+                expect = monitoring_snapshot()
+                expect["node"] = node.services.metrics.snapshot()
+                if doc["nodes"].get(name, {}).get("snapshot") != expect:
+                    reconcile_ok = False
+            vsvc.shutdown()
+    finally:
+        configure_netstats(enabled=False, reset=True)
+        configure_cluster(enabled=False, reset=True)
+        configure_flowprof(enabled=False, reset=True)
+        configure_tracing(sample_rate=0.0)
+    hops = trace["transit"]["count"]
+    cp = trace["critical_path"]
+    assert trace["trace_id"], "assembly found no trace for the payment flow"
+    assert hops >= 2, (
+        f"assembled trace has {hops} hops; a notarised payment must cross "
+        "the wire at least twice"
+    )
+    assert len(trace["nodes"]) >= 2, (
+        f"assembled trace spans {trace['nodes']} — expected multiple nodes"
+    )
+    assert cp is not None and cp["bound_by"] is not None, (
+        "assembly produced no named critical path"
+    )
+    rollup = doc["rollup"]
+    return {
+        "cluster": {
+            "hops": hops,
+            "nodes": len(trace["nodes"]),
+            "transit_p50_s": trace["transit"]["p50_s"],
+            "transit_p99_s": trace["transit"]["p99_s"],
+            "federation_nodes": rollup["n_nodes"],
+            "rollup_p99_s": rollup["cluster_p99_s"],
+            "node_p99_min_s": rollup["node_p99_min_s"],
+            "node_p99_max_s": rollup["node_p99_max_s"],
+            "pernode_reconcile_ok": 1 if reconcile_ok else 0,
+            "critical_node": cp["bound_by"]["node"],
+            "critical_phase": cp["bound_by"]["phase"],
         }
     }
 
@@ -1923,6 +2024,15 @@ def run_smoke() -> int:
         # --check-schema validates. Runs on its own mocknet AFTER the
         # fault passes, with flowprof turned off again at exit.
         out.update(run_smoke_loadharness())
+
+        # 13. cluster observatory pass (docs/OBSERVABILITY.md §Cluster
+        # observatory): hop recording + edge telemetry + tracing forced
+        # on around one notarised payment; the assembled distributed
+        # trace must carry ≥ 2 net.transit hops and a named cross-node
+        # critical path, and the federated snapshot must reconcile with
+        # every node's local monitoring snapshot. Runs last — its forced
+        # toggles must not touch any measured number above.
+        out.update(run_smoke_cluster())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

@@ -53,7 +53,9 @@ from corda_tpu.observability import (
     TraceContext,
     tracer,
 )
+from corda_tpu.observability.cluster import active_cluster
 from corda_tpu.observability.flowprof import active_flowprof, flowprof_frame
+from corda_tpu.observability.trace import current_trace_id
 from corda_tpu.serialization import deserialize, serialize
 
 from .api import (
@@ -937,6 +939,17 @@ class StateMachineManager:
             fp = active_flowprof()
             if fp is not None:
                 fp.note_sent(_logical_id(msg_id))
+        if track_kind is not None:
+            # hop evidence (cluster observatory): wall-clock send stamp on
+            # THIS node, joined by the receiving engine into a per-hop
+            # net.transit span. Same first-stamp-wins semantics as above.
+            cl = active_cluster()
+            if cl is not None:
+                cl.note_send(
+                    str(self.our_identity.name), str(party.name),
+                    track_kind, _logical_id(msg_id),
+                    current_trace_id() or "",
+                )
         # register BEFORE transmitting: a fast peer's reply (Confirm/Ack)
         # can be processed in the window after send — it must find the
         # entry to settle, not race past an empty map and leave a zombie
@@ -1233,6 +1246,15 @@ class StateMachineManager:
                             fp.acct_of(ex.flow_id) if ex is not None
                             else None,
                         )
+                    cl = active_cluster()
+                    if cl is not None and sender:
+                        ex = sess.executor
+                        span = (self._flow_spans.get(ex.flow_id)
+                                if ex is not None else None)
+                        cl.note_recv(
+                            str(self.our_identity.name), sender, msg_id,
+                            span.trace_id if span is not None else "",
+                        )
                 self._wake_key_locked(("sid", sid))
                 self._lock.notify_all()
                 return
@@ -1317,6 +1339,14 @@ class StateMachineManager:
             return
         self._open_flow_span(flow_id, class_path(responder),
                              responder=True, parent_wire=init.trace)
+        cl = active_cluster()
+        if cl is not None:
+            # the Init hop's delivery stamp: trace id straight off the
+            # wire context (authoritative even when unsampled locally)
+            cl.note_recv(
+                str(self.our_identity.name), msg.sender, logical,
+                init.trace.split(":", 1)[0] if init.trace else "",
+            )
         fp = active_flowprof()
         if fp is not None:
             fp.open(flow_id, class_path(responder))

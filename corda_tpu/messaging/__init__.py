@@ -17,8 +17,18 @@ This package provides the same primitives TPU-host-natively:
   with manual ``pump`` stepping for race-free protocol tests.
 - ``MessagingClient`` protocol — the node-facing API (send/subscribe/ack),
   identical over the in-memory fake and the broker.
+- ``netstats`` — off-by-default per-edge network-path telemetry: a
+  ``(src, dst)`` delivery/transit/retransmit ledger fed by both
+  transports, plus an edge-triggered partition-suspect detector.
 """
 
+from .netstats import (
+    NetTelemetry,
+    active_netstats,
+    configure_netstats,
+    netstats,
+    netstats_section,
+)
 from .queue import DurableQueueBroker, Message, QueueClosedError
 from .network import (
     auto_ack,
@@ -87,4 +97,6 @@ __all__ = [
     "SecureFabricClient",
     "NativeEngineUnavailable", "NativeQueueBroker", "make_broker",
     "native_engine_available",
+    "NetTelemetry", "active_netstats", "configure_netstats",
+    "netstats", "netstats_section",
 ]

@@ -17,6 +17,7 @@ from __future__ import annotations
 import json
 import threading
 
+from .netstats import active_netstats
 from .network import MessagingClient, PeerHandle, TopicMessage
 from .queue import DurableQueueBroker, QueueClosedError
 
@@ -54,9 +55,13 @@ class BrokerMessagingClient(MessagingClient):
             # envelope carries the topic + sender; payload stays opaque bytes
             header = json.dumps({"topic": topic, "sender": self._name}).encode()
             framed = len(header).to_bytes(4, "big") + header + payload
-            return self._broker.publish(
+            mid = self._broker.publish(
                 p2p_queue(name), framed, msg_id=msg_id, sender=self._name
             )
+        nets = active_netstats()
+        if nets is not None:
+            nets.on_send(self._name, name, mid)
+        return mid
 
     def add_handler(self, topic, callback) -> None:
         # ack-unaware (single-parameter) handlers get auto-ack-on-return
@@ -100,7 +105,10 @@ class BrokerMessagingClient(MessagingClient):
             # a certified-but-malicious peer must not speak as the notary
             # — and is dropped, so the mutual-auth boundary extends from
             # the socket to per-message attribution.
+            nets = active_netstats()
             if msg.sender and msg.sender != header["sender"]:
+                if nets is not None:
+                    nets.on_drop(msg.sender, self._name, "spoof")
                 try:
                     self._broker.ack(msg.msg_id)
                 except (QueueClosedError, ConnectionError):
@@ -109,6 +117,10 @@ class BrokerMessagingClient(MessagingClient):
             tmsg = TopicMessage(
                 header["topic"], body, header["sender"], msg.msg_id
             )
+            if nets is not None:
+                # delivery stamp: the leased message reached its consumer
+                # (handler dispatch below; redeliveries restamp honestly)
+                nets.on_deliver(header["sender"], self._name, msg.msg_id)
             with self._lock:
                 handlers = list(self._handlers.get(tmsg.topic, ()))
             if not handlers:

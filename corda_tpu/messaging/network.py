@@ -22,6 +22,7 @@ import threading
 import time
 from collections import deque
 
+from .netstats import active_netstats
 from .queue import Message
 
 logger = logging.getLogger(__name__)
@@ -106,23 +107,26 @@ class _InMemoryNode(MessagingClient):
             self._handlers.setdefault(topic, []).append(callback)
 
     def _enqueue(self, msg: TopicMessage, *, front: bool = False,
-                 force: bool = False) -> None:
+                 force: bool = False) -> bool:
         """``front`` models fault-injected reordering; ``force`` bypasses
         the dedupe set — an injected DUPLICATE must reach the handlers
         (simulating broker visibility-timeout redelivery), because the
         dedupe being exercised is the protocol layer's, not the
-        transport's."""
+        transport's. Returns False when the message was swallowed (node
+        stopped, or the transport dedupe dropped a duplicate wire id) —
+        the edge telemetry's duplicates-dropped feed."""
         with self._lock:
             if not self.running:
-                return
+                return False
             if not force:
                 if msg.msg_id in self._seen:
-                    return  # dedupe / dropped-after-stop
+                    return False  # dedupe / dropped-after-stop
                 self._seen.add(msg.msg_id)
             if front:
                 self._inbox.appendleft(msg)
             else:
                 self._inbox.append(msg)
+            return True
 
     def _pump_one(self) -> bool:
         with self._lock:
@@ -196,6 +200,11 @@ class InMemoryMessagingNetwork:
 
     def _deliver(self, recipient: str, msg: TopicMessage,
                  *, matured: bool = False) -> None:
+        nets = active_netstats()
+        if nets is not None and not matured:
+            # the edge send stamp: first entry of a wire id into the
+            # transport (a matured delayed message was already stamped)
+            nets.on_send(msg.sender, recipient, msg.msg_id)
         inj = self._injector
         duplicate = reorder = False
         if inj is not None and not matured:
@@ -206,20 +215,32 @@ class InMemoryMessagingNetwork:
             )
             if verdict.drop:
                 self.dropped.append((recipient, msg))
+                if nets is not None:
+                    nets.on_drop(msg.sender, recipient,
+                                 verdict.reason or "drop")
                 return
             if verdict.delay_rounds:
                 with self._lock:
                     self._delayed.append(
                         (self._round + verdict.delay_rounds, recipient, msg)
                     )
+                if nets is not None:
+                    nets.on_delay(msg.sender, recipient, verdict.delay_rounds)
                 return
             duplicate, reorder = verdict.duplicate, verdict.reorder
         with self._lock:
             node = self._nodes.get(recipient)
         if node is None or not node.running:
             self.dropped.append((recipient, msg))
+            if nets is not None:
+                nets.on_drop(msg.sender, recipient, "down")
             return
-        node._enqueue(msg, front=reorder)
+        enqueued = node._enqueue(msg, front=reorder)
+        if nets is not None:
+            if enqueued:
+                nets.on_deliver(msg.sender, recipient, msg.msg_id)
+            else:
+                nets.on_duplicate(msg.sender, recipient)
         if duplicate:
             node._enqueue(msg, force=True)
         if self._pumping.is_set():
@@ -245,6 +266,12 @@ class InMemoryMessagingNetwork:
             moved = True
         for node in nodes:
             moved |= node._pump_one()
+        nets = active_netstats()
+        if nets is not None:
+            # partition detection rides the pump: an edge with pending
+            # sends and no delivery past the deadline raises its suspect
+            # event here, once per episode
+            nets.check_partitions()
         return moved
 
     def run_until_quiescent(self, max_rounds: int = 10_000) -> int:

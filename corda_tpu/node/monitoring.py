@@ -302,9 +302,16 @@ def monitoring_snapshot() -> dict:
     critical-path phase accounting waterfall (observability/flowprof —
     ``{"enabled": false}`` while off), ``sampler`` the wall-clock stack
     sampler's folded-stack dump (observability/sampler, same off-marker
-    contract), ``process`` the remaining cross-cutting metrics (e.g. the
-    verifier's ``device_failover`` counters)."""
+    contract), ``net`` the per-edge network-path telemetry ledgers
+    (messaging/netstats — delivery/transit/retransmit counts and
+    partition-suspect state, ``{"enabled": false}`` while off),
+    ``cluster`` the cross-node hop recorder's status
+    (observability/cluster, same off-marker contract), ``process`` the
+    remaining cross-cutting metrics (e.g. the verifier's
+    ``device_failover`` counters)."""
     from corda_tpu.durability import durability_section
+    from corda_tpu.messaging.netstats import netstats_section
+    from corda_tpu.observability.cluster import cluster_section
     from corda_tpu.observability.devicemon import devices_section
     from corda_tpu.observability.flowprof import flowprof_section
     from corda_tpu.observability.sampler import sampler_section
@@ -320,6 +327,8 @@ def monitoring_snapshot() -> dict:
         "durability": durability_section(),
         "flowprof": flowprof_section(),
         "sampler": sampler_section(),
+        "net": netstats_section(),
+        "cluster": cluster_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
@@ -327,6 +336,8 @@ def monitoring_snapshot() -> dict:
                     or k.startswith("replay.")
                     or k.startswith("recovery.")
                     or k.startswith("flowprof.")
-                    or k.startswith("sampler."))
+                    or k.startswith("sampler.")
+                    or k.startswith("net.")
+                    or k.startswith("cluster."))
         },
     }

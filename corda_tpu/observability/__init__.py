@@ -34,8 +34,27 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
 - ``sampler`` — off-by-default wall-clock sampling profiler over
   ``sys._current_frames()``: folded flamegraph stacks per thread role,
   self-measured duty cycle pinned under a 3% overhead budget.
+- ``cluster`` — off-by-default cross-node distributed trace assembly:
+  a hop recorder stamping every tracked message's send/delivery on
+  wall clocks, a per-edge clock-skew estimator, and a TraceAssembler
+  joining every node's span ring into one distributed trace with
+  synthetic ``net.transit`` spans and a cross-node critical path.
+- ``federation`` — ``federated_snapshot()``: every cluster member's
+  ``monitoring_snapshot()`` + SLO status in one versioned document with
+  mesh-wide rollups (cluster p99, per-node deltas, unhealthy list),
+  served over ``CordaRPCOps.cluster_snapshot()``.
 """
 
+from .cluster import (
+    CLUSTER_SCHEMA,
+    ClusterRecorder,
+    EdgeOffsetEstimator,
+    TraceAssembler,
+    active_cluster,
+    cluster_recorder,
+    cluster_section,
+    configure_cluster,
+)
 from .devicemon import (
     DeviceMonitor,
     DeviceWatchdog,
@@ -45,7 +64,18 @@ from .devicemon import (
     device_watchdog,
     devicemon,
 )
-from .exposition import metrics_text, parse_prometheus, render_prometheus
+from .exposition import (
+    escape_label_value,
+    metrics_text,
+    parse_prometheus,
+    render_prometheus,
+)
+from .federation import (
+    FEDERATION_SCHEMA,
+    federated_snapshot,
+    render_federated_prometheus,
+    set_cluster_handle,
+)
 from .flowprof import (
     PHASES,
     FlowProfiler,
@@ -87,6 +117,7 @@ from .trace import (
     SPAN_FLOW,
     SPAN_FLOW_RESPONDER,
     SPAN_FLOW_VERIFY,
+    SPAN_NET_TRANSIT,
     SPAN_NOTARY_ATTEST,
     SPAN_NOTARY_SUBMIT,
     SPAN_SERVING_BATCH,
@@ -102,9 +133,13 @@ from .trace import (
 )
 
 __all__ = [
+    "CLUSTER_SCHEMA",
+    "ClusterRecorder",
     "DeviceMonitor",
     "DeviceProfiler",
     "DeviceWatchdog",
+    "EdgeOffsetEstimator",
+    "FEDERATION_SCHEMA",
     "FlowProfiler",
     "NOOP_SPAN",
     "PHASES",
@@ -113,6 +148,7 @@ __all__ = [
     "SPAN_FLOW",
     "SPAN_FLOW_RESPONDER",
     "SPAN_FLOW_VERIFY",
+    "SPAN_NET_TRANSIT",
     "SPAN_NOTARY_ATTEST",
     "SPAN_NOTARY_SUBMIT",
     "SPAN_SERVING_BATCH",
@@ -122,13 +158,18 @@ __all__ = [
     "Span",
     "StackSampler",
     "TimedRLock",
+    "TraceAssembler",
     "TraceContext",
     "Tracer",
+    "active_cluster",
     "active_devicemon",
     "active_flowprof",
     "active_profiler",
     "active_sampler",
     "active_slo",
+    "cluster_recorder",
+    "cluster_section",
+    "configure_cluster",
     "configure_devicemon",
     "configure_flowprof",
     "configure_profiler",
@@ -139,6 +180,8 @@ __all__ = [
     "default_device_ordinal",
     "device_watchdog",
     "devicemon",
+    "escape_label_value",
+    "federated_snapshot",
     "flight_dump",
     "flowprof",
     "flowprof_frame",
@@ -149,9 +192,11 @@ __all__ = [
     "parse_prometheus",
     "profiler",
     "read_flight_dump",
+    "render_federated_prometheus",
     "render_prometheus",
     "sampler",
     "sampler_section",
+    "set_cluster_handle",
     "slo_monitor",
     "stamp_span",
     "tracer",

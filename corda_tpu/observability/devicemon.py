@@ -425,6 +425,8 @@ class DeviceMonitor:
     def prometheus_lines(self) -> list[str]:
         """``device.*`` families with a ``device`` label, Prometheus text
         0.0.4 — appended to ``metrics_text()`` while the monitor is on."""
+        from corda_tpu.observability.exposition import escape_label_value
+
         snap = self.snapshot()
         counters = ("dispatches", "settles", "rows", "padded_rows",
                     "failures")
@@ -435,8 +437,9 @@ class DeviceMonitor:
         for key in counters:
             lines.append(f"# TYPE cordatpu_device_{key} counter")
             for o, e in sorted(snap["devices"].items()):
+                dev = escape_label_value(o)
                 lines.append(
-                    f'cordatpu_device_{key}_total{{device="{o}"}} {e[key]}'
+                    f'cordatpu_device_{key}_total{{device="{dev}"}} {e[key]}'
                 )
         for key in gauges:
             rows = [
@@ -448,13 +451,15 @@ class DeviceMonitor:
             lines.append(f"# TYPE cordatpu_device_{key} gauge")
             for o, v in rows:
                 lines.append(
-                    f'cordatpu_device_{key}{{device="{o}"}} {v}'
+                    f'cordatpu_device_{key}'
+                    f'{{device="{escape_label_value(o)}"}} {v}'
                 )
         lines.append("# TYPE cordatpu_device_unhealthy gauge")
         for o, e in sorted(snap["devices"].items()):
             flag = 1 if e["unhealthy"] else 0
             lines.append(
-                f'cordatpu_device_unhealthy{{device="{o}"}} {flag}'
+                f'cordatpu_device_unhealthy'
+                f'{{device="{escape_label_value(o)}"}} {flag}'
             )
         return lines
 

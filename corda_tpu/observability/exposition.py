@@ -31,6 +31,18 @@ def _sanitize(name: str) -> str:
     return s
 
 
+def escape_label_value(v) -> str:
+    """Escape a label VALUE per the Prometheus text format (0.0.4):
+    backslash, double-quote and newline — in that order, so the escape
+    character itself never double-escapes. Every labeled family
+    (``device=``, ``objective=``, ``node=``, ``edge=``) must route its
+    values through here: a hostile node name (an X.500 string is
+    operator input) with a quote or newline would otherwise corrupt the
+    whole scrape body."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _fmt(v) -> str:
     if v is None:
         return "NaN"
@@ -136,6 +148,7 @@ def metrics_text(node_registry=None) -> str:
     registry or the SLO monitor is enabled, their labeled ``device.*`` /
     ``slo.*`` families append here (one attribute-read check each while
     off — the exposition must stay free for idle processes)."""
+    from corda_tpu.messaging.netstats import active_netstats
     from corda_tpu.node.monitoring import node_metrics
     from corda_tpu.observability.devicemon import active_devicemon
     from corda_tpu.observability.slo import active_slo
@@ -149,6 +162,11 @@ def metrics_text(node_registry=None) -> str:
     slo = active_slo()
     if slo is not None:
         lines = slo.prometheus_lines()
+        if lines:
+            out += "\n".join(lines) + "\n"
+    nets = active_netstats()
+    if nets is not None:
+        lines = nets.prometheus_lines()
         if lines:
             out += "\n".join(lines) + "\n"
     if node_registry is not None:

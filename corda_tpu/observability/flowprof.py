@@ -246,11 +246,12 @@ class FlowProfiler:
             )
             acct.phases["engine_other"] = max(0.0, wall - attributed)
             phases = dict(acct.phases)
-        self._record(acct.flow_class, wall, phases)
+        self._record(acct.flow_class, wall, phases, flow_id)
         return {"flow_id": flow_id, "flow_class": acct.flow_class,
                 "wall_s": wall, "phases": phases}
 
-    def _record(self, flow_class: str, wall: float, phases: dict) -> None:
+    def _record(self, flow_class: str, wall: float, phases: dict,
+                flow_id: str = "") -> None:
         timers = _phase_timers()
         for phase, seconds in phases.items():
             timers[phase].update(seconds)
@@ -272,8 +273,21 @@ class FlowProfiler:
             for p, v in phases.items():
                 agg["phases"][p] += v
             self._recent.append({
-                "flow_class": flow_class, "wall_s": wall, "phases": phases,
+                "flow_id": flow_id, "flow_class": flow_class,
+                "wall_s": wall, "phases": phases,
             })
+
+    def waterfall_of(self, flow_id: str) -> dict | None:
+        """The most recent closed waterfall for one flow id (the cluster
+        TraceAssembler's per-node phase attribution feed), or None when
+        it never closed under accounting / aged out of the recent ring."""
+        with self._lock:
+            for rec in reversed(self._recent):
+                if rec.get("flow_id") == flow_id:
+                    return {"flow_class": rec["flow_class"],
+                            "wall_s": rec["wall_s"],
+                            "phases": dict(rec["phases"])}
+        return None
 
     # ----------------------------------------------------------- activation
     def activate(self, acct: _FlowAcct | None) -> "_Activation":

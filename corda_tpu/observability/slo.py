@@ -238,8 +238,17 @@ class SLOMonitor:
     def prometheus_lines(self) -> list[str]:
         """``slo.*`` families with objective/priority labels — appended
         to ``metrics_text()`` while the monitor is on."""
+        from corda_tpu.observability.exposition import escape_label_value
+
         snap = self.snapshot()
         lines: list[str] = []
+
+        def labels_of(st: dict) -> str:
+            return (
+                f'objective="{escape_label_value(st["objective"])}",'
+                f'priority="{escape_label_value(st["priority"] or "all")}"'
+            )
+
         gauges = (
             ("slo_p99_seconds", "p99_s"),
             ("slo_error_rate", "error_rate"),
@@ -248,19 +257,11 @@ class SLOMonitor:
         for fam, key in gauges:
             lines.append(f"# TYPE cordatpu_{fam} gauge")
             for st in snap["objectives"]:
-                labels = (
-                    f'objective="{st["objective"]}",'
-                    f'priority="{st["priority"] or "all"}"'
-                )
-                lines.append(f"cordatpu_{fam}{{{labels}}} {st[key]}")
+                lines.append(f"cordatpu_{fam}{{{labels_of(st)}}} {st[key]}")
         lines.append("# TYPE cordatpu_slo_breached gauge")
         for st in snap["objectives"]:
-            labels = (
-                f'objective="{st["objective"]}",'
-                f'priority="{st["priority"] or "all"}"'
-            )
             flag = 1 if st["breached"] else 0
-            lines.append(f"cordatpu_slo_breached{{{labels}}} {flag}")
+            lines.append(f"cordatpu_slo_breached{{{labels_of(st)}}} {flag}")
         lines.append("# TYPE cordatpu_slo_breaches counter")
         lines.append(f"cordatpu_slo_breaches_total {snap['breaches']}")
         return lines
@@ -431,6 +432,16 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
         })
     except Exception:
         pass
+    try:
+        # network-path telemetry (messaging/netstats): per-edge delivery/
+        # transit/retransmit ledgers and partition-suspect state — the
+        # section a "why did this hop stall" dump gets read for.
+        # {"enabled": false} while off.
+        from corda_tpu.messaging.netstats import netstats_section
+
+        lines.append({"kind": "net", "snapshot": netstats_section()})
+    except Exception:
+        pass
     for event in list(devicemon().events) + list(_global.events):
         lines.append({"kind": "event", "event": event})
     try:
@@ -460,12 +471,17 @@ def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
     / ``slo`` / ``resilience`` / ``durability`` / ``flowprof`` /
-    ``sampler`` (the snapshots), ``events`` (device + SLO health
-    events), ``faults`` (injected chaos events), ``header``."""
+    ``sampler`` / ``net`` (the snapshots), ``events`` (device + SLO
+    health events), ``faults`` (injected chaos events), ``header``.
+
+    Forward-compat: records whose ``kind`` this reader does not know
+    (written by a NEWER dumper) round-trip untouched under ``extra``
+    instead of being dropped — an old analysis tool must never silently
+    eat a section it cannot name."""
     out: dict = {"header": None, "spans": [], "metrics": None,
                  "devices": None, "slo": None, "resilience": None,
                  "durability": None, "flowprof": None, "sampler": None,
-                 "events": [], "faults": []}
+                 "net": None, "events": [], "faults": [], "extra": []}
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -478,12 +494,14 @@ def read_flight_dump(path: str) -> dict:
             elif kind == "span":
                 out["spans"].append(rec["span"])
             elif kind in ("metrics", "devices", "slo", "resilience",
-                          "durability", "flowprof", "sampler"):
+                          "durability", "flowprof", "sampler", "net"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])
             elif kind == "fault":
                 out["faults"].append(rec["event"])
+            else:
+                out["extra"].append(rec)
     return out
 
 

@@ -56,6 +56,8 @@ SPAN_VERIFIER_REQUEST = "verifier.request"  # BatchedVerifierService round-trip
 SPAN_WAVEFRONT_WINDOW = "wavefront.window"  # one DAG-resolve window
 SPAN_NOTARY_SUBMIT = "notary.submit"      # batched-notary request→response
 SPAN_NOTARY_ATTEST = "notary.attest"      # notary attestation processing
+SPAN_NET_TRANSIT = "net.transit"          # synthetic per-hop transit span
+#                                           (cluster.TraceAssembler output)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -631,6 +631,13 @@ def trace_for_value(proxy, flow_id: str) -> PolledValue:
     return PolledValue(lambda: proxy.trace_for(flow_id))
 
 
+def cluster_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the federated cluster document
+    (``CordaRPCOps.cluster_snapshot``): per-node monitoring snapshots
+    plus the mesh rollup — the fleet-overview widget's feed."""
+    return PolledValue(lambda: proxy.cluster_snapshot())
+
+
 # ------------------------------------------------------------- model tier
 
 class NodeMonitorModel:

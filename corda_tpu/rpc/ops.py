@@ -260,6 +260,18 @@ class CordaRPCOps:
 
         return flight_dump(path, reason=reason)
 
+    def cluster_snapshot(self) -> dict:
+        """The federated cluster document (docs/OBSERVABILITY.md
+        §Cluster observatory): every cluster member's monitoring
+        snapshot + SLO status with mesh-wide rollups (cluster p99,
+        per-node deltas, unhealthy-node list). Federates over the
+        handle registered via ``set_cluster_handle``; an unclustered
+        node answers with a single-node document built from ITS OWN
+        ``monitoring_snapshot()``."""
+        from corda_tpu.observability.federation import federated_snapshot
+
+        return federated_snapshot(local_ops=self)
+
     # ------------------------------------------------------------ tracing
     def trace_dump(self, limit: int = 200) -> list:
         """The most recent finished spans from the process tracer's ring

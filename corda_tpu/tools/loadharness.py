@@ -73,6 +73,7 @@ class HarnessConfig:
     resilience: bool = False        # self-healing serving policy
     flowprof: bool = True           # per-step waterfalls
     sampler: bool = False           # attach folded stacks to the result
+    netstats: bool = True           # per-step edge retransmit/transit
 
 
 def _quantile(sorted_vals: list, q: float) -> float:
@@ -225,6 +226,10 @@ class LoadHarness:
             from corda_tpu.observability.flowprof import configure_flowprof
 
             configure_flowprof(enabled=True, reset=True)
+        if cfg.netstats:
+            from corda_tpu.messaging.netstats import configure_netstats
+
+            configure_netstats(enabled=True, reset=True)
         t_start = time.monotonic()
         next_arrival = t_start
         end = t_start + cfg.step_duration_s
@@ -280,6 +285,18 @@ class LoadHarness:
             "slo_ok": slo_ok,
             "slo": statuses,
         }
+        # network-path telemetry (always numeric — the schema gate
+        # requires the keys even when the netstats toggle is off)
+        retransmits, net_p99 = 0, 0.0
+        if cfg.netstats:
+            from corda_tpu.messaging.netstats import active_netstats
+
+            nets = active_netstats()
+            if nets is not None:
+                retransmits = nets.total_retransmits()
+                net_p99 = nets.transit_p99_s()
+        step["retransmits"] = retransmits
+        step["net_transit_p99_s"] = net_p99
         if cfg.flowprof:
             step["waterfall"] = self._waterfall()
         m = node_metrics()
@@ -347,6 +364,10 @@ class LoadHarness:
                 )
 
                 configure_flowprof(enabled=False, reset=True)
+            if cfg.netstats:
+                from corda_tpu.messaging.netstats import configure_netstats
+
+                configure_netstats(enabled=False, reset=True)
             if sampler_obj is not None:
                 from corda_tpu.observability.sampler import configure_sampler
 

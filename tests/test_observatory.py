@@ -1,0 +1,574 @@
+"""Cluster observatory tests — network-path telemetry (per-edge
+ledgers, first-send transit semantics, the edge-triggered partition
+detector), clock-skew correction, distributed trace assembly (one
+assembled trace per payment, hop transits reconciling against
+flowprof's ``message_transit``), metrics federation (per-node sections
+EXACTLY equal to each node's local snapshot), Prometheus label-value
+escaping under hostile names, flight-dump forward-compat, and the
+off-by-default zero-names pin (fresh subprocess)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from corda_tpu.messaging.netstats import (
+    NetTelemetry,
+    configure_netstats,
+    logical_msg_id,
+    netstats,
+    netstats_section,
+)
+from corda_tpu.observability.cluster import (
+    ClusterRecorder,
+    EdgeOffsetEstimator,
+    TraceAssembler,
+    cluster_section,
+    configure_cluster,
+)
+from corda_tpu.observability.federation import (
+    federated_snapshot,
+    render_federated_prometheus,
+)
+from corda_tpu.observability.exposition import (
+    escape_label_value,
+    parse_prometheus,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture
+def nt():
+    clock = FakeClock()
+    t = NetTelemetry(partition_deadline_s=2.0, clock=clock)
+    t.enable()
+    t.clock = clock  # test handle
+    return t
+
+
+# --------------------------------------------------- edge ledger (unit)
+
+class TestNetTelemetry:
+    def test_logical_id_strips_retransmit_suffix(self):
+        assert logical_msg_id("m1") == "m1"
+        assert logical_msg_id("m1~3") == "m1"
+        assert logical_msg_id("m~1~2") == "m"
+
+    def test_transit_is_first_send_to_delivery(self, nt):
+        """A retransmitted message keeps its ORIGINAL stamp: transit
+        honestly includes the loss-recovery wall."""
+        nt.on_send("a", "b", "m1")
+        nt.clock.advance(1.0)
+        nt.on_send("a", "b", "m1~1")          # retransmit, stamp kept
+        nt.clock.advance(0.5)
+        nt.on_deliver("a", "b", "m1~1")
+        snap = nt.snapshot()
+        e = snap["edges"]["a->b"]
+        assert e["delivered"] == 1
+        assert e["retransmits"] == 1
+        assert e["pending"] == 0
+        assert e["transit_p50_s"] == pytest.approx(1.5)
+
+    def test_drop_delay_duplicate_accounting(self, nt):
+        nt.on_drop("a", "b", "partition")
+        nt.on_drop("a", "b", "down")
+        nt.on_drop("a", "b", "partition")
+        nt.on_delay("a", "b", 3)
+        nt.on_duplicate("a", "b")
+        e = nt.snapshot()["edges"]["a->b"]
+        assert e["drops"] == 3
+        assert e["drops_by_reason"] == {"partition": 2, "down": 1}
+        assert e["delays"] == 1 and e["delay_rounds"] == 3
+        assert e["duplicates_dropped"] == 1
+
+    def test_partition_fires_exactly_once_per_episode(self, nt):
+        """Edge-triggered: ONE suspect event per episode however many
+        checks run, cleared by the next delivery (healed event), and a
+        fresh episode fires again."""
+        nt.on_send("a", "b", "m1")
+        assert nt.check_partitions() == []          # within deadline
+        nt.clock.advance(3.0)
+        fired = nt.check_partitions()
+        assert [e["kind"] for e in fired] == ["net.partition_suspect"]
+        assert fired[0]["edge"] == "a->b"
+        assert fired[0]["waited_s"] == pytest.approx(3.0)
+        # re-checks while still suspected stay silent
+        nt.clock.advance(10.0)
+        assert nt.check_partitions() == []
+        assert nt.snapshot()["suspects"] == ["a->b"]
+        # delivery heals
+        nt.on_deliver("a", "b", "m1")
+        snap = nt.snapshot()
+        assert snap["suspects"] == []
+        kinds = [e["kind"] for e in snap["events"]]
+        assert kinds == ["net.partition_suspect", "net.partition_healed"]
+        # a second episode fires a second (single) event
+        nt.on_send("a", "b", "m2")
+        nt.clock.advance(3.0)
+        assert len(nt.check_partitions()) == 1
+        assert nt.snapshot()["edges"]["a->b"]["episodes"] == 2
+
+    def test_worst_edge_p99_and_total_retransmits(self, nt):
+        nt.on_send("a", "b", "m1")
+        nt.clock.advance(0.1)
+        nt.on_deliver("a", "b", "m1")
+        nt.on_send("a", "c", "m2")
+        nt.clock.advance(0.4)
+        nt.on_deliver("a", "c", "m2")
+        nt.on_send("a", "c", "m2~1")
+        assert nt.transit_p99_s() == pytest.approx(0.4)
+        assert nt.total_retransmits() == 1
+
+    def test_prometheus_lines_parse_with_hostile_edge(self, nt):
+        nt.on_send('a"x\\y', "b", "m1")
+        nt.clock.advance(0.2)
+        nt.on_deliver('a"x\\y', "b", "m1")
+        text = "\n".join(nt.prometheus_lines()) + "\n"
+        samples = parse_prometheus(text)  # raises on any malformed line
+        assert any("net_edge_delivered" in k for k in samples)
+
+
+# --------------------------------- partition detector through the wire
+
+class TestPartitionIntegration:
+    def test_seeded_partition_suspect_once_then_heals(self):
+        """A fault-plan partition through the real in-memory transport:
+        the drop is attributed ``partition``, the suspect event fires
+        ONCE while the pending send ages, and the post-heal retransmit
+        delivers, healing the edge with recovery wall in the transit."""
+        from corda_tpu.faultinject import FaultInjector, FaultPlan, Partition
+        from corda_tpu.messaging.network import InMemoryMessagingNetwork
+
+        plan = FaultPlan(seed=7, partitions=(
+            Partition(0, 3, frozenset({"n1"}), frozenset({"n2"})),
+        ))
+        net = InMemoryMessagingNetwork(fault_injector=FaultInjector(plan))
+        n1 = net.create_node("n1")
+        n2 = net.create_node("n2")
+        n2.add_handler("t", lambda m: None)
+        configure_netstats(enabled=True, reset=True,
+                           partition_deadline_s=0.05)
+        try:
+            n1.send("n2", "t", b"x", msg_id="pmsg")   # severed (round 0)
+            net.pump()                                # round 1
+            time.sleep(0.12)
+            net.pump()                                # round 2 → suspect
+            net.pump()                                # round 3 → silent
+            n1.send("n2", "t", b"x", msg_id="pmsg~1")  # healed window
+            net.pump()
+            snap = netstats().snapshot()
+            e = snap["edges"]["n1->n2"]
+            assert e["drops_by_reason"] == {"partition": 1}
+            assert e["retransmits"] == 1
+            assert e["delivered"] == 1
+            assert e["episodes"] == 1
+            assert not e["partition_suspect"]
+            kinds = [ev["kind"] for ev in snap["events"]]
+            assert kinds.count("net.partition_suspect") == 1
+            assert kinds.count("net.partition_healed") == 1
+            # transit includes the partition's recovery wall
+            assert e["transit_p50_s"] >= 0.12
+        finally:
+            configure_netstats(enabled=False, reset=True,
+                               partition_deadline_s=2.0)
+
+
+# ------------------------------------------------- clock-skew correction
+
+class TestEdgeOffsetEstimator:
+    def _hops(self, skew):
+        """Symmetric 0.010s true transit, B's clock ``skew`` ahead."""
+        hops = []
+        for i, true_t in enumerate((0.010, 0.014, 0.011)):
+            t0 = 100.0 + i
+            hops.append({"src": "A", "dst": "B", "msg_id": f"f{i}",
+                         "t_send": t0, "t_recv": t0 + true_t + skew,
+                         "kind": "data", "trace_id": "t"})
+            hops.append({"src": "B", "dst": "A", "msg_id": f"r{i}",
+                         "t_send": t0 + skew, "t_recv": t0 + true_t,
+                         "kind": "data", "trace_id": "t"})
+        return hops
+
+    def test_recovers_offset_from_bidirectional_minima(self):
+        est = EdgeOffsetEstimator(self._hops(skew=5.0))
+        assert est.offset_s("A", "B") == pytest.approx(5.0)
+        assert est.offset_s("B", "A") == pytest.approx(-5.0)
+
+    def test_corrected_transit_is_sane_under_skew(self):
+        hops = self._hops(skew=5.0)
+        est = EdgeOffsetEstimator(hops)
+        for h in hops:
+            corrected = est.corrected_transit_s(h)
+            assert 0.0 <= corrected <= 0.02, (h, corrected)
+
+    def test_one_directional_edge_estimates_zero(self):
+        hops = [{"src": "A", "dst": "B", "t_send": 1.0, "t_recv": 7.0}]
+        est = EdgeOffsetEstimator(hops)
+        assert est.offset_s("A", "B") == 0.0
+        assert est.corrected_transit_s(hops[0]) == pytest.approx(6.0)
+
+
+# ------------------------------------------- hop recorder (unit)
+
+class TestClusterRecorder:
+    def test_first_send_stamp_wins_and_join(self):
+        rec = ClusterRecorder()
+        rec.enable()
+        rec.note_send("a", "b", "data", "m1", "tid", now=10.0)
+        rec.note_send("a", "b", "data", "m1", "tid", now=11.0)  # retx
+        rec.note_recv("b", "a", "m1", "tid", now=10.3)
+        (hop,) = rec.hops()
+        assert hop["t_send"] == 10.0 and hop["t_recv"] == 10.3
+        assert hop["src"] == "a" and hop["dst"] == "b"
+        assert rec.hops_for("tid") == [hop]
+        assert rec.hops_for("other") == []
+
+    def test_recv_without_send_evidence_is_dropped(self):
+        rec = ClusterRecorder()
+        rec.enable()
+        rec.note_recv("b", "a", "ghost", "tid", now=1.0)
+        assert rec.hops() == []
+        assert rec.snapshot()["hops"] == 0
+
+    def test_receiver_trace_id_is_authoritative(self):
+        rec = ClusterRecorder()
+        rec.enable()
+        rec.note_send("a", "b", "init", "m1", "send-tid", now=1.0)
+        rec.note_recv("b", "a", "m1", "recv-tid", now=1.1)
+        rec.note_send("a", "b", "init", "m2", "send-tid", now=2.0)
+        rec.note_recv("b", "a", "m2", "", now=2.1)   # unsampled receiver
+        tids = [h["trace_id"] for h in rec.hops()]
+        assert tids == ["recv-tid", "send-tid"]
+
+
+# ----------------------------------- distributed assembly (integration)
+
+def _quiesce_monitoring(timeout_s=30.0):
+    """Wait until two consecutive monitoring snapshots are equal —
+    responder flows (FinalityFlow broadcast) may still be closing after
+    the initiator's result resolves."""
+    from corda_tpu.node.monitoring import monitoring_snapshot
+
+    prev, deadline = None, time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        cur = monitoring_snapshot()
+        if cur == prev:
+            return cur
+        prev = cur
+        time.sleep(0.05)
+    raise AssertionError("monitoring snapshot never quiesced")
+
+
+class TestDistributedAssembly:
+    def test_payment_assembles_one_trace_and_reconciles_flowprof(self):
+        """The acceptance path: a 3-node notarised payment assembles
+        into ONE distributed trace — every span carries the same trace
+        id, ≥2 hops crossed the wire, hop transit quantiles are
+        monotone, the summed raw data-hop transits match flowprof's
+        ``message_transit`` total within 5%, and the critical path
+        names a bound-by contributor."""
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+        from corda_tpu.observability import configure_tracing, tracer
+        from corda_tpu.observability.flowprof import (
+            configure_flowprof, flowprof,
+        )
+        from corda_tpu.testing import MockNetworkNodes
+        from bench import wait_for_complete_trace
+
+        configure_tracing(sample_rate=1.0)
+        configure_flowprof(enabled=True, reset=True)
+        configure_cluster(enabled=True, reset=True)
+        configure_netstats(enabled=True, reset=True)
+        try:
+            with MockNetworkNodes() as net:
+                alice = net.create_node("ClusAlice")
+                bob = net.create_node("ClusBob")
+                notary = net.create_notary_node("ClusNotary")
+                alice.run_flow(
+                    CashIssueFlow(500, "GBP", b"\x05", notary.party)
+                )
+                handle = alice.smm.start_flow(
+                    CashPaymentFlow(120, "GBP", bob.party)
+                )
+                handle.result.result(timeout=120)
+                wait_for_complete_trace(
+                    tracer(), handle.flow_id,
+                    {"flow", "flow.responder", "flow.verify_stx",
+                     "notary.attest"},
+                )
+                _quiesce_monitoring()
+                trace = TraceAssembler(net).assemble(
+                    flow_id=handle.flow_id
+                )
+
+            assert trace["trace_id"]
+            # ONE trace: every non-linked span shares the id
+            own = [s for s in trace["spans"]
+                   if s["trace_id"] == trace["trace_id"]]
+            assert own, trace["spans"]
+            assert len(trace["nodes"]) >= 2, trace["nodes"]
+            hops = trace["hops"]
+            assert trace["transit"]["count"] >= 2, trace["transit"]
+            assert all(h["name"] == "net.transit" for h in hops)
+            assert trace["transit"]["p99_s"] >= trace["transit"]["p50_s"]
+
+            # ±5%: summed raw data-hop transit vs flowprof's
+            # message_transit over the trace's flows (the hooks stamp
+            # the same engine sites)
+            fids = {s["attrs"]["flow.id"] for s in own
+                    if s.get("attrs", {}).get("flow.id")}
+            fp = flowprof()
+            transit_total = 0.0
+            for fid in fids:
+                wf = fp.waterfall_of(fid)
+                if wf is not None:
+                    transit_total += wf["phases"].get(
+                        "message_transit", 0.0)
+            hop_total = sum(
+                h["attrs"]["net.raw_s"] for h in hops
+                if h["attrs"]["kind"] == "data"
+            )
+            assert transit_total > 0.0
+            assert abs(hop_total - transit_total) <= \
+                0.05 * transit_total, (hop_total, transit_total)
+
+            cp = trace["critical_path"]
+            assert cp is not None
+            assert cp["end_to_end_s"] > 0.0
+            assert cp["bound_by"] is not None
+            assert cp["bound_by"]["node"], cp["bound_by"]
+            # every hop is individually attributed as a remote entry
+            assert any(c["kind"] == "hop" for c in cp["contributors"])
+        finally:
+            configure_netstats(enabled=False, reset=True)
+            configure_cluster(enabled=False, reset=True)
+            configure_flowprof(enabled=False, reset=True)
+            configure_tracing(sample_rate=0.0)
+
+    def test_assemble_needs_a_selector(self):
+        with pytest.raises(ValueError):
+            TraceAssembler({}, recorder=ClusterRecorder()).assemble()
+
+    def test_unknown_flow_id_yields_empty_trace(self):
+        trace = TraceAssembler(
+            {"n1": []}, recorder=ClusterRecorder()
+        ).assemble(flow_id="nope")
+        assert trace["trace_id"] is None
+        assert trace["spans"] == [] and trace["hops"] == []
+        assert trace["critical_path"] is None
+
+    def test_handle_shapes_span_list_and_callable(self):
+        span = {"trace_id": "t1", "span_id": "s1", "parent_id": None,
+                "name": "flow", "start_s": 1.0, "end_s": 2.0,
+                "duration_s": 1.0, "attrs": {"node": "n1"}, "links": []}
+        rec = ClusterRecorder()
+        rec.enable()
+        for handle in ({"n1": [span]}, {"n1": lambda: [span]}):
+            trace = TraceAssembler(handle, recorder=rec).assemble("t1")
+            assert [s["span_id"] for s in trace["spans"]] == ["s1"]
+            assert trace["nodes"] == ["n1"]
+        with pytest.raises(TypeError):
+            TraceAssembler(42).assemble("t1")
+
+
+# ------------------------------------------------------------ federation
+
+class TestFederation:
+    def test_per_node_sections_reconcile_exactly(self):
+        """The acceptance pin: each node's federation section equals its
+        OWN local monitoring_snapshot() — federation relays, never
+        recomputes."""
+        from corda_tpu.finance import CashIssueFlow
+        from corda_tpu.node.monitoring import monitoring_snapshot
+        from corda_tpu.testing import MockNetworkNodes
+
+        with MockNetworkNodes() as net:
+            alice = net.create_node("FedAlice")
+            notary = net.create_notary_node("FedNotary")
+            alice.run_flow(CashIssueFlow(100, "GBP", b"\x02", notary.party))
+            _quiesce_monitoring()
+            doc = federated_snapshot(net)
+            assert doc["schema"] == 1
+            assert doc["rollup"]["n_nodes"] == 2
+            for name, node in net.nodes.items():
+                expect = monitoring_snapshot()
+                expect["node"] = node.services.metrics.snapshot()
+                assert doc["nodes"][name]["snapshot"] == expect, name
+
+    def test_single_node_document_without_cluster(self):
+        doc = federated_snapshot()
+        assert doc["rollup"]["n_nodes"] == 1
+        assert "local" in doc["nodes"]
+
+    def test_rollup_merge_and_deltas(self):
+        def mk(p99, samples, flows):
+            return lambda: {
+                "slo": {"enabled": True, "objectives": [
+                    {"p99_s": p99, "samples": samples, "breached": False},
+                ]},
+                "flowprof": {"enabled": True, "flows": flows},
+            }
+
+        doc = federated_snapshot({
+            "fast": mk(0.010, 100, 10),
+            "slow": mk(0.100, 100, 30),
+        })
+        r = doc["rollup"]
+        assert r["node_p99_min_s"] == pytest.approx(0.010)
+        assert r["node_p99_max_s"] == pytest.approx(0.100)
+        # weighted nearest-rank over the windows lands on the slow node
+        assert r["cluster_p99_s"] == pytest.approx(0.100)
+        assert r["deltas"]["slow"]["p99_delta_s"] > 0
+        assert r["deltas"]["fast"]["flows_delta"] == pytest.approx(-10.0)
+        assert r["unhealthy_nodes"] == []
+
+    def test_breached_objective_marks_node_unhealthy(self):
+        doc = federated_snapshot({
+            "sick": lambda: {
+                "slo": {"enabled": True, "objectives": [
+                    {"p99_s": 9.0, "samples": 5, "breached": True},
+                ]},
+            },
+        })
+        assert doc["rollup"]["unhealthy_nodes"] == ["sick"]
+
+    def test_federated_prometheus_hostile_node_names(self):
+        """node= label values with quotes, backslashes and newlines must
+        not corrupt the scrape body."""
+        hostile = 'evil"node\\with\nnewline'
+        doc = federated_snapshot({
+            hostile: lambda: {"slo": {"enabled": False}},
+        })
+        text = render_federated_prometheus(doc)
+        samples = parse_prometheus(text)  # raises on any malformed line
+        assert float(samples["cordatpu_cluster_nodes"]) == 1.0
+        escaped = escape_label_value(hostile)
+        assert "\n" not in escaped
+        assert f'node="{escaped}"' in text
+
+
+# ------------------------------------------------------- label escaping
+
+class TestLabelEscaping:
+    def test_escape_ordering_backslash_first(self):
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+        assert escape_label_value("plain") == "plain"
+
+    def test_cluster_snapshot_rpc_surface(self):
+        """CordaRPCOps.cluster_snapshot() without a registered handle is
+        the single-node document, and the polled binding wraps it."""
+        from corda_tpu.rpc.bindings import cluster_snapshot_value
+
+        class FakeProxy:
+            def cluster_snapshot(self):
+                return federated_snapshot()
+
+        val = cluster_snapshot_value(FakeProxy())
+        doc = val.refresh()
+        assert doc["rollup"]["n_nodes"] == 1
+
+
+# -------------------------------------------- flight-dump forward-compat
+
+class TestFlightDumpForwardCompat:
+    def test_unknown_kind_round_trips_untouched(self, tmp_path):
+        """A record written by a NEWER dumper must survive an old
+        reader: it lands under ``extra`` verbatim instead of being
+        dropped."""
+        from corda_tpu.observability.slo import flight_dump, read_flight_dump
+
+        path = flight_dump(str(tmp_path / "flight.jsonl"), reason="fc")
+        alien = {"kind": "hologram", "payload": {"x": [1, 2, 3]}}
+        with open(path, "a") as f:
+            f.write(json.dumps(alien) + "\n")
+        out = read_flight_dump(path)
+        assert out["extra"] == [alien]
+        assert out["header"]["reason"] == "fc"
+
+    def test_net_kind_round_trips(self, tmp_path):
+        from corda_tpu.observability.slo import flight_dump, read_flight_dump
+
+        configure_netstats(enabled=True, reset=True)
+        try:
+            netstats().on_send("a", "b", "m1")
+            netstats().on_deliver("a", "b", "m1")
+            path = flight_dump(str(tmp_path / "f.jsonl"), reason="net")
+            out = read_flight_dump(path)
+            assert out["net"]["enabled"] is True
+            assert "a->b" in out["net"]["edges"]
+        finally:
+            configure_netstats(enabled=False, reset=True)
+
+    def test_net_kind_disabled_marker(self, tmp_path):
+        from corda_tpu.observability.slo import flight_dump, read_flight_dump
+
+        configure_netstats(enabled=False)
+        path = flight_dump(str(tmp_path / "f.jsonl"), reason="off")
+        out = read_flight_dump(path)
+        assert out["net"] == {"enabled": False}
+
+
+# ------------------------------------------------- off-by-default pins
+
+class TestOffByDefaultPins:
+    def test_sections_disabled_markers(self):
+        configure_netstats(enabled=False)
+        configure_cluster(enabled=False)
+        assert netstats_section() == {"enabled": False}
+        assert cluster_section() == {"enabled": False}
+
+    def test_zero_names_when_off_fresh_subprocess(self):
+        """netstats + cluster OFF (the default) through a REAL mocknet
+        flow: bare disabled markers in the snapshot, NO net./cluster.
+        registry names, and the hot-path checks hand back None — pinned
+        in a fresh subprocess so no other test's configure_* latch can
+        mask a regression."""
+        code = """
+import json, os
+os.environ.pop("CORDA_TPU_NETSTATS", None)
+os.environ.pop("CORDA_TPU_CLUSTER", None)
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.testing import MockNetworkNodes
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.messaging.netstats import active_netstats
+from corda_tpu.observability.cluster import active_cluster
+with MockNetworkNodes() as net:
+    alice = net.create_node("OffAlice")
+    notary = net.create_notary_node("OffNotary")
+    alice.run_flow(CashIssueFlow(100, "GBP", b"\\x01", notary.party))
+snap = monitoring_snapshot()
+assert snap["net"] == {"enabled": False}, snap["net"]
+assert snap["cluster"] == {"enabled": False}, snap["cluster"]
+names = list(node_metrics().snapshot())
+assert not any(
+    n.startswith(("net.", "cluster.")) for n in names
+), names
+assert active_netstats() is None
+assert active_cluster() is None
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]

@@ -333,6 +333,7 @@ class TestPerfGate:
         step = {
             "qps": 8.0, "offered": 40, "completed": 39, "errors": 1,
             "shed": 0, "p50_s": 0.05, "p99_s": 0.4,
+            "retransmits": 0, "net_transit_p99_s": 0.0,
             "waterfall": json.loads(json.dumps(self.LOADTEST_WF)),
         }
         return {
@@ -366,6 +367,10 @@ class TestPerfGate:
                 "gc_pause", 0.1), "unknown phase"),
             (lambda d: d["steps"][0].pop("shed"),
              "missing numeric 'shed'"),
+            (lambda d: d["steps"][0].pop("retransmits"),
+             "missing numeric 'retransmits'"),
+            (lambda d: d["steps"][0].pop("net_transit_p99_s"),
+             "missing numeric 'net_transit_p99_s'"),
             (lambda d: d["steps"][0].__setitem__("errors", -1),
              "negative errors"),
             (lambda d: d.__setitem__("knee_qps", 0),
@@ -462,6 +467,44 @@ class TestPerfGate:
             broken = json.loads(json.dumps(good))
             doctor(broken["durability"])
             bad = tmp_path / "dur_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_check_schema_validates_cluster_section(self, tmp_path):
+        """ISSUE 15 satellite: the `cluster` section the smoke's
+        observatory leg emits is schema-validated — well-formed passes;
+        a missing key, fewer than 2 hops, inverted transit quantiles, a
+        rollup p99 outside the per-node envelope, and a failed per-node
+        reconciliation fail."""
+        good = dict(self.SYNTHETIC)
+        good["cluster"] = {
+            "hops": 14, "nodes": 3, "transit_p50_s": 0.002,
+            "transit_p99_s": 0.009, "federation_nodes": 3,
+            "rollup_p99_s": 0.05, "node_p99_min_s": 0.01,
+            "node_p99_max_s": 0.08, "pernode_reconcile_ok": 1,
+        }
+        ok = tmp_path / "clus.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("federation_nodes"),
+             "missing numeric 'federation_nodes'"),
+            (lambda d: d.__setitem__("hops", 1),
+             "at least twice"),
+            (lambda d: d.__setitem__("transit_p99_s", 0.001),
+             "below transit_p50_s"),
+            (lambda d: d.__setitem__("rollup_p99_s", 0.5),
+             "outside the per-node envelope"),
+            (lambda d: d.__setitem__("pernode_reconcile_ok", 0),
+             "pernode_reconcile_ok is 0"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["cluster"])
+            bad = tmp_path / "clus_bad.json"
             bad.write_text(json.dumps(broken))
             proc = self._run("--result", str(bad), "--check-schema")
             assert proc.returncode == 1, (needle, proc.stdout)
@@ -988,3 +1031,39 @@ class TestGraphs:
         assert dot.startswith("digraph") and '"notary" -> "crypto"' in dot
         # the architecture holds: no module-level import points UP the map
         assert layering_violations(edges) == []
+
+
+class TestClusterDump:
+    """ISSUE 15: `tools_cluster_dump.py` — the one-shot cluster
+    observatory CLI — runs a 3-node payment with the observatory forced
+    on and writes the assembled distributed trace + federated snapshot
+    as ONE artifact."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    def test_cli_writes_combined_artifact(self, tmp_path):
+        out = tmp_path / "CLUSTER.json"
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(self.REPO, "tools_cluster_dump.py"),
+             "--out", str(out)],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "cluster-dump: trace" in proc.stdout
+        doc = json.loads(out.read_text())
+        assert doc["schema"] == 1
+        trace = doc["trace"]
+        assert trace["trace_id"]
+        assert len(trace["nodes"]) == 3
+        assert trace["transit"]["count"] >= 2
+        assert trace["transit"]["p99_s"] >= trace["transit"]["p50_s"]
+        assert trace["critical_path"]["bound_by"] is not None
+        fed = doc["federation"]
+        assert fed["rollup"]["n_nodes"] == 3
+        # federation keys are registry names; trace nodes are the spans'
+        # X.500 identities — every member must appear in the trace
+        for name in fed["nodes"]:
+            assert any(name in node for node in trace["nodes"]), (
+                name, trace["nodes"])

@@ -1,0 +1,149 @@
+"""Cluster observatory dump CLI (docs/OBSERVABILITY.md §Cluster
+observatory).
+
+Runs one notarised payment across an in-process 3-node mock network
+with hop recording + edge telemetry + tracing forced on, assembles the
+payment's DISTRIBUTED trace (node-annotated spans, synthetic
+``net.transit`` hop spans, the cross-node critical path) and the
+federated cluster snapshot, and writes both as ONE JSON artifact:
+
+    {"schema": 1, "trace": <TraceAssembler.assemble()>,
+     "federation": <federated_snapshot()>}
+
+    python tools_cluster_dump.py                       # CLUSTER.json
+    python tools_cluster_dump.py --out /tmp/cluster.json
+
+Knobs:
+
+    --out PATH       output path (default CLUSTER.json)
+    --amount N       payment amount in GBP minor units (default 250)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+sys.path.insert(0, str(ROOT))
+
+DUMP_SCHEMA = 1
+
+
+def run_dump() -> dict:
+    """The 3-node payment demo: returns the combined artifact body."""
+    from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+    from corda_tpu.messaging.netstats import configure_netstats
+    from corda_tpu.observability import (
+        TraceAssembler,
+        configure_tracing,
+        federated_snapshot,
+    )
+    from corda_tpu.observability.cluster import configure_cluster
+    from corda_tpu.observability.flowprof import configure_flowprof
+    from corda_tpu.testing import MockNetworkNodes
+    from corda_tpu.verifier import BatchedVerifierService
+
+    configure_tracing(sample_rate=1.0)
+    configure_flowprof(enabled=True, reset=True)
+    configure_cluster(enabled=True, reset=True)
+    configure_netstats(enabled=True, reset=True)
+    try:
+        with MockNetworkNodes() as net:
+            alice = net.create_node("DumpAlice")
+            bob = net.create_node("DumpBob")
+            notary = net.create_notary_node("DumpNotary")
+            vsvc = BatchedVerifierService(use_device=False)
+            alice.services.transaction_verifier_service = vsvc
+            alice.run_flow(
+                CashIssueFlow(1000, "GBP", b"\x0c", notary.party)
+            )
+            handle = alice.smm.start_flow(
+                CashPaymentFlow(250, "GBP", bob.party)
+            )
+            handle.result.result(timeout=120)
+            # responder spans land at FINISH time and can trail the
+            # initiator's result — poll until all 3 nodes appear
+            import time
+            deadline = time.monotonic() + 15.0
+            while True:
+                trace = TraceAssembler(net).assemble(
+                    flow_id=handle.flow_id
+                )
+                if len(trace.get("nodes", ())) >= 3 \
+                        or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.05)
+            federation = federated_snapshot(net)
+            vsvc.shutdown()
+    finally:
+        configure_netstats(enabled=False, reset=True)
+        configure_cluster(enabled=False, reset=True)
+        configure_flowprof(enabled=False, reset=True)
+        configure_tracing(sample_rate=0.0)
+    return {"schema": DUMP_SCHEMA, "trace": trace,
+            "federation": federation}
+
+
+def write_dump(doc: dict, path: str) -> str:
+    """Atomic write (tmp+rename — the BASELINE/LOADTEST idiom)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default="CLUSTER.json")
+    args = ap.parse_args(argv)
+
+    doc = run_dump()
+    path = write_dump(doc, args.out)
+    trace = doc["trace"]
+    cp = trace.get("critical_path") or {}
+    bound = cp.get("bound_by") or {}
+    print(
+        "cluster-dump: trace {tid} — {nodes} nodes, {spans} spans, "
+        "{hops} hops (transit p99 {p99:.4f}s)".format(
+            tid=(trace.get("trace_id") or "?")[:16],
+            nodes=len(trace.get("nodes", ())),
+            spans=len(trace.get("spans", ())),
+            hops=trace.get("transit", {}).get("count", 0),
+            p99=trace.get("transit", {}).get("p99_s", 0.0),
+        )
+    )
+    if bound:
+        print(
+            "cluster-dump: bound by {node} {kind} {phase} "
+            "({seconds:.4f}s, {share:.0%} of end-to-end)".format(
+                node=bound.get("node"), kind=bound.get("kind"),
+                phase=bound.get("phase"),
+                seconds=bound.get("seconds", 0.0),
+                share=bound.get("share", 0.0),
+            )
+        )
+    rollup = doc["federation"].get("rollup", {})
+    print(
+        "cluster-dump: federation — {n} nodes, cluster p99 "
+        "{p99:.4f}s, unhealthy {unhealthy}; wrote {path}".format(
+            n=rollup.get("n_nodes", 0),
+            p99=rollup.get("cluster_p99_s", 0.0),
+            unhealthy=rollup.get("unhealthy_nodes", []),
+            path=path,
+        )
+    )
+    if trace.get("transit", {}).get("count", 0) < 2:
+        print("cluster-dump: WARNING — fewer than 2 hops assembled; "
+              "the trace join likely failed")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -169,9 +169,22 @@ MULTICHIP_REQUIRED_KEYS = (
 )
 
 # keys every loadtest step must carry for --check-schema (the open-loop
-# SLO-attainment pass — docs/LOAD_HARNESS.md)
+# SLO-attainment pass — docs/LOAD_HARNESS.md). The last two ride the
+# per-edge network telemetry (messaging/netstats): harness runs with the
+# toggle off still emit them as 0 / 0.0 — numeric, never absent.
 LOADTEST_STEP_REQUIRED_KEYS = (
     "qps", "offered", "completed", "errors", "shed", "p50_s", "p99_s",
+    "retransmits", "net_transit_p99_s",
+)
+
+# keys the smoke's cluster section must carry for --check-schema
+# (the cluster-observatory pass — docs/OBSERVABILITY.md §Cluster
+# observatory): assembled-trace hop census, transit quantiles, and the
+# federation rollup + reconciliation flag
+CLUSTER_REQUIRED_KEYS = (
+    "hops", "nodes", "transit_p50_s", "transit_p99_s",
+    "federation_nodes", "rollup_p99_s", "node_p99_min_s",
+    "node_p99_max_s", "pernode_reconcile_ok",
 )
 
 # the flowprof closed phase set (corda_tpu/observability/flowprof.PHASES,
@@ -559,6 +572,48 @@ def check_schema(result: dict) -> list[str]:
                             f"loadtest/knee: p99_s {kp99} below p50_s "
                             f"{kp50} (quantiles must be monotone)"
                         )
+    cluster = result.get("cluster")
+    if cluster is not None:
+        if not isinstance(cluster, dict):
+            problems.append("cluster: expected an object")
+        else:
+            def cnum(key):
+                v = cluster.get(key)
+                return v if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) else None
+
+            for key in CLUSTER_REQUIRED_KEYS:
+                if cnum(key) is None:
+                    problems.append(f"cluster: missing numeric {key!r}")
+                elif cnum(key) < 0:
+                    problems.append(f"cluster: negative {key} {cnum(key)}")
+            hops = cnum("hops")
+            if hops is not None and hops < 2:
+                problems.append(
+                    f"cluster: assembled trace has {hops:g} hops — a "
+                    "notarised payment must cross the wire at least twice"
+                )
+            tp50, tp99 = cnum("transit_p50_s"), cnum("transit_p99_s")
+            if tp50 is not None and tp99 is not None and tp99 < tp50:
+                problems.append(
+                    f"cluster: transit_p99_s {tp99} below transit_p50_s "
+                    f"{tp50} (quantiles must be monotone)"
+                )
+            lo, mid, hi = (cnum("node_p99_min_s"), cnum("rollup_p99_s"),
+                           cnum("node_p99_max_s"))
+            if (lo is not None and mid is not None and hi is not None
+                    and not (lo <= mid <= hi)):
+                problems.append(
+                    f"cluster: rollup_p99_s {mid} outside the per-node "
+                    f"envelope [{lo}, {hi}] (rollup must reconcile with "
+                    "its members)"
+                )
+            rec = cnum("pernode_reconcile_ok")
+            if rec is not None and rec != 1:
+                problems.append(
+                    f"cluster: pernode_reconcile_ok is {rec:g} (federated "
+                    "sections must equal each node's local snapshot)"
+                )
     return problems
 
 
