@@ -1770,6 +1770,46 @@ def run_smoke_loadharness() -> dict:
     }
 
 
+def run_smoke_overload() -> dict:
+    """The smoke's overload-certification leg (docs/OVERLOAD.md): the
+    three-phase metastability scenario on a fresh mocknet — baseline at
+    a modest arrival rate, a 3x storm under a partition burst + message
+    chaos with deadline propagation, retry budgets and adaptive
+    admission enabled, then recovery back at the baseline rate. Asserts
+    the four certification flags the scenario scores: goodput held above
+    the floor during the storm, recovery to ≥ 90% of baseline within
+    the wall, brownout shed BULK before INTERACTIVE, and retransmit
+    volume reconciled against the retry budget. Emits the ``overload``
+    section ``tools_perf_gate.py --check-schema`` validates."""
+    from corda_tpu.tools.loadharness import OverloadConfig, run_overload
+
+    out = run_overload(OverloadConfig(
+        base_qps=6.0,
+        overload_factor=3.0,
+        baseline_s=2.0,
+        storm_s=3.0,
+        recovery_s=20.0,
+        recovery_window_s=1.5,
+        partition_bursts=1,
+        partition_burst_s=0.6,
+        deadline_s=4.5,
+        slo_p99_s=1.5,
+        limit=24.0,
+    ))
+    sec = out["overload"]
+    for flag in ("goodput_floor_ok", "recovery_ok", "brownout_order_ok",
+                 "retry_budget_ok"):
+        assert sec.get(flag), (
+            f"overload certification failed: {flag} is false "
+            f"(goodput_ratio {sec.get('goodput_ratio')}, recovery_ratio "
+            f"{sec.get('recovery_ratio')}, rejects "
+            f"{sec.get('reject_rate_by_class')}, retransmits "
+            f"{sec.get('retransmits')} vs granted "
+            f"{sec.get('retry_budget_granted')})"
+        )
+    return out
+
+
 def run_smoke_cluster() -> dict:
     """The smoke's cluster-observatory leg (docs/OBSERVABILITY.md
     §Cluster observatory): tracing + flowprof + hop recording + edge
@@ -2025,7 +2065,15 @@ def run_smoke() -> int:
         # fault passes, with flowprof turned off again at exit.
         out.update(run_smoke_loadharness())
 
-        # 13. cluster observatory pass (docs/OBSERVABILITY.md §Cluster
+        # 13. overload certification pass (docs/OVERLOAD.md): the
+        # three-phase metastability scenario — baseline, 3x storm under
+        # a partition burst with deadline propagation / retry budgets /
+        # adaptive admission on, recovery — scored into the ``overload``
+        # section the perf gate's --check-schema validates. Runs on its
+        # own mocknet with every overload toggle restored at exit.
+        out.update(run_smoke_overload())
+
+        # 14. cluster observatory pass (docs/OBSERVABILITY.md §Cluster
         # observatory): hop recording + edge telemetry + tracing forced
         # on around one notarised payment; the assembled distributed
         # trace must carry ≥ 2 net.transit hops and a named cross-node

@@ -29,6 +29,15 @@ from .api import (
 )
 from .checkpoints import CheckpointStorage, WalCheckpointStorage
 from .engine import FlowHandle, StateMachineManager
+from .overload import (
+    FlowAdmissionError,
+    OverloadGovernor,
+    active_overload,
+    configure_overload,
+    deadline_scope,
+    overload_section,
+    remaining_deadline,
+)
 from .protocols import (
     AbstractStateReplacementFlow,
     BroadcastTransactionFlow,
@@ -59,6 +68,9 @@ __all__ = [
     "CheckpointStorage",
     "WalCheckpointStorage",
     "FlowHandle", "StateMachineManager",
+    "FlowAdmissionError", "OverloadGovernor", "active_overload",
+    "configure_overload", "deadline_scope", "overload_section",
+    "remaining_deadline",
     "AbstractStateReplacementFlow", "BroadcastTransactionFlow",
     "CollectSignaturesFlow", "ContractUpgradeFlow", "FetchRequest",
     "FinalityFlow", "NotaryChangeFlow", "NotaryException",

@@ -202,6 +202,18 @@ class FlowLogic:
         FlowLogic.waitForLedgerCommit)."""
         return self._executor.op_wait_ledger_commit(tx_id)
 
+    def commit_pin(self) -> None:
+        """Mark this flow's point of no return (docs/OVERLOAD.md): a
+        durable side effect is about to happen (or may already have
+        happened) on another node — notarisation is the canonical case —
+        so an end-to-end deadline must no longer abandon the flow.
+        Abandoning between the notary's commit and the local vault
+        record poisons the spent states: the vault re-selects them and
+        every later spend double-spends forever. From the pin on, the
+        deadline sheds only at admission/queue doors ahead of the
+        commit; the flow itself runs to completion."""
+        self._executor.op_commit_pin()
+
     # ------------------------------------------------------------ metadata
     @property
     def flow_id(self) -> str:

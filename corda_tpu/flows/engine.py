@@ -68,6 +68,11 @@ from .api import (
     responder_for,
 )
 from .checkpoints import CheckpointStorage
+from .overload import (
+    FlowAdmissionError,
+    active_overload,
+    deadline_scope,
+)
 from .sessions import (
     SESSION_TOPIC,
     SessionAck,
@@ -125,15 +130,33 @@ def _sid_for(flow_id: str, op_index: int) -> int:
 
 class _SessionState:
     __slots__ = ("local_sid", "peer", "peer_sid", "inbound", "executor",
-                 "rejected")
+                 "rejected", "seq_out", "seq_enqueued", "seq_pending",
+                 "gap_since", "gap_timer_armed")
 
     def __init__(self, local_sid: int, peer: Party, executor):
         self.local_sid = local_sid
         self.peer = peer
         self.peer_sid: int | None = None
-        self.inbound: deque = deque()  # ("data"|"end", payload/error, msg_id, ack)
+        # ("data"|"end", payload/error, msg_id, ack, seq)
+        self.inbound: deque = deque()
         self.executor = executor
         self.rejected: str | None = None
+        # per-session ordered delivery (see SessionData.seq): outbound
+        # messages are stamped 1, 2, ... from seq_out; inbound sequenced
+        # messages move pending → inbound only in seq order, so a
+        # delayed Data can never be overtaken by a later Data or the
+        # End — the gap parks in seq_pending until the retransmit fills
+        # it. Counters are restored from the oplog on crash replay
+        # (op_send / op_receive records carry the seq). gap_since /
+        # gap_timer_armed drive the liveness backstop: a gap older than
+        # the session retry deadline can never fill (the sender gave
+        # up), so _gap_check force-drains it rather than park the
+        # receiving flow forever.
+        self.seq_out: int = 0
+        self.seq_enqueued: int = 0
+        self.seq_pending: dict[int, tuple] = {}
+        self.gap_since: float | None = None
+        self.gap_timer_armed: bool = False
 
 
 class _Retrans:
@@ -186,6 +209,13 @@ class _FlowExecutor:
         # park/replay, closed in flow_finished
         fp = active_flowprof()
         self.prof_acct = fp.acct_of(flow_id) if fp is not None else None
+        # propagated end-to-end deadline (docs/OVERLOAD.md): absolute
+        # wall-clock epoch by which the caller stops caring, or None.
+        # Set by start_flow (initiator), _handle_init (responder, off the
+        # SessionInit wire field) and _rebuild (from checkpoint meta, so
+        # the deadline survives park/replay and crash restore); bound as
+        # the thread's deadline scope for every execution segment
+        self.deadline_t: float | None = None
 
     # ------------------------------------------------------------ op core
     def _do_op(self, effect, replay=None):
@@ -215,6 +245,24 @@ class _FlowExecutor:
         )
         return self._do_op(lambda idx: fn(), replay)
 
+    def op_commit_pin(self) -> None:
+        """Recorded op marking the flow's point of no return
+        (FlowLogic.commit_pin): from here the propagated deadline stops
+        shedding this flow (resume-time shed and retransmit-entry kill
+        both check the pin). Recorded so crash restore re-establishes
+        the pin from the oplog before any replay decision."""
+        def effect(idx):
+            self.smm._commit_pinned.add(self.flow_id)
+            return {"commit_pin": True}
+
+        self._do_op(
+            effect,
+            replay=lambda idx, rec: self.smm._commit_pinned.add(self.flow_id),
+        )
+
+    def _pinned(self) -> bool:
+        return self.flow_id in self.smm._commit_pinned
+
     def op_sleep(self, seconds: float) -> None:
         rec = self._do_op(lambda idx: {"deadline": time.time() + seconds})
         remaining = rec["deadline"] - time.time()
@@ -233,47 +281,94 @@ class _FlowExecutor:
             # and re-publishes under the same deterministic msg id, which
             # the recipient's consumed-set dedupes. A *recorded* send was
             # durably enqueued, so replay never re-sends.
-            self._send_data(local_sid, payload, idx)
-            return {"i": idx}
+            seq = self._send_data(local_sid, payload, idx)
+            return {"i": idx, "seq": seq}
 
-        self._do_op(effect)
+        def replay(idx, rec):
+            # replay never re-sends, so the session's outbound sequence
+            # counter must be restored from the record — the next LIVE
+            # send (and the finish-time End) continue the numbering the
+            # peer has already seen
+            seq = rec.get("seq", 0)
+            if seq:
+                sess = self.smm.session(local_sid)
+                if seq > sess.seq_out:
+                    sess.seq_out = seq
+
+        self._do_op(effect, replay)
 
     def _retry_deadline_s(self) -> float | None:
         """Deadline propagation: a flow declaring ``retry_deadline_s``
         bounds every retransmit window it opens (sessions inherit the
-        flow's budget); otherwise the SMM policy default applies."""
+        flow's budget); otherwise the SMM policy default applies. An
+        end-to-end deadline tightens either further — retransmitting a
+        message whose flow is already dead is pure storm fuel."""
+        rem = None
+        if self.deadline_t is not None and not self._pinned():
+            # floor keeps the entry alive long enough for the deadline
+            # pop to fail the session cleanly rather than instantly
+            rem = max(0.05, self.deadline_t - time.time())
         flow_budget = getattr(self.flow, "retry_deadline_s", None)
         if flow_budget is None or self.smm._retry_policy is None:
-            return None
-        return min(flow_budget, self.smm._retry_policy.deadline_s)
+            return rem
+        out = min(flow_budget, self.smm._retry_policy.deadline_s)
+        return out if rem is None else min(out, rem)
 
-    def _send_data(self, local_sid: int, payload: bytes, idx: int):
+    def _send_data(self, local_sid: int, payload: bytes, idx: int) -> int:
         sess = self.smm.session(local_sid)
         if sess.peer_sid is None:
             raise FlowException("session not confirmed")
+        sess.seq_out += 1
         self.smm.send_to(
-            sess.peer, SessionData(sess.peer_sid, payload),
+            sess.peer, SessionData(sess.peer_sid, payload, sess.seq_out),
             msg_id=f"{self.flow_id}:op{idx}",
             track_kind="data", track_sid=local_sid,
             deadline_s=self._retry_deadline_s(),
         )
+        return sess.seq_out
 
     def op_receive(self, local_sid: int):
         def effect(idx):
             sess = self.smm.session(local_sid)
-            self.smm.wait_or_killed(
+            # deadline-aware wait: an unpinned flow whose end-to-end
+            # deadline expires while it waits must shed, not hang — with
+            # ordered delivery a permanently-lost message parks the
+            # session (the End waits behind the gap too), so the wake
+            # can no longer rely on SOMETHING eventually arriving. The
+            # park path wakes via the sleeper timer and replays through
+            # _run_body's shed; the on-thread path returns None here.
+            dl = (self.deadline_t
+                  if self.deadline_t is not None and not self._pinned()
+                  else None)
+            got = self.smm.wait_or_killed(
                 lambda: sess.inbound[0] if sess.inbound else None,
+                timeout=(None if dl is None
+                         else max(0.0, dl - time.time())),
                 executor=self, park_key=("sid", local_sid),
+                sleep_deadline=dl,
             )
+            if got is None:
+                ov = active_overload()
+                if ov is not None:
+                    ov.note_deadline_shed(
+                        str(getattr(self.flow, "priority", "service"))
+                        if self.flow is not None else "service"
+                    )
+                raise FlowException("flow deadline exceeded")
             # pop + mark-consumed atomically: a retransmit landing between
             # the two would pass both dedupe checks (not buffered, not yet
             # consumed) and be re-buffered — a later receive would then
             # consume the stale duplicate as its own message
-            kind, body, msg_id, ack = self.smm.consume_inbound(sess)
+            kind, body, msg_id, ack, seq = self.smm.consume_inbound(sess)
             if kind == "end":
                 rec = {"end": body if body else "peer ended session"}
             else:
                 rec = {"payload": body, "msg_id": msg_id}
+            if seq:
+                # persisted so crash replay can restore the session's
+                # delivery cursor (seq omitted when 0 — pre-sequencing
+                # checkpoints keep their exact record shape)
+                rec["seq"] = seq
             # record BEFORE ack: consumed-and-durable, then delete from queue
             with flowprof_frame("checkpoint"):
                 self.smm.checkpoints.record_op(self.flow_id, idx, rec)
@@ -289,6 +384,14 @@ class _FlowExecutor:
         self.op_counter += 1
         if idx < len(self.oplog):
             rec = self.oplog[idx]
+            seq = rec.get("seq", 0)
+            if seq:
+                # replayed receive: advance the delivery cursor so a NEW
+                # message arriving post-restore (seq = cursor + 1) drains
+                # instead of parking behind seqs consumed pre-crash
+                sess = self.smm.session(local_sid)
+                if seq > sess.seq_enqueued:
+                    sess.seq_enqueued = seq
         else:
             rec = effect(idx)
             # effect already recorded (pre-ack); skip double record
@@ -304,7 +407,8 @@ class _FlowExecutor:
             self.smm.send_to(
                 party,
                 SessionInit(sid, class_path(type(flow)), b"",
-                            trace=self.trace_span.wire()),
+                            trace=self.trace_span.wire(),
+                            deadline=self.deadline_t or 0.0),
                 msg_id=f"{self.flow_id}:op{idx}",
                 track_kind="init", track_sid=sid,
                 deadline_s=self._retry_deadline_s(),
@@ -356,16 +460,26 @@ class _FlowExecutor:
     def op_end_session(self, local_sid: int, error: str) -> None:
         def effect(idx):
             sess = self.smm.session(local_sid)
+            seq = 0
             if sess.peer_sid is not None:
+                sess.seq_out += 1
+                seq = sess.seq_out
                 self.smm.send_to(
-                    sess.peer, SessionEnd(sess.peer_sid, error),
+                    sess.peer, SessionEnd(sess.peer_sid, error, seq),
                     msg_id=f"{self.flow_id}:op{idx}",
                     track_kind="data", track_sid=local_sid,
                     deadline_s=self._retry_deadline_s(),
                 )
-            return {"i": idx}
+            return {"i": idx, "seq": seq}
 
-        self._do_op(effect)
+        def replay(idx, rec):
+            seq = rec.get("seq", 0)
+            if seq:
+                sess = self.smm.session(local_sid)
+                if seq > sess.seq_out:
+                    sess.seq_out = seq
+
+        self._do_op(effect, replay)
 
     def op_wait_ledger_commit(self, tx_id):
         def effect(idx):
@@ -382,6 +496,15 @@ class _FlowExecutor:
     def run_once(self) -> str:
         """Execute on the calling worker thread until the flow finishes,
         parks, or dies → "finished" | "parked"."""
+        if self.deadline_t is not None:
+            # bind the propagated deadline for this execution segment so
+            # every downstream submit on this thread (serving scheduler,
+            # notary request, consensus client) sheds already-dead work
+            with deadline_scope(self.deadline_t):
+                return self._run_acct()
+        return self._run_acct()
+
+    def _run_acct(self) -> str:
         acct = self.prof_acct
         if acct is not None:
             fp = active_flowprof()
@@ -405,6 +528,21 @@ class _FlowExecutor:
 
     def _run_body(self) -> str:
         try:
+            if (self.deadline_t is not None
+                    and time.time() >= self.deadline_t
+                    and not self._pinned()):
+                # the caller already gave up: fail here, before any
+                # verify/notary work — goodput, not throughput. The
+                # deadline itself (not the governor) is the opt-in, so a
+                # propagated deadline sheds even with overload off; the
+                # governor only adds counting + SLO observation.
+                ov = active_overload()
+                if ov is not None:
+                    ov.note_deadline_shed(
+                        str(getattr(self.flow, "priority", "service"))
+                        if self.flow is not None else "service"
+                    )
+                raise FlowException("flow deadline exceeded")
             if self.responder_cls is not None:
                 session = self.op_accept_session()
                 self.flow = self.responder_cls(session)
@@ -441,8 +579,16 @@ class _FlowExecutor:
             try:
                 sess = self.smm.session(sid)
                 if sess.peer_sid is not None:
+                    # sequenced AFTER every data this flow sent on the
+                    # session: the peer defers the End until the data
+                    # has arrived (retransmits fill any gap), so an End
+                    # racing a delayed payload can no longer kill the
+                    # peer's receive. Deterministic across crash-replay:
+                    # seq_out is restored from the replayed send records.
                     self.smm.send_to(
-                        sess.peer, SessionEnd(sess.peer_sid, error_msg),
+                        sess.peer,
+                        SessionEnd(sess.peer_sid, error_msg,
+                                   sess.seq_out + 1),
                         msg_id=f"{self.flow_id}:end{sid}",
                         track_kind="data", track_sid=sid,
                         deadline_s=self._retry_deadline_s(),
@@ -543,6 +689,10 @@ class StateMachineManager:
         # (and pruned) in flow_finished / _fail_unrunnable
         self._flow_spans: dict[str, object] = {}
         self._killed_ids: set[str] = set()
+        # flows past their point of no return (FlowLogic.commit_pin) —
+        # exempt from deadline sheds; survives park/replay in memory and
+        # crash restore via the oplog marker (pruned with the flow)
+        self._commit_pinned: set[str] = set()
         self._workers: list[threading.Thread] = []
         self._timer: threading.Thread | None = None
         messaging.add_handler(SESSION_TOPIC, self._on_message)
@@ -589,7 +739,20 @@ class StateMachineManager:
             span.finish()
 
     # ------------------------------------------------------------ public
-    def start_flow(self, flow: FlowLogic, flow_id: str | None = None) -> FlowHandle:
+    def start_flow(self, flow: FlowLogic, flow_id: str | None = None,
+                   deadline_s: float | None = None) -> FlowHandle:
+        # adaptive admission (docs/OVERLOAD.md) gates FIRST: a rejection
+        # must cost the caller one exception — no span, no flowprof
+        # account, and above all no checkpoint write
+        priority = str(getattr(flow, "priority", "service"))
+        ov = active_overload()
+        if ov is not None:
+            if not ov.try_admit(priority):
+                raise FlowAdmissionError(
+                    f"flow admission rejected ({priority}): node over "
+                    "concurrency limit"
+                )
+        deadline_t = time.time() + deadline_s if deadline_s is not None else None
         flow_id = flow_id or secrets.token_hex(16)
         self._open_flow_span(flow_id, class_path(type(flow)))
         fp = active_flowprof()
@@ -599,11 +762,29 @@ class StateMachineManager:
             "cls": class_path(type(flow)),
             "fields": flow.flow_fields(),
             "responder": False,
+            # omitted when unset: checkpoints of deadline-less flows (and
+            # all pre-overload checkpoints) keep their exact byte shape
+            **({"deadline": deadline_t} if deadline_t else {}),
         })
         self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
                                   time.time())
         fut: Future = Future()
+        if ov is not None:
+            t0 = time.monotonic()
+
+            def _release(f, _ov=ov, _p=priority, _t0=t0):
+                try:
+                    err = f.exception() is not None
+                except Exception:
+                    err = True  # cancelled future (shutdown)
+                _ov.release(_p, time.monotonic() - _t0, error=err)
+
+            # the future outlives this executor across park/replay, so
+            # one done-callback frees the admission slot exactly once
+            # however many executors the flow burns through
+            fut.add_done_callback(_release)
         ex = _FlowExecutor(self, flow_id, [], flow, result=fut)
+        ex.deadline_t = deadline_t
         with self._lock:
             self._flows[flow_id] = ex
             self._results[flow_id] = fut
@@ -637,6 +818,11 @@ class StateMachineManager:
             for rec in oplog:
                 if isinstance(rec, dict) and "msg_id" in rec:
                     self._consumed_msg_ids.add(rec["msg_id"])
+                # re-establish the point-of-no-return pin BEFORE any
+                # resume-time deadline decision (crash restore loses the
+                # in-memory set; the shed check runs ahead of replay)
+                if isinstance(rec, dict) and rec.get("commit_pin"):
+                    self._commit_pinned.add(flow_id)
         cls = load_class(meta["cls"])
         with self._lock:
             fut = self._results.setdefault(flow_id, Future())
@@ -646,6 +832,8 @@ class StateMachineManager:
         else:
             flow = cls.from_flow_fields(meta["fields"])
             ex = _FlowExecutor(self, flow_id, oplog, flow, result=fut)
+        # .get: pre-overload checkpoints carry no deadline and decode fine
+        ex.deadline_t = meta.get("deadline")
         with self._lock:
             ex.killed = flow_id in self._killed_ids
             self._flows[flow_id] = ex
@@ -731,6 +919,7 @@ class StateMachineManager:
             self._park_key_of.pop(flow_id, None)
             self._sleepers.pop(flow_id, None)
             self._killed_ids.discard(flow_id)
+            self._commit_pinned.discard(flow_id)
         if fut is not None and not fut.done():
             try:
                 fut.set_exception(
@@ -964,6 +1153,11 @@ class StateMachineManager:
     def _track_unacked(self, party_name: str, payload: bytes, base_id: str,
                        kind: str, sid: int, deadline_s: float | None) -> None:
         policy = self._retry_policy
+        ov = active_overload()
+        if ov is not None:
+            # a FRESH tracked send earns retry-budget tokens for this
+            # peer edge (outside the SMM lock — the governor locks itself)
+            ov.note_send("session", party_name)
         entry = _Retrans(
             base_id, party_name, payload, kind, sid, policy, self._retx_rng,
             deadline_s if deadline_s is not None else policy.deadline_s,
@@ -980,6 +1174,12 @@ class StateMachineManager:
 
         def loop():
             while True:
+                # governor prep OUTSIDE the SMM lock: sync_net_events
+                # walks the netstats event ring under netstats' own lock
+                ov = active_overload()
+                if ov is not None:
+                    ov.sync_net_events()
+                our = str(self.our_identity.name)
                 with self._lock:
                     if self._closed or not self._unacked:
                         self._retx_timer = None
@@ -999,11 +1199,39 @@ class StateMachineManager:
                             )
                             continue
                         if e.next_at <= now:
+                            if ov is not None and not ov.allow_retry(
+                                    "session", e.party_name):
+                                # retry budget exhausted for this edge:
+                                # hold one backoff without sending — the
+                                # entry's hard deadline still bounds the
+                                # total wait, and fresh sends refill
+                                e.next_at = now + e.backoff_s
+                                continue
                             e.attempt += 1
-                            e.backoff_s = self._retry_policy.backoff_s(
+                            backoff = self._retry_policy.backoff_s(
                                 e.attempt, self._retx_rng
                             )
-                            e.next_at = now + e.backoff_s
+                            if ov is not None and ov.edge_suspected(
+                                    our, e.party_name):
+                                # partition suspected on this edge (PR
+                                # 15's net.partition_suspect): widen
+                                # pre-emptively so the heal meets a
+                                # drained backoff, not a storm
+                                backoff *= ov.suspect_backoff_scale
+                            e.backoff_s = backoff
+                            if e.attempt >= 2:
+                                # FULL jitter over the whole backoff, not
+                                # the policy's ±fraction: after a long
+                                # outage every parked entry reaches
+                                # next_at in the same tick, and fractional
+                                # jitter re-releases them as one N-wide
+                                # burst. Attempt 1 keeps the policy
+                                # cadence (first-retransmit latency).
+                                e.next_at = now + self._retx_rng.uniform(
+                                    0.0, backoff
+                                )
+                            else:
+                                e.next_at = now + backoff
                             resend.append((
                                 e.party_name, e.payload,
                                 f"{e.base_id}~{e.attempt}",
@@ -1045,7 +1273,7 @@ class StateMachineManager:
         if kind == "init":
             sess.rejected = error   # open_session waits on rejected/confirm
         else:
-            sess.inbound.append(("end", error, "", None))
+            sess.inbound.append(("end", error, "", None, 0))
         self._wake_key_locked(("sid", sid))
         self._lock.notify_all()
 
@@ -1135,6 +1363,7 @@ class StateMachineManager:
             self._flows.pop(ex.flow_id, None)
             self._results.pop(ex.flow_id, None)
             self._killed_ids.discard(ex.flow_id)
+            self._commit_pinned.discard(ex.flow_id)
             self._park_key_of.pop(ex.flow_id, None)
             self._sleepers.pop(ex.flow_id, None)
             for sid in ex.sessions:
@@ -1195,13 +1424,13 @@ class StateMachineManager:
                 ack()
         elif isinstance(obj, SessionData):
             self._buffer(obj.recipient_session_id, "data", obj.payload,
-                         logical, ack, msg.sender)
+                         logical, ack, msg.sender, obj.seq)
         elif isinstance(obj, SessionEnd):
             self._buffer(obj.recipient_session_id, "end", obj.error,
-                         logical, ack, msg.sender)
+                         logical, ack, msg.sender, obj.seq)
 
     def _buffer(self, sid: int, kind: str, body, msg_id: str, ack,
-                sender: str = "") -> None:
+                sender: str = "", seq: int = 0) -> None:
         ack_peer = None
         transport_ack = False
         with self._lock:
@@ -1229,15 +1458,25 @@ class StateMachineManager:
                 ack_peer = sess.peer
                 transport_ack = True
                 sess = None  # handled: fall through to the ack block
-            elif any(q[2] == msg_id for q in sess.inbound if q[2]):
+            elif any(q[2] == msg_id for q in sess.inbound if q[2]) or any(
+                    q[2] == msg_id
+                    for q in sess.seq_pending.values() if q[2]):
                 # retransmit already buffered but not yet consumed: settle
                 # this duplicate's transport lease (the buffered original's
                 # own ack + session retransmit carry the delivery guarantee)
                 transport_ack = True
                 sess = None
+            elif seq and seq <= sess.seq_enqueued:
+                # a sequence position already delivered under another wire
+                # id: nothing left to deliver, settle the transport lease
+                # (the sender's own retransmit/deadline settles its entry)
+                transport_ack = True
+                sess = None
             else:
-                sess.inbound.append((kind, body, msg_id, ack))
                 if msg_id:
+                    # transit telemetry at ARRIVAL — a message parked in
+                    # seq_pending has finished its network leg even though
+                    # delivery to the flow waits for the gap to fill
                     fp = active_flowprof()
                     if fp is not None:
                         ex = sess.executor
@@ -1255,6 +1494,44 @@ class StateMachineManager:
                             str(self.our_identity.name), sender, msg_id,
                             span.trace_id if span is not None else "",
                         )
+                entry = (kind, body, msg_id, ack, seq)
+                if seq and seq > sess.seq_enqueued + 1:
+                    # out of order: a lower-seq message is still in flight
+                    # (dropped → retransmitting, or delayed). Park until
+                    # the gap fills — delivering now would let this
+                    # message (or the End) overtake the one the flow's
+                    # next receive actually needs.
+                    sess.seq_pending[seq] = entry
+                    if sess.gap_since is None:
+                        sess.gap_since = time.monotonic()
+                    if not sess.gap_timer_armed:
+                        # liveness backstop: if the gap never fills (the
+                        # sender hit its retry deadline and gave up), a
+                        # timer force-drains rather than park the
+                        # receiving flow forever. Transient thread, only
+                        # when reordering actually occurred — clean runs
+                        # create no threads (the off-by-default pin).
+                        sess.gap_timer_armed = True
+                        t = threading.Timer(
+                            self._gap_limit_s(), self._gap_check,
+                            args=(sid,))
+                        t.daemon = True
+                        t.name = f"flow-session-gap-{sid}"
+                        t.start()
+                else:
+                    sess.inbound.append(entry)
+                    if seq:
+                        sess.seq_enqueued = seq
+                    # drain consecutive parked successors
+                    nxt = sess.seq_enqueued + 1
+                    while nxt in sess.seq_pending:
+                        sess.inbound.append(sess.seq_pending.pop(nxt))
+                        sess.seq_enqueued = nxt
+                        nxt += 1
+                    # the front gap just moved: clear the backstop clock,
+                    # or restart it for the next gap in line
+                    sess.gap_since = (None if not sess.seq_pending
+                                      else time.monotonic())
                 self._wake_key_locked(("sid", sid))
                 self._lock.notify_all()
                 return
@@ -1262,6 +1539,53 @@ class StateMachineManager:
             self.ack_session_msg(ack_peer, msg_id)
         if transport_ack and ack:
             ack()
+
+    def _gap_limit_s(self) -> float:
+        """How long a sequence gap may park deliveries before the
+        backstop concludes the missing message is never coming: the
+        session retry deadline — past it the sender has failed the
+        session on its side, so no retransmit can still be in flight."""
+        if self._retry_policy is not None:
+            return self._retry_policy.deadline_s
+        return 60.0
+
+    def _gap_check(self, sid: int) -> None:
+        """Timer body for the sequencing liveness backstop (armed in
+        _buffer when a message parks behind a gap). If the front gap is
+        older than _gap_limit_s, force-drain seq_pending in sequence
+        order: the flow then observes the loss as a protocol error /
+        peer-end instead of parking forever — exactly the
+        pre-sequencing failure mode, minus the reorder window. A late
+        retransmit of the gap seq then lands `seq <= seq_enqueued` and
+        is settled as a stale position."""
+        rearm: float | None = None
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None or self._closed:
+                return
+            if not sess.seq_pending:
+                sess.gap_timer_armed = False
+                sess.gap_since = None
+                return
+            now = time.monotonic()
+            started = sess.gap_since if sess.gap_since is not None else now
+            if now - started >= self._gap_limit_s() - 0.05:
+                for s in sorted(sess.seq_pending):
+                    sess.inbound.append(sess.seq_pending.pop(s))
+                    sess.seq_enqueued = max(sess.seq_enqueued, s)
+                sess.gap_since = None
+                sess.gap_timer_armed = False
+                self._wake_key_locked(("sid", sid))
+                self._lock.notify_all()
+            else:
+                # gap moved (partial drain) since the timer was armed:
+                # check again when the current front gap would expire
+                rearm = max(0.1, self._gap_limit_s() - (now - started))
+        if rearm is not None:
+            t = threading.Timer(rearm, self._gap_check, args=(sid,))
+            t.daemon = True
+            t.name = f"flow-session-gap-{sid}"
+            t.start()
 
     def _handle_init(self, msg, init: SessionInit, ack) -> None:
         logical = _logical_id(msg.msg_id)
@@ -1337,6 +1661,24 @@ class StateMachineManager:
             if ack:
                 ack()
             return
+        if init.deadline and time.time() >= init.deadline:
+            # the initiator's caller already gave up: reject before
+            # spawning a responder that would burn verify/notary work on
+            # a dead flow (docs/OVERLOAD.md). Marked like any rejection
+            # so a retransmitted Init repeats the verdict.
+            reason = "flow deadline exceeded before responder start"
+            self.checkpoints.mark_init_rejected(logical, reason)
+            ov = active_overload()
+            if ov is not None:
+                ov.note_deadline_shed()
+            self.messaging.send(
+                msg.sender, SESSION_TOPIC,
+                serialize(SessionReject(init.initiator_session_id, reason)),
+                msg_id=f"reject-{msg.msg_id}",
+            )
+            if ack:
+                ack()
+            return
         self._open_flow_span(flow_id, class_path(responder),
                              responder=True, parent_wire=init.trace)
         cl = active_cluster()
@@ -1354,6 +1696,10 @@ class StateMachineManager:
             "cls": class_path(responder),
             "fields": {},
             "responder": True,
+            # the propagated deadline survives responder park/replay and
+            # crash restore exactly like the initiator's (omitted when
+            # unset — pre-overload checkpoint shape unchanged)
+            **({"deadline": init.deadline} if init.deadline else {}),
         })
         self.checkpoints.add_flow(flow_id, blob, str(self.our_identity.name),
                                   time.time())
@@ -1364,6 +1710,7 @@ class StateMachineManager:
                        "first": init.first_payload},
             result=fut,
         )
+        ex.deadline_t = init.deadline or None
         with self._lock:
             self._flows[flow_id] = ex
             self._results[flow_id] = fut

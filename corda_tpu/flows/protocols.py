@@ -342,6 +342,13 @@ class NotaryFlowClient(FlowLogic):
         # flowprof park hint: every wait this request/response exchange
         # parks or blocks on books to notary_rtt — the notarisation
         # round-trip is the one counterparty wait with a name
+        # point of no return: once the request may have reached the
+        # notary, a deadline shed would abandon a possibly-committed
+        # spend before the vault records it — the inputs would be
+        # re-selected and double-spend forever. The deadline still sheds
+        # at the notary's own admission door (front-door + batch-window
+        # shed); this flow now runs to completion.
+        self.commit_pin()
         with flowprof_hint("notary_rtt"):
             session = self.initiate_flow(notary)
             validating = self.services.network_map_cache.is_validating_notary(
@@ -404,6 +411,7 @@ class NotaryServiceFlow(FlowLogic):
                 ftx = self.session.receive(FilteredTransaction).unwrap(
                     lambda f: f
                 )
+                self.commit_pin()  # process() commits synchronously
                 sig = self.record(lambda: service.process(ftx, caller))
             elif isinstance(service, BatchedNotaryService):
                 # the service re-verifies signatures+contracts itself, so
@@ -412,14 +420,22 @@ class NotaryServiceFlow(FlowLogic):
                     self.session, check_signatures=False,
                     check_contracts=False,
                 ))
+                # the propagated deadline sheds at the service's front
+                # door (before the request joins a batch); once enqueued
+                # the batch may commit, so this responder is past its
+                # point of no return — it must wait the request out and
+                # deliver the verdict rather than abandon a committed
+                # spend (docs/OVERLOAD.md)
+                self.commit_pin()
                 sig = self.record(lambda: service.request(
                     stx, self.services.load_state, caller
-                ).result(timeout=60))
+                ).result(timeout=60.0))
             elif isinstance(service, ValidatingNotaryService):
                 stx = self.sub_flow(ReceiveTransactionFlow(
                     self.session, check_signatures=False,
                     check_contracts=False,
                 ))
+                self.commit_pin()  # process() commits synchronously
                 sig = self.record(lambda: service.process(
                     stx, self.services.load_state, caller
                 ))

@@ -28,6 +28,13 @@ class SessionInit:
     # Init only: Data/End ride an established session whose responder
     # already joined the trace.
     trace: str = ""
+    # end-to-end deadline propagation (docs/OVERLOAD.md): absolute
+    # wall-clock epoch seconds by which the initiating flow's caller
+    # stops caring, 0.0 when none. Wall-clock on purpose — the deadline
+    # crosses nodes, and monotonic clocks do not travel. The responder
+    # binds it so ITS downstream submits (serving, notary) shed work
+    # that is already dead. Carried on Init only, like trace.
+    deadline: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -46,12 +53,22 @@ class SessionReject:
 class SessionData:
     recipient_session_id: int
     payload: bytes
+    # per-session delivery order (docs/OVERLOAD.md): 1-based position of
+    # this message among everything the peer flow sent on this session,
+    # 0 = unsequenced (pre-sequencing peer). The receiver delivers
+    # sequenced messages strictly in order, parking gaps until the
+    # retransmit fills them — without it, a delayed/dropped Data can be
+    # overtaken by a later Data (protocol desync) or by the SessionEnd
+    # (the flow dies on "peer ended session" while the payload it needed
+    # is still in flight — fatal after a notary commit).
+    seq: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
 class SessionEnd:
     recipient_session_id: int
     error: str                # "" = normal end
+    seq: int = 0              # ordered after every Data (see SessionData)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -69,13 +86,18 @@ class SessionAck:
 
 register_custom(
     SessionInit, "flows.SessionInit",
+    # deadline is omitted when unset so flows without one (and nodes
+    # with overload protection off) put zero extra bytes on the wire
     to_fields=lambda m: {
         "sid": m.initiator_session_id, "flow": m.flow_name,
         "first": m.first_payload, "trace": m.trace,
+        **({"deadline": m.deadline} if m.deadline else {}),
     },
-    # .get: Inits serialized before the trace field existed decode fine
+    # .get: Inits serialized before the trace/deadline fields existed
+    # decode fine
     from_fields=lambda d: SessionInit(
-        d["sid"], d["flow"], d["first"], d.get("trace", "")
+        d["sid"], d["flow"], d["first"], d.get("trace", ""),
+        d.get("deadline", 0.0),
     ),
 )
 register_custom(
@@ -92,13 +114,24 @@ register_custom(
 )
 register_custom(
     SessionData, "flows.SessionData",
-    to_fields=lambda m: {"sid": m.recipient_session_id, "payload": m.payload},
-    from_fields=lambda d: SessionData(d["sid"], d["payload"]),
+    # seq omitted when 0 so unsequenced senders (and pre-sequencing
+    # captures) keep their exact byte shape; .get on decode for the
+    # same reason
+    to_fields=lambda m: {
+        "sid": m.recipient_session_id, "payload": m.payload,
+        **({"seq": m.seq} if m.seq else {}),
+    },
+    from_fields=lambda d: SessionData(
+        d["sid"], d["payload"], d.get("seq", 0)
+    ),
 )
 register_custom(
     SessionEnd, "flows.SessionEnd",
-    to_fields=lambda m: {"sid": m.recipient_session_id, "error": m.error},
-    from_fields=lambda d: SessionEnd(d["sid"], d["error"]),
+    to_fields=lambda m: {
+        "sid": m.recipient_session_id, "error": m.error,
+        **({"seq": m.seq} if m.seq else {}),
+    },
+    from_fields=lambda d: SessionEnd(d["sid"], d["error"], d.get("seq", 0)),
 )
 register_custom(
     SessionAck, "flows.SessionAck",

@@ -306,10 +306,13 @@ def monitoring_snapshot() -> dict:
     (messaging/netstats — delivery/transit/retransmit counts and
     partition-suspect state, ``{"enabled": false}`` while off),
     ``cluster`` the cross-node hop recorder's status
-    (observability/cluster, same off-marker contract), ``process`` the
+    (observability/cluster, same off-marker contract), ``overload`` the
+    overload governor's admission/retry-budget/deadline-shed state
+    (flows/overload — ``{"enabled": false}`` while off), ``process`` the
     remaining cross-cutting metrics (e.g. the verifier's
     ``device_failover`` counters)."""
     from corda_tpu.durability import durability_section
+    from corda_tpu.flows.overload import overload_section
     from corda_tpu.messaging.netstats import netstats_section
     from corda_tpu.observability.cluster import cluster_section
     from corda_tpu.observability.devicemon import devices_section
@@ -329,6 +332,7 @@ def monitoring_snapshot() -> dict:
         "sampler": sampler_section(),
         "net": netstats_section(),
         "cluster": cluster_section(),
+        "overload": overload_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
@@ -338,6 +342,9 @@ def monitoring_snapshot() -> dict:
                     or k.startswith("flowprof.")
                     or k.startswith("sampler.")
                     or k.startswith("net.")
-                    or k.startswith("cluster."))
+                    or k.startswith("cluster.")
+                    or k.startswith("overload.")
+                    or k.startswith("retry_budget.")
+                    or k.startswith("admission."))
         },
     }

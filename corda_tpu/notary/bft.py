@@ -755,6 +755,13 @@ class BFTClusterClient:
         with self._lock:
             self._futures[d] = fut
         payload = serialize({"command": command, "client": self.name})
+        from corda_tpu.flows.overload import active_overload
+
+        ov = active_overload()
+        if ov is not None:
+            # the whole-cluster broadcast is ONE fresh send for budget
+            # purposes: re-broadcasts below spend against it
+            ov.note_send("bft.submit", self.name)
         for r in self._replicas:
             self._messaging.send(r, T_REQUEST, payload)
         client = self
@@ -765,7 +772,20 @@ class BFTClusterClient:
                 # pipelined caller may dwell several windows between
                 # dispatch and collect, and that dwell must not consume
                 # the timeout (the slot has been replicating meanwhile)
-                deadline = time.monotonic() + client._timeout_s
+                from corda_tpu.flows.overload import (
+                    active_overload,
+                    remaining_deadline,
+                )
+
+                budget = client._timeout_s
+                rem = remaining_deadline()
+                if rem is not None:
+                    # propagated end-to-end deadline bounds the quorum
+                    # wait: a round for a dead flow is not worth waiting
+                    # out the full view timeout (docs/OVERLOAD.md)
+                    budget = min(budget, max(0.05, rem))
+                deadline = time.monotonic() + budget
+                ov = active_overload()
                 try:
                     while True:
                         try:
@@ -782,6 +802,13 @@ class BFTClusterClient:
                             # re-broadcast retry must fire on either
                             if time.monotonic() >= deadline:
                                 raise
+                            if ov is not None and not ov.allow_retry(
+                                    "bft.submit", client.name):
+                                # retry budget exhausted: skip this
+                                # round's re-broadcast and keep waiting —
+                                # the original request may still land a
+                                # quorum, and the hard deadline bounds us
+                                continue
                             for r in client._replicas:
                                 client._messaging.send(r, T_REQUEST, payload)
                 finally:

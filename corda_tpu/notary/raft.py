@@ -686,7 +686,21 @@ class RaftUniquenessProvider(UniquenessProvider):
         the state machine is idempotent per tx_id. Retries back off
         exponentially with jitter under the overall ``_retry_s`` deadline
         (the propagated budget — no attempt outlives it)."""
-        deadline = time.monotonic() + self._retry_s
+        from corda_tpu.flows.overload import active_overload, remaining_deadline
+
+        budget = self._retry_s
+        rem = remaining_deadline()
+        if rem is not None:
+            # the propagated end-to-end deadline tightens the submit
+            # budget: a consensus round for a dead flow is wasted work
+            # (docs/OVERLOAD.md). Small floor so an on-the-edge submit
+            # fails with a timeout, not a zero-wait raise.
+            budget = min(budget, max(0.05, rem))
+        deadline = time.monotonic() + budget
+        ov = active_overload()
+        edge = str(getattr(self.node, "name", "raft"))
+        if ov is not None:
+            ov.note_send("raft.submit", edge)
         attempt = 0
         while True:
             try:
@@ -700,6 +714,13 @@ class RaftUniquenessProvider(UniquenessProvider):
                     raise
                 if time.monotonic() > deadline:
                     raise
+                if ov is not None and not ov.allow_retry("raft.submit", edge):
+                    # retry budget exhausted (token bucket per layer+edge,
+                    # docs/OVERLOAD.md): under a submit storm, resubmits
+                    # must stay a bounded fraction of fresh submits
+                    raise NotaryError(
+                        "raft submit retry budget exhausted"
+                    ) from e
                 pause = self._retry_policy.backoff_s(attempt, self._retry_rng)
                 attempt += 1
                 time.sleep(min(pause, max(0.0, deadline - time.monotonic())))

@@ -220,7 +220,8 @@ class ValidatingNotaryService(NotaryService):
 
 
 class _PendingRequest:
-    __slots__ = ("stx", "resolve_state", "caller", "future", "span")
+    __slots__ = ("stx", "resolve_state", "caller", "future", "span",
+                 "deadline_t")
 
     def __init__(self, stx, resolve_state, caller, span=None):
         self.stx = stx
@@ -231,6 +232,10 @@ class _PendingRequest:
         # CALLER's thread — the flusher pipeline threads that settle the
         # future have no ambient trace context of their own
         self.span = span
+        # propagated end-to-end deadline (absolute epoch, or None),
+        # captured on the caller's thread like the span: the flush window
+        # drops requests whose flow is already dead (docs/OVERLOAD.md)
+        self.deadline_t: float | None = None
 
 
 class BatchedNotaryService(NotaryService):
@@ -627,10 +632,25 @@ class BatchedNotaryService(NotaryService):
             fut: Future = Future()
             fut.set_result(cached)
             return fut
+        from corda_tpu.flows.overload import active_overload, remaining_deadline
+
+        rem = remaining_deadline()
+        if rem is not None and rem <= 0.0:
+            # the submitting flow's end-to-end deadline already passed:
+            # shed at the door before the request burns a batch slot, a
+            # device dispatch, and a consensus round (docs/OVERLOAD.md)
+            ov = active_overload()
+            if ov is not None:
+                ov.note_deadline_shed()
+            raise NotaryInternalException(
+                "notary request shed: flow deadline exceeded"
+            )
         trc = tracer()
         span = trc.start(SPAN_NOTARY_SUBMIT, trc.current(),
                          attrs={"tx.id": str(stx.id), "caller": caller})
         req = _PendingRequest(stx, resolve_state, caller, span=span)
+        if rem is not None:
+            req.deadline_t = time.time() + rem
         if span.sampled:
             def close_span(f: Future):
                 err = f.exception() if not f.cancelled() else None
@@ -744,7 +764,29 @@ class BatchedNotaryService(NotaryService):
             with self._lock:
                 batch = self._pending[: self._max_batch]
                 self._pending = self._pending[self._max_batch :]
-                return batch, self._stopped
+            # propagated-deadline shed (docs/OVERLOAD.md): requests whose
+            # flow died while queued in the window are failed here rather
+            # than carried through verify/commit/sign — under overload
+            # the window is exactly where dead work piles up
+            now = time.time()
+            dead = [r for r in batch
+                    if r.deadline_t is not None and now >= r.deadline_t]
+            if dead:
+                from corda_tpu.flows.overload import active_overload
+
+                ov = active_overload()
+                batch = [r for r in batch if r not in dead]
+                for r in dead:
+                    if ov is not None:
+                        ov.note_deadline_shed()
+                    try:
+                        r.future.set_exception(NotaryInternalException(
+                            "notary request shed: flow deadline exceeded "
+                            "while batched"
+                        ))
+                    except Exception:
+                        pass  # caller cancelled
+            return batch, self._stopped
 
         try:
             while True:

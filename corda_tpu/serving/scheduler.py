@@ -90,6 +90,7 @@ from corda_tpu.observability.profiler import (
     active_profiler,
     stamp_span,
 )
+from corda_tpu.flows.overload import remaining_deadline
 from corda_tpu.observability.flowprof import active_flowprof
 from corda_tpu.observability.slo import active_slo
 
@@ -470,6 +471,14 @@ class DeviceScheduler:
         span covering admission→dispatch."""
         if priority not in _CLASSES:
             raise ValueError(f"unknown priority class {priority!r}")
+        if deadline_s is None:
+            # end-to-end deadline propagation (docs/OVERLOAD.md): a flow
+            # carrying a propagated deadline bounds its serving submits
+            # automatically — the queue sheds this request the moment the
+            # caller's caller has given up. An explicit deadline_s wins.
+            rem = remaining_deadline()
+            if rem is not None:
+                deadline_s = max(0.0, rem)
         rows = list(rows)
         fut: Future = Future()
         if not rows:
@@ -651,11 +660,17 @@ class DeviceScheduler:
         spans landed) — shared by assembly-time and slot-wait shedding."""
         _metrics().counter("serving.shed").inc(len(requests))
         slo = active_slo()
+        fp = active_flowprof()
         now = time.monotonic()
         for r in requests:
             if slo is not None:
                 # a shed IS the SLO signal: the request aged out
                 slo.observe(r.priority, now - r.enqueued_at, error=True)
+            if fp is not None:
+                # the shed request's whole life was queue wait: book it to
+                # the owning flow's phase ledger so propagated-deadline
+                # sheds show up in the waterfall, not as missing wall
+                fp.add(r.acct, "queue_wait", now - r.enqueued_at)
             err = DeadlineExceededError(
                 "request shed: deadline passed while queued"
             )

@@ -119,12 +119,14 @@ class LoadHarness:
         from corda_tpu.verifier import BatchedVerifierService
 
         cfg = self.config
+        chaos_injector = None
         if cfg.chaos is not None:
             from corda_tpu.faultinject import FaultInjector
             from corda_tpu.faultinject import clear as clear_injector
             from corda_tpu.faultinject import install as install_injector
 
-            install_injector(FaultInjector(cfg.chaos))
+            chaos_injector = FaultInjector(cfg.chaos)
+            install_injector(chaos_injector)
             stack.callback(clear_injector)
         if cfg.resilience:
             from corda_tpu.serving import ResiliencePolicy, configure_scheduler
@@ -134,6 +136,13 @@ class LoadHarness:
                 resilience=ResiliencePolicy(flight_dump_on_quarantine=False),
             )
         net = stack.enter_context(MockNetworkNodes())
+        if chaos_injector is not None:
+            # the global install() above feeds the named fault SITES
+            # (check_site); transport drop/delay/partition decisions are
+            # made by the NETWORK's own injector reference — without this
+            # the chaos plan never touches a delivery
+            net.net.set_fault_injector(chaos_injector)
+            stack.callback(lambda: net.net.set_fault_injector(None))
         checkpoints = None
         if cfg.durable:
             from corda_tpu.durability import DurableStore
@@ -416,6 +425,422 @@ class LoadHarness:
 
 def run_harness(config: HarnessConfig | None = None) -> dict:
     return LoadHarness(config).run()
+
+
+# ======================================================================
+# Overload / metastability certification (docs/OVERLOAD.md)
+# ======================================================================
+
+OVERLOAD_SCHEMA = 1
+
+
+@dataclasses.dataclass
+class OverloadConfig:
+    """The metastability scenario's knobs: drive the node 2–5x past its
+    knee under a partition/crash storm with the overload governor ON,
+    and certify (a) a goodput floor DURING the storm and (b) recovery to
+    a fraction of baseline within a bounded wall AFTER it — the two
+    properties a metastable system fails (goodput collapses and the
+    collapse outlives the trigger)."""
+
+    base_qps: float = 8.0           # at/near the knee found by the ramp
+    overload_factor: float = 3.0    # storm offered load = factor × base
+    baseline_s: float = 4.0         # unmolested goodput reference window
+    storm_s: float = 6.0            # overload + chaos window
+    recovery_s: float = 30.0        # max wall to recover after the storm
+    recovery_window_s: float = 3.0  # goodput measurement granularity
+    goodput_floor: float = 0.5      # storm goodput ≥ floor × baseline
+    recovery_floor: float = 0.9     # recovered when ≥ floor × baseline
+    # per-flow end-to-end deadline: a few multiples of the SLO (the
+    # caller's give-up point, not the p99 target) — tight enough to shed
+    # genuinely dead work, loose enough that chaos retransmit backoffs
+    # alone don't kill every in-flight flow
+    deadline_s: float = 6.0
+    # governor knobs for the run (configure_overload)
+    limit: float = 32.0             # starting AIMD concurrency limit
+    slo_p99_s: float = 1.5
+    retry_ratio: float = 0.5
+    retry_burst: float = 32.0
+    seed: int = 2026
+    # arrival class mix: (priority, weight) — brownout order certifies
+    # BULK sheds first and INTERACTIVE last against exactly this mix
+    mix: tuple = (("interactive", 0.2), ("service", 0.5), ("bulk", 0.3))
+    max_inflight: int = 1024        # open-loop backstop (NOT the governor)
+    # storm composition (the existing fault fabric)
+    drop_p: float = 0.08
+    delay_p: float = 0.10
+    partition_bursts: int = 2       # full partitions of B / the notary
+    partition_burst_s: float = 0.8
+    workload: str = "payment"
+    durable: bool = False
+    use_device: bool = False
+
+
+class _PhaseStats:
+    """One phase's per-class outcome ledger (thread-safe, same contract
+    as _StepStats)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok = 0
+        self.errors = 0
+        self.offered: dict[str, int] = {}
+        self.rejected: dict[str, int] = {}
+        self.latencies: list[float] = []
+
+    def complete(self, latency_s: float, error: bool) -> None:
+        with self.lock:
+            if error:
+                self.errors += 1
+            else:
+                self.ok += 1
+                self.latencies.append(latency_s)
+
+
+class OverloadScenario:
+    """Runs baseline → storm → recovery against the 3-node mocknet and
+    scores the metastability certificate (docs/OVERLOAD.md)."""
+
+    def __init__(self, config: OverloadConfig | None = None):
+        self.config = config or OverloadConfig()
+        self._rng = random.Random(self.config.seed)
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._idle = threading.Condition(self._inflight_lock)
+
+    # ----------------------------------------------------------- arrivals
+    def _pick_class(self) -> str:
+        r = self._rng.random()
+        acc = 0.0
+        for cls, w in self.config.mix:
+            acc += w
+            if r < acc:
+                return cls
+        return self.config.mix[-1][0]
+
+    def _start(self, sender, receiver, notary, stats: _PhaseStats,
+               scheduled_t: float) -> None:
+        from corda_tpu.finance import CashIssueFlow, CashPaymentFlow
+        from corda_tpu.flows.overload import FlowAdmissionError
+
+        cfg = self.config
+        cls = self._pick_class()
+        if cfg.workload == "payment":
+            flow = CashPaymentFlow(1, "GBP", receiver.party)
+        else:
+            flow = CashIssueFlow(1, "GBP", b"\x77", notary.party)
+        # the governor's brownout keys on this (BULK → SERVICE →
+        # INTERACTIVE); the scenario certifies that order holds
+        flow.priority = cls
+        with stats.lock:
+            stats.offered[cls] = stats.offered.get(cls, 0) + 1
+        with self._inflight_lock:
+            if self._inflight >= cfg.max_inflight:
+                stats.complete(0.0, error=True)
+                return
+            self._inflight += 1
+        try:
+            handle = sender.smm.start_flow(flow, deadline_s=cfg.deadline_s)
+        except FlowAdmissionError:
+            # the graceful-degradation path under certification: a cheap
+            # fail-fast reject, NOT an error completion — counted per
+            # class so the brownout order is checkable
+            with stats.lock:
+                stats.rejected[cls] = stats.rejected.get(cls, 0) + 1
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+            return
+        except Exception:
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+            stats.complete(0.0, error=True)
+            return
+
+        def done(fut, _t0=scheduled_t):
+            latency = time.monotonic() - _t0
+            err = fut.exception() is not None
+            stats.complete(latency, error=err)
+            with self._inflight_lock:
+                self._inflight -= 1
+                self._idle.notify_all()
+
+        handle.result.add_done_callback(done)
+
+    def _phase(self, fixture, qps: float, duration_s: float,
+               drain_s: float) -> tuple[_PhaseStats, float]:
+        """One open-loop arrival window at ``qps``; returns (stats,
+        goodput qps = ok completions / the arrival window)."""
+        net, sender, receiver, notary = fixture
+        stats = _PhaseStats()
+        t0 = time.monotonic()
+        next_arrival = t0
+        end = t0 + duration_s
+        while next_arrival < end:
+            now = time.monotonic()
+            if next_arrival > now:
+                time.sleep(next_arrival - now)
+            self._start(sender, receiver, notary, stats, next_arrival)
+            next_arrival += self._rng.expovariate(qps)
+        with self._inflight_lock:
+            self._idle.wait_for(
+                lambda: self._inflight == 0, timeout=drain_s
+            )
+        return stats, stats.ok / duration_s
+
+    # -------------------------------------------------------------- storm
+    def _storm_plans(self):
+        from corda_tpu.faultinject import FaultPlan, Partition
+
+        cfg = self.config
+        sever_forever = (0, 1 << 30)
+        chaos = FaultPlan(
+            seed=cfg.seed, drop_p=cfg.drop_p, delay_p=cfg.delay_p,
+            delay_rounds=(1, 3), duplicate_p=0.02,
+        )
+        # a one-sided Partition severs the node from EVERYONE — the
+        # network-visible shape of both a partition and a crashed node,
+        # healed by swapping the plain-chaos plan back in
+        bursts = [
+            FaultPlan(seed=cfg.seed + 1, drop_p=cfg.drop_p, partitions=(
+                Partition(*sever_forever, frozenset({"HarnessB"})),
+            )),
+            FaultPlan(seed=cfg.seed + 2, drop_p=cfg.drop_p, partitions=(
+                Partition(*sever_forever, frozenset({"HarnessNotary"})),
+            )),
+        ]
+        return chaos, bursts
+
+    def _arm_plan(self, net, plan) -> None:
+        """Swap the active fault plan: the module-global install feeds
+        the named fault SITES (check_site), the network-instance
+        reference is what actually drops/delays deliveries — both must
+        point at the same injector or the storm is a fiction."""
+        from corda_tpu.faultinject import FaultInjector, install
+
+        inj = FaultInjector(plan)
+        install(inj)
+        net.net.set_fault_injector(inj)
+
+    def _storm_thread(self, net, stop: threading.Event) -> threading.Thread:
+        """Drives the chaos timeline for the storm window: baseline drop/
+        delay chaos throughout, with full partition/crash bursts of the
+        receiver and the notary spread across it. Swapping the armed plan
+        is the heal mechanism (the netstats partition detector sees the
+        silence and raises ``net.partition_suspect``; the heal must then
+        NOT burst)."""
+        cfg = self.config
+        chaos, bursts = self._storm_plans()
+
+        def run():
+            self._arm_plan(net, chaos)
+            n = max(0, cfg.partition_bursts)
+            if n == 0:
+                stop.wait(cfg.storm_s)
+                return
+            gap = cfg.storm_s / (n + 1)
+            for i in range(n):
+                if stop.wait(max(0.0, gap - cfg.partition_burst_s / 2)):
+                    break
+                self._arm_plan(net, bursts[i % len(bursts)])
+                if stop.wait(cfg.partition_burst_s):
+                    break
+                self._arm_plan(net, chaos)  # heal
+            stop.wait(None)  # hold plain chaos until the storm window ends
+
+        t = threading.Thread(target=run, daemon=True, name="overload-storm")
+        t.start()
+        return t
+
+    # ---------------------------------------------------------------- run
+    def run(self) -> dict:
+        import contextlib
+
+        from corda_tpu.faultinject import clear as clear_injector
+        from corda_tpu.finance import CashIssueFlow
+        from corda_tpu.flows.overload import (
+            configure_overload,
+            overload_section,
+        )
+        from corda_tpu.messaging.netstats import (
+            active_netstats,
+            configure_netstats,
+        )
+
+        cfg = self.config
+        harness = LoadHarness(HarnessConfig(
+            seed=cfg.seed, workload=cfg.workload, durable=cfg.durable,
+            use_device=cfg.use_device, chaos=None,
+        ))
+        try:
+            with contextlib.ExitStack() as stack:
+                stack.callback(clear_injector)
+                fixture = harness._build(stack)
+                net, sender, receiver, notary = fixture
+                stack.callback(lambda: net.net.set_fault_injector(None))
+                # ---- setup (UNMEASURED): pre-issue cash for every phase
+                if cfg.workload == "payment":
+                    expected = int(cfg.base_qps * (
+                        cfg.baseline_s
+                        + cfg.overload_factor * cfg.storm_s
+                        + cfg.recovery_s
+                    ) * 1.5) + 16
+                    for _ in range(expected):
+                        sender.run_flow(
+                            CashIssueFlow(1, "GBP", b"\x77", notary.party)
+                        )
+                # governor + netstats ON for the certified run (netstats
+                # feeds the partition-suspect backoff widening)
+                configure_netstats(enabled=True, reset=True)
+                configure_overload(
+                    enabled=True, reset=True, limit=cfg.limit,
+                    slo_p99_s=cfg.slo_p99_s, retry_ratio=cfg.retry_ratio,
+                    retry_burst=cfg.retry_burst,
+                )
+                stack.callback(
+                    lambda: configure_overload(enabled=False, reset=True)
+                )
+                stack.callback(
+                    lambda: configure_netstats(enabled=False, reset=True)
+                )
+                # ---- phase 1: baseline goodput at base_qps, no faults
+                base_stats, base_goodput = self._phase(
+                    fixture, cfg.base_qps, cfg.baseline_s,
+                    drain_s=cfg.deadline_s + 3.0,
+                )
+                # ---- phase 2: storm — offered load at factor × base
+                # under drop/delay chaos + partition/crash bursts
+                stop = threading.Event()
+                storm = self._storm_thread(net, stop)
+                storm_stats, storm_goodput = self._phase(
+                    fixture, cfg.base_qps * cfg.overload_factor,
+                    cfg.storm_s, drain_s=cfg.deadline_s + 3.0,
+                )
+                stop.set()
+                storm.join(timeout=5.0)
+                clear_injector()   # full heal
+                net.net.set_fault_injector(None)
+                # ---- phase 3: recovery — base_qps windows until goodput
+                # clears the floor or the wall expires
+                t_rec0 = time.monotonic()
+                recovery_goodput = 0.0
+                recovery_wall_s = cfg.recovery_s
+                recovered = False
+                rec_stats_all: list[_PhaseStats] = []
+                while time.monotonic() - t_rec0 < cfg.recovery_s:
+                    rstats, rgood = self._phase(
+                        fixture, cfg.base_qps, cfg.recovery_window_s,
+                        drain_s=cfg.deadline_s + 2.0,
+                    )
+                    rec_stats_all.append(rstats)
+                    recovery_goodput = rgood
+                    if (base_goodput > 0
+                            and rgood >= cfg.recovery_floor * base_goodput):
+                        recovery_wall_s = time.monotonic() - t_rec0
+                        recovered = True
+                        break
+                ov_snap = overload_section()
+                nets = active_netstats()
+                retransmits = (
+                    nets.total_retransmits() if nets is not None else 0
+                )
+        finally:
+            clear_injector()
+        return self._score(
+            base_stats, base_goodput, storm_stats, storm_goodput,
+            recovery_goodput, recovery_wall_s, recovered,
+            ov_snap, retransmits,
+        )
+
+    def _score(self, base_stats, base_goodput, storm_stats, storm_goodput,
+               recovery_goodput, recovery_wall_s, recovered,
+               ov_snap: dict, retransmits: int) -> dict:
+        cfg = self.config
+        goodput_ratio = (
+            storm_goodput / base_goodput if base_goodput > 0 else 0.0
+        )
+        recovery_ratio = (
+            recovery_goodput / base_goodput if base_goodput > 0 else 0.0
+        )
+        # brownout order: per-class REJECT RATE must be monotone
+        # BULK ≥ SERVICE ≥ INTERACTIVE (rates, not counts — the mix is
+        # not uniform). Small epsilon: one stray reject in a small
+        # window must not flip the verdict.
+        with storm_stats.lock:
+            offered = dict(storm_stats.offered)
+            rejected = dict(storm_stats.rejected)
+        rates = {
+            cls: (rejected.get(cls, 0) / offered[cls])
+            if offered.get(cls) else 0.0
+            for cls, _w in cfg.mix
+        }
+        eps = 0.02
+        brownout_order_ok = (
+            rates.get("interactive", 0.0) <= rates.get("service", 0.0) + eps
+            and rates.get("service", 0.0) <= rates.get("bulk", 0.0) + eps
+        )
+        # retry-budget reconcile: granted never exceeds earned (the
+        # governor's own invariant), and wire-observed retransmits stay
+        # within granted + granted headroom — every untracked responder
+        # echo (Confirm/Reject re-sent under a ``~`` wire id) is caused
+        # 1:1 by a budget-granted initiator retransmit
+        granted = int(ov_snap.get("retry_granted", 0))
+        denied = int(ov_snap.get("retry_denied", 0))
+        earned = float(ov_snap.get("budget_earned", 0.0))
+        retry_budget_ok = granted <= earned and retransmits <= 2 * granted + 16
+        goodput_floor_ok = goodput_ratio >= cfg.goodput_floor
+        recovery_ok = recovered and recovery_ratio >= cfg.recovery_floor
+        section = {
+            "schema": OVERLOAD_SCHEMA,
+            "base_qps": cfg.base_qps,
+            "overload_qps": cfg.base_qps * cfg.overload_factor,
+            "deadline_s": cfg.deadline_s,
+            "baseline_goodput_qps": base_goodput,
+            "storm_goodput_qps": storm_goodput,
+            "goodput_ratio": goodput_ratio,
+            "goodput_floor": cfg.goodput_floor,
+            "goodput_floor_ok": int(goodput_floor_ok),
+            "recovery_goodput_qps": recovery_goodput,
+            "recovery_ratio": recovery_ratio,
+            "recovery_floor": cfg.recovery_floor,
+            "recovery_wall_s": recovery_wall_s,
+            "recovery_wall_limit_s": cfg.recovery_s,
+            "recovery_ok": int(recovery_ok),
+            "offered_by_class": offered,
+            "rejected_by_class": rejected,
+            "reject_rate_by_class": rates,
+            "brownout_order_ok": int(brownout_order_ok),
+            "admission_rejected": sum(rejected.values()),
+            "deadline_shed": int(ov_snap.get("deadline_shed", 0)),
+            "retransmits": int(retransmits),
+            "retry_budget_granted": granted,
+            "retry_budget_denied": denied,
+            "retry_budget_earned": earned,
+            "retry_budget_ok": int(retry_budget_ok),
+            "config": {
+                "overload_factor": cfg.overload_factor,
+                "baseline_s": cfg.baseline_s,
+                "storm_s": cfg.storm_s,
+                "limit": cfg.limit,
+                "slo_p99_s": cfg.slo_p99_s,
+                "retry_ratio": cfg.retry_ratio,
+                "mix": [list(m) for m in cfg.mix],
+                "drop_p": cfg.drop_p,
+                "partition_bursts": cfg.partition_bursts,
+                "seed": cfg.seed,
+                "workload": cfg.workload,
+                "durable": cfg.durable,
+            },
+        }
+        return {"overload": section}
+
+
+def run_overload(config: OverloadConfig | None = None) -> dict:
+    """Run the metastability certification; returns ``{"overload": ...}``
+    ready to merge into a LOADTEST/bench payload (schema checked by
+    ``tools_perf_gate.py --check-schema``)."""
+    return OverloadScenario(config).run()
 
 
 def write_loadtest(result: dict, path: str = "LOADTEST.json") -> str:

@@ -332,7 +332,8 @@ class TestMonitoringSurface:
         snap = monitoring_snapshot()
         assert set(snap) == {"serving", "profiler", "devices", "slo",
                              "resilience", "durability", "flowprof",
-                             "sampler", "net", "cluster", "process"}
+                             "sampler", "net", "cluster", "overload",
+                             "process"}
         # devicemon/slo/resilience/durability/flowprof/sampler are off by
         # default: bare disabled markers, no slots laid out, no metrics
         # created (ISSUE 7 overhead contract; ISSUEs 9/10 extend it to

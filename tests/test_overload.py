@@ -1,0 +1,729 @@
+"""Overload governor tests (docs/OVERLOAD.md): adaptive admission with
+brownout ordering, AIMD limit adaptation against a fake clock, retry
+token buckets with counter reconciliation, end-to-end deadline
+propagation (thread scope, SessionInit wire compatibility, engine /
+scheduler sheds), the partition-heal full-jitter retransmit fix
+(satellite regression with the fault injector), the forced fault sites,
+a compact metastability storm on a real mocknet, and the off-by-default
+zero-overhead pin in a fresh subprocess."""
+
+import dataclasses
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.crypto import generate_keypair
+from corda_tpu.flows import (
+    CheckpointStorage,
+    FlowException,
+    FlowLogic,
+    InitiatedBy,
+    StateMachineManager,
+)
+from corda_tpu.flows.overload import (
+    BULK,
+    INTERACTIVE,
+    SERVICE,
+    _DEFAULT_CLASS_SHARES,
+    FlowAdmissionError,
+    OverloadGovernor,
+    active_overload,
+    configure_overload,
+    current_deadline_t,
+    deadline_scope,
+    overload_governor,
+    overload_section,
+    remaining_deadline,
+)
+from corda_tpu.ledger import CordaX500Name, Party
+from corda_tpu.messaging import InMemoryMessagingNetwork
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_party(name):
+    kp = generate_keypair()
+    return Party(CordaX500Name(name, "City", "GB"), kp.public)
+
+
+A = make_party("OverA")
+B = make_party("OverB")
+PARTIES = {str(A.name): A, str(B.name): B}
+
+RECORDED: dict = {}
+
+
+@dataclasses.dataclass
+class DeadlineProbeFlow(FlowLogic):
+    """No sessions: records the thread-scope deadline seen in call()."""
+
+    key: str
+
+    def call(self):
+        RECORDED[self.key] = remaining_deadline()
+        return "ok"
+
+
+@dataclasses.dataclass
+class PingFlow(FlowLogic):
+    peer_name: str
+
+    def call(self):
+        s = self.initiate_flow(PARTIES[self.peer_name])
+        return s.send_and_receive(int, 1).unwrap(lambda x: x)
+
+
+@InitiatedBy(PingFlow)
+class PingResponder(FlowLogic):
+    def __init__(self, session):
+        self.session = session
+
+    def call(self):
+        v = self.session.receive(int).unwrap(lambda x: x)
+        # the initiator's deadline crossed the wire in SessionInit and
+        # is bound as this responder executor's thread scope
+        RECORDED["responder_deadline"] = remaining_deadline()
+        self.session.send(v + 1)
+
+
+class MockNet:
+    """Two SMM nodes over the in-memory network (test_flows idiom)."""
+
+    def __init__(self):
+        self.net = InMemoryMessagingNetwork()
+        self.net.start_pumping()
+        self.smm = {}
+        for p in (A, B):
+            self.smm[str(p.name)] = StateMachineManager(
+                self.net.create_node(str(p.name)),
+                CheckpointStorage(),
+                p,
+                PARTIES.get,
+            )
+
+    def stop(self):
+        for smm in self.smm.values():
+            smm.stop()
+        self.net.stop_pumping()
+
+
+@pytest.fixture
+def mocknet():
+    net = MockNet()
+    yield net
+    net.stop()
+
+
+@pytest.fixture
+def gov():
+    """The global governor, enabled with small test knobs; everything is
+    restored to module defaults afterwards so no other test observes a
+    leaked limit or share table."""
+    g = configure_overload(
+        enabled=True, reset=True, limit=8.0, min_limit=2.0,
+        slo_p99_s=0.5, retry_ratio=0.5, retry_burst=4.0,
+        retry_initial=2.0, suspect_backoff_scale=4.0,
+    )
+    yield g
+    configure_overload(
+        enabled=False, reset=True, limit=64.0, min_limit=4.0,
+        max_limit=4096.0, slo_p99_s=1.0, retry_ratio=0.5,
+        retry_burst=32.0, retry_initial=2.0, suspect_backoff_scale=4.0,
+        class_shares=dict(_DEFAULT_CLASS_SHARES),
+    )
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------- admission
+
+class TestAdmission:
+    def test_admits_until_limit_then_rejects(self, gov):
+        for _ in range(8):
+            assert gov.try_admit(INTERACTIVE)
+        assert not gov.try_admit(INTERACTIVE)
+        snap = gov.snapshot()
+        assert snap["admitted"] == 8 and snap["rejected"] == 1
+        gov.release(INTERACTIVE, 0.01)
+        assert gov.try_admit(INTERACTIVE)
+
+    def test_brownout_order_bulk_sheds_first(self, gov):
+        configure_overload(limit=10.0)
+        # fill to 6 in-flight: bulk's ceiling (10 × 0.6) is reached,
+        # service (8.5) and interactive (10) still have headroom
+        for _ in range(6):
+            assert gov.try_admit(INTERACTIVE)
+        assert not gov.try_admit(BULK)
+        assert gov.try_admit(SERVICE)
+        assert gov.try_admit(INTERACTIVE)
+        # fill to 9: service's ceiling (10 × 0.85) is crossed too
+        assert gov.try_admit(SERVICE)
+        assert not gov.try_admit(SERVICE)
+        # interactive rides to the full limit, then sheds last
+        assert gov.try_admit(INTERACTIVE)
+        assert not gov.try_admit(INTERACTIVE)
+        snap = gov.snapshot()
+        assert snap["rejected_by_class"] == {BULK: 1, SERVICE: 1,
+                                             INTERACTIVE: 1}
+
+    def test_unknown_class_uses_service_share(self, gov):
+        configure_overload(limit=10.0)
+        for _ in range(9):
+            gov.try_admit(INTERACTIVE)
+        # 9 in-flight ≥ 10 × 0.85 → an unknown class rejects like SERVICE
+        assert not gov.try_admit("weird")
+        assert gov.snapshot()["rejected_by_class"] == {"weird": 1}
+
+    def test_reject_observes_slo_error_without_latency(self, gov):
+        from corda_tpu.observability.slo import configure_slo, slo_monitor
+
+        configure_slo(enabled=True)
+        try:
+            m = slo_monitor()
+            m._samples.clear()
+            configure_overload(limit=0.0)
+            assert not gov.try_admit(BULK)
+            samples = list(m._samples[BULK])
+            assert len(samples) == 1
+            _t, latency, error = samples[0]
+            assert latency is None and error is True
+        finally:
+            configure_slo(enabled=False)
+
+    def test_deadline_shed_observes_slo_with_latency(self, gov):
+        from corda_tpu.observability.slo import configure_slo, slo_monitor
+
+        configure_slo(enabled=True)
+        try:
+            m = slo_monitor()
+            m._samples.clear()
+            gov.note_deadline_shed(SERVICE, 1.25)
+            _t, latency, error = list(m._samples[SERVICE])[0]
+            assert latency == 1.25 and error is True
+            assert gov.snapshot()["deadline_shed"] == 1
+        finally:
+            configure_slo(enabled=False)
+
+
+class TestAIMD:
+    def _gov(self, clock) -> OverloadGovernor:
+        g = OverloadGovernor(clock=clock)
+        g.enable()
+        g.slo_p99_s = 0.5
+        g.limit = 64.0
+        g.min_limit = 4.0
+        g.adapt_min_samples = 4
+        return g
+
+    def test_breaching_windows_cut_multiplicatively(self):
+        clock = FakeClock()
+        g = self._gov(clock)
+        for _ in range(6):
+            g._inflight += 1
+            clock.advance(0.3)
+            g.release(SERVICE, 2.0)  # far over the 0.5s SLO
+        # at least two multiplicative cuts landed (each adapt window
+        # needs adapt_min_samples, so not every release adapts)
+        assert g.limit <= 64.0 * 0.7 ** 2
+        assert g.limit >= g.min_limit
+
+    def test_healthy_windows_raise_additively(self):
+        clock = FakeClock()
+        g = self._gov(clock)
+        g.limit = 8.0
+        for _ in range(6):
+            g._inflight += 1
+            clock.advance(0.3)
+            g.release(SERVICE, 0.05)
+        assert 8.0 < g.limit <= 8.0 + 6 * g.increase
+
+    def test_limit_never_below_floor(self):
+        clock = FakeClock()
+        g = self._gov(clock)
+        for _ in range(60):
+            g._inflight += 1
+            clock.advance(0.3)
+            g.release(SERVICE, 5.0)
+        assert g.limit == g.min_limit
+
+    def test_error_completions_feed_no_latency(self):
+        clock = FakeClock()
+        g = self._gov(clock)
+        for _ in range(10):
+            g._inflight += 1
+            clock.advance(0.3)
+            g.release(SERVICE, 9.0, error=True)
+        # errored completions carry no latency sample: too few samples to
+        # adapt, the limit holds
+        assert g.limit == 64.0
+
+
+# ------------------------------------------------------- retry budgets
+
+class TestRetryBudget:
+    def test_initial_allowance_then_denial(self, gov):
+        assert gov.allow_retry("session", "peer1")
+        assert gov.allow_retry("session", "peer1")
+        assert not gov.allow_retry("session", "peer1")
+        snap = gov.snapshot()
+        assert snap["retry_granted"] == 2 and snap["retry_denied"] == 1
+
+    def test_fresh_sends_earn_tokens(self, gov):
+        for _ in range(2):
+            gov.allow_retry("session", "peer2")
+        assert not gov.allow_retry("session", "peer2")
+        # 2 fresh sends × 0.5 ratio = 1 token
+        gov.note_send("session", "peer2")
+        gov.note_send("session", "peer2")
+        assert gov.allow_retry("session", "peer2")
+        assert not gov.allow_retry("session", "peer2")
+
+    def test_burst_cap_bounds_idle_accumulation(self, gov):
+        for _ in range(100):
+            gov.note_send("session", "peer3")
+        grants = 0
+        while gov.allow_retry("session", "peer3"):
+            grants += 1
+            assert grants < 50, "bucket escaped its burst cap"
+        # retry_burst=4 in the fixture: at most 4 grants however many
+        # fresh sends accumulated while idle
+        assert grants == 4
+
+    def test_edges_are_independent(self, gov):
+        for _ in range(2):
+            assert gov.allow_retry("session", "edge-a")
+        assert not gov.allow_retry("session", "edge-a")
+        assert gov.allow_retry("session", "edge-b")
+        assert gov.allow_retry("raft.submit", "edge-a")
+
+    def test_granted_never_exceeds_earned(self, gov):
+        rng = random.Random(7)
+        for _ in range(500):
+            edge = f"p{rng.randrange(6)}"
+            if rng.random() < 0.5:
+                gov.note_send("session", edge)
+            else:
+                gov.allow_retry("session", edge)
+        snap = gov.snapshot()
+        assert snap["retry_granted"] <= snap["budget_earned"]
+
+    def test_bucket_table_is_bounded(self, gov):
+        for i in range(OverloadGovernor.BUCKET_CAP + 64):
+            gov.note_send("session", f"edge-{i}")
+        assert len(gov._buckets) <= OverloadGovernor.BUCKET_CAP
+
+
+# ------------------------------------------------------ deadline scope
+
+class TestDeadlineScope:
+    def test_scope_binds_and_restores(self):
+        assert remaining_deadline() is None
+        t = time.time() + 5.0
+        with deadline_scope(t):
+            assert current_deadline_t() == t
+            rem = remaining_deadline()
+            assert rem is not None and 4.0 < rem <= 5.0
+            with deadline_scope(t + 10):
+                assert current_deadline_t() == t + 10
+            assert current_deadline_t() == t
+        assert remaining_deadline() is None
+
+    def test_expired_deadline_goes_negative(self):
+        with deadline_scope(time.time() - 1.0):
+            assert remaining_deadline() < 0
+
+
+# ------------------------------------------------- wire compatibility
+
+class TestSessionInitWire:
+    def test_deadline_omitted_when_unset(self):
+        from corda_tpu.flows.sessions import SessionInit
+        from corda_tpu.serialization import deserialize, serialize
+
+        init = SessionInit(7, "x.Y", b"blob")
+        data = serialize(init)
+        assert b"deadline" not in data  # zero wire bytes when off
+        back = deserialize(data)
+        assert back.deadline == 0.0
+
+    def test_deadline_round_trips_when_set(self):
+        from corda_tpu.flows.sessions import SessionInit
+        from corda_tpu.serialization import deserialize, serialize
+
+        t = time.time() + 30.0
+        back = deserialize(serialize(SessionInit(7, "x.Y", b"b", deadline=t)))
+        assert back.deadline == pytest.approx(t)
+
+    def test_old_payload_without_deadline_decodes(self):
+        # a pre-overload peer's Init: same type name, no deadline field —
+        # byte-identical to a deadline-less Init from this build
+        from corda_tpu.flows.sessions import SessionInit
+        from corda_tpu.serialization import deserialize, serialize
+
+        old = serialize(SessionInit(9, "a.B", b""))
+        init = deserialize(old)
+        assert init.initiator_session_id == 9 and init.deadline == 0.0
+
+
+# ----------------------------------------------------------- fault sites
+
+class TestFaultSites:
+    def test_admission_site_forces_reject(self, gov):
+        from corda_tpu.faultinject import FaultInjector, FaultPlan, clear, install
+
+        install(FaultInjector(FaultPlan(
+            seed=3, fail_sites=(("overload.admission", 1),),
+        )))
+        try:
+            assert not gov.try_admit(INTERACTIVE)  # capacity exists; forced
+            assert gov.try_admit(INTERACTIVE)      # only the 1st call fails
+        finally:
+            clear()
+
+    def test_retry_budget_site_forces_denial(self, gov):
+        from corda_tpu.faultinject import FaultInjector, FaultPlan, clear, install
+
+        install(FaultInjector(FaultPlan(
+            seed=4, fail_sites=(("retry.budget_exhausted", 1),),
+        )))
+        try:
+            assert not gov.allow_retry("session", "peerX")  # tokens exist
+            assert gov.allow_retry("session", "peerX")
+            assert gov.snapshot()["retry_denied"] == 1
+        finally:
+            clear()
+
+
+# -------------------------------------------------- engine integration
+
+class TestEngineDeadlines:
+    def test_admission_reject_is_fail_fast_no_checkpoint(self, gov, mocknet):
+        configure_overload(limit=0.0)
+        smm = mocknet.smm[str(A.name)]
+        before = len(smm.checkpoints.all_flows())
+        with pytest.raises(FlowAdmissionError, match="admission rejected"):
+            smm.start_flow(DeadlineProbeFlow("reject"))
+        assert len(smm.checkpoints.all_flows()) == before
+        assert smm.flows_in_progress() == []
+
+    def test_release_frees_slot_after_completion(self, gov, mocknet):
+        configure_overload(limit=1.0)
+        smm = mocknet.smm[str(A.name)]
+        h = smm.start_flow(DeadlineProbeFlow("slot1"))
+        assert h.result.result(timeout=30) == "ok"
+        deadline = time.monotonic() + 5
+        while gov.inflight() > 0:
+            assert time.monotonic() < deadline, "slot never released"
+            time.sleep(0.01)
+        h2 = smm.start_flow(DeadlineProbeFlow("slot2"))
+        assert h2.result.result(timeout=30) == "ok"
+
+    def test_expired_deadline_sheds_before_work(self, gov, mocknet):
+        smm = mocknet.smm[str(A.name)]
+        RECORDED.pop("dead", None)
+        h = smm.start_flow(DeadlineProbeFlow("dead"), deadline_s=0.0)
+        with pytest.raises(FlowException, match="deadline exceeded"):
+            h.result.result(timeout=30)
+        assert "dead" not in RECORDED  # the body never ran
+        assert gov.snapshot()["deadline_shed"] >= 1
+
+    def test_deadline_visible_in_flow_scope(self, mocknet):
+        # deadline propagation works with the governor OFF — the
+        # deadline parameter is the opt-in, not the env knob
+        smm = mocknet.smm[str(A.name)]
+        h = smm.start_flow(DeadlineProbeFlow("scoped"), deadline_s=30.0)
+        assert h.result.result(timeout=30) == "ok"
+        assert RECORDED["scoped"] is not None
+        assert 0.0 < RECORDED["scoped"] <= 30.0
+        h2 = smm.start_flow(DeadlineProbeFlow("unscoped"))
+        assert h2.result.result(timeout=30) == "ok"
+        assert RECORDED["unscoped"] is None
+
+    def test_deadline_crosses_wire_to_responder(self, mocknet):
+        RECORDED.pop("responder_deadline", None)
+        smm = mocknet.smm[str(A.name)]
+        h = smm.start_flow(PingFlow(str(B.name)), deadline_s=30.0)
+        assert h.result.result(timeout=30) == 2
+        rem = RECORDED["responder_deadline"]
+        assert rem is not None and 0.0 < rem <= 30.0
+
+    def test_no_deadline_means_none_at_responder(self, mocknet):
+        RECORDED.pop("responder_deadline", None)
+        smm = mocknet.smm[str(A.name)]
+        h = smm.start_flow(PingFlow(str(B.name)))
+        assert h.result.result(timeout=30) == 2
+        assert RECORDED["responder_deadline"] is None
+
+
+# ------------------------------------- satellite 1: heal-burst jitter
+
+class _RecordingRng(random.Random):
+    """random.Random that records uniform() calls (the full-jitter
+    re-arm draws uniform(0, backoff); the policy's ±fraction jitter
+    draws random(), so the two are distinguishable)."""
+
+    def __init__(self):
+        super().__init__(1234)
+        self.uniform_calls = []
+
+    def uniform(self, a, b):
+        v = super().uniform(a, b)
+        self.uniform_calls.append((a, b, v))
+        return v
+
+
+class TestRetransmitJitter:
+    def test_full_jitter_rearm_under_partition(self, mocknet):
+        """Sever B with the fault injector so every tracked send
+        retransmits; once entries pass attempt 2 the re-arm must draw
+        FULL jitter — uniform(0, backoff) — not the policy's ±fraction
+        (the synchronized-release regression: a heal after an outage
+        released every parked entry as one burst)."""
+        from corda_tpu.faultinject import FaultInjector, FaultPlan, Partition
+
+        smm = mocknet.smm[str(A.name)]
+        rec = _RecordingRng()
+        smm._retx_rng = rec
+        plan = FaultPlan(seed=11, partitions=(
+            Partition(0, 1 << 30, frozenset({str(B.name)})),
+        ))
+        mocknet.net.set_fault_injector(FaultInjector(plan))
+        try:
+            for i in range(8):
+                smm._track_unacked(
+                    str(B.name), b"payload", f"jit-{i}", "data",
+                    10_000 + i, 30.0,
+                )
+            deadline = time.monotonic() + 20
+            while True:
+                with smm._lock:
+                    entries = list(smm._unacked.values())
+                    done = (len(entries) == 8
+                            and all(e.attempt >= 2 for e in entries))
+                if done:
+                    break
+                assert time.monotonic() < deadline, (
+                    "entries never reached attempt 2: "
+                    + str([(e.base_id, e.attempt) for e in entries])
+                )
+                time.sleep(0.02)
+            rearms = [c for c in rec.uniform_calls if c[0] == 0.0 and c[1] > 0]
+            # every attempt ≥ 2 re-arm drew from the FULL [0, backoff)
+            # range — at least one per entry
+            assert len(rearms) >= 8, rec.uniform_calls
+            # and the draws actually spread (not degenerate at the top)
+            fracs = sorted(v / b for _a, b, v in rearms)
+            assert fracs[0] < 0.5, fracs
+        finally:
+            mocknet.net.set_fault_injector(None)
+
+    def test_suspect_edge_widens_backoff(self, gov):
+        gov._suspect_edges = {f"{A.name}->{B.name}"}
+        assert gov.edge_suspected(str(A.name), str(B.name))
+        assert not gov.edge_suspected(str(B.name), str(A.name))
+
+
+# ---------------------------- satellite 2: scheduler sheds observe SLO
+
+class TestSchedulerShedObservation:
+    def test_scope_deadline_sheds_queue_and_observes(self):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability.slo import configure_slo, slo_monitor
+        from corda_tpu.serving import DeadlineExceededError, DeviceScheduler
+
+        kp = generate_keypair()
+        from corda_tpu.crypto import sign
+
+        rows = [(kp.public, sign(kp.private, b"m"), b"m")]
+        configure_slo(enabled=True)
+        s = DeviceScheduler(use_device_default=False)
+        try:
+            m = slo_monitor()
+            m._samples.clear()
+            shed0 = node_metrics().counter("serving.shed").count
+            s.pause()
+            with deadline_scope(time.time() + 0.01):
+                # no explicit deadline_s: the propagated scope bounds it
+                doomed = s.submit_rows(rows)
+            time.sleep(0.05)
+            s.resume()
+            with pytest.raises(DeadlineExceededError):
+                doomed.result(timeout=30)
+            assert node_metrics().counter("serving.shed").count == shed0 + 1
+            samples = [x for dq in m._samples.values() for x in dq]
+            assert any(err and lat is not None for _t, lat, err in samples)
+        finally:
+            s.shutdown()
+            configure_slo(enabled=False)
+
+
+# ------------------------------- satellite 3: compact metastability storm
+
+class TestMetastabilityStorm:
+    def test_storm_at_3x_with_partition_burst(self, gov, mocknet):
+        """~3x sustainable arrival rate with drop/delay chaos and a
+        partition burst mid-storm: every started future resolves exactly
+        once, checkpoints do not leak, retry volume stays inside the
+        budget, and a post-storm batch completes cleanly (no metastable
+        collapse outliving the trigger)."""
+        from corda_tpu.faultinject import FaultInjector, FaultPlan, Partition
+        from corda_tpu.messaging.netstats import (
+            active_netstats,
+            configure_netstats,
+        )
+
+        smm = mocknet.smm[str(A.name)]
+        configure_netstats(enabled=True, reset=True)
+        configure_overload(limit=12.0, slo_p99_s=0.5)
+        chaos = FaultPlan(seed=21, drop_p=0.10, delay_p=0.10,
+                          delay_rounds=(1, 3))
+        burst = FaultPlan(seed=22, drop_p=0.10, partitions=(
+            Partition(0, 1 << 30, frozenset({str(B.name)})),
+        ))
+        classes = [BULK, SERVICE, INTERACTIVE]
+        handles, rejected = [], 0
+        completions: dict[int, int] = {}
+        try:
+            mocknet.net.set_fault_injector(FaultInjector(chaos))
+            for i in range(60):
+                flow = PingFlow(str(B.name))
+                flow.priority = classes[i % 3]
+                try:
+                    h = smm.start_flow(flow, deadline_s=2.0)
+                except FlowAdmissionError:
+                    rejected += 1
+                    continue
+                idx = len(handles)
+                completions[idx] = 0
+
+                def done(_f, _i=idx):
+                    completions[_i] += 1
+
+                h.result.add_done_callback(done)
+                handles.append(h)
+                if i == 20:
+                    mocknet.net.set_fault_injector(FaultInjector(burst))
+                if i == 32:
+                    mocknet.net.set_fault_injector(FaultInjector(chaos))
+                time.sleep(0.01)
+            # every admitted future resolves (ok or error) within a
+            # bounded wall — errors are fine, hanging forever is the
+            # metastable failure this certifies against
+            deadline = time.monotonic() + 120
+            while not all(h.result.done() for h in handles):
+                assert time.monotonic() < deadline, (
+                    f"{sum(not h.result.done() for h in handles)} futures "
+                    "never resolved"
+                )
+                time.sleep(0.1)
+            # ... exactly once
+            assert all(v == 1 for v in completions.values()), completions
+        finally:
+            mocknet.net.set_fault_injector(None)
+        # checkpoints bounded: initiator side fully drained
+        deadline = time.monotonic() + 20
+        while smm.checkpoints.all_flows():
+            assert time.monotonic() < deadline, (
+                f"checkpoints leaked: {len(smm.checkpoints.all_flows())}"
+            )
+            time.sleep(0.05)
+        # retry volume reconciles against the budget
+        snap = gov.snapshot()
+        nets = active_netstats()
+        retransmits = nets.total_retransmits() if nets else 0
+        assert snap["retry_granted"] <= snap["budget_earned"]
+        assert retransmits <= 2 * snap["retry_granted"] + 16, (
+            retransmits, snap["retry_granted"],
+        )
+        configure_netstats(enabled=False, reset=True)
+        # post-storm recovery: a clean batch completes
+        ok = 0
+        for _ in range(10):
+            flow = PingFlow(str(B.name))
+            try:
+                h = smm.start_flow(flow, deadline_s=10.0)
+            except FlowAdmissionError:
+                continue
+            try:
+                if h.result.result(timeout=30) == 2:
+                    ok += 1
+            except Exception:
+                pass
+        assert ok >= 8, f"node did not recover: {ok}/10 clean flows"
+
+
+# -------------------------------------------------- off-by-default pin
+
+class TestOffByDefault:
+    def test_section_disabled_marker(self):
+        configure_overload(enabled=False)
+        assert overload_section() == {"enabled": False}
+        assert active_overload() is None
+
+    def test_monitoring_snapshot_carries_section(self, gov):
+        from corda_tpu.node.monitoring import monitoring_snapshot
+
+        snap = monitoring_snapshot()
+        assert snap["overload"]["enabled"] is True
+        assert "limit" in snap["overload"]
+
+    def test_zero_overhead_when_off(self):
+        """Fresh subprocess, CORDA_TPU_OVERLOAD unset, a REAL session
+        flow: no overload./retry_budget./admission. registry names, no
+        new threads, the disabled snapshot marker, and SessionInit wire
+        bytes identical to a pre-overload build (no deadline key)."""
+        code = """
+import json, os, threading
+os.environ.pop("CORDA_TPU_OVERLOAD", None)
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.testing import MockNetworkNodes
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.flows.overload import active_overload
+from corda_tpu.flows.sessions import SessionInit
+from corda_tpu.serialization import serialize
+threads_before = {t.name for t in threading.enumerate()}
+with MockNetworkNodes() as net:
+    alice = net.create_node("OffAlice")
+    notary = net.create_notary_node("OffNotary")
+    alice.run_flow(CashIssueFlow(100, "GBP", b"\\x01", notary.party))
+assert active_overload() is None
+snap = monitoring_snapshot()
+assert snap["overload"] == {"enabled": False}, snap["overload"]
+names = list(node_metrics().snapshot())
+assert not any(
+    n.startswith(("overload.", "retry_budget.", "admission."))
+    for n in names
+), names
+threads_after = {t.name for t in threading.enumerate()}
+new = {t for t in threads_after - threads_before
+       if not t.startswith(("mock-net-pump", "flow-", "notary-",
+                            "verifier", "serving", "wal"))}
+assert not new, new
+assert b"deadline" not in serialize(SessionInit(1, "x.Y", b""))
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]

@@ -510,6 +510,64 @@ class TestPerfGate:
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
 
+    def test_check_schema_validates_overload_section(self, tmp_path):
+        """ISSUE 16 satellite: the `overload` section the smoke's
+        metastability-certification leg emits is schema-validated —
+        well-formed passes; a missing key, a failed certification flag,
+        a goodput ratio inconsistent with storm/baseline, retry grants
+        above the earned budget, retransmit volume escaping the budget,
+        and a recovery wall past its limit all fail."""
+        good = dict(self.SYNTHETIC)
+        good["overload"] = {
+            "schema": 1, "base_qps": 8.0, "overload_qps": 24.0,
+            "deadline_s": 6.0, "baseline_goodput_qps": 8.0,
+            "storm_goodput_qps": 6.0, "goodput_ratio": 0.75,
+            "goodput_floor": 0.5, "goodput_floor_ok": 1,
+            "recovery_goodput_qps": 7.6, "recovery_ratio": 0.95,
+            "recovery_floor": 0.9, "recovery_wall_s": 6.5,
+            "recovery_wall_limit_s": 30.0, "recovery_ok": 1,
+            "brownout_order_ok": 1, "admission_rejected": 40,
+            "deadline_shed": 12, "retransmits": 120,
+            "retry_budget_granted": 100, "retry_budget_denied": 9,
+            "retry_budget_earned": 250.0, "retry_budget_ok": 1,
+        }
+        ok = tmp_path / "ovl.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        # a disabled section is not a failure (the leg may be skipped)
+        off = dict(self.SYNTHETIC)
+        off["overload"] = {"enabled": False}
+        offp = tmp_path / "ovl_off.json"
+        offp.write_text(json.dumps(off))
+        proc = self._run("--result", str(offp), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("storm_goodput_qps"),
+             "missing numeric 'storm_goodput_qps'"),
+            (lambda d: d.__setitem__("goodput_floor_ok", 0),
+             "goodput_floor_ok is 0"),
+            (lambda d: d.__setitem__("recovery_ok", 0),
+             "recovery_ok is 0"),
+            (lambda d: d.__setitem__("goodput_ratio", 0.2),
+             "inconsistent with storm/baseline"),
+            (lambda d: d.__setitem__("retry_budget_granted", 400),
+             "exceeds budget earned"),
+            (lambda d: d.__setitem__("retransmits", 5000),
+             "retry volume escaped the budget"),
+            (lambda d: d.__setitem__("recovery_wall_s", 31.0),
+             "recovery must be prompt"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["overload"])
+            bad = tmp_path / "ovl_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
     def test_gate_passes_in_tolerance_fails_on_20pct_regression(
         self, tmp_path
     ):

@@ -27,6 +27,21 @@ Knobs:
     --device         device-batched signature verification
     --sampler        attach the stack sampler's folded stacks
     --out PATH       output path (default LOADTEST.json)
+
+Overload certification (docs/OVERLOAD.md) rides the same CLI: after the
+ramp locates the knee, ``--overload`` re-runs the harness topology as a
+three-phase metastability scenario — baseline at the knee, a storm at
+``--overload-factor``× the knee under partition bursts + message chaos,
+then recovery back at the knee — with deadline propagation, retry
+budgets and adaptive admission enabled. The scored ``overload`` section
+(goodput floor, brownout order, retry-budget reconciliation, bounded
+recovery wall) merges into LOADTEST.json and is validated by the same
+``--check-schema``; any failed certification flag exits nonzero.
+
+    --overload            run the metastability scenario past the knee
+    --overload-factor F   storm arrival multiple of the knee (default 3)
+    --storm S             storm duration in seconds (default 6)
+    --recovery S          recovery wall bound in seconds (default 30)
 """
 
 from __future__ import annotations
@@ -65,6 +80,15 @@ def main(argv: list[str] | None = None) -> int:
                     help="device-batched signature verification")
     ap.add_argument("--sampler", action="store_true",
                     help="attach the stack sampler's folded stacks")
+    ap.add_argument("--overload", action="store_true",
+                    help="after the ramp, certify graceful degradation "
+                         "at --overload-factor × the knee under chaos")
+    ap.add_argument("--overload-factor", type=float, default=3.0,
+                    help="storm arrival multiple of the knee (default 3)")
+    ap.add_argument("--storm", type=float, default=6.0,
+                    help="storm duration in seconds (default 6)")
+    ap.add_argument("--recovery", type=float, default=30.0,
+                    help="recovery wall bound in seconds (default 30)")
     ap.add_argument("--out", default="LOADTEST.json")
     args = ap.parse_args(argv)
 
@@ -132,6 +156,51 @@ def main(argv: list[str] | None = None) -> int:
         "top phases: "
         + ", ".join(f"{p} {v:.2f}s" for p, v in top)
     )
+    if args.overload:
+        from corda_tpu.tools.loadharness import OverloadConfig, run_overload
+
+        ocfg = OverloadConfig(
+            base_qps=knee["qps"],
+            overload_factor=args.overload_factor,
+            storm_s=args.storm,
+            recovery_s=args.recovery,
+            # deadline = caller's give-up point, a few multiples of the
+            # SLO target — not the SLO itself (under storm backoffs a
+            # 1×p99 deadline kills every retransmitting flow)
+            deadline_s=3.0 * args.p99,
+            slo_p99_s=args.p99,
+            workload=args.workload,
+            seed=args.seed,
+            durable=args.durable,
+            use_device=args.device,
+        )
+        section = run_overload(ocfg)["overload"]
+        result["overload"] = section
+        path = write_loadtest(result, args.out)
+        print(
+            "loadgen: overload {oq:g} qps ({f:g}x knee) — goodput "
+            "{gr:.0%} of baseline (floor {gf:.0%}), recovered to "
+            "{rr:.0%} in {rw:.1f}s, rejected {rej}, shed {shed}, "
+            "retry budget {gr_n}/{earn:g}".format(
+                oq=section["overload_qps"], f=args.overload_factor,
+                gr=section["goodput_ratio"], gf=section["goodput_floor"],
+                rr=section["recovery_ratio"],
+                rw=section["recovery_wall_s"],
+                rej=section["admission_rejected"],
+                shed=section["deadline_shed"],
+                gr_n=section["retry_budget_granted"],
+                earn=section["retry_budget_earned"],
+            )
+        )
+        bad = [
+            flag for flag in ("goodput_floor_ok", "recovery_ok",
+                              "brownout_order_ok", "retry_budget_ok")
+            if not section.get(flag)
+        ]
+        if bad:
+            print(f"loadgen: overload certification FAILED: "
+                  f"{', '.join(bad)}; wrote {path}")
+            return 1
     print(f"loadgen: wrote {path}")
     print(json.dumps({"knee_qps": knee["qps"], "steps": len(result['steps'])}))
     return 0

@@ -121,6 +121,14 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # IS the section, with ``knee_qps`` at top level.
     "loadtest/knee_qps": ("higher", 0.50),
     "knee_qps": ("higher", 0.50),
+    # overload certification (docs/OVERLOAD.md): goodput retained past the
+    # knee during a partition/chaos storm, and goodput recovered after the
+    # storm ends, both relative to the pre-storm baseline. Loose tolerances:
+    # both ratios ride mocknet latency under injected chaos on a shared CI
+    # host — the hard floors (0.5 / 0.9) are enforced by the scenario's own
+    # *_ok flags, which --check-schema requires to be true.
+    "overload/goodput_ratio": ("higher", 0.40),
+    "overload/recovery_ratio": ("higher", 0.30),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -185,6 +193,21 @@ CLUSTER_REQUIRED_KEYS = (
     "hops", "nodes", "transit_p50_s", "transit_p99_s",
     "federation_nodes", "rollup_p99_s", "node_p99_min_s",
     "node_p99_max_s", "pernode_reconcile_ok",
+)
+
+# keys the overload section must carry for --check-schema (the
+# metastability-certification pass — docs/OVERLOAD.md): offered load vs
+# the knee, goodput retained during the storm and recovered after it,
+# brownout ordering, and the retry-budget counter reconciliation
+OVERLOAD_REQUIRED_KEYS = (
+    "base_qps", "overload_qps", "deadline_s",
+    "baseline_goodput_qps", "storm_goodput_qps", "goodput_ratio",
+    "goodput_floor", "goodput_floor_ok",
+    "recovery_goodput_qps", "recovery_ratio", "recovery_floor",
+    "recovery_wall_s", "recovery_wall_limit_s", "recovery_ok",
+    "brownout_order_ok", "admission_rejected", "deadline_shed",
+    "retransmits", "retry_budget_granted", "retry_budget_denied",
+    "retry_budget_earned", "retry_budget_ok",
 )
 
 # the flowprof closed phase set (corda_tpu/observability/flowprof.PHASES,
@@ -572,6 +595,69 @@ def check_schema(result: dict) -> list[str]:
                             f"loadtest/knee: p99_s {kp99} below p50_s "
                             f"{kp50} (quantiles must be monotone)"
                         )
+    overload = result.get("overload")
+    if overload is not None:
+        if not isinstance(overload, dict):
+            problems.append("overload: expected an object")
+        elif not overload.get("enabled", True):
+            # a disabled capture ({"enabled": false}) carries no numbers
+            pass
+        else:
+            def onum(key):
+                v = overload.get(key)
+                return v if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) else None
+
+            for key in OVERLOAD_REQUIRED_KEYS:
+                if onum(key) is None:
+                    problems.append(f"overload: missing numeric {key!r}")
+                elif onum(key) < 0:
+                    problems.append(
+                        f"overload: negative {key} {onum(key)}"
+                    )
+            for flag in ("goodput_floor_ok", "recovery_ok",
+                         "brownout_order_ok", "retry_budget_ok"):
+                v = onum(flag)
+                if v is not None and v != 1:
+                    problems.append(
+                        f"overload: {flag} is {v:g} (the pass must prove "
+                        "graceful degradation, not merely run)"
+                    )
+            base, storm = onum("baseline_goodput_qps"), \
+                onum("storm_goodput_qps")
+            ratio = onum("goodput_ratio")
+            if (base is not None and storm is not None
+                    and ratio is not None and base > 0
+                    and abs(ratio - storm / base) > 0.01):
+                problems.append(
+                    f"overload: goodput_ratio {ratio} inconsistent with "
+                    f"storm/baseline ({storm / base:.3f})"
+                )
+            granted, earned = onum("retry_budget_granted"), \
+                onum("retry_budget_earned")
+            if (granted is not None and earned is not None
+                    and granted > earned):
+                problems.append(
+                    f"overload: retry_budget_granted {granted:g} exceeds "
+                    f"budget earned {earned:g} (the token bucket cannot "
+                    "grant more than fresh sends funded)"
+                )
+            retx = onum("retransmits")
+            if (retx is not None and granted is not None
+                    and retx > 2 * granted + 16):
+                problems.append(
+                    f"overload: retransmits {retx:g} exceed "
+                    f"2×retry_budget_granted+16 ({2 * granted + 16:g}) — "
+                    "retry volume escaped the budget"
+                )
+            wall, limit = onum("recovery_wall_s"), \
+                onum("recovery_wall_limit_s")
+            if wall is not None and limit is not None and wall > limit:
+                problems.append(
+                    f"overload: recovery_wall_s {wall:g} exceeds the "
+                    f"{limit:g}s bound (recovery must be prompt, not "
+                    "eventual)"
+                )
     cluster = result.get("cluster")
     if cluster is not None:
         if not isinstance(cluster, dict):
