@@ -1628,6 +1628,191 @@ def run_smoke_durability() -> dict:
         shutil.rmtree(base, ignore_errors=True)
 
 
+def run_smoke_statestore() -> dict:
+    """The smoke's statestore leg (docs/STATE_STORE.md): a
+    ``DeviceShardedUniquenessProvider`` on the virtual-device mesh is
+    bulk-loaded to a LOW occupancy, its batched device probe/commit
+    throughput measured, loaded further to a HIGH occupancy and
+    re-measured — probe cost must survive table fill — while an
+    ``InMemoryUniquenessProvider`` oracle runs the identical workload:
+    verdicts and ``consumed_digest()`` must stay bit-identical,
+    including a deliberate double-spend sweep. Emits the ``statestore``
+    section (probes/sec at both occupancies, spill counts, parity
+    flags) that ``tools_perf_gate.py --check-schema`` validates."""
+    import hashlib
+
+    from corda_tpu.crypto import SecureHash
+    from corda_tpu.ledger import StateRef
+    from corda_tpu.notary.uniqueness import InMemoryUniquenessProvider
+    from corda_tpu.statestore import configure_statestore, statestore_enabled
+    from corda_tpu.statestore.provider import DeviceShardedUniquenessProvider
+    from corda_tpu.statestore.table import key_rows
+
+    was_enabled = statestore_enabled()
+    configure_statestore(enabled=True)
+
+    def tx(i: int) -> SecureHash:
+        return SecureHash(hashlib.sha256(b"smoke-st-%d" % i).digest())
+
+    def refs(lo: int, hi: int) -> list:
+        return [StateRef(tx(i), 0) for i in range(lo, hi)]
+
+    try:
+        oracle = InMemoryUniquenessProvider()
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=1024, max_probe=16,
+        )
+
+        def commit_range(lo: int, hi: int, batch: int = 64) -> float:
+            t0 = time.perf_counter()
+            for s in range(lo, hi, batch):
+                reqs = [
+                    ([StateRef(tx(i), 0)], tx(100_000 + i), "smoke")
+                    for i in range(s, min(s + batch, hi))
+                ]
+                a = oracle.commit_batch(reqs)
+                d = dev.commit_batch(reqs)
+                assert [x is None for x in a] == [x is None for x in d], (
+                    "statestore verdicts diverged from the host oracle"
+                )
+            return time.perf_counter() - t0
+
+        def probe_rate(n_rows: int) -> float:
+            from corda_tpu.notary.uniqueness import _ref_key
+
+            rows = key_rows(
+                [_ref_key(r) for r in refs(0, n_rows // 2)]
+                + [_ref_key(r) for r in refs(10**6, 10**6 + n_rows // 2)]
+            )
+            dev._table.probe_rows(rows)        # warm the compile
+            reps = 5
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                bits = dev._table.probe_rows(rows)
+            wall = time.perf_counter() - t0
+            assert bits[: n_rows // 2].all() and not bits[n_rows // 2:].any()
+            return n_rows * reps / wall
+
+        commit_range(0, 512)              # ~6% of 8192 slots
+        occ_low = dev._table.occupancy()
+        probes_low = probe_rate(512)
+        commit_range(512, 4096)           # ~50%
+        occ_high = dev._table.occupancy()
+        probes_high = probe_rate(512)
+
+        # double-spend sweep: every re-commit under a new tx must
+        # conflict, identically on both providers
+        thief = [
+            ([StateRef(tx(i), 0)], tx(900_000 + i), "smoke-thief")
+            for i in range(0, 4096, 64)
+        ]
+        a = oracle.commit_batch(thief)
+        d = dev.commit_batch(thief)
+        verdict_parity = int(
+            [x is None for x in a] == [x is None for x in d]
+            and all(x is not None for x in d)
+        )
+        digest_parity = int(
+            oracle.consumed_digest() == dev.consumed_digest()
+        )
+        assert verdict_parity == 1, "double-spend sweep verdicts diverged"
+        assert digest_parity == 1, "consumed_digest diverged from oracle"
+        stats = dev.table_stats()
+        return {
+            "statestore": {
+                "rows": stats["live_rows"],
+                "shards": stats["shards"],
+                "slots_per_shard": stats["slots_per_shard"],
+                "occupancy_low": round(occ_low, 4),
+                "occupancy_high": round(occ_high, 4),
+                "probes_per_sec": round(probes_low, 1),
+                "probes_per_sec_high": round(probes_high, 1),
+                "spill_rows": stats["spill_rows"],
+                "verdict_parity": verdict_parity,
+                "digest_parity": digest_parity,
+            }
+        }
+    finally:
+        configure_statestore(enabled=was_enabled)
+
+
+def run_statestore_scale() -> int:
+    """``bench.py --statestore-scale``: the 10^7-state scenario — a
+    seed-deterministic streamed ledger (``stream_commit_requests``, no
+    signing, bounded frontier) is committed through a shadowless
+    ``DeviceShardedUniquenessProvider`` in large batches, every
+    conflict check a batched device probe. Row count via
+    ``CORDA_TPU_BENCH_STATESTORE_ROWS`` (default 10^7). Prints one JSON
+    line; exit 0 iff the expected-conflict accounting holds."""
+    from corda_tpu.statestore import configure_statestore
+    from corda_tpu.statestore.provider import DeviceShardedUniquenessProvider
+    from corda_tpu.testing.generated_ledger import stream_commit_requests
+
+    n_states = int(os.environ.get(
+        "CORDA_TPU_BENCH_STATESTORE_ROWS", str(10**7)
+    ))
+    batch = 4096
+    configure_statestore(enabled=True)
+    # shards × slots sized to hold the spent set at ~50% occupancy;
+    # overflow beyond the probe window spills host-side and is counted
+    slots = 1 << max(12, (n_states // 8).bit_length())
+    dev = DeviceShardedUniquenessProvider(
+        slots_per_shard=slots, max_probe=64, shadow=False,
+    )
+    out = {
+        "metric": "statestore_scale", "unit": "states", "ok": False,
+        "n_states": n_states,
+    }
+    t0 = time.perf_counter()
+    window: list = []
+    expect: list = []
+    n_commits = n_conflicts = want_conflicts = spent_rows = 0
+    try:
+        def flush() -> None:
+            nonlocal n_commits, n_conflicts, spent_rows
+            if not window:
+                return
+            res = dev.commit_batch(window)
+            for r, exp in zip(res, expect):
+                if r is None:
+                    n_commits += 1
+                else:
+                    n_conflicts += 1
+                assert not (exp and r is None), (
+                    "a deliberate double-spend was admitted"
+                )
+            window.clear()
+            expect.clear()
+
+        for req in stream_commit_requests(
+            seed=2026, n_states=n_states, double_spend_fraction=0.01,
+        ):
+            window.append((list(req.refs), req.tx_id, req.caller))
+            expect.append(req.expect_conflict)
+            want_conflicts += int(req.expect_conflict)
+            spent_rows += len(req.refs)
+            if len(window) >= batch:
+                flush()
+        flush()
+        assert n_conflicts >= want_conflicts, (n_conflicts, want_conflicts)
+        out.update({
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "commits": n_commits,
+            "conflicts": n_conflicts,
+            "deliberate_double_spends": want_conflicts,
+            "spent_rows": spent_rows,
+            "rows_per_sec": round(
+                spent_rows / max(time.perf_counter() - t0, 1e-9), 1
+            ),
+            "table": dev.table_stats(),
+        })
+        out["ok"] = True
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    print(json.dumps(out), flush=True)
+    return 0 if out["ok"] else 1
+
+
 def run_smoke_batchverify() -> dict:
     """The smoke's batch-verification leg (docs/BATCH_VERIFY.md): the
     RLC batch check must agree with per-signature verification on clean
@@ -2051,6 +2236,13 @@ def run_smoke() -> int:
         # the fault passes without touching any measured number.
         out.update(run_smoke_durability())
 
+        # 10b. statestore pass (docs/STATE_STORE.md): the device-sharded
+        # uniqueness table bulk-loaded and probe/commit-measured at two
+        # occupancies against the in-memory oracle — verdicts AND
+        # consumed-set digest bit-identical, double-spends rejected.
+        # Rides after the fault passes; restores the feature gate.
+        out.update(run_smoke_statestore())
+
         # 11. batchverify pass (docs/BATCH_VERIFY.md): RLC batch≡per-sig
         # parity at N=16/64, offender bisection at the corner positions,
         # and one BLS aggregate-QC encode/decode/verify round trip.
@@ -2269,4 +2461,6 @@ def main() -> int:
 if __name__ == "__main__":
     if "--smoke" in sys.argv[1:]:
         sys.exit(run_smoke())
+    if "--statestore-scale" in sys.argv[1:]:
+        sys.exit(run_statestore_scale())
     sys.exit(main())

@@ -308,9 +308,11 @@ def monitoring_snapshot() -> dict:
     ``cluster`` the cross-node hop recorder's status
     (observability/cluster, same off-marker contract), ``overload`` the
     overload governor's admission/retry-budget/deadline-shed state
-    (flows/overload — ``{"enabled": false}`` while off), ``process`` the
-    remaining cross-cutting metrics (e.g. the verifier's
-    ``device_failover`` counters)."""
+    (flows/overload — ``{"enabled": false}`` while off), ``statestore``
+    the device-resident sharded state store's table stats + probe/spill
+    registries (corda_tpu/statestore — ``{"enabled": false}`` until the
+    first device table exists), ``process`` the remaining cross-cutting
+    metrics (e.g. the verifier's ``device_failover`` counters)."""
     from corda_tpu.durability import durability_section
     from corda_tpu.flows.overload import overload_section
     from corda_tpu.messaging.netstats import netstats_section
@@ -320,6 +322,7 @@ def monitoring_snapshot() -> dict:
     from corda_tpu.observability.sampler import sampler_section
     from corda_tpu.observability.slo import slo_section
     from corda_tpu.serving.resilience import resilience_section
+    from corda_tpu.statestore import statestore_section
 
     return {
         "serving": _process_registry.section("serving."),
@@ -333,6 +336,7 @@ def monitoring_snapshot() -> dict:
         "net": netstats_section(),
         "cluster": cluster_section(),
         "overload": overload_section(),
+        "statestore": statestore_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
@@ -345,6 +349,7 @@ def monitoring_snapshot() -> dict:
                     or k.startswith("cluster.")
                     or k.startswith("overload.")
                     or k.startswith("retry_budget.")
-                    or k.startswith("admission."))
+                    or k.startswith("admission.")
+                    or k.startswith("statestore."))
         },
     }

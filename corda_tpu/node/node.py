@@ -292,7 +292,18 @@ class Node:
             # replica set's keys up front); the container builds the
             # single-replica and Raft tiers
             notary_store = self._durable_store_for("notary")
-            if notary_store is not None:
+            from corda_tpu.statestore import statestore_enabled
+
+            if statestore_enabled():
+                # device-resident consumed set (docs/STATE_STORE.md);
+                # the durable store, when configured, becomes its
+                # recovery/spill journal
+                from corda_tpu.statestore import (
+                    DeviceShardedUniquenessProvider,
+                )
+
+                uniqueness = DeviceShardedUniquenessProvider(notary_store)
+            elif notary_store is not None:
                 from corda_tpu.notary import DurableUniquenessProvider
 
                 uniqueness = DurableUniquenessProvider(notary_store)

@@ -112,7 +112,7 @@ class NodeVaultService:
     """
 
     def __init__(self, path: str = ":memory:", my_keys=None, observe_all=False,
-                 journal=None):
+                 journal=None, state_index=None):
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("PRAGMA journal_mode=WAL")
         self._db.execute(
@@ -153,6 +153,17 @@ class NodeVaultService:
         # SQLite vault is already durable on its own.
         self._journal = journal
         self.last_recovery = None
+        # device-resident unconsumed-state index (docs/STATE_STORE.md):
+        # explicit injection wins; otherwise constructed iff
+        # CORDA_TPU_STATESTORE=1 (maybe_vault_index returns None while
+        # the feature is off — no device allocations on the default
+        # path). Attached BEFORE journal recovery so replay repopulates
+        # it alongside the SQL pages.
+        if state_index is None:
+            from corda_tpu.statestore import maybe_vault_index
+
+            state_index = maybe_vault_index()
+        self._state_index = state_index
         # LSN of the last journal record whose SQL effect is known
         # applied (appends happen strictly AFTER their _apply_stx, so a
         # snapshot claiming coverage of this LSN can never lack it)
@@ -254,6 +265,20 @@ class NodeVaultService:
                         )
                 produced.append(StateAndRef(tstate, ref))
             self._db.commit()
+            if self._state_index is not None and not (consumed == [] and produced == []):
+                # keep the device index synchronous with the SQL pages
+                # (same locked region, so a query between the two views
+                # can never observe them disagreeing)
+                self._state_index.remove_states([sr.ref for sr in consumed])
+                adds = []
+                for sr in produced:
+                    parts = getattr(sr.state.data, "participants", ())
+                    owner = (
+                        getattr(parts[0], "owning_key", parts[0])
+                        if parts else None
+                    )
+                    adds.append((sr.ref, owner))
+                self._state_index.add_states(adds)
             lsn = None
             if journal and self._journal is not None:
                 lsn = self._journal.append(
@@ -524,8 +549,35 @@ class NodeVaultService:
             raise SoftLockError(
                 f"insufficient funds: have {total}, need {required_quantity}"
             )
+        if self._state_index is not None:
+            # device cross-check of the SQL selection: every picked ref
+            # must be in the unconsumed index; a miss is counted, never
+            # fatal (SQL is authoritative — see docs/STATE_STORE.md)
+            bits = self._state_index.contains([sr.ref for sr in picked])
+            if bits is not None and not all(bits):
+                from corda_tpu.node.monitoring import node_metrics
+
+                node_metrics().counter(
+                    "statestore.vault.select_mismatch"
+                ).inc(int(len(bits) - bits.sum()))
         self.soft_lock_reserve(lock_id, [sr.ref for sr in picked])
         return picked
+
+    def unconsumed_ref_exists(self, ref: StateRef) -> bool:
+        """Membership of one ref in the UNCONSUMED page — answered by
+        the device index when one is attached (falling back to SQL on a
+        probe failure), by SQL otherwise."""
+        if self._state_index is not None:
+            bits = self._state_index.contains([ref])
+            if bits is not None:
+                return bool(bits[0])
+        with self._lock:
+            row = self._db.execute(
+                "SELECT 1 FROM vault_states"
+                " WHERE tx_id=? AND output_index=? AND consumed=0",
+                (ref.txhash.bytes, ref.index),
+            ).fetchone()
+        return row is not None
 
     def close(self) -> None:
         if self._journal is not None:

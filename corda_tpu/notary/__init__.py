@@ -25,7 +25,20 @@ from .raft import RaftNode, RaftUniquenessProvider
 from .raft_storage import RaftStorage
 from .bft import BFTClusterClient, BFTReplica, BFTUniquenessProvider
 
+
+def __getattr__(name: str):
+    # lazy: the device-sharded provider lives in corda_tpu.statestore
+    # (docs/STATE_STORE.md) and is re-exported here as a notary backend
+    # without importing that package on the default path
+    if name == "DeviceShardedUniquenessProvider":
+        from corda_tpu.statestore import DeviceShardedUniquenessProvider
+
+        return DeviceShardedUniquenessProvider
+    raise AttributeError(name)
+
+
 __all__ = [
+    "DeviceShardedUniquenessProvider",
     "DurableUniquenessProvider",
     "InMemoryUniquenessProvider", "NotaryError", "PersistentUniquenessProvider",
     "UniquenessConflict", "UniquenessProvider",

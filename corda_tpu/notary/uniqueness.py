@@ -126,10 +126,53 @@ class InMemoryUniquenessProvider(UniquenessProvider):
                     _ref_key(ref), ConsumedStateDetails(tx_id, i, caller_name)
                 )
 
+    def commit_batch(self, requests):
+        """Single-pass batch settle under ONE lock acquisition (the base
+        class's default loops ``commit()``, re-taking the lock per
+        request). Conflict reporting is pinned identical to the loop:
+        requests settle in order, a committed request's keys conflict
+        later requests in the same batch, and an idempotent re-commit of
+        the same tx succeeds. This is the host shadow's fair A/B
+        baseline for the device-sharded provider
+        (docs/STATE_STORE.md)."""
+        out: list[UniquenessConflict | None] = []
+        with self._lock:
+            for states, tx_id, caller in requests:
+                conflict = {}
+                for ref in states:
+                    prior = self._map.get(_ref_key(ref))
+                    if prior is not None and prior.consuming_tx != tx_id:
+                        conflict[ref] = prior
+                if conflict:
+                    out.append(UniquenessConflict(conflict))
+                    continue
+                for i, ref in enumerate(states):
+                    self._map.setdefault(
+                        _ref_key(ref), ConsumedStateDetails(tx_id, i, caller)
+                    )
+                out.append(None)
+        return out
+
     def committed_txs(self) -> int:
         """Distinct transactions committed (ops/loadtest observability)."""
         with self._lock:
             return len({d.consuming_tx for d in self._map.values()})
+
+    def consumed_digest(self) -> str:
+        """Same formula as ``DurableUniquenessProvider.consumed_digest``
+        — this provider is the never-crashed host-map ORACLE the
+        device-sharded statestore must match bit-for-bit."""
+        import hashlib
+
+        h = hashlib.sha256()
+        with self._lock:
+            for key in sorted(self._map):
+                d = self._map[key]
+                h.update(key)
+                h.update(d.consuming_tx.bytes)
+                h.update(d.input_index.to_bytes(4, "big"))
+                h.update(d.requesting_party_name.encode())
+        return h.hexdigest()
 
 
 class DurableUniquenessProvider(UniquenessProvider):

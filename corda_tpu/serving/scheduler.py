@@ -270,7 +270,7 @@ class _MeshPending:
 
     __slots__ = ("_rows", "_mask", "spent_all", "total_valid", "_n",
                  "device_rows", "device_mask", "padded_lanes",
-                 "stall_until")
+                 "stall_until", "statestore_hits")
 
     def __init__(self, rows: list, mask, spent_all, total, bucket: int):
         self._rows = rows            # (PublicKey, sig, msg): host fallback
@@ -282,6 +282,9 @@ class _MeshPending:
         self.device_mask = np.ones(len(rows), dtype=bool)
         self.padded_lanes = int(bucket)
         self.stall_until = None      # injected-stall horizon (faultinject)
+        # device scalar from the statestore's fused membership screen
+        # over spent_all (docs/STATE_STORE.md); harvested at collect()
+        self.statestore_hits = None
 
     def inject_stall(self, delay_s: float) -> None:
         if delay_s <= 0:
@@ -303,7 +306,7 @@ class _MeshPending:
             if delay > 0:
                 time.sleep(delay)
         try:
-            return np.asarray(self._mask)[: self._n]
+            out = np.asarray(self._mask)[: self._n]
         except Exception:
             from corda_tpu.crypto import is_valid
 
@@ -313,6 +316,16 @@ class _MeshPending:
             return np.array(
                 [is_valid(k, s, m) for k, s, m in self._rows], dtype=bool
             )
+        if self.statestore_hits is not None:
+            try:
+                hits = int(self.statestore_hits)
+            except Exception:
+                _metrics().counter("statestore.mega_screen_failed").inc()
+            else:
+                m = _metrics()
+                m.counter("statestore.mega_probe_rows").inc(self._n)
+                m.counter("statestore.mega_probe_hits").inc(hits)
+        return out
 
 
 class DeviceScheduler:
@@ -861,9 +874,23 @@ class DeviceScheduler:
             keys, sigs, msgs, min_bucket=bucket,
             spent_hashes=_consumed_rows(msgs),
         )
-        return _MeshPending(
+        pending = _MeshPending(
             dev_rows, mask, spent_all, total, bucket=int(mask.shape[0]),
         )
+        from corda_tpu.statestore import active_mega_screen
+
+        screen = active_mega_screen()
+        if screen is not None:
+            # fuse the statestore's conflict screen into the same
+            # dispatch round: probe the still-device-resident consumed
+            # delta against the sharded table — device-to-device, no
+            # host copy; the hit count settles with the batch
+            # (docs/STATE_STORE.md §Serving fusion)
+            try:
+                pending.statestore_hits = screen(spent_all, len(dev_rows))
+            except Exception:
+                _metrics().counter("statestore.mega_screen_failed").inc()
+        return pending
 
     # ------------------------------------------------------------- hedging
     def _arm_hedge(self, entry: _InFlight) -> None:

@@ -1,0 +1,152 @@
+"""Device-resident sharded state store (docs/STATE_STORE.md).
+
+The authoritative "is this state consumed?" set, moved from a host
+Python dict onto the accelerator mesh: ``DeviceShardedTable`` is the
+HBM linear-probe table, ``DeviceShardedUniquenessProvider`` the notary
+backend that conflict-checks and commits a whole batch in one fused
+device round-trip, ``DeviceVaultIndex`` the vault's unconsumed-ref
+membership + owner-bucket index. ``DurableStore`` (docs/DURABILITY.md)
+is the recovery/spill tier beneath the provider.
+
+Feature-gated: ``CORDA_TPU_STATESTORE=1`` (``configure_statestore`` in
+process). While off the subsystem costs nothing — no device
+allocations, no threads, no metrics; ``statestore_section()`` reports
+``{"enabled": False}``; the serving scheduler's mega-batch hook is two
+module-attribute reads.
+"""
+
+from __future__ import annotations
+
+import os
+
+_env_checked = False
+_enabled = False
+_slots_per_shard: int | None = None
+_max_probe: int | None = None
+
+# process-lifetime registry of constructed tables (only enabled owners
+# build tables, so this stays empty — and the section stays
+# {"enabled": False} — while the feature is off)
+_TABLES: list = []
+
+# the uniqueness provider's fused mega-batch membership screen
+# (serving/scheduler.py probes the all-gathered consumed delta through
+# this without materializing it on the host); None until a provider
+# registers
+_mega_screen = None
+
+
+def statestore_enabled() -> bool:
+    """One-time env probe of ``CORDA_TPU_STATESTORE`` (cached — the
+    steady-state disabled cost is one global read)."""
+    global _env_checked, _enabled
+    if not _env_checked:
+        _enabled = os.environ.get(
+            "CORDA_TPU_STATESTORE", ""
+        ).strip().lower() in ("1", "true", "yes", "on")
+        _env_checked = True
+    return _enabled
+
+
+def configure_statestore(enabled: bool | None = None,
+                         slots_per_shard: int | None = None,
+                         max_probe: int | None = None) -> None:
+    """In-process override of the env gate + table geometry (tests,
+    embedders). Does not touch existing tables."""
+    global _env_checked, _enabled, _slots_per_shard, _max_probe
+    if enabled is not None:
+        _enabled = bool(enabled)
+        _env_checked = True
+    if slots_per_shard is not None:
+        _slots_per_shard = int(slots_per_shard)
+    if max_probe is not None:
+        _max_probe = int(max_probe)
+
+
+def default_slots_per_shard() -> int:
+    if _slots_per_shard is not None:
+        return _slots_per_shard
+    return int(os.environ.get("CORDA_TPU_STATESTORE_SLOTS", "4096"))
+
+
+def default_max_probe() -> int:
+    if _max_probe is not None:
+        return _max_probe
+    return int(os.environ.get("CORDA_TPU_STATESTORE_PROBE", "32"))
+
+
+def _register_table(table) -> None:
+    _TABLES.append(table)
+
+
+def set_mega_screen(fn) -> None:
+    """Register (or clear, with None) the fused mega-batch screen."""
+    global _mega_screen
+    _mega_screen = fn
+
+
+def active_mega_screen():
+    return _mega_screen
+
+
+def statestore_section() -> dict:
+    """Monitoring section. ``{"enabled": False}`` until the first table
+    exists (the latch is table construction itself — nothing to reset,
+    nothing allocated while off)."""
+    if not _TABLES:
+        return {"enabled": False}
+    from corda_tpu.node.monitoring import node_metrics
+
+    return {
+        "enabled": True,
+        "tables": [t.stats() for t in _TABLES],
+        "metrics": node_metrics().section("statestore."),
+    }
+
+
+def maybe_vault_index():
+    """A fresh ``DeviceVaultIndex`` when the feature is on, else None —
+    the vault's construction-time hook (node/vault.py)."""
+    if not statestore_enabled():
+        return None
+    from corda_tpu.statestore.vault_index import DeviceVaultIndex
+
+    return DeviceVaultIndex()
+
+
+def __getattr__(name: str):
+    # lazy re-exports: importing corda_tpu.statestore while the feature
+    # is off must not pull in jax or allocate anything
+    if name in ("DeviceShardedTable", "TOMBSTONE", "key_rows",
+                "payload_rows"):
+        from corda_tpu.statestore import table as _t
+
+        return getattr(_t, name)
+    if name in ("DeviceShardedUniquenessProvider", "StateStoreSpillError"):
+        from corda_tpu.statestore import provider as _p
+
+        return getattr(_p, name)
+    if name == "DeviceVaultIndex":
+        from corda_tpu.statestore.vault_index import DeviceVaultIndex
+
+        return DeviceVaultIndex
+    raise AttributeError(name)
+
+
+__all__ = [
+    "DeviceShardedTable",
+    "DeviceShardedUniquenessProvider",
+    "DeviceVaultIndex",
+    "StateStoreSpillError",
+    "TOMBSTONE",
+    "active_mega_screen",
+    "configure_statestore",
+    "default_max_probe",
+    "default_slots_per_shard",
+    "key_rows",
+    "maybe_vault_index",
+    "payload_rows",
+    "set_mega_screen",
+    "statestore_enabled",
+    "statestore_section",
+]

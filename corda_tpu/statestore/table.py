@@ -1,0 +1,490 @@
+"""Device-resident sharded hash table (docs/STATE_STORE.md).
+
+One open-addressing table striped over the mesh: each device ordinal
+owns ``slots_per_shard`` contiguous slots of a (shards × slots) linear-
+probe table living in device memory (HBM on TPU), placed with the same
+``NamedSharding`` the mesh verifier shards batches with
+(``parallel/mesh.py``). A row is
+
+- ``keys``  (S, 8) int32 — the SHA-256 of the member key, the same
+  ``"<i4"`` word view the serving mega-batch uses for its consumed-set
+  delta (``serving/scheduler._consumed_rows``);
+- ``txs``   (S, 8) int32 — the raw 32-byte payload words stored beside
+  the key (the uniqueness provider keeps the consuming tx id here —
+  raw, not hashed, so idempotent re-commit checks compare the full
+  256-bit identity on device);
+- ``tags``  (S,)   int32 — 0 = empty, odd = live (low bit set; the
+  uniqueness table stores ``key_word0|1``, the vault index an
+  owner-bucket fold), ``2`` = tombstone (slot freed by a delete but
+  kept non-empty so later probes of colliding keys still scan past
+  it).
+
+A key hashes to one owner shard (``word1 mod n_shards``) and one home
+slot (``word2 mod slots_per_shard``); probes scan a fixed ``max_probe``
+window from the home slot (wrapping). A window with no free slot on
+insert reports the row as OVERFLOW — the caller spills it to the host
+tier and counts it (``statestore.spills``); membership stays exact
+because the spill set is consulted beside every device probe.
+
+Kernels (all one ``shard_map`` dispatch each, collectives only where a
+cross-shard verdict is required):
+
+- ``probe``: vectorized membership of B replicated query rows — each
+  shard scans the windows it owns, one psum combines the bits;
+- ``commit``: the fused conflict-check + insert for a notary batch —
+  phase 1 probes every (request, ref) row in parallel and one psum
+  produces the per-request conflict verdict (a hit whose stored 256-bit
+  payload differs from the committing tx), phase 2 sequentially inserts
+  the rows of non-conflicted requests on their owner shards (no
+  collectives — batch keys are host-deduplicated, see
+  ``provider.py``); table arrays are DONATED so the update is in-place
+  in device memory;
+- ``remove``: sequential tombstone pass (the vault index frees
+  consumed refs).
+
+Construction is the feature gate's device-allocation point: nothing in
+this module allocates until a table object is built, and tables are
+only built by enabled owners (``CORDA_TPU_STATESTORE=1`` — see
+``__init__.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import numpy as np
+
+TOMBSTONE = 2
+
+_DEF_SLOTS = 4096
+_DEF_PROBE = 32
+
+
+def key_rows(keys: list[bytes]) -> np.ndarray:
+    """(N, 8) int32 rows: the SHA-256 of each member key viewed as
+    little-endian int32 words — the same row shape/byte order the
+    serving mega-batch all-gathers for its consumed-set delta."""
+    out = np.zeros((len(keys), 8), dtype=np.int32)
+    for i, k in enumerate(keys):
+        out[i] = np.frombuffer(hashlib.sha256(k).digest(), dtype="<i4")
+    return out
+
+
+def payload_rows(payloads: list[bytes]) -> np.ndarray:
+    """(N, 8) int32 rows of raw 32-byte payloads (consuming tx ids) —
+    NOT hashed, so the device row is invertible back to the id."""
+    out = np.zeros((len(payloads), 8), dtype=np.int32)
+    for i, p in enumerate(payloads):
+        if len(p) != 32:
+            raise ValueError(f"payload must be 32 bytes, got {len(p)}")
+        out[i] = np.frombuffer(p, dtype="<i4")
+    return out
+
+
+def _pow2_at_least(n: int, floor: int = 8) -> int:
+    b = max(floor, 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+class DeviceShardedTable:
+    """One mesh-sharded open-addressing table. Thread-safe: a single
+    lock serializes mutating dispatches (the provider/vault layers hold
+    their own locks too; this one makes the table safe standalone)."""
+
+    def __init__(self, mesh=None, slots_per_shard: int | None = None,
+                 max_probe: int | None = None, name: str = "statestore"):
+        import jax
+        from corda_tpu.parallel.mesh import make_mesh
+        from corda_tpu.statestore import (
+            _register_table,
+            default_max_probe,
+            default_slots_per_shard,
+        )
+
+        self.name = name
+        self.mesh = mesh or make_mesh()
+        self.n_shards = int(np.prod(self.mesh.devices.shape))
+        self.slots_per_shard = int(
+            slots_per_shard or default_slots_per_shard()
+        )
+        self.max_probe = int(max_probe or default_max_probe())
+        if self.max_probe > self.slots_per_shard:
+            self.max_probe = self.slots_per_shard
+        self.total_slots = self.n_shards * self.slots_per_shard
+        self._lock = threading.Lock()
+        self._steps: dict = {}   # (kind, *shape) -> compiled step
+        self._n_live = 0         # host count of live device rows
+        self._axis = self.mesh.axis_names[0]
+        sharding = self._sharding()
+        zk = np.zeros((self.total_slots, 8), np.int32)
+        zt = np.zeros((self.total_slots,), np.int32)
+        self._keys = jax.device_put(zk, sharding)
+        self._txs = jax.device_put(zk, sharding)
+        self._tags = jax.device_put(zt, sharding)
+        _register_table(self)
+
+    # ----------------------------------------------------------- plumbing
+    def _sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(self._axis))
+
+    def _compat(self) -> dict:
+        from corda_tpu.parallel.mesh import _shard_map_compat_kwargs
+
+        return _shard_map_compat_kwargs()
+
+    def _shard_map(self, fn, in_specs, out_specs):
+        import jax
+
+        try:
+            from jax import shard_map
+        except ImportError:  # older jax releases
+            from jax.experimental.shard_map import shard_map
+
+        return shard_map(
+            fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+            **self._compat(),
+        )
+
+    # ------------------------------------------------------------ kernels
+    def _probe_step(self, b: int):
+        """Vectorized membership for ``b`` replicated query rows."""
+        step = self._steps.get(("probe", b))
+        if step is not None:
+            return step
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        n_shards, S, W = self.n_shards, self.slots_per_shard, self.max_probe
+        axis = self._axis
+
+        def fn(keys, tags, q):
+            me = jax.lax.axis_index(axis).astype(jnp.int32)
+            owner = (
+                q[:, 1].astype(jnp.uint32) % jnp.uint32(n_shards)
+            ).astype(jnp.int32)
+            mine = owner == me
+            h = (
+                q[:, 2].astype(jnp.uint32) % jnp.uint32(S)
+            ).astype(jnp.int32)
+            win = (h[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]) % S
+            hit = ((tags[win] & 1) != 0) & jnp.all(
+                keys[win] == q[:, None, :], axis=-1
+            )
+            found = jnp.any(hit, axis=-1) & mine
+            return jax.lax.psum(found.astype(jnp.int32), axis)
+
+        spec = P(axis)
+        step = jax.jit(self._shard_map(
+            fn, in_specs=(spec, spec, P()), out_specs=P()
+        ))
+        self._steps[("probe", b)] = step
+        return step
+
+    def _commit_step(self, r: int, k: int):
+        """Fused conflict-check + insert for (r requests × k ref slots).
+        Batch keys must be unique across the whole (r, k) grid — the
+        provider host-routes intra-batch duplicates (provider.py)."""
+        step = self._steps.get(("commit", r, k))
+        if step is not None:
+            return step
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        n_shards, S, W = self.n_shards, self.slots_per_shard, self.max_probe
+        axis = self._axis
+        rk = r * k
+
+        def fn(keys, txs, tags, q, qtx, qtag, valid, pre_conflict, force):
+            me = jax.lax.axis_index(axis).astype(jnp.int32)
+            qf = q.reshape(rk, 8)
+            txrep = jnp.repeat(qtx, k, axis=0)          # (rk, 8)
+            owner = (
+                qf[:, 1].astype(jnp.uint32) % jnp.uint32(n_shards)
+            ).astype(jnp.int32)
+            mine = (owner == me) & (valid.reshape(rk) != 0)
+            h = (
+                qf[:, 2].astype(jnp.uint32) % jnp.uint32(S)
+            ).astype(jnp.int32)
+            win = (h[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]) % S
+            live = (tags[win] & 1) != 0                  # (rk, W)
+            hit = live & jnp.all(keys[win] == qf[:, None, :], axis=-1)
+            differs = jnp.any(txs[win] != txrep[:, None, :], axis=-1)
+            present_l = (jnp.any(hit, axis=-1) & mine).astype(jnp.int32)
+            conf_l = (
+                jnp.any(hit & differs, axis=-1) & mine
+            ).astype(jnp.int32)
+            # ONE collective: every shard learns the global per-request
+            # verdict before the insert pass — the conflict check and the
+            # consumed-set commit share this shard_map round
+            both = jax.lax.psum(
+                jnp.concatenate([present_l, conf_l]), axis
+            )
+            present = both[:rk]
+            conflict = jnp.minimum(
+                both[rk:].reshape(r, k).sum(axis=1) + pre_conflict, 1
+            )
+            conflict = jnp.where(force != 0, 0, conflict)
+            do = mine & (jnp.repeat(conflict, k) == 0) & (present == 0)
+
+            def body(i, carry):
+                def attempt(c):
+                    ks, ts, gs, ov = c
+                    wt = gs[win[i]]
+                    free = (wt & 1) == 0
+                    has = jnp.any(free)
+                    pos = win[i, jnp.argmax(free)]
+
+                    def write(c2):
+                        k2, t2, g2, o2 = c2
+                        k2 = k2.at[pos].set(qf[i])
+                        t2 = t2.at[pos].set(txrep[i])
+                        g2 = g2.at[pos].set(qtag.reshape(rk)[i] | 1)
+                        return k2, t2, g2, o2
+
+                    def spill(c2):
+                        k2, t2, g2, o2 = c2
+                        return k2, t2, g2, o2.at[i].set(1)
+
+                    return jax.lax.cond(has, write, spill, (ks, ts, gs, ov))
+
+                return jax.lax.cond(do[i], attempt, lambda c: c, carry)
+
+            ov0 = jnp.zeros(rk, jnp.int32)
+            keys, txs, tags, ov = jax.lax.fori_loop(
+                0, rk, body, (keys, txs, tags, ov0)
+            )
+            overflow = jax.lax.psum(ov, axis).reshape(r, k)
+            n_ins = jax.lax.psum(
+                jnp.sum(do.astype(jnp.int32)) - jnp.sum(ov), axis
+            )
+            return keys, txs, tags, conflict, overflow, n_ins
+
+        spec = P(axis)
+        step = jax.jit(
+            self._shard_map(
+                fn,
+                in_specs=(spec, spec, spec, P(), P(), P(), P(), P(), P()),
+                out_specs=(spec, spec, spec, P(), P(), P()),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._steps[("commit", r, k)] = step
+        return step
+
+    def _remove_step(self, b: int):
+        step = self._steps.get(("remove", b))
+        if step is not None:
+            return step
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        n_shards, S, W = self.n_shards, self.slots_per_shard, self.max_probe
+        axis = self._axis
+
+        def fn(keys, txs, tags, q, valid):
+            me = jax.lax.axis_index(axis).astype(jnp.int32)
+            owner = (
+                q[:, 1].astype(jnp.uint32) % jnp.uint32(n_shards)
+            ).astype(jnp.int32)
+            mine = (owner == me) & (valid != 0)
+            h = (
+                q[:, 2].astype(jnp.uint32) % jnp.uint32(S)
+            ).astype(jnp.int32)
+            win = (h[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]) % S
+
+            def body(i, carry):
+                def attempt(c):
+                    ks, ts, gs, rm = c
+                    wk, wg = ks[win[i]], gs[win[i]]
+                    hit = ((wg & 1) != 0) & jnp.all(
+                        wk == q[i][None, :], axis=-1
+                    )
+                    has = jnp.any(hit)
+                    pos = win[i, jnp.argmax(hit)]
+
+                    def tomb(c2):
+                        k2, t2, g2, r2 = c2
+                        k2 = k2.at[pos].set(jnp.zeros(8, jnp.int32))
+                        t2 = t2.at[pos].set(jnp.zeros(8, jnp.int32))
+                        g2 = g2.at[pos].set(TOMBSTONE)
+                        return k2, t2, g2, r2.at[i].set(1)
+
+                    return jax.lax.cond(
+                        has, tomb, lambda c2: c2, (ks, ts, gs, rm)
+                    )
+
+                return jax.lax.cond(mine[i], attempt, lambda c: c, carry)
+
+            rm0 = jnp.zeros(b, jnp.int32)
+            keys, txs, tags, rm = jax.lax.fori_loop(
+                0, b, body, (keys, txs, tags, rm0)
+            )
+            return keys, txs, tags, jax.lax.psum(rm, axis)
+
+        spec = P(axis)
+        step = jax.jit(
+            self._shard_map(
+                fn,
+                in_specs=(spec, spec, spec, P(), P()),
+                out_specs=(spec, spec, spec, P()),
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+        self._steps[("remove", b)] = step
+        return step
+
+    # --------------------------------------------------------- public ops
+    def probe_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Membership bits for (N, 8) int32 query rows — one dispatch,
+        bucket-padded; returns (N,) bool."""
+        n = rows.shape[0]
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        b = _pow2_at_least(n)
+        q = np.zeros((b, 8), np.int32)
+        q[:n] = rows
+        # pad rows are all-zero keys; a zero key CAN legitimately be
+        # probed, but its pad duplicates only re-report the same bit
+        with self._lock:
+            step = self._probe_step(b)
+            found = step(self._keys, self._tags, q)
+        return np.asarray(found)[:n] > 0
+
+    def probe_device_count(self, rows_dev, n: int):
+        """Fused membership screen over an ALREADY-DEVICE-RESIDENT (B, 8)
+        int32 row array (the serving mega-batch's all-gathered consumed
+        delta): probes without any host materialization of the rows and
+        returns the DEVICE scalar hit count — the caller reads it back
+        whenever it settles the batch. ``n`` bounds the real rows (the
+        tail is collective padding)."""
+        import jax.numpy as jnp
+
+        b = int(rows_dev.shape[0])
+        with self._lock:
+            step = self._probe_step(b)
+            found = step(self._keys, self._tags, rows_dev.astype(jnp.int32))
+        return (found[:n] > 0).sum()
+
+    def commit_rows(
+        self,
+        q: np.ndarray,          # (R, K, 8) int32
+        qtx: np.ndarray,        # (R, 8) int32
+        valid: np.ndarray,      # (R, K) int32
+        pre_conflict: np.ndarray,   # (R,) int32
+        force: np.ndarray,      # (R,) int32
+        qtag: np.ndarray | None = None,   # (R, K) int32 tag values
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """ONE fused device round-trip: per-request conflict verdicts +
+        insert of every row of non-conflicted requests. Returns
+        (conflict (R,) bool, overflow (R, K) bool). Keys must be unique
+        across the batch (caller-enforced)."""
+        r0, k0 = q.shape[0], q.shape[1]
+        r, k = _pow2_at_least(r0), _pow2_at_least(k0, 1)
+        qp = np.zeros((r, k, 8), np.int32)
+        qp[:r0, :k0] = q
+        txp = np.zeros((r, 8), np.int32)
+        txp[:r0] = qtx
+        vp = np.zeros((r, k), np.int32)
+        vp[:r0, :k0] = valid
+        pcp = np.zeros((r,), np.int32)
+        pcp[:r0] = pre_conflict
+        fp = np.zeros((r,), np.int32)
+        fp[:r0] = force
+        tagp = np.zeros((r, k), np.int32)
+        if qtag is None:
+            tagp[:, :] = qp[:, :, 0]
+        else:
+            tagp[:r0, :k0] = qtag
+        with self._lock:
+            step = self._commit_step(r, k)
+            (self._keys, self._txs, self._tags, conflict, overflow,
+             n_ins) = step(
+                self._keys, self._txs, self._tags, qp, txp, tagp, vp,
+                pcp, fp,
+            )
+            conflict = np.asarray(conflict)[:r0] > 0
+            overflow = np.asarray(overflow)[:r0, :k0] > 0
+            self._n_live += int(n_ins)
+        return conflict, overflow
+
+    def insert_rows(self, rows: np.ndarray, payloads: np.ndarray,
+                    tags: np.ndarray | None = None) -> np.ndarray:
+        """Insert-only bulk load (recovery rebuild, vault produce): rows
+        already present are skipped, no conflict check. Returns the
+        (N,) bool overflow mask. Duplicate keys WITHIN one call must be
+        host-deduplicated by the caller."""
+        n = rows.shape[0]
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        q = rows.reshape(n, 1, 8)
+        valid = np.ones((n, 1), np.int32)
+        tagm = None if tags is None else tags.reshape(n, 1)
+        _conflict, overflow = self.commit_rows(
+            q, payloads, valid,
+            np.zeros(n, np.int32), np.ones(n, np.int32), qtag=tagm,
+        )
+        return overflow.reshape(n)
+
+    def remove_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Tombstone (N, 8) rows; returns (N,) bool removed-on-device
+        (False = the key was not device-resident — spilled or absent)."""
+        n = rows.shape[0]
+        if n == 0:
+            return np.zeros(0, dtype=bool)
+        b = _pow2_at_least(n)
+        q = np.zeros((b, 8), np.int32)
+        q[:n] = rows
+        v = np.zeros((b,), np.int32)
+        v[:n] = 1
+        with self._lock:
+            step = self._remove_step(b)
+            self._keys, self._txs, self._tags, removed = step(
+                self._keys, self._txs, self._tags, q, v
+            )
+            removed = np.asarray(removed)[:n] > 0
+            self._n_live -= int(removed.sum())
+        return removed
+
+    def count_tag(self, tag: int) -> int:
+        """Device scan: live slots carrying exactly ``tag`` (the vault
+        index's owner-bucket count). Plain jnp over the sharded array —
+        XLA partitions the reduction."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            return int(jnp.sum(self._tags == jnp.int32(tag | 1)))
+
+    def live_rows(self) -> tuple[np.ndarray, np.ndarray]:
+        """AUDIT op (digest verification, tests): download the table and
+        return (keys (N, 8), payloads (N, 8)) of every live row. Not a
+        hot path — one full host copy."""
+        with self._lock:
+            tags = np.asarray(self._tags)
+            mask = (tags & 1) != 0
+            return np.asarray(self._keys)[mask], np.asarray(self._txs)[mask]
+
+    # -------------------------------------------------------------- stats
+    @property
+    def n_live(self) -> int:
+        return self._n_live
+
+    def occupancy(self) -> float:
+        return self._n_live / float(self.total_slots)
+
+    def stats(self) -> dict:
+        return {
+            "name": self.name,
+            "shards": self.n_shards,
+            "slots_per_shard": self.slots_per_shard,
+            "max_probe": self.max_probe,
+            "live_rows": self._n_live,
+            "occupancy": round(self.occupancy(), 6),
+        }

@@ -1,0 +1,126 @@
+"""DeviceVaultIndex: the vault's unconsumed-state index on device
+(docs/STATE_STORE.md).
+
+A second ``DeviceShardedTable`` tracking the vault's UNCONSUMED page:
+recording a transaction inserts the produced refs (tag = an owner-
+bucket fold of the first participant's key, so "how many unconsumed
+states does this owner hold" is one device reduction) and tombstones
+the consumed ones; ``contains`` answers batched unconsumed-ref
+membership, feeding coin selection's cross-check. Rows the probe window
+cannot place spill to a host set — membership consults it beside every
+device probe, like the provider's spill tier.
+
+The SQLite vault remains authoritative: the index is a synchronously-
+maintained accelerator of membership answers, and a ``statestore.probe``
+fault on its dispatch degrades to the SQL answer
+(``statestore.vault.probe_failover``) instead of failing the caller.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+
+import numpy as np
+
+from corda_tpu.faultinject import check_site
+from corda_tpu.notary.uniqueness import _ref_key
+from corda_tpu.statestore.table import DeviceShardedTable, key_rows
+
+
+def owner_bucket(owner_key) -> int:
+    """30-bit odd positive fold of a serialized owner key — the tag
+    value grouping an owner's unconsumed rows for the device-side
+    count. Bucket collisions merge counts (approximate by design)."""
+    from corda_tpu.serialization import serialize
+
+    h = hashlib.sha256(serialize(owner_key)).digest()
+    raw = int.from_bytes(h[:4], "little") & 0x3FFFFFFF
+    return (raw << 1) | 1
+
+
+class DeviceVaultIndex:
+    def __init__(self, mesh=None, slots_per_shard: int | None = None,
+                 max_probe: int | None = None):
+        from corda_tpu.node.monitoring import node_metrics
+
+        self._table = DeviceShardedTable(
+            mesh=mesh, slots_per_shard=slots_per_shard,
+            max_probe=max_probe, name="vault",
+        )
+        self._spill: dict[bytes, int] = {}   # ref key -> owner bucket
+        self._lock = threading.Lock()
+        self._metrics = node_metrics()
+
+    # ---------------------------------------------------------- mutation
+    def add_states(self, items) -> None:
+        """``items``: (StateRef, owner_key_or_None) produced rows.
+        Idempotent — re-recording an stx re-offers present rows and the
+        table skips them."""
+        if not items:
+            return
+        with self._lock:
+            keys = [_ref_key(ref) for ref, _ in items]
+            rows = key_rows(keys)
+            payloads = np.zeros((len(items), 8), np.int32)
+            tags = np.zeros((len(items),), np.int32)
+            for i, (ref, owner) in enumerate(items):
+                payloads[i] = np.frombuffer(ref.txhash.bytes, dtype="<i4")
+                tags[i] = owner_bucket(owner) if owner is not None else 1
+            overflow = self._table.insert_rows(rows, payloads, tags)
+            for i, key in enumerate(keys):
+                if overflow[i] and key not in self._spill:
+                    self._spill[key] = int(tags[i])
+                    self._metrics.counter("statestore.vault.spills").inc()
+            self._metrics.counter("statestore.vault.adds").inc(len(items))
+
+    def remove_states(self, refs) -> None:
+        """Tombstone consumed refs (device first, spill otherwise)."""
+        if not refs:
+            return
+        with self._lock:
+            keys = [_ref_key(ref) for ref in refs]
+            removed = self._table.remove_rows(key_rows(keys))
+            for key, hit in zip(keys, removed):
+                if not hit:
+                    self._spill.pop(key, None)
+            self._metrics.counter("statestore.vault.removes").inc(
+                len(refs)
+            )
+
+    # --------------------------------------------------------- membership
+    def contains(self, refs) -> np.ndarray | None:
+        """Batched unconsumed-ref membership, or None when the device
+        probe fails (``statestore.probe`` fault site) — the caller falls
+        back to its authoritative SQL answer."""
+        if not refs:
+            return np.zeros(0, dtype=bool)
+        with self._lock:
+            keys = [_ref_key(ref) for ref in refs]
+            try:
+                check_site("statestore.probe")
+                bits = self._table.probe_rows(key_rows(keys))
+            except Exception:
+                self._metrics.counter(
+                    "statestore.vault.probe_failover"
+                ).inc()
+                return None
+            for i, key in enumerate(keys):
+                if key in self._spill:
+                    bits[i] = True
+        return bits
+
+    def owner_count(self, owner_key) -> int:
+        """Unconsumed rows in this owner's bucket — one device
+        reduction plus the host spill scan."""
+        bucket = owner_bucket(owner_key)
+        with self._lock:
+            n = self._table.count_tag(bucket)
+            n += sum(1 for b in self._spill.values() if b == bucket)
+        return n
+
+    # -------------------------------------------------------------- stats
+    def stats(self) -> dict:
+        stats = self._table.stats()
+        stats["spill_rows"] = len(self._spill)
+        return stats

@@ -10,10 +10,12 @@ scheduler, notary services) with realistic shapes.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import hashlib
 import random
 
-from corda_tpu.crypto import generate_keypair, sign_tx_id
+from corda_tpu.crypto import SecureHash, generate_keypair, sign_tx_id
 from corda_tpu.ledger import (
     Amount,
     CordaX500Name,
@@ -180,3 +182,82 @@ class GeneratedLedger:
             else:
                 self.move(with_notary_sig=with_notary_sig)
         return dict(self.transactions)
+
+    def stream(self, n: int, issue_fraction: float = 0.3,
+               with_notary_sig: bool = True, max_unspent: int = 4096):
+        """Streamed driver: yields each fully-signed transaction WITHOUT
+        retaining it, and caps the unspent frontier (oldest entries are
+        dropped — those states simply never get spent), so memory stays
+        bounded regardless of ``n``. Same seed ⇒ same stream."""
+        for _ in range(n):
+            if not self.unspent or self.rng.random() < issue_fraction:
+                stx = self.issue()
+            else:
+                stx = self.move(with_notary_sig=with_notary_sig)
+            self.transactions.pop(stx.id, None)
+            if len(self.unspent) > max_unspent:
+                del self.unspent[: len(self.unspent) - max_unspent]
+            yield stx
+
+
+@dataclasses.dataclass(frozen=True)
+class GenCommitRequest:
+    """One streamed uniqueness-commit request: ``(refs, tx_id, caller)``
+    plus whether the generator deliberately made it a double-spend (so a
+    scale test knows the expected verdict without tracking state)."""
+
+    refs: tuple
+    tx_id: SecureHash
+    caller: str
+    expect_conflict: bool
+
+
+def stream_commit_requests(
+    seed: int,
+    n_states: int,
+    *,
+    spend_fraction: float = 0.6,
+    double_spend_fraction: float = 0.0,
+    max_frontier: int = 8192,
+    caller: str = "gen-loadtest",
+):
+    """Seed-deterministic stream of notary commit requests building an
+    ``n_states``-output ledger with NO signing, NO state blobs and a
+    bounded unspent frontier — the shape a 10^7-state conflict-check
+    scale run needs (uniqueness providers never verify signatures, so a
+    scale sweep over them should not pay host ed25519 costs; the signed
+    path is ``GeneratedLedger.stream``). Tx ids are
+    ``sha256("gen:<seed>:<counter>")`` — same seed ⇒ bit-identical
+    stream. ``double_spend_fraction`` re-spends an already-consumed ref
+    (a fresh tx id, so the provider MUST report a conflict); such
+    requests are flagged ``expect_conflict`` and consume nothing."""
+    rng = random.Random(seed)
+    frontier: collections.deque = collections.deque()
+    spent_ring: collections.deque = collections.deque(maxlen=1024)
+    produced = 0
+    counter = 0
+    while produced < n_states:
+        counter += 1
+        tx_id = SecureHash(
+            hashlib.sha256(f"gen:{seed}:{counter}".encode()).digest()
+        )
+        if (double_spend_fraction > 0 and spent_ring
+                and rng.random() < double_spend_fraction):
+            ref = spent_ring[rng.randrange(len(spent_ring))]
+            yield GenCommitRequest((ref,), tx_id, caller, True)
+            continue
+        refs: list = []
+        if frontier and rng.random() < spend_fraction:
+            k = min(len(frontier), rng.randint(1, 3))
+            for _ in range(k):
+                refs.append(frontier.popleft())
+        n_out = rng.randint(1, 3)
+        yield GenCommitRequest(tuple(refs), tx_id, caller, False)
+        spent_ring.extend(refs)
+        for i in range(n_out):
+            frontier.append(StateRef(tx_id, i))
+            produced += 1
+        while len(frontier) > max_frontier:
+            # dropped states are simply never spent — the frontier (and
+            # so generator memory) stays O(max_frontier)
+            frontier.popleft()

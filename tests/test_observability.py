@@ -333,7 +333,7 @@ class TestMonitoringSurface:
         assert set(snap) == {"serving", "profiler", "devices", "slo",
                              "resilience", "durability", "flowprof",
                              "sampler", "net", "cluster", "overload",
-                             "process"}
+                             "statestore", "process"}
         # devicemon/slo/resilience/durability/flowprof/sampler are off by
         # default: bare disabled markers, no slots laid out, no metrics
         # created (ISSUE 7 overhead contract; ISSUEs 9/10 extend it to
@@ -353,6 +353,11 @@ class TestMonitoringSurface:
         assert "enabled" in snap["sampler"]
         assert snap["durability"] == {"enabled": False} \
             or snap["durability"]["enabled"] is True
+        # statestore latches like durability (a table built by ANY test
+        # in this process flips it); pristine off-state is subprocess-
+        # pinned in test_statestore.py::TestOffByDefault
+        assert snap["statestore"] == {"enabled": False} \
+            or snap["statestore"]["enabled"] is True
         assert "shed" in snap["serving"]
         assert "device_failover" not in snap["serving"]
         assert "verifier.device_failover" in snap["process"]

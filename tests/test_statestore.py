@@ -1,0 +1,981 @@
+"""Device-resident sharded state store (PR 17, docs/STATE_STORE.md).
+
+Acceptance axes:
+
+- table ops on the 8-virtual-device mesh: insert / probe / remove /
+  tombstone / probe-window overflow and the occupancy accounting;
+- the randomized double-spend sweep: ``DeviceShardedUniquenessProvider``
+  verdicts AND ``consumed_digest()`` bit-identical to the
+  ``InMemoryUniquenessProvider`` host-map oracle across fresh commits,
+  double-spends, idempotent client retries, multi-ref requests,
+  intra-batch duplicate keys (host-routed) and empty-ref requests;
+- the spill tier: probe-window overflow spills host-side with exact
+  membership, and a ``statestore.spill`` fault is a HARD error
+  (``StateStoreSpillError``), never silent;
+- ``statestore.probe`` faults: provider fails over to the host shadow
+  with identical verdicts (scale mode without a shadow raises), the
+  vault index degrades to its SQL answer;
+- durable recovery: restart-from-directory rebuilds the device table
+  (digest parity, device probes hit), and the kill-storm harness drives
+  the durable statestore through every PR 10 crash site + a torn WAL
+  tail, asserting the rebuilt ``consumed_digest()`` matches a
+  never-crashed host oracle bit-for-bit;
+- vault index wiring: record/consume maintains the device index beside
+  the SQL pages, coin selection cross-checks, owner-bucket counts;
+- the serving mega-batch fusion: the registered membership screen
+  counts device-resident hits and ``collect()`` harvests the counters;
+- satellites: single-pass ``InMemoryUniquenessProvider.commit_batch``
+  under ONE lock acquisition with loop-identical verdicts, and the
+  seed-deterministic streamed ledger generators (bounded memory, flagged
+  double-spends, a slow-marked 10^7-state scale run);
+- off-by-default: a fresh subprocess without ``CORDA_TPU_STATESTORE``
+  never imports jax from the statestore package, allocates no tables,
+  registers no ``statestore.*`` metrics and reports
+  ``{"enabled": False}``.
+"""
+
+import dataclasses
+import hashlib
+import itertools
+import json
+import os
+import random
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from corda_tpu.crypto import SecureHash, generate_keypair
+from corda_tpu.durability import DurableStore
+from corda_tpu.faultinject import (
+    FaultInjector,
+    FaultPlan,
+    InjectedCrash,
+    clear as clear_injector,
+    install as install_injector,
+    truncate_wal_tail,
+)
+from corda_tpu.ledger import (
+    Amount,
+    CordaX500Name,
+    Party,
+    StateRef,
+    TransactionBuilder,
+    register_contract,
+)
+from corda_tpu.node import NodeVaultService
+from corda_tpu.node.monitoring import node_metrics
+from corda_tpu.notary import InMemoryUniquenessProvider, NotaryError
+from corda_tpu.serialization import register_custom
+from corda_tpu.statestore import (
+    DeviceShardedTable,
+    DeviceShardedUniquenessProvider,
+    DeviceVaultIndex,
+    StateStoreSpillError,
+    active_mega_screen,
+    key_rows,
+    payload_rows,
+    statestore_section,
+)
+from corda_tpu.testing.generated_ledger import (
+    GeneratedLedger,
+    stream_commit_requests,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tx(i: int) -> SecureHash:
+    return SecureHash(hashlib.sha256(b"ss-tx-%d" % i).digest())
+
+
+def _ref(i: int, idx: int = 0) -> StateRef:
+    return StateRef(
+        SecureHash(hashlib.sha256(b"ss-ref-%d" % i).digest()), idx
+    )
+
+
+def _counters() -> dict:
+    return {
+        k: v["count"] for k, v in node_metrics().snapshot().items()
+        if k.startswith("statestore.") and v.get("type") == "counter"
+    }
+
+
+def _delta(before: dict) -> dict:
+    after = _counters()
+    return {
+        k: after.get(k, 0) - before.get(k, 0)
+        for k in set(after) | set(before)
+        if after.get(k, 0) != before.get(k, 0)
+    }
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    clear_injector()
+    yield
+    clear_injector()
+
+
+def _assert_verdicts_equal(want, got):
+    assert len(want) == len(got)
+    for w, g in zip(want, got):
+        assert (w is None) == (g is None), (w, g)
+        if w is not None:
+            assert w.state_history == g.state_history
+
+
+# ----------------------------------------------------------- table ops
+
+class TestDeviceTable:
+    def test_insert_probe_remove_tombstone(self):
+        t = DeviceShardedTable(slots_per_shard=64, max_probe=8, name="t1")
+        keys = [b"k-%d" % i for i in range(16)]
+        rows = key_rows(keys)
+        payloads = payload_rows([hashlib.sha256(k).digest() for k in keys])
+        overflow = t.insert_rows(rows, payloads)
+        assert not overflow.any()
+        assert t.n_live == 16
+        absent = key_rows([b"absent-%d" % i for i in range(8)])
+        assert t.probe_rows(rows).all()
+        assert not t.probe_rows(absent).any()
+        # re-offering present rows is idempotent (no duplicate rows)
+        overflow = t.insert_rows(rows, payloads)
+        assert not overflow.any()
+        assert t.n_live == 16
+        # tombstone half; membership flips only for the removed half
+        removed = t.remove_rows(rows[:8])
+        assert removed.all()
+        assert t.n_live == 8
+        bits = t.probe_rows(rows)
+        assert not bits[:8].any() and bits[8:].all()
+        # removing an absent key reports False, removes nothing
+        assert not t.remove_rows(absent).any()
+        # a tombstoned slot is reusable
+        assert not t.insert_rows(rows[:4], payloads[:4]).any()
+        assert t.probe_rows(rows[:4]).all()
+        assert t.n_live == 12
+        stats = t.stats()
+        assert stats["live_rows"] == 12
+        assert stats["shards"] >= 1
+        assert 0 < stats["occupancy"] < 1
+
+    def test_probe_window_overflow_reported(self):
+        t = DeviceShardedTable(slots_per_shard=8, max_probe=2, name="t2")
+        keys = [b"ovf-%d" % i for i in range(48)]
+        rows = key_rows(keys)
+        payloads = payload_rows(
+            [hashlib.sha256(k).digest() for k in keys]
+        )
+        overflow = t.insert_rows(rows, payloads)
+        # 48 rows into windows of 2 over 8-slot shards MUST overflow some
+        assert overflow.any() and not overflow.all()
+        bits = t.probe_rows(rows)
+        assert (bits == ~overflow).all()
+        assert t.n_live == int((~overflow).sum())
+
+    def test_count_tag(self):
+        t = DeviceShardedTable(slots_per_shard=64, max_probe=8, name="t3")
+        keys = [b"tag-%d" % i for i in range(12)]
+        tags = np.array([0x11] * 5 + [0x33] * 7, np.int32)
+        t.insert_rows(
+            key_rows(keys),
+            payload_rows([hashlib.sha256(k).digest() for k in keys]),
+            tags,
+        )
+        assert t.count_tag(0x11) == 5
+        assert t.count_tag(0x33) == 7
+        assert t.count_tag(0x55) == 0
+
+
+# -------------------------------------------- randomized oracle parity
+
+class TestOracleParity:
+    def test_randomized_double_spend_sweep(self):
+        """Verdicts AND consumed_digest() bit-identical to the host-map
+        oracle over 10 randomized batches mixing fresh commits,
+        double-spends, idempotent retries, multi-ref requests,
+        intra-batch duplicate keys and empty-ref requests."""
+        rng = random.Random(1707)
+        oracle = InMemoryUniquenessProvider()
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=256, max_probe=16
+        )
+        before = _counters()
+        counter = itertools.count()
+
+        def fresh_refs(k):
+            return [_ref(next(counter)) for _ in range(k)]
+
+        committed = []
+        try:
+            for batch_no in range(10):
+                reqs = []
+                for _ in range(11):
+                    roll = rng.random()
+                    if roll < 0.15 and committed:
+                        reqs.append(rng.choice(committed))   # retry
+                    elif roll < 0.35 and committed:
+                        states = rng.choice(committed)[0]
+                        reqs.append((
+                            [rng.choice(states)],
+                            _tx(10000 + next(counter)), "mallory",
+                        ))
+                    else:
+                        reqs.append((
+                            fresh_refs(rng.randint(1, 3)),
+                            _tx(20000 + next(counter)), "party",
+                        ))
+                # intra-batch duplicate keys: first-wins, host-routed
+                shared = fresh_refs(1)[0]
+                reqs.append(([shared] + fresh_refs(1),
+                             _tx(31000 + batch_no), "dup-a"))
+                reqs.append(([shared], _tx(32000 + batch_no), "dup-b"))
+                reqs.append(([], _tx(33000 + batch_no), "empty"))
+                want = oracle.commit_batch(reqs)
+                got = dev.commit_batch(reqs)
+                _assert_verdicts_equal(want, got)
+                for req, w in zip(reqs, want):
+                    if w is None and req[0] and req not in committed:
+                        committed.append(req)
+            assert dev.consumed_digest() == oracle.consumed_digest()
+            assert dev.device_divergence() == 0
+            d = _delta(before)
+            assert d.get("statestore.ab_mismatch", 0) == 0
+            assert d.get("statestore.host_routed", 0) >= 20
+            assert d.get("statestore.conflicts", 0) >= 10
+        finally:
+            dev.close()
+
+    def test_same_batch_fresh_commit_and_identical_retry(self):
+        """An identical retry of a fresh commit in the SAME batch (dup
+        keys, both idempotently succeed) installs the key ONCE on
+        device — no duplicate rows, digest parity held."""
+        oracle = InMemoryUniquenessProvider()
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=64, max_probe=8
+        )
+        try:
+            req = ([_ref(90001)], _tx(90001), "retry-client")
+            reqs = [req, req]
+            _assert_verdicts_equal(
+                oracle.commit_batch(reqs), dev.commit_batch(reqs)
+            )
+            assert dev._table.n_live + dev.spill_count() == 1
+            assert dev.consumed_digest() == oracle.consumed_digest()
+        finally:
+            dev.close()
+
+
+# ------------------------------------------------------------ spill tier
+
+class TestSpillTier:
+    def test_overflow_spills_with_exact_membership(self):
+        oracle = InMemoryUniquenessProvider()
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=8, max_probe=2
+        )
+        before = _counters()
+        try:
+            reqs = [
+                ([_ref(40000 + i)], _tx(40000 + i), "loader")
+                for i in range(48)
+            ]
+            for lo in range(0, 48, 8):
+                _assert_verdicts_equal(
+                    oracle.commit_batch(reqs[lo:lo + 8]),
+                    dev.commit_batch(reqs[lo:lo + 8]),
+                )
+            assert dev.spill_count() > 0
+            assert _delta(before).get("statestore.spills", 0) \
+                == dev.spill_count()
+            # double-spending SPILLED refs must still conflict exactly
+            spilled_keys = set(dev._spill)
+            thieves = [
+                ([states[0]], _tx(41000 + i), "mallory")
+                for i, (states, _t, _c) in enumerate(reqs)
+                if states[0].txhash.bytes
+                + states[0].index.to_bytes(4, "big") in spilled_keys
+            ][:4]
+            assert thieves, "no request landed in the spill tier"
+            _assert_verdicts_equal(
+                oracle.commit_batch(thieves), dev.commit_batch(thieves)
+            )
+            assert dev.consumed_digest() == oracle.consumed_digest()
+            assert dev.device_divergence() == 0
+            stats = dev.table_stats()
+            assert stats["spill_rows"] == dev.spill_count()
+        finally:
+            dev.close()
+
+    def test_spill_fault_is_a_hard_error(self):
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=8, max_probe=2
+        )
+        before = _counters()
+        install_injector(FaultInjector(FaultPlan(
+            seed=3, fail_sites=(("statestore.spill", 1),),
+        )))
+        try:
+            with pytest.raises(StateStoreSpillError):
+                for lo in range(0, 64, 8):
+                    dev.commit_batch([
+                        ([_ref(42000 + lo + i)], _tx(42000 + lo + i), "x")
+                        for i in range(8)
+                    ])
+            assert _delta(before).get("statestore.spill_errors", 0) == 1
+        finally:
+            clear_injector()
+            dev.close()
+
+
+# ---------------------------------------------------- probe-fault paths
+
+class TestProbeFaultFailover:
+    def test_failover_to_shadow_keeps_verdict_parity(self):
+        oracle = InMemoryUniquenessProvider()
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=256, max_probe=16
+        )
+        before = _counters()
+        try:
+            batch1 = [
+                ([_ref(50000 + i)], _tx(50000 + i), "p") for i in range(6)
+            ]
+            install_injector(FaultInjector(FaultPlan(
+                seed=4, fail_sites=(("statestore.probe", 1),),
+            )))
+            _assert_verdicts_equal(
+                oracle.commit_batch(batch1), dev.commit_batch(batch1)
+            )
+            clear_injector()
+            d = _delta(before)
+            assert d.get("statestore.probe_failover", 0) == 1
+            # failed-over commits live in the spill tier, so membership
+            # (and a later double-spend verdict) stays exact on the
+            # recovered device path
+            assert dev.spill_count() == 6
+            batch2 = (
+                [([_ref(50000 + i)], _tx(51000 + i), "mallory")
+                 for i in range(3)]
+                + [([_ref(52000 + i)], _tx(52000 + i), "p")
+                   for i in range(3)]
+            )
+            _assert_verdicts_equal(
+                oracle.commit_batch(batch2), dev.commit_batch(batch2)
+            )
+            assert dev.consumed_digest() == oracle.consumed_digest()
+            assert dev.device_divergence() == 0
+        finally:
+            clear_injector()
+            dev.close()
+
+    def test_scale_mode_probe_fault_raises(self):
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=64, max_probe=8, shadow=False
+        )
+        install_injector(FaultInjector(FaultPlan(
+            seed=5, fail_sites=(("statestore.probe", 1),),
+        )))
+        try:
+            with pytest.raises(NotaryError):
+                dev.commit_batch([([_ref(53000)], _tx(53000), "p")])
+        finally:
+            clear_injector()
+            dev.close()
+
+    def test_durable_store_requires_shadow(self, tmp_path):
+        with pytest.raises(ValueError):
+            DeviceShardedUniquenessProvider(
+                DurableStore(str(tmp_path), name="x"), shadow=False
+            )
+
+
+# ------------------------------------------------- durable recovery tier
+
+# the kill-storm workload (mirrors tests/test_durability._workload):
+# deliberate double-spends and client retries interleaved so every
+# crash schedule crosses them
+def _workload():
+    ops = []
+    for i in range(30):
+        ops.append(("commit", [_ref(60000 + i)], _tx(60000 + i), True))
+        if i == 9:
+            ops.append(
+                ("commit", [_ref(60003)], _tx(60900), False)
+            )  # double spend
+        if i == 14:
+            ops.append(("snapshot",))
+        if i == 15:
+            ops.append(
+                ("commit", [_ref(60010)], _tx(60010), True)
+            )  # client retry
+        if i == 24:
+            ops.append(("snapshot",))
+        if i == 25:
+            ops.append(
+                ("commit", [_ref(60020)], _tx(60901), False)
+            )  # double spend
+    return ops
+
+
+def _drive_device(base_dir, schedule=(), torn_cut=0, seed=2026):
+    """Run the workload against a durable DeviceShardedUniquenessProvider
+    under a crash schedule; on InjectedCrash EVERY in-memory object —
+    including the device table — is dropped (that is the crash), the
+    torn-write injector optionally chops the unacked WAL tail, and a
+    fresh provider rebuilds device state from the directory alone."""
+
+    def build():
+        return DeviceShardedUniquenessProvider(
+            DurableStore(
+                base_dir, name="ss", segment_max_bytes=256,
+                snapshot_every=1 << 30,
+            ),
+            slots_per_shard=64, max_probe=8,
+        )
+
+    inj = None
+    if schedule:
+        inj = install_injector(FaultInjector(FaultPlan(
+            seed=seed, crash_sites=tuple(schedule),
+        )))
+    prov = build()
+    outcomes = []
+    crashes = 0
+    i = 0
+    ops = _workload()
+    while i < len(ops):
+        op = ops[i]
+        try:
+            if op[0] == "snapshot":
+                prov.snapshot_now()
+                outcomes.append("snap")
+            else:
+                conflict = prov.commit_batch([(op[1], op[2], "ks")])[0]
+                outcomes.append(conflict is None)
+            i += 1  # ACKED: the client saw this op complete
+        except InjectedCrash:
+            crashes += 1
+            prov = None
+            if torn_cut:
+                truncate_wal_tail(os.path.join(base_dir, "wal"), torn_cut)
+            prov = build()
+            # client retry of the same op — its ack never arrived
+    if inj is not None:
+        clear_injector()
+    return outcomes, prov.consumed_digest(), crashes, prov
+
+
+def _drive_host_oracle():
+    """The never-crashed host-map oracle run of the same workload."""
+    prov = InMemoryUniquenessProvider()
+    outcomes = []
+    for op in _workload():
+        if op[0] == "snapshot":
+            outcomes.append("snap")
+        else:
+            conflict = prov.commit_batch([(op[1], op[2], "ks")])[0]
+            outcomes.append(conflict is None)
+    return outcomes, prov.consumed_digest()
+
+
+KILL_SCHEDULES = [
+    pytest.param(
+        (("durability.wal.pre_fsync", 5),), 5, id="pre-fsync-torn-tail"
+    ),
+    pytest.param(
+        (("durability.snapshot.rename", 1),), 0, id="mid-snapshot"
+    ),
+    pytest.param(
+        (("durability.wal.pre_fsync", 4),
+         ("durability.wal.post_fsync", 9),
+         ("durability.snapshot.rename", 2),
+         ("durability.compact", 2)),
+        0, id="kill-storm-all-sites",
+    ),
+]
+
+
+class TestDurableRecovery:
+    def test_restart_rebuilds_device_table(self, tmp_path):
+        """Restart-from-directory: snapshot + WAL replay repopulate the
+        shadow AND the device table (statestore.rebuild_rows), the
+        digest matches the pre-restart one bit-for-bit, and recovered
+        double-spend checks are answered by DEVICE probes."""
+        base = str(tmp_path)
+        dev = DeviceShardedUniquenessProvider(
+            DurableStore(base, name="ss", snapshot_every=1 << 30),
+            slots_per_shard=64, max_probe=8,
+        )
+        reqs = [
+            ([_ref(70000 + 2 * i), _ref(70000 + 2 * i + 1)],
+             _tx(70000 + i), "p")
+            for i in range(12)
+        ]
+        dev.commit_batch(reqs[:6])
+        dev.snapshot_now()
+        dev.commit_batch(reqs[6:])
+        digest = dev.consumed_digest()
+        dev.close()
+
+        before = _counters()
+        dev2 = DeviceShardedUniquenessProvider(
+            DurableStore(base, name="ss", snapshot_every=1 << 30),
+            slots_per_shard=64, max_probe=8,
+        )
+        try:
+            assert dev2.last_recovery is not None
+            assert dev2.last_recovery.replayed >= 6
+            d = _delta(before)
+            assert d.get("statestore.rebuild_rows", 0) == 24
+            assert dev2._table.n_live + dev2.spill_count() == 24
+            assert dev2.consumed_digest() == digest
+            assert dev2.device_divergence() == 0
+            # the recovered DEVICE table answers the conflict check
+            probe_before = _counters()
+            got = dev2.commit_batch(
+                [([_ref(70000)], _tx(79999), "mallory")]
+            )
+            assert got[0] is not None
+            assert _delta(probe_before).get(
+                "statestore.probe_rows", 0
+            ) >= 1
+            # and a fresh commit still lands
+            assert dev2.commit_batch(
+                [([_ref(71000)], _tx(71000), "p")]
+            ) == [None]
+        finally:
+            dev2.close()
+
+    @pytest.mark.parametrize("schedule,torn_cut", KILL_SCHEDULES)
+    def test_kill_storm_matches_host_oracle(self, tmp_path, schedule,
+                                            torn_cut):
+        """The PR 17 crash-recovery acceptance: the durable statestore
+        killed at PR 10's crash sites (incl. a torn WAL tail) loses no
+        acked commit, admits no double-spend, and the REBUILT device
+        table's consumed_digest() matches the never-crashed host-map
+        oracle bit-for-bit."""
+        oracle_outcomes, oracle_digest = _drive_host_oracle()
+        outcomes, digest, crashes, prov = _drive_device(
+            str(tmp_path), schedule=schedule, torn_cut=torn_cut
+        )
+        try:
+            assert crashes == len(schedule), (
+                "a scheduled crash site never fired — the schedule does "
+                "not cross the code path it claims to kill"
+            )
+            assert outcomes == oracle_outcomes
+            assert digest == oracle_digest
+            assert prov.device_divergence() == 0
+            # the recovered provider still rejects a fresh double-spend
+            with pytest.raises(NotaryError):
+                prov.commit([_ref(60000)], _tx(60902), "mallory")
+        finally:
+            prov.close()
+
+
+# ------------------------------------------------------ vault index tier
+
+@dataclasses.dataclass(frozen=True)
+class SSCoin:
+    amount: Amount
+    owner: Party
+
+    @property
+    def participants(self):
+        return [self.owner]
+
+
+@dataclasses.dataclass(frozen=True)
+class SSCoinCmd:
+    op: str = "issue"
+
+
+register_custom(
+    SSCoin, "test.ss.Coin",
+    to_fields=lambda s: {"q": s.amount.quantity, "t": s.amount.token,
+                         "o": s.owner},
+    from_fields=lambda d: SSCoin(Amount(d["q"], d["t"]), d["o"]),
+)
+register_custom(
+    SSCoinCmd, "test.ss.CoinCmd",
+    to_fields=lambda c: {"op": c.op},
+    from_fields=lambda d: SSCoinCmd(d["op"]),
+)
+
+
+@register_contract("test.ss.CoinContract")
+class SSCoinContract:
+    def verify(self, tx):
+        pass
+
+
+def _party(name: str):
+    kp = generate_keypair()
+    return Party(CordaX500Name(name, "London", "GB"), kp.public), kp
+
+
+def _issue(owner, notary_party, notary_kp, quantity=100, n_outputs=1):
+    b = TransactionBuilder(notary=notary_party)
+    for _ in range(n_outputs):
+        b.add_output_state(
+            SSCoin(Amount(quantity, "GBP"), owner), "test.ss.CoinContract"
+        )
+    b.add_command(SSCoinCmd("issue"), owner.owning_key)
+    return b.sign_initial_transaction(notary_kp)
+
+
+class TestVaultIndex:
+    @pytest.fixture(scope="class")
+    def parties(self):
+        return _party("SS Alice"), _party("SS Bob"), _party("SS Notary")
+
+    def test_record_consume_membership_and_owner_counts(self, parties):
+        (alice, alice_kp), (bob, _bob_kp), (notary, notary_kp) = parties
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        vault = NodeVaultService(observe_all=True, state_index=index)
+        before = _counters()
+        vault.record_transaction(
+            _issue(alice, notary, notary_kp, n_outputs=3)
+        )
+        refs = [sr.ref for sr in vault.unconsumed_states(SSCoin)]
+        assert len(refs) == 3
+        assert index.contains(refs).all()
+        assert index.owner_count(alice.owning_key) == 3
+        assert index.owner_count(bob.owning_key) == 0
+        assert vault.unconsumed_ref_exists(refs[0])
+        fake = StateRef(_tx(80000), 7)
+        assert not vault.unconsumed_ref_exists(fake)
+        # spend one: alice -> bob consumes a ref, produces bob's
+        b = TransactionBuilder(notary=notary)
+        sr = vault.unconsumed_states(SSCoin)[0]
+        b.add_input_state(sr)
+        b.add_output_state(
+            SSCoin(Amount(100, "GBP"), bob), "test.ss.CoinContract"
+        )
+        b.add_command(SSCoinCmd("move"), alice.owning_key)
+        vault.record_transaction(b.sign_initial_transaction(alice_kp))
+        assert not index.contains([sr.ref])[0]
+        assert not vault.unconsumed_ref_exists(sr.ref)
+        assert index.owner_count(alice.owning_key) == 2
+        assert index.owner_count(bob.owning_key) == 1
+        # coin selection cross-check: SQL picks are device-present
+        picked = vault.select_fungible("GBP", 150, "flow-ss", SSCoin)
+        assert len(picked) >= 2
+        d = _delta(before)
+        assert d.get("statestore.vault.select_mismatch", 0) == 0
+        assert d.get("statestore.vault.adds", 0) == 4
+        assert d.get("statestore.vault.removes", 0) == 1
+
+    def test_probe_fault_degrades_to_sql(self, parties):
+        (alice, _kp), _bob, (notary, notary_kp) = parties
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        vault = NodeVaultService(observe_all=True, state_index=index)
+        vault.record_transaction(_issue(alice, notary, notary_kp))
+        ref = vault.unconsumed_states(SSCoin)[0].ref
+        before = _counters()
+        install_injector(FaultInjector(FaultPlan(
+            seed=6, fail_sites=(("statestore.probe", 1),),
+        )))
+        try:
+            assert index.contains([ref]) is None
+            # the vault helper still answers correctly — from SQL
+            install_injector(FaultInjector(FaultPlan(
+                seed=6, fail_sites=(("statestore.probe", 1),),
+            )))
+            assert vault.unconsumed_ref_exists(ref)
+        finally:
+            clear_injector()
+        assert _delta(before).get(
+            "statestore.vault.probe_failover", 0
+        ) == 2
+
+    def test_journal_recovery_repopulates_index(self, parties, tmp_path):
+        (alice, _kp), _bob, (notary, notary_kp) = parties
+        store = DurableStore(str(tmp_path), name="vault")
+        vault = NodeVaultService(
+            observe_all=True, journal=store,
+        )
+        vault.record_transaction(_issue(alice, notary, notary_kp))
+        ref = vault.unconsumed_states(SSCoin)[0].ref
+        store.flush()
+        store.close()
+        # restart: the index is attached BEFORE journal recovery, so
+        # replay repopulates it beside the SQL pages
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        vault2 = NodeVaultService(
+            observe_all=True,
+            journal=DurableStore(str(tmp_path), name="vault"),
+            state_index=index,
+        )
+        assert index.contains([ref])[0]
+        assert vault2.unconsumed_ref_exists(ref)
+
+
+# --------------------------------------------------- serving fusion tier
+
+class TestMegaScreenFusion:
+    def test_screen_counts_device_resident_hits(self):
+        import jax.numpy as jnp
+
+        dev = DeviceShardedUniquenessProvider(
+            slots_per_shard=64, max_probe=8
+        )
+        try:
+            assert active_mega_screen() is not None
+            reqs = [([_ref(81000 + i)], _tx(81000 + i), "p")
+                    for i in range(6)]
+            dev.commit_batch(reqs)
+            present = key_rows([
+                ref.txhash.bytes + ref.index.to_bytes(4, "big")
+                for (states, _t, _c) in reqs for ref in states
+            ])
+            absent = key_rows([b"ss-not-there-%d" % i for i in range(2)])
+            rows = jnp.asarray(np.concatenate([present, absent]))
+            hits = int(active_mega_screen()(rows, rows.shape[0]))
+            assert hits == 6
+            # the padding tail beyond n is excluded from the count
+            assert int(active_mega_screen()(rows, 3)) == 3
+        finally:
+            dev.close()
+        # close() unregisters the screen
+        assert active_mega_screen() is None
+
+    def test_collect_harvests_screen_counters(self):
+        import jax.numpy as jnp
+
+        from corda_tpu.serving.scheduler import _MeshPending
+
+        before = _counters()
+        pending = _MeshPending(
+            [(None, None, b"m")] * 3, np.array([True, True, False]),
+            None, 2, bucket=4,
+        )
+        pending.statestore_hits = jnp.int32(2)
+        assert pending.collect().tolist() == [True, True, False]
+        d = _delta(before)
+        assert d.get("statestore.mega_probe_rows", 0) == 3
+        assert d.get("statestore.mega_probe_hits", 0) == 2
+
+    def test_monitoring_section_reports_tables(self):
+        # tables were built by earlier tests in this process
+        section = statestore_section()
+        assert section["enabled"] is True
+        names = {t["name"] for t in section["tables"]}
+        assert "uniqueness" in names
+        from corda_tpu.node.monitoring import monitoring_snapshot
+
+        snap = monitoring_snapshot()
+        assert snap["statestore"]["enabled"] is True
+        assert not any(
+            k.startswith("statestore.") for k in snap["process"]
+        )
+
+
+# ------------------------------------- satellite: single-pass InMemory
+
+class _CountingLock:
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self._lock.acquire()
+        self.acquisitions += 1
+        return self
+
+    def __exit__(self, *exc):
+        self._lock.release()
+        return False
+
+
+class TestInMemorySinglePass:
+    def _requests(self):
+        a, b, c = _ref(82001), _ref(82002), _ref(82003)
+        return [
+            ([a, b], _tx(82001), "p1"),          # fresh, multi-ref
+            ([c], _tx(82002), "p2"),             # fresh
+            ([a], _tx(82003), "thief"),          # intra-batch conflict
+            ([a, b], _tx(82001), "p1"),          # idempotent retry
+            ([b, c], _tx(82004), "thief2"),      # conflicts BOTH priors
+            ([], _tx(82005), "empty"),
+        ]
+
+    def test_single_lock_acquisition(self):
+        prov = InMemoryUniquenessProvider()
+        lock = _CountingLock()
+        prov._lock = lock
+        out = prov.commit_batch(self._requests())
+        assert lock.acquisitions == 1
+        assert [o is None for o in out] == [
+            True, True, False, True, False, True
+        ]
+
+    def test_batch_verdicts_identical_to_per_request_loop(self):
+        batch = InMemoryUniquenessProvider()
+        got = batch.commit_batch(self._requests())
+        loop = InMemoryUniquenessProvider()
+        want = []
+        for states, tx_id, caller in self._requests():
+            try:
+                loop.commit(states, tx_id, caller)
+                want.append(None)
+            except NotaryError as e:
+                want.append(e.conflict)
+        _assert_verdicts_equal(want, got)
+        assert batch.consumed_digest() == loop.consumed_digest()
+
+
+# -------------------------------- satellite: streamed ledger generators
+
+class TestGeneratedStreams:
+    def test_stream_commit_requests_is_seed_deterministic(self):
+        def take(n):
+            return [
+                (r.refs, r.tx_id, r.expect_conflict)
+                for r in itertools.islice(
+                    stream_commit_requests(
+                        seed=5, n_states=10**9,
+                        double_spend_fraction=0.05,
+                    ), n,
+                )
+            ]
+
+        assert take(400) == take(400)
+
+    def test_flagged_double_spends_conflict_and_nothing_else(self):
+        prov = InMemoryUniquenessProvider()
+        n_conflicts = 0
+        for req in stream_commit_requests(
+            seed=9, n_states=3000, double_spend_fraction=0.05,
+            max_frontier=64,
+        ):
+            verdict = prov.commit_batch(
+                [(list(req.refs), req.tx_id, req.caller)]
+            )[0]
+            assert (verdict is not None) == req.expect_conflict, req
+            n_conflicts += req.expect_conflict
+        assert n_conflicts > 10
+
+    def test_generated_ledger_stream_is_memory_bounded(self):
+        gen = GeneratedLedger(seed=3)
+        seen = 0
+        for stx in gen.stream(30, max_unspent=16):
+            assert stx.sigs
+            seen += 1
+        assert seen == 30
+        # streamed txs are NOT retained and the frontier stays capped
+        assert not gen.transactions
+        assert len(gen.unspent) <= 16
+
+    @pytest.mark.slow
+    def test_ten_million_state_ledger_scale(self):
+        """Satellite 2 acceptance: the streamed generator builds a
+        10^7-state ledger with bounded memory while the conflict checks
+        run on every request; every deliberately-flagged double-spend is
+        rejected and no legitimate request conflicts."""
+        prov = InMemoryUniquenessProvider()
+        conflicts = 0
+        batch = []
+
+        def settle(batch):
+            got = prov.commit_batch(
+                [(list(r.refs), r.tx_id, r.caller) for r in batch]
+            )
+            n = 0
+            for r, verdict in zip(batch, got):
+                assert (verdict is not None) == r.expect_conflict
+                n += r.expect_conflict
+            return n
+        for req in stream_commit_requests(
+            seed=2026, n_states=10**7, double_spend_fraction=0.002,
+            max_frontier=8192,
+        ):
+            batch.append(req)
+            if len(batch) == 4096:
+                conflicts += settle(batch)
+                batch = []
+        if batch:
+            conflicts += settle(batch)
+        assert conflicts > 1000
+        assert prov.committed_txs() > 10**6
+
+
+# ---------------------------------------------------- off-by-default pin
+
+class TestOffByDefault:
+    def test_fresh_subprocess_zero_overhead(self):
+        """Fresh subprocess, CORDA_TPU_STATESTORE unset: the statestore
+        package imports WITHOUT jax, allocates no tables, registers no
+        statestore.* metrics, the vault attaches no index, the serving
+        hook reads None, and the monitoring section is the off marker."""
+        code = """
+import json, os, sys
+os.environ.pop("CORDA_TPU_STATESTORE", None)
+import corda_tpu.statestore as ss
+assert not ss.statestore_enabled()
+assert "jax" not in sys.modules, "statestore import pulled in jax"
+assert ss.statestore_section() == {"enabled": False}
+assert ss.maybe_vault_index() is None
+assert ss.active_mega_screen() is None
+from corda_tpu.node import NodeVaultService
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+vault = NodeVaultService(observe_all=True)
+assert vault._state_index is None
+assert monitoring_snapshot()["statestore"] == {"enabled": False}
+assert not any(
+    n.startswith("statestore.") for n in node_metrics().snapshot()
+)
+print(json.dumps({"ok": True}))
+"""
+        env = {k: v for k, v in os.environ.items()
+               if k != "CORDA_TPU_STATESTORE"}
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1]) == {
+            "ok": True
+        }
+
+    def test_env_gate_enables_vault_index_and_notary_reexport(self):
+        """CORDA_TPU_STATESTORE=1 in a fresh subprocess: the gate reads
+        on, maybe_vault_index builds a device index, the notary package
+        re-exports the provider, and the monitoring section reports the
+        table."""
+        code = """
+import json
+import corda_tpu.statestore as ss
+assert ss.statestore_enabled()
+idx = ss.maybe_vault_index()
+from corda_tpu.statestore import DeviceVaultIndex
+assert isinstance(idx, DeviceVaultIndex)
+from corda_tpu.notary import DeviceShardedUniquenessProvider
+from corda_tpu.statestore import provider as _p
+assert DeviceShardedUniquenessProvider \
+    is _p.DeviceShardedUniquenessProvider
+section = ss.statestore_section()
+assert section["enabled"] is True
+assert section["tables"][0]["name"] == "vault"
+print(json.dumps({"ok": True}))
+"""
+        env = dict(os.environ)
+        env["CORDA_TPU_STATESTORE"] = "1"
+        env["CORDA_TPU_STATESTORE_SLOTS"] = "64"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=180, env=env,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1]) == {
+            "ok": True
+        }

@@ -472,6 +472,54 @@ class TestPerfGate:
             assert proc.returncode == 1, (needle, proc.stdout)
             assert needle in proc.stdout, (needle, proc.stdout)
 
+    def test_check_schema_validates_statestore_section(self, tmp_path):
+        """PR 17 satellite: the `statestore` section the smoke's
+        device-table pass emits is schema-validated — well-formed
+        passes; missing keys, occupancy outside [0,1], a flat two-point
+        occupancy sweep, negative spill counts and failed oracle-parity
+        flags fail; a disabled capture carries no numbers."""
+        good = dict(self.SYNTHETIC)
+        good["statestore"] = {
+            "rows": 4096, "shards": 8, "slots_per_shard": 1024,
+            "occupancy_low": 0.0625, "occupancy_high": 0.5,
+            "probes_per_sec": 350000.0, "probes_per_sec_high": 400000.0,
+            "spill_rows": 0, "verdict_parity": 1, "digest_parity": 1,
+        }
+        ok = tmp_path / "ss.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda d: d.pop("probes_per_sec"),
+             "missing numeric 'probes_per_sec'"),
+            (lambda d: d.__setitem__("occupancy_high", 1.5),
+             "exceeds 1.0"),
+            (lambda d: d.__setitem__("occupancy_high", 0.0625),
+             "two distinct load points"),
+            (lambda d: d.__setitem__("spill_rows", -3),
+             "negative spill_rows"),
+            (lambda d: d.__setitem__("verdict_parity", 0),
+             "verdict_parity is 0"),
+            (lambda d: d.__setitem__("digest_parity", 0),
+             "digest_parity is 0"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["statestore"])
+            bad = tmp_path / "ss_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+        # a disabled capture ({"enabled": false}) is not an error
+        off = dict(self.SYNTHETIC)
+        off["statestore"] = {"enabled": False}
+        offp = tmp_path / "ss_off.json"
+        offp.write_text(json.dumps(off))
+        proc = self._run("--result", str(offp), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
     def test_check_schema_validates_cluster_section(self, tmp_path):
         """ISSUE 15 satellite: the `cluster` section the smoke's
         observatory leg emits is schema-validated — well-formed passes;

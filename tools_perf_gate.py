@@ -129,6 +129,12 @@ GATED_METRICS: dict[str, tuple[str, float]] = {
     # *_ok flags, which --check-schema requires to be true.
     "overload/goodput_ratio": ("higher", 0.40),
     "overload/recovery_ratio": ("higher", 0.30),
+    # device-resident state store (docs/STATE_STORE.md): batched
+    # membership-probe throughput against the sharded HBM table at low
+    # occupancy. Loose tolerance: the smoke rides host-platform XLA on a
+    # shared CI host; correctness (verdict/digest parity, spill
+    # accounting) is enforced by the *_parity flags --check-schema pins.
+    "statestore/probes_per_sec": ("higher", 0.50),
 }
 
 # keys every per-kernel profile entry must carry for --check-schema
@@ -208,6 +214,17 @@ OVERLOAD_REQUIRED_KEYS = (
     "brownout_order_ok", "admission_rejected", "deadline_shed",
     "retransmits", "retry_budget_granted", "retry_budget_denied",
     "retry_budget_earned", "retry_budget_ok",
+)
+
+# keys the smoke's statestore section must carry for --check-schema
+# (the device-resident sharded state-store pass — docs/STATE_STORE.md):
+# table shape, occupancy at the two load points, probe throughput, spill
+# accounting and the verdict/digest oracle-parity flags
+STATESTORE_REQUIRED_KEYS = (
+    "rows", "shards", "slots_per_shard",
+    "occupancy_low", "occupancy_high",
+    "probes_per_sec", "probes_per_sec_high",
+    "spill_rows", "verdict_parity", "digest_parity",
 )
 
 # the flowprof closed phase set (corda_tpu/observability/flowprof.PHASES,
@@ -700,6 +717,47 @@ def check_schema(result: dict) -> list[str]:
                     f"cluster: pernode_reconcile_ok is {rec:g} (federated "
                     "sections must equal each node's local snapshot)"
                 )
+    statestore = result.get("statestore")
+    if statestore is not None:
+        if not isinstance(statestore, dict):
+            problems.append("statestore: expected an object")
+        elif not statestore.get("enabled", True):
+            # a disabled capture ({"enabled": false}) carries no numbers
+            pass
+        else:
+            def snum(key):
+                v = statestore.get(key)
+                return v if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) else None
+
+            for key in STATESTORE_REQUIRED_KEYS:
+                if snum(key) is None:
+                    problems.append(f"statestore: missing numeric {key!r}")
+                elif snum(key) < 0:
+                    problems.append(
+                        f"statestore: negative {key} {snum(key)}"
+                    )
+            for key in ("occupancy_low", "occupancy_high"):
+                v = snum(key)
+                if v is not None and v > 1.0:
+                    problems.append(
+                        f"statestore: {key} {v} exceeds 1.0 (occupancy is "
+                        "live rows over table slots)"
+                    )
+            lo, hi = snum("occupancy_low"), snum("occupancy_high")
+            if lo is not None and hi is not None and hi <= lo:
+                problems.append(
+                    f"statestore: occupancy_high {hi} not above "
+                    f"occupancy_low {lo} (the pass must measure the table "
+                    "at two distinct load points)"
+                )
+            for flag in ("verdict_parity", "digest_parity"):
+                v = snum(flag)
+                if v is not None and v != 1:
+                    problems.append(
+                        f"statestore: {flag} is {v:g} (the pass must prove "
+                        "bit-parity with the host oracle, not merely run)"
+                    )
     return problems
 
 
