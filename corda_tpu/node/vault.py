@@ -173,6 +173,13 @@ class NodeVaultService:
                 self._apply_journal, self._load_pages
             )
             self._journal_lsn = journal.wal.durable_lsn
+        if self._state_index is not None:
+            # converge the device index with whatever SQL already holds:
+            # snapshot-restored pages (_load_pages writes SQL directly)
+            # and pre-existing rows of a file-backed vault are invisible
+            # to stx replay, so without this pass unconsumed_ref_exists
+            # would answer a confident False for live states
+            self._rebuild_state_index()
 
     # -- recording ------------------------------------------------------------
 
@@ -220,6 +227,7 @@ class NodeVaultService:
         wtx = stx.tx
         produced: list[StateAndRef] = []
         consumed: list[StateAndRef] = []
+        fresh_adds: list[tuple] = []   # (ref, owner) of NEWLY-inserted rows
         with self._lock:
             for ref in wtx.inputs:
                 row = self._db.execute(
@@ -263,22 +271,27 @@ class NodeVaultService:
                             "INSERT INTO vault_participants VALUES (?,?,?)",
                             (stx.id.bytes, idx, serialize(key)),
                         )
-                produced.append(StateAndRef(tstate, ref))
-            self._db.commit()
-            if self._state_index is not None and not (consumed == [] and produced == []):
-                # keep the device index synchronous with the SQL pages
-                # (same locked region, so a query between the two views
-                # can never observe them disagreeing)
-                self._state_index.remove_states([sr.ref for sr in consumed])
-                adds = []
-                for sr in produced:
-                    parts = getattr(sr.state.data, "participants", ())
+                    parts = getattr(tstate.data, "participants", ())
                     owner = (
                         getattr(parts[0], "owning_key", parts[0])
                         if parts else None
                     )
-                    adds.append((sr.ref, owner))
-                self._state_index.add_states(adds)
+                    fresh_adds.append((ref, owner))
+                produced.append(StateAndRef(tstate, ref))
+            self._db.commit()
+            if self._state_index is not None and (wtx.inputs or fresh_adds):
+                # keep the device index synchronous with the SQL pages
+                # (same locked region, so a query between the two views
+                # can never observe them disagreeing). Removals cover ALL
+                # inputs, not just the rows SQL still saw as consumed=0:
+                # a replay over an already-applied file-backed vault finds
+                # no unconsumed row, yet the index must still converge to
+                # "consumed" (removing an absent ref is a no-op). Adds
+                # cover only rows whose INSERT landed — a re-offered ref
+                # may already be consumed=1 in SQL and must not resurrect
+                # in the index.
+                self._state_index.remove_states(list(wtx.inputs))
+                self._state_index.add_states(fresh_adds)
             lsn = None
             if journal and self._journal is not None:
                 lsn = self._journal.append(
@@ -337,6 +350,27 @@ class NodeVaultService:
                 ],
             )
             self._db.commit()
+
+    def _rebuild_state_index(self) -> None:
+        """Bulk-load every UNCONSUMED SQL row into the device index
+        (idempotent — present rows are re-offered and skipped)."""
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT tx_id, output_index, state_blob FROM vault_states"
+                " WHERE consumed=0"
+            ).fetchall()
+            if not rows:
+                return
+            adds = []
+            for tx_id, idx, blob in rows:
+                tstate = deserialize(blob)
+                parts = getattr(tstate.data, "participants", ())
+                owner = (
+                    getattr(parts[0], "owning_key", parts[0])
+                    if parts else None
+                )
+                adds.append((StateRef(SecureHash(tx_id), idx), owner))
+            self._state_index.add_states(adds)
 
     def pages_digest(self) -> str:
         """One hash over the consumed/unconsumed pages (soft-lock ids

@@ -117,8 +117,8 @@ def maybe_vault_index():
 def __getattr__(name: str):
     # lazy re-exports: importing corda_tpu.statestore while the feature
     # is off must not pull in jax or allocate anything
-    if name in ("DeviceShardedTable", "TOMBSTONE", "key_rows",
-                "payload_rows"):
+    if name in ("DeviceShardedTable", "DeviceTableLostError", "TOMBSTONE",
+                "key_rows", "payload_rows"):
         from corda_tpu.statestore import table as _t
 
         return getattr(_t, name)
@@ -136,6 +136,7 @@ def __getattr__(name: str):
 __all__ = [
     "DeviceShardedTable",
     "DeviceShardedUniquenessProvider",
+    "DeviceTableLostError",
     "DeviceVaultIndex",
     "StateStoreSpillError",
     "TOMBSTONE",

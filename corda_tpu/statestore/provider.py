@@ -416,7 +416,12 @@ class DeviceShardedUniquenessProvider(UniquenessProvider):
     def _mega_screen(self, rows_dev, n: int):
         """Membership screen over the serving mega-batch's device-
         resident consumed delta — device-to-device, no host copy; the
-        scheduler harvests the returned device scalar at settle time."""
+        scheduler harvests the returned device scalar at settle time.
+        Device tier ONLY (the spill set is never consulted — consulting
+        it would force the rows to the host), so the hit count
+        undercounts under spill pressure: it feeds the advisory
+        ``statestore.mega_probe_hits`` metric and must never be used as
+        a conflict verdict — ``commit_batch`` decides those exactly."""
         return self._table.probe_device_count(rows_dev, n)
 
     # -------------------------------------------------- attestation journal

@@ -24,7 +24,9 @@ slot (``word2 mod slots_per_shard``); probes scan a fixed ``max_probe``
 window from the home slot (wrapping). A window with no free slot on
 insert reports the row as OVERFLOW — the caller spills it to the host
 tier and counts it (``statestore.spills``); membership stays exact
-because the spill set is consulted beside every device probe.
+because the spill set is consulted beside every device probe. The one
+exception is ``probe_device_count`` (the serving mega-batch screen),
+which is device-tier-only and advisory by design — see its docstring.
 
 Kernels (all one ``shard_map`` dispatch each, collectives only where a
 cross-shard verdict is required):
@@ -59,6 +61,16 @@ TOMBSTONE = 2
 
 _DEF_SLOTS = 4096
 _DEF_PROBE = 32
+
+
+class DeviceTableLostError(RuntimeError):
+    """The table's device arrays were invalidated by a failed DONATED
+    dispatch (commit/remove donate them; an error mid-dispatch can leave
+    them deleted). The table latches poisoned and every device op raises
+    this — deterministically, instead of dereferencing deleted buffers —
+    so the owning tiers' failover paths (uniqueness shadow/spill, vault
+    SQL) take over for the rest of the process. Counted once as
+    ``statestore.table_lost``."""
 
 
 def key_rows(keys: list[bytes]) -> np.ndarray:
@@ -117,6 +129,7 @@ class DeviceShardedTable:
         self._lock = threading.Lock()
         self._steps: dict = {}   # (kind, *shape) -> compiled step
         self._n_live = 0         # host count of live device rows
+        self._poisoned = False   # arrays lost to a failed donated step
         self._axis = self.mesh.axis_names[0]
         sharding = self._sharding()
         zk = np.zeros((self.total_slots, 8), np.int32)
@@ -149,6 +162,29 @@ class DeviceShardedTable:
             fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
             **self._compat(),
         )
+
+    def _check_usable(self) -> None:
+        if self._poisoned:
+            raise DeviceTableLostError(
+                f"device table '{self.name}' lost its arrays to a failed"
+                " donated dispatch; host tiers are authoritative"
+            )
+
+    def _mark_poisoned_if_lost(self) -> None:
+        """After a failed donated step: if any table array was actually
+        deleted by the aborted dispatch, no later dispatch can ever
+        succeed — latch poisoned and count the loss once. Arrays that
+        survived (the error fired before donation took effect) leave the
+        table usable; ``self._keys`` et al. were never reassigned."""
+        lost = any(
+            getattr(buf, "is_deleted", lambda: False)()
+            for buf in (self._keys, self._txs, self._tags)
+        )
+        if lost and not self._poisoned:
+            self._poisoned = True
+            from corda_tpu.node.monitoring import node_metrics
+
+            node_metrics().counter("statestore.table_lost").inc()
 
     # ------------------------------------------------------------ kernels
     def _probe_step(self, b: int):
@@ -354,6 +390,7 @@ class DeviceShardedTable:
         # pad rows are all-zero keys; a zero key CAN legitimately be
         # probed, but its pad duplicates only re-report the same bit
         with self._lock:
+            self._check_usable()
             step = self._probe_step(b)
             found = step(self._keys, self._tags, q)
         return np.asarray(found)[:n] > 0
@@ -364,11 +401,18 @@ class DeviceShardedTable:
         delta): probes without any host materialization of the rows and
         returns the DEVICE scalar hit count — the caller reads it back
         whenever it settles the batch. ``n`` bounds the real rows (the
-        tail is collective padding)."""
+        tail is collective padding).
+
+        DEVICE TIER ONLY: the rows never touch the host, so the caller's
+        spill set is NOT consulted and the count undercounts whenever
+        consumed rows overflowed host-side. It is an advisory metric
+        (``statestore.mega_probe_hits``), never a membership verdict —
+        exact membership goes through ``probe_rows`` + the spill set."""
         import jax.numpy as jnp
 
         b = int(rows_dev.shape[0])
         with self._lock:
+            self._check_usable()
             step = self._probe_step(b)
             found = step(self._keys, self._tags, rows_dev.astype(jnp.int32))
         return (found[:n] > 0).sum()
@@ -404,12 +448,17 @@ class DeviceShardedTable:
         else:
             tagp[:r0, :k0] = qtag
         with self._lock:
+            self._check_usable()
             step = self._commit_step(r, k)
-            (self._keys, self._txs, self._tags, conflict, overflow,
-             n_ins) = step(
-                self._keys, self._txs, self._tags, qp, txp, tagp, vp,
-                pcp, fp,
-            )
+            try:
+                (self._keys, self._txs, self._tags, conflict, overflow,
+                 n_ins) = step(
+                    self._keys, self._txs, self._tags, qp, txp, tagp, vp,
+                    pcp, fp,
+                )
+            except Exception:
+                self._mark_poisoned_if_lost()
+                raise
             conflict = np.asarray(conflict)[:r0] > 0
             overflow = np.asarray(overflow)[:r0, :k0] > 0
             self._n_live += int(n_ins)
@@ -445,10 +494,15 @@ class DeviceShardedTable:
         v = np.zeros((b,), np.int32)
         v[:n] = 1
         with self._lock:
+            self._check_usable()
             step = self._remove_step(b)
-            self._keys, self._txs, self._tags, removed = step(
-                self._keys, self._txs, self._tags, q, v
-            )
+            try:
+                self._keys, self._txs, self._tags, removed = step(
+                    self._keys, self._txs, self._tags, q, v
+                )
+            except Exception:
+                self._mark_poisoned_if_lost()
+                raise
             removed = np.asarray(removed)[:n] > 0
             self._n_live -= int(removed.sum())
         return removed
@@ -460,6 +514,7 @@ class DeviceShardedTable:
         import jax.numpy as jnp
 
         with self._lock:
+            self._check_usable()
             return int(jnp.sum(self._tags == jnp.int32(tag | 1)))
 
     def live_rows(self) -> tuple[np.ndarray, np.ndarray]:
@@ -467,6 +522,7 @@ class DeviceShardedTable:
         return (keys (N, 8), payloads (N, 8)) of every live row. Not a
         hot path — one full host copy."""
         with self._lock:
+            self._check_usable()
             tags = np.asarray(self._tags)
             mask = (tags & 1) != 0
             return np.asarray(self._keys)[mask], np.asarray(self._txs)[mask]
@@ -487,4 +543,5 @@ class DeviceShardedTable:
             "max_probe": self.max_probe,
             "live_rows": self._n_live,
             "occupancy": round(self.occupancy(), 6),
+            "poisoned": self._poisoned,
         }

@@ -61,29 +61,58 @@ class DeviceVaultIndex:
             return
         with self._lock:
             keys = [_ref_key(ref) for ref, _ in items]
-            rows = key_rows(keys)
-            payloads = np.zeros((len(items), 8), np.int32)
-            tags = np.zeros((len(items),), np.int32)
-            for i, (ref, owner) in enumerate(items):
-                payloads[i] = np.frombuffer(ref.txhash.bytes, dtype="<i4")
-                tags[i] = owner_bucket(owner) if owner is not None else 1
-            overflow = self._table.insert_rows(rows, payloads, tags)
-            for i, key in enumerate(keys):
-                if overflow[i] and key not in self._spill:
-                    self._spill[key] = int(tags[i])
-                    self._metrics.counter("statestore.vault.spills").inc()
+            # a key already in the spill tier IS a member: re-offering
+            # it to the device could make it resident in BOTH tiers, and
+            # a later remove that tombstones only the device copy would
+            # leave a stale spill entry reporting it unconsumed forever
+            fresh = [
+                (i, key) for i, key in enumerate(keys)
+                if key not in self._spill
+            ]
+            if fresh:
+                rows = key_rows([key for _, key in fresh])
+                payloads = np.zeros((len(fresh), 8), np.int32)
+                tags = np.zeros((len(fresh),), np.int32)
+                for j, (i, _key) in enumerate(fresh):
+                    ref, owner = items[i]
+                    payloads[j] = np.frombuffer(
+                        ref.txhash.bytes, dtype="<i4"
+                    )
+                    tags[j] = owner_bucket(owner) if owner is not None else 1
+                try:
+                    overflow = self._table.insert_rows(rows, payloads, tags)
+                except Exception:
+                    # device leg unavailable (poisoned table / real device
+                    # error): every row spills, membership stays exact
+                    self._metrics.counter(
+                        "statestore.vault.add_failover"
+                    ).inc()
+                    overflow = np.ones(len(fresh), dtype=bool)
+                for j, (_i, key) in enumerate(fresh):
+                    if overflow[j]:
+                        self._spill[key] = int(tags[j])
+                        self._metrics.counter(
+                            "statestore.vault.spills"
+                        ).inc()
             self._metrics.counter("statestore.vault.adds").inc(len(items))
 
     def remove_states(self, refs) -> None:
-        """Tombstone consumed refs (device first, spill otherwise)."""
+        """Tombstone consumed refs — device AND spill: a consumed key
+        must survive in neither tier, whichever holds it."""
         if not refs:
             return
         with self._lock:
             keys = [_ref_key(ref) for ref in refs]
-            removed = self._table.remove_rows(key_rows(keys))
-            for key, hit in zip(keys, removed):
-                if not hit:
-                    self._spill.pop(key, None)
+            try:
+                self._table.remove_rows(key_rows(keys))
+            except Exception:
+                # device leg unavailable: contains() degrades to the SQL
+                # answer on its own; the spill pop below still applies
+                self._metrics.counter(
+                    "statestore.vault.remove_failover"
+                ).inc()
+            for key in keys:
+                self._spill.pop(key, None)
             self._metrics.counter("statestore.vault.removes").inc(
                 len(refs)
             )

@@ -714,6 +714,134 @@ class TestVaultIndex:
         assert index.contains([ref])[0]
         assert vault2.unconsumed_ref_exists(ref)
 
+    def _spend(self, vault, spender, spender_kp, to, notary):
+        b = TransactionBuilder(notary=notary)
+        sr = vault.unconsumed_states(SSCoin)[0]
+        b.add_input_state(sr)
+        b.add_output_state(
+            SSCoin(Amount(100, "GBP"), to), "test.ss.CoinContract"
+        )
+        b.add_command(SSCoinCmd("move"), spender.owning_key)
+        vault.record_transaction(b.sign_initial_transaction(spender_kp))
+        return sr.ref
+
+    def test_file_backed_replay_does_not_resurrect_spent_refs(
+            self, parties, tmp_path):
+        """Journal replay over an ALREADY-APPLIED file-backed vault: the
+        SQL re-insert is ignored (rowcount 0) and the consumed=0 lookup
+        misses, yet the device index must still converge — spent refs
+        stay out, live refs stay in."""
+        (alice, alice_kp), (bob, _), (notary, notary_kp) = parties
+        db = str(tmp_path / "vault.db")
+        vault = NodeVaultService(
+            db, observe_all=True,
+            journal=DurableStore(str(tmp_path), name="vault"),
+        )
+        vault.record_transaction(_issue(alice, notary, notary_kp, n_outputs=2))
+        spent = self._spend(vault, alice, alice_kp, bob, notary)
+        live = [sr.ref for sr in vault.unconsumed_states(SSCoin)]
+        vault.close()
+        # restart: vault.db already holds every row, so the WAL tail
+        # replays over applied state
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        vault2 = NodeVaultService(
+            db, observe_all=True,
+            journal=DurableStore(str(tmp_path), name="vault"),
+            state_index=index,
+        )
+        assert not index.contains([spent])[0]
+        assert not vault2.unconsumed_ref_exists(spent)
+        assert index.contains(live).all()
+        for ref in live:
+            assert vault2.unconsumed_ref_exists(ref)
+
+    def test_snapshot_restore_populates_index(self, parties, tmp_path):
+        """States restored through the page snapshot (_load_pages writes
+        SQL directly, bypassing record_transaction) must still land in
+        the device index — a confident False for a live state is the one
+        answer the index may never give."""
+        (alice, _kp), _bob, (notary, notary_kp) = parties
+        store = DurableStore(str(tmp_path), name="vault")
+        vault = NodeVaultService(observe_all=True, journal=store)
+        vault.record_transaction(_issue(alice, notary, notary_kp, n_outputs=3))
+        refs = [sr.ref for sr in vault.unconsumed_states(SSCoin)]
+        vault.snapshot_now()
+        vault.close()
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        before = _counters()
+        vault2 = NodeVaultService(
+            observe_all=True,
+            journal=DurableStore(str(tmp_path), name="vault"),
+            state_index=index,
+        )
+        assert index.contains(refs).all()
+        for ref in refs:
+            assert vault2.unconsumed_ref_exists(ref)
+        # the coin-selection cross-check agrees with SQL
+        vault2.select_fungible("GBP", 150, "flow-snap", SSCoin)
+        assert _delta(before).get(
+            "statestore.vault.select_mismatch", 0
+        ) == 0
+
+    def test_spilled_key_never_dual_resident(self):
+        """A key in the spill tier is never re-offered to the device: a
+        later remove must clear BOTH tiers, or the consumed ref would
+        report unconsumed forever from the stale spill entry."""
+        from corda_tpu.notary.uniqueness import _ref_key
+
+        index = DeviceVaultIndex(slots_per_shard=8, max_probe=2)
+        refs = [_ref(91000 + i) for i in range(64)]
+        index.add_states([(r, None) for r in refs])
+        assert index.stats()["spill_rows"] > 0
+        spilled_keys = set(index._spill)
+        spilled = [r for r in refs if _ref_key(r) in spilled_keys]
+        resident = [r for r in refs if _ref_key(r) not in spilled_keys]
+        # open device room, then re-offer the whole set (idempotent
+        # re-record shape) — spilled keys must NOT migrate onto device
+        index.remove_states(resident[: len(resident) // 2])
+        index.add_states([(r, None) for r in refs])
+        assert set(index._spill) == spilled_keys
+        # consuming the spilled refs clears them from every tier
+        index.remove_states(spilled)
+        assert not index.contains(spilled).any()
+        assert index.stats()["spill_rows"] == 0
+
+    def test_lost_table_poisons_and_vault_degrades_to_sql(self, parties):
+        """A donated dispatch that dies after deleting the table arrays
+        latches the table poisoned (statestore.table_lost); the vault
+        index degrades: probes fall back to SQL, adds spill, removes
+        still clear the spill tier."""
+        from corda_tpu.statestore import DeviceTableLostError
+
+        (alice, alice_kp), (bob, _), (notary, notary_kp) = parties
+        index = DeviceVaultIndex(slots_per_shard=64, max_probe=8)
+        vault = NodeVaultService(observe_all=True, state_index=index)
+        vault.record_transaction(_issue(alice, notary, notary_kp, n_outputs=2))
+        refs = [sr.ref for sr in vault.unconsumed_states(SSCoin)]
+        before = _counters()
+        # simulate the aborted donated step: the buffers are gone
+        table = index._table
+        table._keys.delete()
+        table._mark_poisoned_if_lost()
+        assert table.stats()["poisoned"]
+        with pytest.raises(DeviceTableLostError):
+            table.probe_rows(key_rows([b"x" * 36]))
+        # membership degrades to SQL, still correct
+        assert index.contains(refs) is None
+        assert vault.unconsumed_ref_exists(refs[0])
+        # recording still works: removes clear spill, adds spill host-side
+        spent = self._spend(vault, alice, alice_kp, bob, notary)
+        assert not vault.unconsumed_ref_exists(spent)
+        new_ref = [
+            sr.ref for sr in vault.unconsumed_states(SSCoin)
+            if sr.ref != refs[1]
+        ][0]
+        assert vault.unconsumed_ref_exists(new_ref)
+        d = _delta(before)
+        assert d.get("statestore.table_lost", 0) == 1
+        assert d.get("statestore.vault.add_failover", 0) >= 1
+        assert d.get("statestore.vault.remove_failover", 0) >= 1
+
 
 # --------------------------------------------------- serving fusion tier
 
