@@ -2095,6 +2095,127 @@ def run_smoke_cluster() -> dict:
     }
 
 
+def run_smoke_timeline() -> dict:
+    """The smoke's telemetry-timeline leg (docs/OBSERVABILITY.md
+    §Telemetry timeline): the ring-buffer recorder forced on (no sampler
+    thread — ticks driven by hand for determinism) around a real
+    scheduler burst, asserting at least one counter-delta series and one
+    timer-quantile series landed with monotone timestamps; then a
+    synthetic burn-rate breach is driven through the SLO monitor so the
+    DEFAULT handler writes a flight dump, whose ``timeline`` kind must
+    round-trip through ``read_flight_dump``. Emits the ``timeline``
+    section the perf gate's --check-schema validates. Runs last — its
+    forced toggles must not touch any measured number above."""
+    import tempfile
+
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.node.monitoring import node_metrics
+    from corda_tpu.observability import (
+        SLOObjective,
+        configure_slo,
+        configure_timeline,
+        read_flight_dump,
+    )
+    from corda_tpu.observability import slo as slo_mod
+    from corda_tpu.observability.slo import slo_monitor
+    from corda_tpu.observability.timeseries import timeline
+    from corda_tpu.serving import DeviceScheduler
+
+    flight_dir = tempfile.mkdtemp(prefix="smoke_timeline_flight_")
+    prev_flight_dir = os.environ.get("CORDA_TPU_FLIGHT_DIR")
+    os.environ["CORDA_TPU_FLIGHT_DIR"] = flight_dir
+    configure_timeline(enabled=True, cadence_s=0.05, ring_points=64,
+                       thread=False, reset=True)
+    tl = timeline()
+    burn_alerts_before = node_metrics().counter("slo.burn_alerts").count
+    try:
+        # --- burst phase: host-routed dispatches through a fresh
+        # scheduler, one manual tick per burst → counter deltas + windowed
+        # timer quantiles land in the rings
+        sched = DeviceScheduler(use_device_default=False)
+        kp = generate_keypair()
+        rows = []
+        for i in range(8):
+            msg = b"timeline-%d" % i
+            rows.append((kp.public, sign(kp.private, msg), msg))
+        tl.tick()  # prime the counter deltas
+        for _ in range(3):
+            rr = sched.submit_rows(rows, use_device=False).result(timeout=60)
+            assert rr.mask.all(), "timeline pass rejected valid sigs"
+            tl.tick()
+        sched.shutdown()
+        snap = tl.snapshot()
+        series = snap["series"]
+        counter_series = [
+            n for n, s in series.items() if s["kind"] == "counter_delta"
+        ]
+        timer_series = [
+            n for n, s in series.items() if s["kind"] == "timer_quantile"
+        ]
+        assert counter_series, "no counter-delta series recorded"
+        assert timer_series, "no timer-quantile series recorded"
+        assert any(
+            sum(series[n]["points"]) > 0 for n in counter_series
+        ), "every counter-delta series is flat zero across the burst"
+        ts = snap["timestamps"]
+        assert ts and ts == sorted(ts), "timeline timestamps not monotone"
+        assert len(ts) == snap["ticks"], (len(ts), snap["ticks"])
+
+        # --- synthetic burn-rate breach: an objective with a 10ms p99
+        # target fed 30 deliberately-slow outcomes burns budget at ~100x
+        # in BOTH windows; the next tick's evaluation fires the alert
+        # once and the default handler drops a flight dump
+        configure_slo(
+            enabled=True, reset=True,
+            objectives=[SLOObjective(
+                name="smoke-burn", p99_s=0.010, window_s=60.0,
+                min_samples=5, burn_fast_s=5.0, burn_slow_s=60.0,
+                burn_threshold=2.0,
+            )],
+        )
+        mon = slo_monitor()
+        for _ in range(30):
+            mon.observe("smoke", 0.050)
+        tl.tick()  # samples SLO status + burn rates, fires the alert
+        burn_alerts = (
+            node_metrics().counter("slo.burn_alerts").count
+            - burn_alerts_before
+        )
+        assert burn_alerts >= 1, "synthetic burn-rate breach did not fire"
+        dump_path = slo_mod.last_flight_path
+        assert dump_path and os.path.dirname(dump_path) == flight_dir, \
+            dump_path
+        rt = read_flight_dump(dump_path)
+        rt_tl = rt.get("timeline")
+        flight_roundtrip_ok = int(
+            isinstance(rt_tl, dict) and rt_tl.get("enabled") is True
+            and bool(rt_tl.get("series"))
+            and rt_tl.get("schema") == snap["schema"]
+        )
+        assert flight_roundtrip_ok == 1, rt_tl
+        return {"timeline": {
+            "cadence_s": snap["cadence_s"],
+            "ticks": snap["ticks"],
+            "series": len(series),
+            "counter_series": len(counter_series),
+            "timer_series": len(timer_series),
+            "timestamps": ts,
+            "rings": {n: s["points"] for n, s in series.items()},
+            "burn_alerts": burn_alerts,
+            "flight_roundtrip_ok": flight_roundtrip_ok,
+        }}
+    finally:
+        configure_slo(enabled=False, reset=True)
+        configure_timeline(enabled=False, reset=True)
+        if prev_flight_dir is None:
+            os.environ.pop("CORDA_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["CORDA_TPU_FLIGHT_DIR"] = prev_flight_dir
+        import shutil
+
+        shutil.rmtree(flight_dir, ignore_errors=True)
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -2270,9 +2391,18 @@ def run_smoke() -> int:
         # on around one notarised payment; the assembled distributed
         # trace must carry ≥ 2 net.transit hops and a named cross-node
         # critical path, and the federated snapshot must reconcile with
-        # every node's local monitoring snapshot. Runs last — its forced
+        # every node's local monitoring snapshot. Runs late — its forced
         # toggles must not touch any measured number above.
         out.update(run_smoke_cluster())
+
+        # 15. telemetry timeline pass (docs/OBSERVABILITY.md §Telemetry
+        # timeline): the ring-buffer recorder forced on (hand-driven
+        # ticks) around a scheduler burst — ≥1 counter-delta series and
+        # ≥1 timer-quantile series with monotone timestamps — then a
+        # synthetic burn-rate breach whose default-handler flight dump
+        # must round-trip its ``timeline`` kind. Scored into the
+        # ``timeline`` section the perf gate's --check-schema validates.
+        out.update(run_smoke_timeline())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]
@@ -2455,6 +2585,16 @@ def main() -> int:
         _save_cached(artifact)
     elif p.data.get("value") is None:
         _apply_cached(p)
+    # perf-history sentinel: every full run appends its gated metrics +
+    # git rev to BENCH_HISTORY.jsonl so tools_perf_gate.py --trend can
+    # spot regressions that creep in under the ratchet slack. Best
+    # effort — a history failure must never fail the bench itself.
+    try:
+        import tools_perf_gate
+
+        tools_perf_gate.append_history(dict(p.data), "bench.py")
+    except Exception:
+        pass
     return p.emit(0)
 
 

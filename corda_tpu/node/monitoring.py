@@ -24,22 +24,25 @@ class QuantileReservoir:
 
     NOT thread-safe on its own — the owning metric's lock guards it."""
 
-    __slots__ = ("_size", "_values", "_seen", "_rng")
+    __slots__ = ("_size", "_values", "_seen", "_rng", "_exemplars")
 
     def __init__(self, size: int = 512, seed: int = 0x0B5E):
         self._size = size
         self._values: list[float] = []
         self._seen = 0
         self._rng = random.Random(seed)
+        self._exemplars: list[str] = []
 
-    def update(self, value: float) -> None:
+    def update(self, value: float, exemplar: str = "") -> None:
         self._seen += 1
         if len(self._values) < self._size:
             self._values.append(value)
+            self._exemplars.append(exemplar)
         else:
             j = self._rng.randrange(self._seen)
             if j < self._size:
                 self._values[j] = value
+                self._exemplars[j] = exemplar
 
     def quantiles(self, qs=(0.5, 0.95, 0.99)) -> list[float]:
         """Nearest-rank quantiles over the current sample (0.0 each when
@@ -49,6 +52,23 @@ class QuantileReservoir:
         ordered = sorted(self._values)
         n = len(ordered)
         return [ordered[min(n - 1, int(q * n))] for q in qs]
+
+    def quantiles_with_exemplars(self, qs=(0.5, 0.95, 0.99)) -> list[tuple]:
+        """Like ``quantiles`` but each entry is ``(value, exemplar)`` —
+        the exemplar stamped on the reservoir sample at the quantile rank
+        (empty string when that sample carried none). Exemplars ride the
+        sample they arrived with through replacement, so a quantile's
+        exemplar is always a trace that really took that long."""
+        if not self._values:
+            return [(0.0, "")] * len(qs)
+        order = sorted(range(len(self._values)),
+                       key=lambda i: self._values[i])
+        n = len(order)
+        out = []
+        for q in qs:
+            i = order[min(n - 1, int(q * n))]
+            out.append((self._values[i], self._exemplars[i]))
+        return out
 
 
 class Counter:
@@ -151,6 +171,7 @@ class Timer:
         self._max = 0.0
         self._last = 0.0
         self._reservoir = QuantileReservoir()
+        self._tap = None
 
     class _Ctx:
         def __init__(self, timer):
@@ -167,14 +188,30 @@ class Timer:
     def time(self) -> "_Ctx":
         return Timer._Ctx(self)
 
-    def update(self, seconds: float) -> None:
+    def update(self, seconds: float, *, exemplar: str | None = None) -> None:
         with self._lock:
             self._count += 1
             self._total += seconds
             self._min = min(self._min, seconds)
             self._max = max(self._max, seconds)
             self._last = seconds
-            self._reservoir.update(seconds)
+            self._reservoir.update(seconds, exemplar or "")
+        # Tap outside the lock: one attribute read when no tap is set
+        # (the off-by-default overhead contract), and a tap callback can
+        # never deadlock against a concurrent snapshot.
+        tap = self._tap
+        if tap is not None:
+            tap(seconds)
+
+    def set_tap(self, fn) -> None:
+        """Install (or clear, with None) a per-update observer. At most
+        one tap — the telemetry timeline owns this seam; it receives the
+        raw duration on the updating thread and must be cheap."""
+        self._tap = fn
+
+    def quantiles_with_exemplars(self, qs=(0.5, 0.95, 0.99)) -> list[tuple]:
+        with self._lock:
+            return self._reservoir.quantiles_with_exemplars(qs)
 
     @property
     def count(self) -> int:
@@ -190,8 +227,9 @@ class Timer:
 
     def snapshot(self) -> dict:
         with self._lock:
-            p50, p95, p99 = self._reservoir.quantiles()
-            return {
+            qe = self._reservoir.quantiles_with_exemplars()
+            (p50, e50), (p95, e95), (p99, e99) = qe
+            out = {
                 "type": "timer",
                 "count": self._count,
                 "mean_s": (
@@ -205,6 +243,15 @@ class Timer:
                 "p95_s": p95,
                 "p99_s": p99,
             }
+            # Exemplars appear ONLY when at least one sample carried a
+            # trace id — an un-stamped timer's snapshot shape is
+            # bit-identical to the pre-exemplar era (tests pin it).
+            if e50 or e95 or e99:
+                out["exemplars"] = {
+                    k: v for k, v in
+                    (("p50_s", e50), ("p95_s", e95), ("p99_s", e99)) if v
+                }
+            return out
 
 
 class MetricRegistry:
@@ -311,8 +358,11 @@ def monitoring_snapshot() -> dict:
     (flows/overload — ``{"enabled": false}`` while off), ``statestore``
     the device-resident sharded state store's table stats + probe/spill
     registries (corda_tpu/statestore — ``{"enabled": false}`` until the
-    first device table exists), ``process`` the remaining cross-cutting
-    metrics (e.g. the verifier's ``device_failover`` counters)."""
+    first device table exists), ``timeline`` the ring-buffer telemetry
+    recorder's sampled series (observability/timeseries —
+    ``{"enabled": false}`` while off), ``process`` the remaining
+    cross-cutting metrics (e.g. the verifier's ``device_failover``
+    counters)."""
     from corda_tpu.durability import durability_section
     from corda_tpu.flows.overload import overload_section
     from corda_tpu.messaging.netstats import netstats_section
@@ -321,6 +371,7 @@ def monitoring_snapshot() -> dict:
     from corda_tpu.observability.flowprof import flowprof_section
     from corda_tpu.observability.sampler import sampler_section
     from corda_tpu.observability.slo import slo_section
+    from corda_tpu.observability.timeseries import timeline_section
     from corda_tpu.serving.resilience import resilience_section
     from corda_tpu.statestore import statestore_section
 
@@ -337,6 +388,7 @@ def monitoring_snapshot() -> dict:
         "cluster": cluster_section(),
         "overload": overload_section(),
         "statestore": statestore_section(),
+        "timeline": timeline_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
@@ -350,6 +402,7 @@ def monitoring_snapshot() -> dict:
                     or k.startswith("overload.")
                     or k.startswith("retry_budget.")
                     or k.startswith("admission.")
-                    or k.startswith("statestore."))
+                    or k.startswith("statestore.")
+                    or k.startswith("timeline."))
         },
     }

@@ -43,6 +43,11 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
   ``monitoring_snapshot()`` + SLO status in one versioned document with
   mesh-wide rollups (cluster p99, per-node deltas, unhealthy list),
   served over ``CordaRPCOps.cluster_snapshot()``.
+- ``timeseries`` — the off-by-default ring-buffer telemetry timeline:
+  counter deltas, windowed timer quantiles, per-ordinal device gauges
+  and SLO burn rates sampled at a fixed cadence into bounded rings —
+  rates-over-time without a Prometheus server, carried into every
+  flight dump and rendered by ``tools_timeline.py``.
 """
 
 from .cluster import (
@@ -112,6 +117,14 @@ from .slo import (
     slo_monitor,
     uninstall_crash_dump,
 )
+from .timeseries import (
+    TIMELINE_SCHEMA,
+    TimelineRecorder,
+    active_timeline,
+    configure_timeline,
+    timeline,
+    timeline_section,
+)
 from .trace import (
     NOOP_SPAN,
     SPAN_FLOW,
@@ -157,7 +170,9 @@ __all__ = [
     "SPAN_WAVEFRONT_WINDOW",
     "Span",
     "StackSampler",
+    "TIMELINE_SCHEMA",
     "TimedRLock",
+    "TimelineRecorder",
     "TraceAssembler",
     "TraceContext",
     "Tracer",
@@ -167,6 +182,7 @@ __all__ = [
     "active_profiler",
     "active_sampler",
     "active_slo",
+    "active_timeline",
     "cluster_recorder",
     "cluster_section",
     "configure_cluster",
@@ -175,6 +191,7 @@ __all__ = [
     "configure_profiler",
     "configure_sampler",
     "configure_slo",
+    "configure_timeline",
     "configure_tracing",
     "current_trace_id",
     "default_device_ordinal",
@@ -199,6 +216,16 @@ __all__ = [
     "set_cluster_handle",
     "slo_monitor",
     "stamp_span",
+    "timeline",
+    "timeline_section",
     "tracer",
     "uninstall_crash_dump",
 ]
+
+# CORDA_TPU_TIMELINE=1 env opt-in, deferred to here: enabling touches
+# corda_tpu.node.monitoring, whose package pulls the flow engine, which
+# imports THIS package — at timeseries import time that is a circular
+# import, but by this line every name above is bound.
+from .timeseries import _env_opt_in as _timeline_env_opt_in  # noqa: E402
+
+_timeline_env_opt_in()

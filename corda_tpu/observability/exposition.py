@@ -17,8 +17,58 @@ the node-local registry (notary meters etc.) renders under
 from __future__ import annotations
 
 import math
+import os
 
 _PREFIX = "cordatpu_"
+
+# ---------------------------------------------------------------- exemplars
+# OpenMetrics exemplar suffixes on summary quantile lines: when a timer
+# reservoir sample carried a trace id (Timer.update(..., exemplar=tid)),
+# the quantile line gains `# {trace_id="…"} <value>` — one hop from a bad
+# p99 to the trace that produced it. Off by default: classic Prometheus
+# text-format parsers reject the suffix, so an operator opts in with
+# CORDA_TPU_EXEMPLARS=1 / configure_exemplars(True) once the scraper
+# speaks OpenMetrics.
+
+_exemplars_enabled = os.environ.get(
+    "CORDA_TPU_EXEMPLARS", "") not in ("", "0")
+
+
+def exemplars_enabled() -> bool:
+    return _exemplars_enabled
+
+
+def configure_exemplars(enabled: bool) -> None:
+    global _exemplars_enabled
+    _exemplars_enabled = bool(enabled)
+
+
+# ------------------------------------------------------------------- HELP
+# Operator-facing one-liners for the core families; rendered as `# HELP`
+# ahead of `# TYPE` so a real Prometheus/OpenMetrics scraper ingests
+# documentation with the data. Keyed by the raw (pre-sanitize, namespace-
+# qualified) registry name — families without an entry render TYPE-only.
+_HELP = {
+    "serving.requests": "Requests admitted to the serving scheduler.",
+    "serving.rows": "Work rows admitted to the serving scheduler.",
+    "serving.batches": "Device batches dispatched by the scheduler.",
+    "serving.shed": "Requests shed by overload protection.",
+    "serving.rejected": "Requests rejected at admission.",
+    "serving.wait_s": "Queue wait before dispatch, seconds.",
+    "serving.batch_latency_s": "Dispatch-to-settle batch latency, seconds.",
+    "serving.batch_occupancy": "Rows per dispatched batch.",
+    "serving.batch_pad_waste": "Padding rows wasted per batch.",
+    "serving.device_failover": "Batches failed over from device to host.",
+    "slo.breach": "Edge-triggered SLO breach episodes.",
+    "slo.burn_alerts": "Edge-triggered multi-window burn-rate alerts.",
+    "slo.flight_dumps": "Flight-recorder dumps written.",
+    "slo.flight_dumps_reclaimed":
+        "Old flight dumps deleted by keep-N retention.",
+    "timeline.ticks": "Telemetry timeline sampling ticks.",
+    "timeline.marks": "Point events dropped onto the timeline.",
+    "timeline.series": "Series rings currently held by the timeline.",
+    "verifier.device_failover": "Verifier device-to-host failovers.",
+}
 
 
 def _sanitize(name: str) -> str:
@@ -61,22 +111,36 @@ def _fmt(v) -> str:
     return repr(f)
 
 
-def _render_counter(lines, name, snap):
-    lines.append(f"# TYPE {name} counter")
+def _head(lines, name, typ, raw=""):
+    """Family header: `# HELP` (when the docs dict provides one) then
+    `# TYPE` — HELP first per the exposition-format spec."""
+    h = _HELP.get(raw)
+    if h:
+        lines.append(f"# HELP {name} {h}")
+    lines.append(f"# TYPE {name} {typ}")
+
+
+def _render_counter(lines, name, snap, raw=""):
+    _head(lines, name, "counter", raw)
     lines.append(f"{name}_total {_fmt(snap.get('count', 0))}")
 
 
-def _render_gauge(lines, name, snap):
+def _render_gauge(lines, name, snap, raw=""):
     value = snap.get("value")
     if not isinstance(value, (int, float, bool)) or isinstance(value, complex):
         return  # non-numeric gauges are not expositable
-    lines.append(f"# TYPE {name} gauge")
+    _head(lines, name, "gauge", raw)
     lines.append(f"{name} {_fmt(value)}")
 
 
-def _render_summary(lines, name, snap, *, quantile_keys, sum_key, unit=""):
+def _render_summary(lines, name, snap, *, quantile_keys, sum_key, unit="",
+                    raw=""):
     base = name + unit
-    lines.append(f"# TYPE {base} summary")
+    _head(lines, base, "summary", raw)
+    exemplars = (
+        snap.get("exemplars") if _exemplars_enabled
+        and isinstance(snap.get("exemplars"), dict) else None
+    )
     # an EMPTY reservoir (no samples yet) has no quantiles: omit the
     # quantile lines entirely — a 0.0 (or NaN) p99 on a never-updated
     # timer would read as "this path is instant", the worst possible lie
@@ -85,14 +149,21 @@ def _render_summary(lines, name, snap, *, quantile_keys, sum_key, unit=""):
     if snap.get("count", 0):
         for q, key in quantile_keys:
             if key in snap and snap[key] is not None:
-                lines.append(f'{base}{{quantile="{q}"}} {_fmt(snap[key])}')
+                line = f'{base}{{quantile="{q}"}} {_fmt(snap[key])}'
+                tid = exemplars.get(key) if exemplars else None
+                if tid:
+                    line += (
+                        f' # {{trace_id="{escape_label_value(tid)}"}}'
+                        f" {_fmt(snap[key])}"
+                    )
+                lines.append(line)
     if sum_key is not None and sum_key in snap:
         lines.append(f"{base}_sum {_fmt(snap[sum_key])}")
     lines.append(f"{base}_count {_fmt(snap.get('count', 0))}")
 
 
-def _render_meter(lines, name, snap):
-    lines.append(f"# TYPE {name} counter")
+def _render_meter(lines, name, snap, raw=""):
+    _head(lines, name, "counter", raw)
     lines.append(f"{name}_total {_fmt(snap.get('count', 0))}")
     lines.append(f"# TYPE {name}_m1_rate gauge")
     lines.append(f"{name}_m1_rate {_fmt(snap.get('m1_rate', 0.0))}")
@@ -104,13 +175,14 @@ def _render_meter(lines, name, snap):
         )
 
 
-def _render_timer(lines, name, snap):
+def _render_timer(lines, name, snap, raw=""):
     _render_summary(
         lines, name, snap, unit="_seconds",
         quantile_keys=(
             ("0.5", "p50_s"), ("0.95", "p95_s"), ("0.99", "p99_s"),
         ),
         sum_key="total_s",
+        raw=raw,
     )
     lines.append(f"# TYPE {name}_seconds_max gauge")
     lines.append(f"{name}_seconds_max {_fmt(snap.get('max_s', 0.0))}")
@@ -136,7 +208,8 @@ def render_prometheus(snapshot: dict, *, namespace: str = "") -> str:
         renderer = _RENDERERS.get(snap.get("type"))
         if renderer is None:
             continue
-        renderer(lines, _PREFIX + _sanitize(namespace + name), snap)
+        raw = namespace + name
+        renderer(lines, _PREFIX + _sanitize(raw), snap, raw=raw)
     return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -176,12 +249,15 @@ def metrics_text(node_registry=None) -> str:
 
 def parse_prometheus(text: str) -> dict:
     """Strict-enough parser for the tests: ``{sample_name(+labels): value}``
-    plus ``# TYPE`` records under the ``"__types__"`` key. Raises
-    ``ValueError`` on any line that is neither a comment, blank, nor a
-    well-formed sample — the round-trip guard the acceptance criteria
-    ask for."""
+    plus ``# TYPE`` records under ``"__types__"``, ``# HELP`` text under
+    ``"__help__"``, and OpenMetrics exemplar trace ids under
+    ``"__exemplars__"``. Raises ``ValueError`` on any line that is
+    neither a comment, blank, nor a well-formed sample — the round-trip
+    guard the acceptance criteria ask for."""
     samples: dict = {}
     types: dict = {}
+    help_text: dict = {}
+    exemplars: dict = {}
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
             continue
@@ -189,7 +265,32 @@ def parse_prometheus(text: str) -> dict:
             parts = line.split()
             if len(parts) >= 4 and parts[1] == "TYPE":
                 types[parts[2]] = parts[3]
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                help_text[parts[2]] = line.split(None, 3)[3] if (
+                    len(parts) >= 4) else ""
             continue
+        # OpenMetrics exemplar suffix: `<sample> # {labels} <value>` —
+        # split it off, validate its shape, and keep the trace id.
+        exemplar_tid = None
+        if " # {" in line:
+            line, _, ex = line.partition(" # ")
+            ex = ex.strip()
+            if not (ex.startswith("{") and "} " in ex):
+                raise ValueError(
+                    f"line {lineno}: malformed exemplar {ex!r}"
+                )
+            labels_part, _, ex_value = ex.rpartition(" ")
+            try:
+                float(ex_value)
+            except ValueError:
+                raise ValueError(
+                    f"line {lineno}: non-numeric exemplar value "
+                    f"{ex_value!r}"
+                ) from None
+            pre = 'trace_id="'
+            if pre in labels_part:
+                exemplar_tid = labels_part.split(pre, 1)[1].rsplit(
+                    '"', 1)[0]
         name, sep, value = line.rpartition(" ")
         if not sep or not name:
             raise ValueError(f"line {lineno}: malformed sample {line!r}")
@@ -206,5 +307,9 @@ def parse_prometheus(text: str) -> dict:
                     f"line {lineno}: non-numeric sample value {value!r}"
                 ) from None
         samples[name] = value
+        if exemplar_tid is not None:
+            exemplars[name] = exemplar_tid
     samples["__types__"] = types
+    samples["__help__"] = help_text
+    samples["__exemplars__"] = exemplars
     return samples

@@ -50,7 +50,15 @@ class SLOObjective:
     """One objective: bound the windowed p99 latency and/or the
     error+shed rate for a priority class (``priority=None`` pools every
     class). ``min_samples`` guards cold windows — two requests do not
-    make a p99."""
+    make a p99.
+
+    Burn-rate alerting (the multi-window SRE pattern): an alert fires
+    only when BOTH the fast window (``burn_fast_s``) and the slow window
+    (``burn_slow_s``) are consuming error budget faster than
+    ``burn_threshold``× the sustainable rate — the fast window gives the
+    alert its reaction time, the slow window keeps a transient blip from
+    paging. Budget is ``max_error_rate`` for error objectives and the 1%
+    over-target allowance for p99 objectives."""
 
     name: str
     priority: str | None = None
@@ -58,6 +66,9 @@ class SLOObjective:
     max_error_rate: float | None = None
     window_s: float = 60.0
     min_samples: int = 20
+    burn_fast_s: float = 5.0
+    burn_slow_s: float = 60.0
+    burn_threshold: float = 2.0
 
 
 class SLOMonitor:
@@ -78,8 +89,10 @@ class SLOMonitor:
         self._window_cap = max(64, window_cap)
         self._samples: dict[str, deque] = {}
         self._breached: dict[str, dict] = {}  # objective name → last status
+        self._burning: dict[str, dict] = {}  # objective name → burn status
         self._breach_handler = breach_handler
         self._breach_count = 0
+        self._burn_alerts = 0
         self.events: deque = deque(maxlen=max(16, event_ring))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -110,7 +123,9 @@ class SLOMonitor:
         with self._lock:
             self._samples.clear()
             self._breached.clear()
+            self._burning.clear()
             self._breach_count = 0
+            self._burn_alerts = 0
             self.events.clear()
 
     # ------------------------------------------------------------ feeding
@@ -224,13 +239,108 @@ class SLOMonitor:
                         pass  # a broken handler must not break evaluation
         return statuses
 
+    def _burn_locked(self, obj: SLOObjective, now: float,
+                     window_s: float) -> tuple[float, int]:
+        """Burn rate over one window: budget consumed / budget allowed.
+        Error objectives burn against ``max_error_rate``; p99 objectives
+        burn the 1% over-target allowance (a p99 bound tolerates 1% of
+        requests above the target — more than 1% slow is burn > 1).
+        Returns ``(burn, samples)``."""
+        horizon = now - window_s
+        if obj.priority is None:
+            pools = list(self._samples.values())
+        else:
+            pools = [self._samples.get(obj.priority, ())]
+        window = [s for dq in pools for s in dq if s[0] >= horizon]
+        n = len(window)
+        if n == 0:
+            return 0.0, 0
+        burns = []
+        if obj.max_error_rate is not None:
+            err_rate = sum(1 for s in window if s[2]) / n
+            burns.append(err_rate / max(obj.max_error_rate, 1e-9))
+        if obj.p99_s is not None:
+            lats = [s[1] for s in window if s[1] is not None]
+            if lats:
+                slow_frac = sum(1 for v in lats if v > obj.p99_s) / len(lats)
+                burns.append(slow_frac / 0.01)
+        return (max(burns) if burns else 0.0), n
+
+    def evaluate_burn(self, now: float | None = None) -> list[dict]:
+        """Multi-window burn-rate evaluation: for each objective, compute
+        the budget burn over the fast and slow windows; an alert fires
+        (edge-triggered, exactly once per episode — same latch discipline
+        as ``evaluate``) when BOTH exceed ``burn_threshold`` with at
+        least ``min_samples`` in the fast window. Counted as
+        ``slo.burn_alerts``; the default handler writes a flight dump."""
+        if now is None:
+            now = self._clock()
+        fired: list[dict] = []
+        statuses: list[dict] = []
+        with self._lock:
+            for obj in self._objectives:
+                fast, n_fast = self._burn_locked(obj, now, obj.burn_fast_s)
+                slow, n_slow = self._burn_locked(obj, now, obj.burn_slow_s)
+                burning = (
+                    n_fast >= obj.min_samples
+                    and fast > obj.burn_threshold
+                    and slow > obj.burn_threshold
+                )
+                status = {
+                    "objective": obj.name,
+                    "priority": obj.priority,
+                    "burn_fast": round(fast, 6),
+                    "burn_slow": round(slow, 6),
+                    "fast_window_s": obj.burn_fast_s,
+                    "slow_window_s": obj.burn_slow_s,
+                    "threshold": obj.burn_threshold,
+                    "samples_fast": n_fast,
+                    "samples_slow": n_slow,
+                    "burning": burning,
+                }
+                statuses.append(status)
+                was = obj.name in self._burning
+                if burning and not was:
+                    self._burning[obj.name] = status
+                    self._burn_alerts += 1
+                    self.events.append({
+                        "t": now, "kind": "slo.burn",
+                        "objective": obj.name,
+                        "burn_fast": status["burn_fast"],
+                        "burn_slow": status["burn_slow"],
+                    })
+                    fired.append(status)
+                elif not burning and was:
+                    del self._burning[obj.name]
+                    self.events.append({
+                        "t": now, "kind": "slo.burn_recovered",
+                        "objective": obj.name,
+                    })
+        if fired:
+            from corda_tpu.node.monitoring import node_metrics
+
+            node_metrics().counter("slo.burn_alerts").inc(len(fired))
+            handler = self._breach_handler
+            if handler == self.DEFAULT_HANDLER:
+                handler = _default_burn_handler
+            if handler is not None:
+                for status in fired:
+                    try:
+                        handler(status)
+                    except Exception:
+                        pass  # a broken handler must not break evaluation
+        return statuses
+
     def snapshot(self) -> dict:
         statuses = self.evaluate()
+        burn = self.evaluate_burn()
         with self._lock:
             return {
                 "enabled": self._enabled,
                 "objectives": statuses,
                 "breaches": self._breach_count,
+                "burn": burn,
+                "burn_alerts": self._burn_alerts,
                 "events": list(self.events),
             }
 
@@ -264,6 +374,20 @@ class SLOMonitor:
             lines.append(f"cordatpu_slo_breached{{{labels_of(st)}}} {flag}")
         lines.append("# TYPE cordatpu_slo_breaches counter")
         lines.append(f"cordatpu_slo_breaches_total {snap['breaches']}")
+        burn_gauges = (
+            ("slo_burn_rate_fast", "burn_fast"),
+            ("slo_burn_rate_slow", "burn_slow"),
+        )
+        for fam, key in burn_gauges:
+            lines.append(f"# TYPE cordatpu_{fam} gauge")
+            for st in snap["burn"]:
+                lines.append(f"cordatpu_{fam}{{{labels_of(st)}}} {st[key]}")
+        lines.append("# TYPE cordatpu_slo_burning gauge")
+        for st in snap["burn"]:
+            flag = 1 if st["burning"] else 0
+            lines.append(f"cordatpu_slo_burning{{{labels_of(st)}}} {flag}")
+        lines.append("# TYPE cordatpu_slo_burn_alerts counter")
+        lines.append(f"cordatpu_slo_burn_alerts_total {snap['burn_alerts']}")
         return lines
 
     # ----------------------------------------------------------- lifecycle
@@ -279,6 +403,7 @@ class SLOMonitor:
             while not self._stop.wait(interval):
                 try:
                     self.evaluate()
+                    self.evaluate_burn()
                 except Exception:
                     pass  # evaluation must never kill its own thread
 
@@ -350,6 +475,10 @@ def _default_breach_handler(status: dict) -> None:
     flight_dump(reason=f"slo-breach:{status['objective']}")
 
 
+def _default_burn_handler(status: dict) -> None:
+    flight_dump(reason=f"slo-burn:{status['objective']}")
+
+
 # ----------------------------------------------------------- flight recorder
 
 FLIGHT_SCHEMA = 1
@@ -386,6 +515,17 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
     lines.append({"kind": "metrics", "snapshot": monitoring_snapshot()})
     lines.append({"kind": "devices", "snapshot": devices_section()})
     lines.append({"kind": "slo", "snapshot": slo_section()})
+    try:
+        # telemetry timeline (observability/timeseries): the last
+        # ring_points sampling intervals per series — the section that
+        # answers "what happened in the minute BEFORE the breach", which
+        # every other kind can only answer for the instant of the dump.
+        # {"enabled": false} while off.
+        from corda_tpu.observability.timeseries import timeline_section
+
+        lines.append({"kind": "timeline", "snapshot": timeline_section()})
+    except Exception:
+        pass  # the dump must land even if the timeline is broken
     try:
         # breaker/quarantine status (serving/resilience.py): the state a
         # device-eviction dump exists to explain — {"enabled": false}
@@ -463,25 +603,76 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
         os.replace(tmp, path)
         global last_flight_path
         last_flight_path = path
+        _reclaim_flight_dir(path)
     node_metrics().counter("slo.flight_dumps").inc()
     return path
+
+
+def _reclaim_flight_dir(path: str) -> None:
+    """Keep-N retention for the dump directory: a flapping SLO or a
+    quarantine storm fires the breach handler once per episode, but
+    episodes can recur all night — without a cap the flight recorder
+    becomes a disk-filler. Oldest-first (mtime) reclaim of files matching
+    the standard ``corda_tpu_flight_*.jsonl`` naming ONLY — explicitly
+    named dumps are operator artifacts and never touched.
+    ``CORDA_TPU_FLIGHT_KEEP`` (default 16); ``0`` disables reclaim
+    entirely (the unbounded escape hatch). Counted as
+    ``slo.flight_dumps_reclaimed``. Caller holds ``_flight_lock``."""
+    raw = os.environ.get("CORDA_TPU_FLIGHT_KEEP", "16")
+    try:
+        keep = int(raw)
+    except ValueError:
+        keep = 16
+    if keep <= 0:
+        return
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        names = [
+            n for n in os.listdir(d)
+            if n.startswith("corda_tpu_flight_") and n.endswith(".jsonl")
+        ]
+    except OSError:
+        return
+    if len(names) <= keep:
+        return
+    stamped = []
+    for n in names:
+        p = os.path.join(d, n)
+        try:
+            stamped.append((os.path.getmtime(p), p))
+        except OSError:
+            continue  # raced a concurrent reclaim; skip
+    stamped.sort()
+    reclaimed = 0
+    for _, p in stamped[: max(0, len(stamped) - keep)]:
+        try:
+            os.remove(p)
+            reclaimed += 1
+        except OSError:
+            pass
+    if reclaimed:
+        from corda_tpu.node.monitoring import node_metrics
+
+        node_metrics().counter("slo.flight_dumps_reclaimed").inc(reclaimed)
 
 
 def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
-    / ``slo`` / ``resilience`` / ``durability`` / ``flowprof`` /
-    ``sampler`` / ``net`` (the snapshots), ``events`` (device + SLO
-    health events), ``faults`` (injected chaos events), ``header``.
+    / ``slo`` / ``timeline`` / ``resilience`` / ``durability`` /
+    ``flowprof`` / ``sampler`` / ``net`` (the snapshots), ``events``
+    (device + SLO health events), ``faults`` (injected chaos events),
+    ``header``.
 
     Forward-compat: records whose ``kind`` this reader does not know
     (written by a NEWER dumper) round-trip untouched under ``extra``
     instead of being dropped — an old analysis tool must never silently
     eat a section it cannot name."""
     out: dict = {"header": None, "spans": [], "metrics": None,
-                 "devices": None, "slo": None, "resilience": None,
-                 "durability": None, "flowprof": None, "sampler": None,
-                 "net": None, "events": [], "faults": [], "extra": []}
+                 "devices": None, "slo": None, "timeline": None,
+                 "resilience": None, "durability": None, "flowprof": None,
+                 "sampler": None, "net": None, "events": [], "faults": [],
+                 "extra": []}
     with open(path) as f:
         for raw in f:
             raw = raw.strip()
@@ -493,8 +684,9 @@ def read_flight_dump(path: str) -> dict:
                 out["header"] = rec
             elif kind == "span":
                 out["spans"].append(rec["span"])
-            elif kind in ("metrics", "devices", "slo", "resilience",
-                          "durability", "flowprof", "sampler", "net"):
+            elif kind in ("metrics", "devices", "slo", "timeline",
+                          "resilience", "durability", "flowprof",
+                          "sampler", "net"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])

@@ -603,6 +603,14 @@ def slo_status_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.slo_status())
 
 
+def timeline_snapshot_value(proxy) -> PolledValue:
+    """Read binding over the telemetry timeline's ring snapshot
+    (``CordaRPCOps.timeline_snapshot``): per-series rings of counter
+    deltas, windowed timer quantiles and monitor gauges — the sparkline
+    widget's feed; ``tools_timeline.py`` renders it in the terminal."""
+    return PolledValue(lambda: proxy.timeline_snapshot())
+
+
 def flowprof_snapshot_value(proxy) -> PolledValue:
     """Read binding over the critical-path phase-accounting waterfall
     (``CordaRPCOps.flowprof_snapshot``): per-phase p50/p99 and per-class

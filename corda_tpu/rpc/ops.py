@@ -225,6 +225,17 @@ class CordaRPCOps:
 
         return slo_section()
 
+    def timeline_snapshot(self) -> dict:
+        """The telemetry timeline's ring snapshot (docs/OBSERVABILITY.md
+        §Telemetry timeline): shared sample timestamps plus every series
+        ring oldest-first — counter deltas per interval, windowed timer
+        p50/p99, per-ordinal device gauges, SLO burn rates — and the mark
+        deque. ``{"enabled": false}`` while the timeline is off (the
+        default); ``tools_timeline.py`` renders this live."""
+        from corda_tpu.observability.timeseries import timeline_section
+
+        return timeline_section()
+
     def flowprof_snapshot(self) -> dict:
         """Per-flow critical-path phase accounting (docs/OBSERVABILITY.md
         §Critical-path accounting): p50/p99 per phase over closed flows,

@@ -1198,7 +1198,11 @@ class DeviceScheduler:
         wait_t = m.timer("serving.wait_s")
         fp = active_flowprof()
         for r in batch:
-            wait_t.update(t0 - r.enqueued_at)
+            # exemplar: a sampled request's trace id rides its reservoir
+            # sample, so an exposed p99 quantile can name the trace that
+            # produced it (NOOP spans carry "" → no exemplar)
+            wait_t.update(t0 - r.enqueued_at,
+                          exemplar=r.queue_span.trace_id or None)
             if fp is not None:
                 fp.add(r.acct, "queue_wait", t0 - r.enqueued_at)
         m.meter("serving.batches").mark()
@@ -1635,7 +1639,9 @@ class DeviceScheduler:
             # the device completed this readback (even a hedge-lost late
             # one): its shapes are compiled — hedgeable from here on
             self._warm_keys |= entry.compile_keys
-        m.timer("serving.batch_latency_s").update(latency)
+        m.timer("serving.batch_latency_s").update(
+            latency, exemplar=entry.span.trace_id or None
+        )
         mon = active_devicemon()
         if mon is not None:
             # the per-device completion heartbeat + execute-wall EWMA the

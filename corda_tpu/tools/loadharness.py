@@ -239,6 +239,15 @@ class LoadHarness:
             from corda_tpu.messaging.netstats import configure_netstats
 
             configure_netstats(enabled=True, reset=True)
+        # when the telemetry timeline rides along (tools_loadgen.py
+        # --timeline), stamp the ramp's step boundaries into the mark
+        # deque so a rendered timeline names which qps each ring segment
+        # was recorded under
+        from corda_tpu.observability.timeseries import active_timeline
+
+        tl = active_timeline()
+        if tl is not None:
+            tl.mark("loadharness.step_qps", float(qps))
         t_start = time.monotonic()
         next_arrival = t_start
         end = t_start + cfg.step_duration_s
@@ -389,6 +398,12 @@ class LoadHarness:
         for step in steps:
             if step["slo_ok"]:
                 knee = step
+        if knee is not None:
+            from corda_tpu.observability.timeseries import active_timeline
+
+            tl = active_timeline()
+            if tl is not None:
+                tl.mark("loadharness.knee_qps", float(knee["qps"]))
         result = {
             "schema": LOADTEST_SCHEMA,
             "mode": "open-loop-poisson",

@@ -1,0 +1,667 @@
+"""Telemetry timeline, burn-rate alerting, exemplars & retention (ISSUE 18).
+
+Covers the ring-buffer timeline recorder (counter deltas, windowed timer
+quantiles via taps, marks, snapshot alignment, ring wraparound), the
+multi-window burn-rate alerting's edge-triggered latch under a fake
+clock, the flight dump's ``timeline`` kind round trip, the keep-N
+flight-dump retention policy, reservoir/exposition exemplars, the
+``# HELP`` exposition lines, and the off-by-default overhead contract
+(no sampler thread, no rings, no ``timeline.*`` metrics — pinned in a
+fresh subprocess).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.node.monitoring import (
+    QuantileReservoir,
+    Timer,
+    monitoring_snapshot,
+    node_metrics,
+)
+from corda_tpu.observability import (
+    SLOObjective,
+    active_timeline,
+    configure_slo,
+    configure_timeline,
+    flight_dump,
+    metrics_text,
+    parse_prometheus,
+    read_flight_dump,
+    timeline_section,
+)
+from corda_tpu.observability.exposition import configure_exemplars
+from corda_tpu.observability.slo import SLOMonitor
+from corda_tpu.observability.timeseries import TimelineRecorder, _Ring
+
+
+@pytest.fixture(autouse=True)
+def _timeline_off():
+    """Every test leaves the process-global recorder the way production
+    starts: off, empty, no sampler thread, exemplars off."""
+    yield
+    configure_timeline(enabled=False, reset=True)
+    configure_slo(enabled=False, reset=True, objectives=(),
+                  breach_handler=SLOMonitor.DEFAULT_HANDLER)
+    configure_exemplars(False)
+
+
+# ------------------------------------------------------------------- rings
+
+class TestRing:
+    def test_partial_fill_oldest_first(self):
+        r = _Ring(4)
+        for v in (1.0, 2.0, 3.0):
+            r.append(v)
+        assert len(r) == 3
+        assert r.values() == [1.0, 2.0, 3.0]
+
+    def test_wraparound_keeps_newest(self):
+        r = _Ring(4)
+        for v in range(7):
+            r.append(float(v))
+        assert len(r) == 4
+        assert r.values() == [3.0, 4.0, 5.0, 6.0]
+
+    def test_minimum_width_is_two(self):
+        r = _Ring(0)
+        r.append(1.0)
+        r.append(2.0)
+        r.append(3.0)
+        assert r.values() == [2.0, 3.0]
+
+
+# ---------------------------------------------------------------- recorder
+
+def _fresh_recorder(**kw):
+    """A directly-constructed recorder over throwaway metric names, so
+    nothing leaks into (or depends on) the shared registry defaults."""
+    kw.setdefault("counters", ("tltest.events",))
+    kw.setdefault("timers", ("tltest.lat_s",))
+    kw.setdefault("ring_points", 8)
+    return TimelineRecorder(**kw)
+
+
+class TestRecorder:
+    def test_counter_deltas_primed_then_per_interval(self):
+        rec = _fresh_recorder()
+        c = node_metrics().counter("tltest.events")
+        base = c.count
+        rec.enable()
+        try:
+            rec.tick(now=1.0)  # first sight primes: no delta yet
+            c.inc(5)
+            rec.tick(now=2.0)
+            c.inc(2)
+            rec.tick(now=3.0)
+            snap = rec.snapshot()
+            s = snap["series"]["tltest.events"]
+            assert s["kind"] == "counter_delta"
+            # priming appends 0.0 for the first interval
+            assert s["points"] == [0.0, 5.0, 2.0]
+            assert snap["timestamps"] == [1.0, 2.0, 3.0]
+            assert base >= 0  # the delta series never re-reads lifetime
+        finally:
+            rec.disable()
+
+    def test_timer_tap_windows_quantiles_per_interval(self):
+        rec = _fresh_recorder()
+        t = node_metrics().timer("tltest.lat_s")
+        rec.enable()
+        try:
+            for v in (0.010, 0.020, 0.030):
+                t.update(v)
+            rec.tick(now=1.0)
+            rec.tick(now=2.0)  # idle interval: zeros, count 0
+            snap = rec.snapshot()["series"]
+            assert snap["tltest.lat_s.count"]["points"] == [3.0, 0.0]
+            p50 = snap["tltest.lat_s.p50_s"]["points"]
+            p99 = snap["tltest.lat_s.p99_s"]["points"]
+            assert p50[0] == 0.020 and p99[0] == 0.030
+            assert p50[1] == 0.0 and p99[1] == 0.0
+            assert snap["tltest.lat_s.p50_s"]["kind"] == "timer_quantile"
+        finally:
+            rec.disable()
+
+    def test_disable_removes_tap(self):
+        rec = _fresh_recorder()
+        t = node_metrics().timer("tltest.lat_s")
+        rec.enable()
+        rec.disable()
+        assert t._tap is None
+        t.update(0.5)  # must not feed a dead recorder
+        assert all(len(dq) == 0 for dq in rec._intake.values())
+
+    def test_marks_are_bounded_and_disabled_noop(self):
+        rec = _fresh_recorder(mark_ring=16)
+        rec.mark("never", 1.0)  # disabled: dropped
+        rec.enable()
+        try:
+            for i in range(40):
+                rec.mark("step", float(i), t=float(i))
+            marks = rec.snapshot()["marks"]
+            assert len(marks) == 16
+            assert marks[-1] == {"t": 39.0, "name": "step", "value": 39.0}
+            assert marks[0]["value"] == 24.0
+        finally:
+            rec.disable()
+
+    def test_late_series_aligns_with_trailing_timestamps(self):
+        rec = _fresh_recorder()
+        c = node_metrics().counter("tltest.events")
+        rec.enable()
+        try:
+            rec.tick(now=1.0)
+            rec.tick(now=2.0)
+            t = node_metrics().timer("tltest.lat_s")
+            t.update(0.1)
+            rec.tick(now=3.0)
+            snap = rec.snapshot()
+            assert len(snap["timestamps"]) == 3
+            # the timer count series has 3 points (tap was live from
+            # enable); the counter series also 3; both align fully here —
+            # the alignment contract is len(points) <= len(timestamps)
+            for s in snap["series"].values():
+                assert len(s["points"]) <= len(snap["timestamps"])
+            assert c.count >= 0
+        finally:
+            rec.disable()
+
+    def test_ring_wraparound_bounds_history(self):
+        rec = _fresh_recorder(ring_points=4)
+        rec.enable()
+        try:
+            for i in range(10):
+                rec.tick(now=float(i))
+            snap = rec.snapshot()
+            assert snap["ticks"] == 10
+            assert snap["timestamps"] == [6.0, 7.0, 8.0, 9.0]
+        finally:
+            rec.disable()
+
+    def test_tick_when_disabled_is_noop(self):
+        rec = _fresh_recorder()
+        rec.tick(now=1.0)
+        assert rec.snapshot()["ticks"] == 0
+        assert rec.snapshot()["timestamps"] == []
+
+    def test_reset_clears_rings_and_marks(self):
+        rec = _fresh_recorder()
+        rec.enable()
+        try:
+            rec.tick(now=1.0)
+            rec.mark("m", 1.0)
+            rec.reset()
+            snap = rec.snapshot()
+            assert snap["ticks"] == 0
+            assert snap["series"] == {}
+            assert snap["marks"] == []
+        finally:
+            rec.disable()
+
+    def test_slo_gauges_ride_the_tick(self):
+        rec = _fresh_recorder()
+        configure_slo(enabled=True, reset=True, objectives=[SLOObjective(
+            name="tl-gauge", p99_s=1.0, min_samples=1,
+        )], breach_handler=None)
+        mon = __import__(
+            "corda_tpu.observability.slo", fromlist=["slo_monitor"]
+        ).slo_monitor()
+        mon.observe("tl-gauge", 0.001)
+        rec.enable()
+        try:
+            rec.tick(now=1.0)
+            series = rec.snapshot()["series"]
+            assert "slo.tl-gauge.p99_s" in series
+            assert "slo.tl-gauge.burn_fast" in series
+            assert series["slo.tl-gauge.p99_s"]["kind"] == "gauge"
+        finally:
+            rec.disable()
+
+
+# ------------------------------------------------------------ configuration
+
+class TestConfigure:
+    def test_off_by_default_in_this_process(self):
+        assert active_timeline() is None
+        assert timeline_section() == {"enabled": False}
+        assert monitoring_snapshot()["timeline"] == {"enabled": False}
+
+    def test_configure_round_trip(self):
+        rec = configure_timeline(enabled=True, cadence_s=0.05,
+                                 ring_points=16, thread=False)
+        try:
+            assert active_timeline() is rec
+            assert rec.cadence_s == 0.05 and rec.ring_points == 16
+            # no thread was requested: the sampler must not exist
+            names = {t.name for t in threading.enumerate()}
+            assert "timeline-sampler" not in names
+            sec = timeline_section()
+            assert sec["enabled"] is True and sec["schema"] == 1
+        finally:
+            configure_timeline(enabled=False, reset=True)
+        assert active_timeline() is None
+
+    def test_thread_lifecycle(self):
+        configure_timeline(enabled=True, cadence_s=0.05, thread=True)
+        try:
+            deadline = time.monotonic() + 2.0
+            while time.monotonic() < deadline:
+                if any(t.name == "timeline-sampler"
+                       for t in threading.enumerate()):
+                    break
+                time.sleep(0.01)
+            assert any(t.name == "timeline-sampler"
+                       for t in threading.enumerate())
+        finally:
+            configure_timeline(enabled=False, reset=True)
+        deadline = time.monotonic() + 6.0
+        while time.monotonic() < deadline:
+            if not any(t.name == "timeline-sampler"
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.01)
+        assert not any(t.name == "timeline-sampler"
+                       for t in threading.enumerate())
+
+    def test_rpc_surface_no_services_needed(self):
+        from corda_tpu.rpc.ops import CordaRPCOps
+
+        ops = CordaRPCOps(None, None)
+        assert ops.timeline_snapshot() == {"enabled": False}
+        configure_timeline(enabled=True, thread=False)
+        try:
+            snap = ops.timeline_snapshot()
+            assert snap["enabled"] is True and "series" in snap
+        finally:
+            configure_timeline(enabled=False, reset=True)
+
+    def test_read_bindings_poll(self):
+        from corda_tpu.rpc.bindings import timeline_snapshot_value
+
+        class Proxy:
+            def timeline_snapshot(self):
+                return {"enabled": False}
+
+        pv = timeline_snapshot_value(Proxy())
+        assert pv.get() == {"enabled": False}
+
+
+# -------------------------------------------------- off-by-default (pinned)
+
+class TestOffByDefaultSubprocess:
+    def test_unset_env_means_no_thread_no_rings_no_metrics(self):
+        """The zero-overhead contract, pinned where no earlier test can
+        have flipped a toggle: a fresh interpreter with
+        CORDA_TPU_TIMELINE unset must hold NO sampler thread, NO ring
+        allocations and NO timeline.* registry metrics even after real
+        scheduler traffic."""
+        code = """
+import json, threading
+from corda_tpu.crypto import generate_keypair, sign
+from corda_tpu.node.monitoring import node_metrics
+from corda_tpu.observability.timeseries import active_timeline, timeline
+from corda_tpu.serving import DeviceScheduler
+
+s = DeviceScheduler(use_device_default=False)
+kp = generate_keypair()
+msg = b"off-default"
+rows = [(kp.public, sign(kp.private, msg), msg)]
+assert s.submit_rows(rows).result(timeout=60).mask.all()
+s.shutdown()
+tl = timeline()
+print(json.dumps({
+    "active": active_timeline() is not None,
+    "thread": any(t.name == "timeline-sampler"
+                  for t in threading.enumerate()),
+    "rings": len(tl._rings),
+    "timestamps": tl._timestamps is not None,
+    "intake": len(tl._intake),
+    "metrics": sorted(k for k in node_metrics().snapshot()
+                      if k.startswith("timeline.")),
+}))
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("CORDA_TPU_TIMELINE", None)
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got == {
+            "active": False, "thread": False, "rings": 0,
+            "timestamps": False, "intake": 0, "metrics": [],
+        }
+
+    def test_env_opt_in_starts_sampler(self):
+        code = """
+import json, threading
+import corda_tpu.observability.timeseries as ts
+
+tl = ts.active_timeline()
+print(json.dumps({
+    "active": tl is not None,
+    "cadence": tl.cadence_s,
+    "points": tl.ring_points,
+    "thread": any(t.name == "timeline-sampler"
+                  for t in threading.enumerate()),
+}))
+"""
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   CORDA_TPU_TIMELINE="1",
+                   CORDA_TPU_TIMELINE_CADENCE_S="0.25",
+                   CORDA_TPU_TIMELINE_POINTS="32")
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=env,
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        got = json.loads(out.stdout.strip().splitlines()[-1])
+        assert got == {"active": True, "cadence": 0.25, "points": 32,
+                       "thread": True}
+
+
+# ---------------------------------------------------------------- burn rate
+
+def _burn_monitor(fired, clk, **obj_kw):
+    kw = dict(name="burn", p99_s=0.010, window_s=120.0, min_samples=5,
+              burn_fast_s=5.0, burn_slow_s=60.0, burn_threshold=2.0)
+    kw.update(obj_kw)
+    return SLOMonitor(objectives=[SLOObjective(**kw)],
+                      clock=lambda: clk[0],
+                      breach_handler=fired.append)
+
+
+class TestBurnRate:
+    def test_fires_once_recovers_refires(self):
+        """The edge-triggered latch: a sustained burn episode fires the
+        handler exactly once; the windows draining clears the latch (and
+        appends a recovery event); a second episode re-fires."""
+        fired: list = []
+        clk = [100.0]
+        m = _burn_monitor(fired, clk)
+
+        def burns():
+            # the shared handler also receives plain p99-breach statuses
+            # ("breached" key); burn statuses carry "burning"
+            return [f for f in fired if "burning" in f]
+
+        for _ in range(10):  # every sample 5x over target → burn 100x
+            m.observe("burn", 0.050)
+        st = m.evaluate_burn()
+        assert len(burns()) == 1 and st[0]["burning"] is True
+        assert st[0]["burn_fast"] > 2.0 and st[0]["burn_slow"] > 2.0
+        m.evaluate_burn()
+        m.evaluate_burn()
+        assert len(burns()) == 1, "latched episode must not re-fire"
+        # windows drain: past the slow window everything ages out
+        clk[0] += 120.0
+        st = m.evaluate_burn()
+        assert st[0]["burning"] is False and len(burns()) == 1
+        events = [e["kind"] for e in m.snapshot()["events"]]
+        assert "slo.burn" in events and "slo.burn_recovered" in events
+        # second episode re-fires
+        for _ in range(10):
+            m.observe("burn", 0.050)
+        m.evaluate_burn()
+        assert len(burns()) == 2
+        assert m.snapshot()["burn_alerts"] == 2
+
+    def test_min_samples_guards_cold_fast_window(self):
+        fired: list = []
+        clk = [100.0]
+        m = _burn_monitor(fired, clk, min_samples=50)
+        for _ in range(10):
+            m.observe("burn", 0.050)
+        st = m.evaluate_burn()
+        assert st[0]["burning"] is False and not fired
+
+    def test_healthy_latencies_do_not_burn(self):
+        fired: list = []
+        clk = [100.0]
+        m = _burn_monitor(fired, clk)
+        for _ in range(50):
+            m.observe("burn", 0.001)  # all under target
+        st = m.evaluate_burn()
+        assert st[0]["burn_fast"] == 0.0 and not fired
+
+    def test_error_rate_objective_burns_against_budget(self):
+        fired: list = []
+        clk = [100.0]
+        m = _burn_monitor(fired, clk, max_error_rate=0.01)
+        # 50% errors against a 1% budget → burn 50x in both windows
+        for i in range(20):
+            m.observe("burn", 0.001, error=(i % 2 == 0))
+        st = m.evaluate_burn()
+        assert st[0]["burning"] is True
+        assert st[0]["burn_fast"] == pytest.approx(50.0)
+
+    def test_burn_gauges_in_prometheus_lines(self):
+        clk = [100.0]
+        m = _burn_monitor([], clk)
+        for _ in range(10):
+            m.observe("burn", 0.050)
+        text = "\n".join(m.prometheus_lines())
+        assert 'cordatpu_slo_burn_rate_fast{objective="burn"' in text
+        assert 'cordatpu_slo_burn_rate_slow{objective="burn"' in text
+        assert 'cordatpu_slo_burning{objective="burn"' in text
+        assert "cordatpu_slo_burn_alerts_total 1" in text
+
+    def test_default_handler_writes_flight_dump(self, tmp_path,
+                                                monkeypatch):
+        import corda_tpu.observability.slo as slo_mod
+
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_DIR", str(tmp_path))
+        clk = [100.0]
+        m = SLOMonitor(objectives=[SLOObjective(
+            name="paged", p99_s=0.010, min_samples=5,
+        )], clock=lambda: clk[0],
+            breach_handler=SLOMonitor.DEFAULT_HANDLER)
+        for _ in range(10):
+            m.observe("paged", 0.050)
+        m.evaluate_burn()
+        path = slo_mod.last_flight_path
+        assert path and path.startswith(str(tmp_path))
+        assert read_flight_dump(path)["header"]["reason"] \
+            == "slo-burn:paged"
+
+
+# ------------------------------------------------- flight dump + retention
+
+class TestFlightTimeline:
+    def test_dump_carries_timeline_kind_and_round_trips(self, tmp_path):
+        configure_timeline(enabled=True, cadence_s=0.05, ring_points=16,
+                           thread=False)
+        try:
+            tl = active_timeline()
+            node_metrics().meter("serving.requests").mark(3)
+            tl.tick()
+            tl.tick()
+            tl.mark("deploy", 1.0)
+            path = flight_dump(str(tmp_path / "tl.jsonl"),
+                               reason="timeline-test")
+            back = read_flight_dump(path)
+            snap = back["timeline"]
+            assert snap["enabled"] is True
+            assert snap["ticks"] == 2
+            assert "serving.requests" in snap["series"]
+            assert snap["marks"][-1]["name"] == "deploy"
+        finally:
+            configure_timeline(enabled=False, reset=True)
+
+    def test_dump_with_timeline_off_records_disabled_marker(self,
+                                                            tmp_path):
+        path = flight_dump(str(tmp_path / "off.jsonl"), reason="off")
+        assert read_flight_dump(path)["timeline"] == {"enabled": False}
+
+
+class TestFlightRetention:
+    @staticmethod
+    def _dump_n(tmp_path, n):
+        paths = []
+        for i in range(n):
+            p = str(tmp_path / f"corda_tpu_flight_test_{i:03d}.jsonl")
+            flight_dump(p, reason=f"keep-{i}")
+            # distinct mtimes so oldest-first reclaim is deterministic
+            os.utime(p, (1000.0 + i, 1000.0 + i))
+            paths.append(p)
+        return paths
+
+    def test_keep_n_reclaims_oldest_first(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_KEEP", "3")
+        before = node_metrics().counter("slo.flight_dumps_reclaimed").count
+        self._dump_n(tmp_path, 6)
+        left = sorted(p.name for p in tmp_path.iterdir())
+        assert left == [
+            "corda_tpu_flight_test_003.jsonl",
+            "corda_tpu_flight_test_004.jsonl",
+            "corda_tpu_flight_test_005.jsonl",
+        ]
+        reclaimed = (
+            node_metrics().counter("slo.flight_dumps_reclaimed").count
+            - before
+        )
+        assert reclaimed == 3
+
+    def test_keep_zero_is_unbounded(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_KEEP", "0")
+        self._dump_n(tmp_path, 6)
+        assert len(list(tmp_path.iterdir())) == 6
+
+    def test_non_flight_files_never_touched(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_KEEP", "2")
+        keepers = [tmp_path / "unrelated.jsonl",
+                   tmp_path / "corda_tpu_flight_notes.txt"]
+        for p in keepers:
+            p.write_text("precious\n")
+            os.utime(p, (1.0, 1.0))  # older than every dump
+        self._dump_n(tmp_path, 5)
+        for p in keepers:
+            assert p.exists() and p.read_text() == "precious\n"
+        dumps = [p for p in tmp_path.iterdir()
+                 if p.name.startswith("corda_tpu_flight_test_")]
+        assert len(dumps) == 2
+
+    def test_bad_env_falls_back_to_default(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CORDA_TPU_FLIGHT_KEEP", "banana")
+        self._dump_n(tmp_path, 4)  # default keep is 16: nothing reclaimed
+        assert len(list(tmp_path.iterdir())) == 4
+
+
+# ---------------------------------------------------------------- exemplars
+
+class TestExemplars:
+    def test_reservoir_rides_exemplars_with_samples(self):
+        r = QuantileReservoir(size=8)
+        for i in range(5):
+            r.update(float(i), exemplar=f"tid-{i}")
+        pairs = r.quantiles_with_exemplars((0.5, 0.99))
+        assert pairs[0] == (2.0, "tid-2")
+        assert pairs[1] == (4.0, "tid-4")
+
+    def test_timer_snapshot_shape_unchanged_without_exemplars(self):
+        t = Timer()
+        t.update(0.5)
+        assert "exemplars" not in t.snapshot()
+
+    def test_timer_snapshot_carries_exemplars_when_stamped(self):
+        t = Timer()
+        for i in range(10):
+            t.update(0.001 * (i + 1), exemplar=f"tid-{i}")
+        snap = t.snapshot()
+        assert set(snap["exemplars"]) <= {"p50_s", "p95_s", "p99_s"}
+        assert snap["exemplars"]["p99_s"] == "tid-9"
+
+    def test_scheduler_stamps_trace_ids_when_sampled(self):
+        from corda_tpu.crypto import generate_keypair, sign
+        from corda_tpu.observability import configure_tracing, tracer
+        from corda_tpu.serving import DeviceScheduler
+
+        configure_tracing(sample_rate=1.0)
+        try:
+            s = DeviceScheduler(use_device_default=False)
+            kp = generate_keypair()
+            msg = b"exemplar-stamp"
+            rows = [(kp.public, sign(kp.private, msg), msg)]
+            # queue spans parent under the submitted trace — only a
+            # sampled submit context gets its trace id stamped
+            root = tracer().root("exemplar.test", force=True)
+            fut = s.submit_rows(rows, trace=root)
+            assert fut.result(timeout=60).mask.all()
+            root.finish()
+            s.shutdown()
+            res = node_metrics().timer("serving.wait_s")._reservoir
+            assert any(e for e in res._exemplars), \
+                "sampled dispatch left no trace id in the reservoir"
+        finally:
+            configure_tracing(sample_rate=0.0)
+
+    def test_exposition_emits_and_parses_exemplar_suffix(self):
+        from corda_tpu.node.monitoring import MetricRegistry
+        from corda_tpu.observability import render_prometheus
+
+        reg = MetricRegistry()
+        t = reg.timer("ex.lat_s")
+        for i in range(10):
+            t.update(0.001 * (i + 1), exemplar=f"trace-{i}")
+        configure_exemplars(True)
+        text = render_prometheus(reg.snapshot())
+        assert '# {trace_id="trace-9"}' in text
+        parsed = parse_prometheus(text)
+        key = 'cordatpu_ex_lat_s_seconds{quantile="0.99"}'
+        assert parsed["__exemplars__"][key] == "trace-9"
+        # the sample value itself still parses normally
+        assert float(parsed[key]) == pytest.approx(0.010)
+        configure_exemplars(False)
+        assert "# {" not in render_prometheus(reg.snapshot())
+
+    def test_hostile_trace_id_escaped_in_exemplar(self):
+        from corda_tpu.node.monitoring import MetricRegistry
+        from corda_tpu.observability import render_prometheus
+
+        reg = MetricRegistry()
+        t = reg.timer("ex.hostile_s")
+        t.update(0.5, exemplar='evil"\\\n')
+        configure_exemplars(True)
+        text = render_prometheus(reg.snapshot())
+        assert 'trace_id="evil\\"\\\\\\n"' in text
+        parsed = parse_prometheus(text)  # must not raise
+        assert any(parsed["__exemplars__"].values())
+
+
+# ------------------------------------------------------------- help lines
+
+class TestHelpLines:
+    def test_known_families_carry_help(self):
+        node_metrics().meter("serving.requests")
+        text = metrics_text()
+        assert "# HELP cordatpu_serving_requests " in text
+        # HELP must precede its family's TYPE line
+        lines = text.splitlines()
+        hi = lines.index(next(
+            ln for ln in lines
+            if ln.startswith("# HELP cordatpu_serving_requests")
+        ))
+        assert lines[hi + 1].startswith("# TYPE cordatpu_serving_requests")
+
+    def test_parse_tolerates_and_returns_help(self):
+        node_metrics().meter("serving.requests")
+        parsed = parse_prometheus(metrics_text())
+        assert parsed["__help__"]["cordatpu_serving_requests"]
+        assert "cordatpu_serving_requests_total" in parsed
+
+    def test_round_trip_with_types_and_help(self):
+        text = ("# HELP cordatpu_x total widgets\n"
+                "# TYPE cordatpu_x counter\n"
+                "cordatpu_x_total 7\n")
+        parsed = parse_prometheus(text)
+        assert parsed["cordatpu_x_total"] == "7"
+        assert parsed["__types__"]["cordatpu_x"] == "counter"
+        assert parsed["__help__"]["cordatpu_x"] == "total widgets"

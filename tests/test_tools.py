@@ -922,6 +922,246 @@ class TestPerfGate:
                 "--result", str(rpath), "--baseline", str(bpath))
             assert proc.returncode == want_rc, (ops, proc.stdout)
 
+    def _synthetic_timeline(self):
+        return {
+            "cadence_s": 0.05, "ticks": 3, "series": 4,
+            "counter_series": 1, "timer_series": 3,
+            "timestamps": [1.0, 2.0, 3.0],
+            "rings": {
+                "serving.requests": [0.0, 8.0, 8.0],
+                "serving.wait_s.p50_s": [0.001, 0.002, 0.002],
+                "serving.wait_s.p99_s": [0.004, 0.005, 0.006],
+                "serving.wait_s.count": [8.0, 8.0, 8.0],
+            },
+            "burn_alerts": 1, "flight_roundtrip_ok": 1,
+        }
+
+    def test_check_schema_validates_timeline_section(self, tmp_path):
+        """ISSUE 18: the smoke's `timeline` section is schema-validated —
+        well-formed passes; empty rings, non-monotone timestamps, a p99
+        ring dipping below its p50 sibling, a failed flight round trip
+        and a silent burn-rate pass all fail."""
+        good = dict(self.SYNTHETIC)
+        good["timeline"] = self._synthetic_timeline()
+        ok = tmp_path / "tl.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        for doctor, needle in (
+            (lambda t: t.__setitem__("rings", {}),
+             "missing non-empty 'rings'"),
+            (lambda t: t["rings"].__setitem__("serving.requests", []),
+             "non-empty numeric list"),
+            (lambda t: t.__setitem__("timestamps", [3.0, 1.0, 2.0]),
+             "not monotone"),
+            (lambda t: t["rings"].__setitem__(
+                "serving.wait_s.p99_s", [0.004, 0.001, 0.006]),
+             "quantiles must be monotone"),
+            (lambda t: t.__setitem__("flight_roundtrip_ok", 0),
+             "flight_roundtrip_ok is 0"),
+            (lambda t: t.__setitem__("burn_alerts", 0),
+             "burn_alerts is 0"),
+            (lambda t: t.pop("counter_series"),
+             "missing numeric 'counter_series'"),
+            (lambda t: t.__setitem__("cadence_s", 0.0),
+             "not positive"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["timeline"])
+            bad = tmp_path / "tl_bad.json"
+            bad.write_text(json.dumps(broken))
+            proc = self._run("--result", str(bad), "--check-schema")
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_check_schema_accepts_raw_recorder_snapshot(self, tmp_path):
+        """A LOADTEST.json written with tools_loadgen.py --timeline embeds
+        a RAW TimelineRecorder.snapshot() — the gate validates that shape
+        too (and still rejects a doctored inverted-quantile ring)."""
+        good = dict(self.SYNTHETIC)
+        good["timeline"] = {
+            "enabled": True, "schema": 1, "cadence_s": 0.5,
+            "ring_points": 512, "ticks": 2, "timestamps": [1.0, 2.0],
+            "series": {
+                "serving.requests": {"kind": "counter_delta",
+                                     "points": [0.0, 4.0]},
+                "serving.wait_s.p50_s": {"kind": "timer_quantile",
+                                         "points": [0.002, 0.002]},
+                "serving.wait_s.p99_s": {"kind": "timer_quantile",
+                                         "points": [0.005, 0.006]},
+            },
+            "marks": [],
+        }
+        ok = tmp_path / "raw.json"
+        ok.write_text(json.dumps(good))
+        proc = self._run("--result", str(ok), "--check-schema")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        broken = json.loads(json.dumps(good))
+        broken["timeline"]["series"]["serving.wait_s.p99_s"]["points"] = \
+            [0.001, 0.006]
+        bad = tmp_path / "raw_bad.json"
+        bad.write_text(json.dumps(broken))
+        proc = self._run("--result", str(bad), "--check-schema")
+        assert proc.returncode == 1, proc.stdout
+        assert "quantiles must be monotone" in proc.stdout
+
+    def test_history_appends_validated_entry(self, tmp_path):
+        """ISSUE 18 perf-history sentinel: --history appends one JSONL
+        entry per capture carrying t/date/git_rev/provenance/source and
+        every present gated metric."""
+        import tools_perf_gate as tpg
+
+        res = tmp_path / "bench.json"
+        res.write_text(json.dumps(self.SYNTHETIC))
+        hist = tmp_path / "hist.jsonl"
+        proc = self._run("--result", str(res), "--history",
+                         "--history-file", str(hist))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        lines = hist.read_text().strip().splitlines()
+        assert len(lines) == 1
+        entry = json.loads(lines[0])
+        for key in ("t", "date", "git_rev", "provenance", "source",
+                    "metrics"):
+            assert key in entry, entry
+        assert entry["source"] == "bench.json"
+        assert entry["provenance"] == "deviceless"
+        assert entry["metrics"]["ed25519_sigs_per_sec"] == 100000.0
+        assert tpg.validate_history_entry(entry, "line 1") == []
+        # appending again grows the log — history is an append-only ledger
+        self._run("--result", str(res), "--history",
+                  "--history-file", str(hist))
+        assert len(hist.read_text().strip().splitlines()) == 2
+
+    def _write_history(self, path, values, metric="ed25519_sigs_per_sec"):
+        import tools_perf_gate as tpg
+
+        with open(path, "w") as f:
+            for i, v in enumerate(values):
+                res = dict(self.SYNTHETIC)
+                res[metric] = v
+                entry = tpg.history_entry(res, "doctored.json")
+                entry["t"] = 1000.0 + i
+                f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+    def test_trend_fails_on_monotone_regression(self, tmp_path):
+        """A gated metric worsening strictly across the last 3 captures
+        (here: ed25519 throughput falling, higher-is-better) turns the
+        trend red; the failure names the metric."""
+        hist = tmp_path / "hist.jsonl"
+        self._write_history(hist, (100000.0, 90000.0, 80000.0))
+        proc = self._run("--trend", "--history-file", str(hist))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "REGRESSING" in proc.stdout
+        assert "ed25519_sigs_per_sec" in proc.stdout
+
+    def test_trend_tolerates_non_monotone_dip(self, tmp_path):
+        """A dip that recovers is NOT a trend failure — only strict
+        monotone worsening across the window trips the sentinel."""
+        hist = tmp_path / "hist.jsonl"
+        self._write_history(hist, (100000.0, 90000.0, 95000.0))
+        proc = self._run("--trend", "--history-file", str(hist))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_trend_window_bounds_lookback(self, tmp_path):
+        """--trend-window sets how many consecutive worsening captures
+        trip the sentinel: a 3-capture slide fails at window 3, but a
+        wider window reaching back to the flat era does not (the slide
+        is no longer monotone across ALL of it)."""
+        hist = tmp_path / "hist.jsonl"
+        self._write_history(
+            hist, (100000.0, 100000.0, 95000.0, 90000.0, 80000.0))
+        assert self._run("--trend", "--history-file", str(hist),
+                         "--trend-window", "3").returncode == 1
+        assert self._run("--trend", "--history-file", str(hist),
+                         "--trend-window", "5").returncode == 0
+
+    def test_trend_rejects_malformed_history(self, tmp_path):
+        hist = tmp_path / "hist.jsonl"
+        hist.write_text('{"t": 1.0}\nnot json\n')
+        proc = self._run("--trend", "--history-file", str(hist))
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+class TestTimelineCLI:
+    """ISSUE 18: tools_timeline.py renders a timeline snapshot (from a
+    flight dump, a saved snapshot JSON, or its in-process live demo) as
+    an ASCII sparkline table."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    CLI = os.path.join(REPO, "tools_timeline.py")
+
+    def _run(self, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, self.CLI, *args],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+
+    def _snapshot(self):
+        return {
+            "enabled": True, "schema": 1, "cadence_s": 0.5,
+            "ring_points": 64, "ticks": 3,
+            "timestamps": [1.0, 1.5, 2.0],
+            "series": {
+                "serving.requests": {"kind": "counter_delta",
+                                     "points": [0.0, 4.0, 8.0]},
+            },
+            "marks": [{"t": 1.5, "name": "step", "value": 4.0}],
+        }
+
+    def test_renders_snapshot_file(self, tmp_path):
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps(self._snapshot()))
+        proc = self._run("--snapshot", str(snap))
+        assert proc.returncode == 0, proc.stderr
+        assert "serving.requests" in proc.stdout
+        assert "counter_delta" in proc.stdout
+        assert "step" in proc.stdout  # the mark row
+
+    def test_renders_nested_timeline_key(self, tmp_path):
+        doc = tmp_path / "artifact.json"
+        doc.write_text(json.dumps({"timeline": self._snapshot()}))
+        proc = self._run("--snapshot", str(doc))
+        assert proc.returncode == 0, proc.stderr
+        assert "serving.requests" in proc.stdout
+
+    def test_rejects_snapshotless_json(self, tmp_path):
+        doc = tmp_path / "nothing.json"
+        doc.write_text(json.dumps({"unrelated": 1}))
+        proc = self._run("--snapshot", str(doc))
+        assert proc.returncode == 1
+        assert "no timeline snapshot" in proc.stderr
+
+    def test_renders_flight_dump(self, tmp_path):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability import (
+            configure_timeline,
+            flight_dump,
+        )
+        from corda_tpu.observability.timeseries import active_timeline
+
+        configure_timeline(enabled=True, cadence_s=0.05, ring_points=16,
+                           thread=False)
+        try:
+            node_metrics().meter("serving.requests").mark(5)
+            active_timeline().tick()
+            path = flight_dump(str(tmp_path / "f.jsonl"), reason="cli")
+        finally:
+            configure_timeline(enabled=False, reset=True)
+        proc = self._run("--flight", path)
+        assert proc.returncode == 0, proc.stderr
+        assert "serving.requests" in proc.stdout
+
+    def test_flight_dump_without_timeline_fails_cleanly(self, tmp_path):
+        from corda_tpu.observability import flight_dump
+
+        path = flight_dump(str(tmp_path / "off.jsonl"), reason="off")
+        proc = self._run("--flight", path)
+        assert proc.returncode == 1
+        assert "no timeline kind" in proc.stderr
+
 
 class TestOpCount:
     """ISSUE 8: ops/opcount.py — the parameterized per-verify op model

@@ -80,6 +80,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="device-batched signature verification")
     ap.add_argument("--sampler", action="store_true",
                     help="attach the stack sampler's folded stacks")
+    ap.add_argument("--timeline", action="store_true",
+                    help="record the telemetry timeline through the ramp "
+                         "(qps steps stamped as marks; render with "
+                         "tools_timeline.py --snapshot)")
     ap.add_argument("--overload", action="store_true",
                     help="after the ramp, certify graceful degradation "
                          "at --overload-factor × the knee under chaos")
@@ -128,7 +132,21 @@ def main(argv: list[str] | None = None) -> int:
         resilience=args.resilience,
         sampler=args.sampler,
     )
-    result = run_harness(cfg)
+    if args.timeline:
+        # the timeline rides the whole ramp: the harness stamps each
+        # step's qps (and the knee) into the mark deque, and the ring
+        # snapshot travels in the artifact for tools_timeline.py
+        from corda_tpu.observability import configure_timeline
+        from corda_tpu.observability.timeseries import timeline
+
+        configure_timeline(enabled=True, cadence_s=0.5, reset=True)
+    try:
+        result = run_harness(cfg)
+        if args.timeline:
+            result["timeline"] = timeline().snapshot()
+    finally:
+        if args.timeline:
+            configure_timeline(enabled=False, reset=True)
     path = write_loadtest(result, args.out)
     knee = result.get("knee")
     for step in result["steps"]:

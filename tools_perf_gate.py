@@ -17,6 +17,18 @@ Modes::
     python tools_perf_gate.py --result BENCH_LOCAL.json          # gate (rc 0/1)
     python tools_perf_gate.py --result out.json --write-baseline # (re)base
     python tools_perf_gate.py --result out.json --check-schema   # shape only
+    python tools_perf_gate.py --result out.json --history        # append entry
+    python tools_perf_gate.py --trend                            # trajectory
+
+**Perf-history sentinel** (``--history`` / ``--trend``): every gated
+capture appends one line to ``BENCH_HISTORY.jsonl`` — wall time, ISO
+date, short git rev, chip-vs-deviceless provenance, and every gated
+metric present — so the bench trajectory is a first-class artifact
+instead of a pile of orphan ``BENCH_r0x.json`` files. ``--trend``
+renders each metric's recent trajectory and FAILS on a strict monotone
+regression across the last K entries (``--trend-window``, default 3):
+one noisy capture never trips it, K successive worsenings always do.
+``bench.py`` appends a history entry automatically after every full run.
 
 ``--baseline`` overrides the baseline path (default: PERF_BASELINE.json
 beside this file). ``--write-baseline`` records every known gated metric
@@ -56,6 +68,10 @@ import sys
 
 BASELINE_DEFAULT = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "PERF_BASELINE.json"
+)
+
+HISTORY_DEFAULT = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
 )
 
 # Known gated metrics: path -> (direction, default relative tolerance).
@@ -225,6 +241,22 @@ STATESTORE_REQUIRED_KEYS = (
     "occupancy_low", "occupancy_high",
     "probes_per_sec", "probes_per_sec_high",
     "spill_rows", "verdict_parity", "digest_parity",
+)
+
+# keys the smoke's timeline section must carry for --check-schema (the
+# telemetry-timeline pass — docs/OBSERVABILITY.md §Telemetry timeline):
+# sampling cadence + tick census, the series breakdown, and the two
+# acceptance flags (a synthetic burn-rate alert fired; the flight dump's
+# timeline kind round-tripped)
+TIMELINE_REQUIRED_KEYS = (
+    "cadence_s", "ticks", "series", "counter_series", "timer_series",
+    "burn_alerts", "flight_roundtrip_ok",
+)
+
+# keys every BENCH_HISTORY.jsonl entry must carry (--history appends
+# them, --trend validates before trusting the trajectory)
+HISTORY_REQUIRED_KEYS = (
+    "t", "date", "git_rev", "provenance", "source", "metrics",
 )
 
 # the flowprof closed phase set (corda_tpu/observability/flowprof.PHASES,
@@ -758,6 +790,124 @@ def check_schema(result: dict) -> list[str]:
                         f"statestore: {flag} is {v:g} (the pass must prove "
                         "bit-parity with the host oracle, not merely run)"
                     )
+    tl = result.get("timeline")
+    if tl is not None:
+        if not isinstance(tl, dict):
+            problems.append("timeline: expected an object")
+        elif not tl.get("enabled", True):
+            # a disabled capture ({"enabled": false}) carries no numbers
+            pass
+        else:
+            def tnum(key):
+                v = tl.get(key)
+                return v if isinstance(v, (int, float)) \
+                    and not isinstance(v, bool) else None
+
+            # two shapes land here: the smoke's scored section (flat
+            # counts + a ``rings`` name→points map) and a RAW
+            # ``TimelineRecorder.snapshot()`` (``series`` is a dict of
+            # {kind, points} — what ``tools_loadgen.py --timeline``
+            # embeds). The raw shape skips the smoke-only scoring keys
+            # but gets the same timestamp/ring/quantile checks.
+            raw_snapshot = isinstance(tl.get("series"), dict)
+            if raw_snapshot:
+                rings = {
+                    name: (s or {}).get("points")
+                    for name, s in tl["series"].items()
+                    if isinstance(s, dict)
+                }
+                if not rings:
+                    problems.append(
+                        "timeline: snapshot carries no series"
+                    )
+            else:
+                rings = tl.get("rings")
+                for key in TIMELINE_REQUIRED_KEYS:
+                    if tnum(key) is None:
+                        problems.append(
+                            f"timeline: missing numeric {key!r}"
+                        )
+                    elif tnum(key) < 0:
+                        problems.append(
+                            f"timeline: negative {key} {tnum(key)}"
+                        )
+                for key in ("ticks", "series", "counter_series",
+                            "timer_series"):
+                    v = tnum(key)
+                    if v is not None and v < 1:
+                        problems.append(
+                            f"timeline: {key} is {v:g} — the pass must "
+                            "record at least one"
+                        )
+            if tnum("cadence_s") is not None and tnum("cadence_s") <= 0:
+                problems.append(
+                    f"timeline: cadence_s {tnum('cadence_s')} is not "
+                    "positive"
+                )
+            ts = tl.get("timestamps")
+            if not isinstance(ts, list) or not ts or not all(
+                isinstance(t, (int, float)) and not isinstance(t, bool)
+                for t in ts
+            ):
+                problems.append(
+                    "timeline: missing non-empty numeric 'timestamps' list"
+                )
+            elif any(b < a for a, b in zip(ts, ts[1:])):
+                problems.append(
+                    "timeline: timestamps are not monotone nondecreasing"
+                )
+            if not isinstance(rings, dict) or not rings:
+                if not raw_snapshot:
+                    problems.append(
+                        "timeline: missing non-empty 'rings' object"
+                    )
+            else:
+                for name, ring in rings.items():
+                    if not isinstance(ring, list) or not ring or not all(
+                        isinstance(v, (int, float))
+                        and not isinstance(v, bool) for v in ring
+                    ):
+                        problems.append(
+                            f"timeline/rings/{name}: expected a non-empty "
+                            "numeric list"
+                        )
+                # interval quantiles must be monotone: for every
+                # <timer>.p50_s ring with a <timer>.p99_s sibling, the
+                # p99 point can never sit below the p50 point of the
+                # same interval (align on the trailing points — a series
+                # may have started later than its sibling)
+                for name, p50 in rings.items():
+                    if not name.endswith(".p50_s"):
+                        continue
+                    sibling = name[: -len(".p50_s")] + ".p99_s"
+                    p99 = rings.get(sibling)
+                    if not (isinstance(p50, list) and isinstance(p99, list)):
+                        continue
+                    n = min(len(p50), len(p99))
+                    for i in range(1, n + 1):
+                        a, b = p50[-i], p99[-i]
+                        if (isinstance(a, (int, float))
+                                and isinstance(b, (int, float))
+                                and not isinstance(a, bool)
+                                and not isinstance(b, bool) and b < a):
+                            problems.append(
+                                f"timeline/rings/{sibling}: point {b} "
+                                f"below {name} point {a} (interval "
+                                "quantiles must be monotone)"
+                            )
+                            break
+            v = tnum("flight_roundtrip_ok")
+            if v is not None and v != 1:
+                problems.append(
+                    f"timeline: flight_roundtrip_ok is {v:g} (the pass "
+                    "must prove the dump round-trips, not merely run)"
+                )
+            v = tnum("burn_alerts")
+            if v is not None and v < 1:
+                problems.append(
+                    f"timeline: burn_alerts is {v:g} — the synthetic "
+                    "burn-rate breach must fire"
+                )
     return problems
 
 
@@ -787,6 +937,195 @@ def write_baseline(result: dict, result_path: str, baseline_path: str) -> int:
         f.write("\n")
     os.replace(tmp, baseline_path)
     print(f"perf-gate: wrote {baseline_path} ({len(metrics)} metrics)")
+    return 0
+
+
+# ---------------------------------------------------------- perf history
+
+def _git_rev() -> str:
+    """Short rev of the repo this tool lives in; "unknown" when git is
+    unavailable (a vendored copy, a tarball CI runner)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        rev = out.stdout.strip()
+        return rev if out.returncode == 0 and rev else "unknown"
+    except Exception:
+        return "unknown"
+
+
+def history_entry(result: dict, source: str) -> dict | None:
+    """One BENCH_HISTORY.jsonl record for a bench result: timestamp,
+    git rev, chip-vs-deviceless provenance, and every gated metric the
+    result carries. None when the result carries no gated metric — an
+    empty entry would pollute the trajectory with unplottable points."""
+    import time as _time
+
+    metrics = {}
+    for path in sorted(GATED_METRICS):
+        v = resolve_path(result, path)
+        if v is not None:
+            metrics[path] = v
+    if not metrics:
+        return None
+    now = _time.time()
+    return {
+        "t": now,
+        "date": _time.strftime("%Y-%m-%dT%H:%M:%SZ", _time.gmtime(now)),
+        "git_rev": _git_rev(),
+        "provenance": result.get("device") or "deviceless",
+        "source": source,
+        "metrics": metrics,
+    }
+
+
+def validate_history_entry(entry, where: str) -> list[str]:
+    problems: list[str] = []
+    if not isinstance(entry, dict):
+        return [f"{where}: expected an object"]
+    for key in HISTORY_REQUIRED_KEYS:
+        if key not in entry:
+            problems.append(f"{where}: missing {key!r}")
+    t = entry.get("t")
+    if "t" in entry and (not isinstance(t, (int, float))
+                         or isinstance(t, bool) or t <= 0):
+        problems.append(f"{where}: 't' is not a positive number")
+    for key in ("date", "git_rev", "provenance", "source"):
+        v = entry.get(key)
+        if key in entry and (not isinstance(v, str) or not v):
+            problems.append(f"{where}: {key!r} is not a non-empty string")
+    metrics = entry.get("metrics")
+    if "metrics" in entry:
+        if not isinstance(metrics, dict) or not metrics:
+            problems.append(f"{where}: 'metrics' is not a non-empty object")
+        else:
+            for path, v in metrics.items():
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    problems.append(
+                        f"{where}: metric {path!r} is not numeric"
+                    )
+    return problems
+
+
+def load_history(history_path: str) -> tuple[list[dict], list[str]]:
+    """Parse + validate BENCH_HISTORY.jsonl → (entries, problems)."""
+    entries: list[dict] = []
+    problems: list[str] = []
+    try:
+        with open(history_path) as f:
+            raw_lines = f.readlines()
+    except OSError as e:
+        return [], [f"cannot read {history_path}: {e}"]
+    for i, raw in enumerate(raw_lines, 1):
+        raw = raw.strip()
+        if not raw:
+            continue
+        where = f"{os.path.basename(history_path)}:{i}"
+        try:
+            entry = json.loads(raw)
+        except json.JSONDecodeError as e:
+            problems.append(f"{where}: not JSON ({e})")
+            continue
+        probs = validate_history_entry(entry, where)
+        problems.extend(probs)
+        if not probs:
+            entries.append(entry)
+    return entries, problems
+
+
+def append_history(result: dict, source: str,
+                   history_path: str = HISTORY_DEFAULT) -> int:
+    """Append one validated history record; rc 0/1 (CLI contract)."""
+    entry = history_entry(result, source)
+    if entry is None:
+        print("perf-gate: refusing to append an empty history entry "
+              "(no gated metric found in the result)")
+        return 1
+    probs = validate_history_entry(entry, "new entry")
+    if probs:  # self-check: a bug here must not corrupt the trajectory
+        print("perf-gate: refusing to append a malformed history entry:")
+        for p in probs:
+            print(f"  {p}")
+        return 1
+    with open(history_path, "a") as f:
+        f.write(json.dumps(entry, sort_keys=True) + "\n")
+    print(f"perf-gate: appended {entry['git_rev']}/"
+          f"{entry['provenance']} to {history_path} "
+          f"({len(entry['metrics'])} metrics)")
+    return 0
+
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list[float]) -> str:
+    lo, hi = min(values), max(values)
+    if hi <= lo:
+        return _SPARK[0] * len(values)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1,
+                   int((v - lo) / (hi - lo) * (len(_SPARK) - 1)))]
+        for v in values
+    )
+
+
+def run_trend(history_path: str, window: int) -> int:
+    """Render per-metric trajectories over the history file and FAIL on
+    any metric strictly monotonically worsening across its last
+    ``window`` entries (direction-aware: a rate falling every capture, a
+    latency rising every capture). One noisy point breaks the streak —
+    by design; the sentinel pages on a trend, not a blip."""
+    entries, problems = load_history(history_path)
+    if problems:
+        print(f"perf-gate: history problems in {history_path}:")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    if not entries:
+        print(f"perf-gate: no history entries in {history_path}")
+        return 1
+    window = max(2, int(window))
+    regressions: list[str] = []
+    metric_paths = sorted({
+        p for e in entries for p in e.get("metrics", {})
+    })
+    for path in metric_paths:
+        series = [
+            (e["git_rev"], float(e["metrics"][path]))
+            for e in entries if path in e.get("metrics", {})
+        ]
+        values = [v for _, v in series]
+        direction = GATED_METRICS.get(path, ("higher", 0.0))[0]
+        tail = values[-window:]
+        trajectory = " -> ".join(f"{v:g}" for v in tail)
+        regressed = False
+        if len(tail) >= window:
+            if direction == "higher":
+                regressed = all(b < a for a, b in zip(tail, tail[1:]))
+            else:
+                regressed = all(b > a for a, b in zip(tail, tail[1:]))
+        status = "REGRESSING" if regressed else "ok"
+        print(f"perf-gate: trend {status} {path} "
+              f"[{_sparkline(values)}] {trajectory} "
+              f"({direction} is better, {len(values)} captures)")
+        if regressed:
+            regressions.append(
+                f"{path}: {trajectory} — worsened {window - 1}x in a row "
+                f"({series[-1][0]} is the latest rev)"
+            )
+    if regressions:
+        print(f"perf-gate: {len(regressions)} monotone regression(s) over "
+              f"the last {window} entries:")
+        for r in regressions:
+            print(f"  {r}")
+        return 1
+    print(f"perf-gate: trend ok ({len(metric_paths)} metrics, "
+          f"{len(entries)} history entries)")
     return 0
 
 
@@ -842,7 +1181,7 @@ def run_gate(result: dict, baseline: dict) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--result", required=True,
+    ap.add_argument("--result",
                     help="bench JSON to gate (bench.py / --smoke output "
                          "or BENCH_LOCAL.json)")
     ap.add_argument("--baseline", default=BASELINE_DEFAULT,
@@ -852,13 +1191,35 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--check-schema", action="store_true",
                     help="validate the result's structure only (no "
                          "baseline, no device)")
+    ap.add_argument("--history", action="store_true",
+                    help="append the result's gated metrics to the "
+                         "history file and exit")
+    ap.add_argument("--history-file", default=HISTORY_DEFAULT,
+                    help="history path (default: BENCH_HISTORY.jsonl)")
+    ap.add_argument("--trend", action="store_true",
+                    help="render per-metric trajectories from the history "
+                         "file; fail on monotone regression (no --result "
+                         "needed)")
+    ap.add_argument("--trend-window", type=int, default=3,
+                    help="entries a metric must worsen across, "
+                         "consecutively, to fail --trend (default 3)")
     args = ap.parse_args(argv)
+
+    if args.trend:
+        return run_trend(args.history_file, args.trend_window)
+
+    if not args.result:
+        ap.error("--result is required (except with --trend)")
 
     try:
         result = load_json(args.result)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perf-gate: cannot read result {args.result}: {e}")
         return 2
+
+    if args.history:
+        return append_history(result, os.path.basename(args.result),
+                              args.history_file)
 
     if args.check_schema:
         problems = check_schema(result)

@@ -1,0 +1,194 @@
+"""Telemetry-timeline renderer CLI (docs/OBSERVABILITY.md §Telemetry
+timeline).
+
+Renders a timeline ring snapshot — the fixed-cadence counter-delta /
+timer-quantile / device-gauge / SLO-burn series the off-by-default
+``TimelineRecorder`` samples — as an ASCII sparkline table: one row per
+series with its kind, min/max/last values and the ring's shape over
+time, plus any stamped marks (loadgen qps steps etc.). Reads from any
+of the three places a timeline lands:
+
+    python tools_timeline.py --flight FLIGHT.jsonl   # flight dump kind
+    python tools_timeline.py --snapshot SNAP.json    # saved snapshot
+    python tools_timeline.py --live                  # in-process demo
+
+``--snapshot`` accepts a raw ``TimelineRecorder.snapshot()`` dict (what
+``CordaRPCOps.timeline_snapshot()`` returns — pipe a remote scrape to a
+file and point this at it), or any JSON carrying one under a
+``timeline`` key (a ``monitoring_snapshot()``, a ``bench.py --smoke``
+artifact). ``--live`` forces the timeline on around a host-path
+scheduler burst and renders what the rings caught — a seconds-fast
+demo of the recorder end to end.
+
+Knobs:
+
+    --flight PATH    render the ``timeline`` kind of a flight dump
+    --snapshot PATH  render a snapshot JSON (raw or nested)
+    --live           in-process demo burst (no artifact needed)
+    --points N       show only the last N ring points (default: all)
+    --width N        sparkline glyph budget per row (default 32)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+sys.path.insert(0, str(ROOT))
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list, width: int = 32) -> str:
+    """Min-max-normalised sparkline of ``values``; flat series render as
+    all-low so a spike is always visible against its floor."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK[0] * len(vals)
+    return "".join(
+        _SPARK[min(len(_SPARK) - 1, int((v - lo) / span * len(_SPARK)))]
+        for v in vals
+    )
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e9:
+        return str(int(v))
+    return f"{v:.4g}"
+
+
+def extract_timeline(doc: dict) -> dict | None:
+    """Find the timeline snapshot inside ``doc``: the dict itself when it
+    IS a snapshot (has ``series``), else its ``timeline`` key."""
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("series"), dict):
+        return doc
+    inner = doc.get("timeline")
+    if isinstance(inner, dict) and isinstance(inner.get("series"), dict):
+        return inner
+    return None
+
+
+def render_timeline(snap: dict, *, points: int | None = None,
+                    width: int = 32) -> str:
+    """The sparkline table for one snapshot, as a printable string."""
+    ts = list(snap.get("timestamps") or [])
+    series = snap.get("series") or {}
+    lines = []
+    span = ts[-1] - ts[0] if len(ts) >= 2 else 0.0
+    lines.append(
+        f"timeline: {snap.get('ticks', len(ts))} ticks"
+        f" @ {snap.get('cadence_s', '?')}s cadence,"
+        f" {len(series)} series, {span:.2f}s span,"
+        f" ring={snap.get('ring_points', '?')}"
+    )
+    if not series:
+        lines.append("  (no series recorded)")
+        return "\n".join(lines)
+    name_w = max(len(n) for n in series) + 2
+    kind_w = max(len(s.get("kind", "?")) for s in series.values()) + 2
+    lines.append(
+        f"  {'series'.ljust(name_w)}{'kind'.ljust(kind_w)}"
+        f"{'min'.rjust(12)}{'max'.rjust(12)}{'last'.rjust(12)}  spark"
+    )
+    for name in sorted(series):
+        s = series[name]
+        pts = [float(v) for v in (s.get("points") or [])]
+        if points is not None:
+            pts = pts[-points:]
+        if not pts:
+            continue
+        lines.append(
+            f"  {name.ljust(name_w)}{s.get('kind', '?').ljust(kind_w)}"
+            f"{_fmt(min(pts)).rjust(12)}{_fmt(max(pts)).rjust(12)}"
+            f"{_fmt(pts[-1]).rjust(12)}  {_sparkline(pts, width)}"
+        )
+    marks = snap.get("marks") or []
+    if marks:
+        lines.append(f"  marks ({len(marks)}):")
+        for mk in marks:
+            lines.append(
+                f"    t={_fmt(float(mk.get('t', 0.0)))}"
+                f" {mk.get('name', '?')}={_fmt(float(mk.get('value', 0.0)))}"
+            )
+    return "\n".join(lines)
+
+
+def run_live_demo() -> dict:
+    """Force the timeline on around a host-path scheduler burst and
+    return the snapshot — what a live ``CordaRPCOps.timeline_snapshot()``
+    scrape of a loaded node looks like, without needing a node."""
+    from corda_tpu.crypto import generate_keypair, sign
+    from corda_tpu.observability import configure_timeline
+    from corda_tpu.observability.timeseries import timeline
+    from corda_tpu.serving import DeviceScheduler
+
+    configure_timeline(enabled=True, cadence_s=0.05, ring_points=64,
+                       thread=False, reset=True)
+    tl = timeline()
+    try:
+        sched = DeviceScheduler(use_device_default=False)
+        kp = generate_keypair()
+        rows = []
+        for i in range(8):
+            msg = b"timeline-demo-%d" % i
+            rows.append((kp.public, sign(kp.private, msg), msg))
+        tl.tick()
+        for step, reps in enumerate((1, 2, 4)):
+            tl.mark("demo.step", float(reps))
+            for _ in range(reps):
+                sched.submit_rows(rows, use_device=False).result(timeout=60)
+            tl.tick()
+        sched.shutdown()
+        return tl.snapshot()
+    finally:
+        configure_timeline(enabled=False, reset=True)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--flight", help="flight-dump JSONL to read")
+    src.add_argument("--snapshot", help="snapshot JSON to read")
+    src.add_argument("--live", action="store_true",
+                     help="in-process demo burst")
+    ap.add_argument("--points", type=int, default=None,
+                    help="show only the last N ring points")
+    ap.add_argument("--width", type=int, default=32,
+                    help="sparkline glyph budget (default 32)")
+    args = ap.parse_args(argv)
+
+    if args.live:
+        snap = run_live_demo()
+    elif args.flight:
+        from corda_tpu.observability import read_flight_dump
+
+        dump = read_flight_dump(args.flight)
+        snap = dump.get("timeline")
+        if not isinstance(snap, dict) or not snap.get("enabled"):
+            print(f"timeline: no timeline kind in {args.flight} "
+                  "(was the recorder enabled when the dump was written?)",
+                  file=sys.stderr)
+            return 1
+    else:
+        with open(args.snapshot, encoding="utf-8") as f:
+            doc = json.load(f)
+        snap = extract_timeline(doc)
+        if snap is None:
+            print(f"timeline: no timeline snapshot in {args.snapshot}",
+                  file=sys.stderr)
+            return 1
+    print(render_timeline(snap, points=args.points, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
