@@ -2216,6 +2216,87 @@ def run_smoke_timeline() -> dict:
         shutil.rmtree(flight_dir, ignore_errors=True)
 
 
+def run_smoke_concurrency() -> dict:
+    """The smoke's concurrency-observatory leg (docs/OBSERVABILITY.md
+    §Concurrency observatory + §Causal profiler): contention timing
+    forced on (no factory patch — an explicitly-named timed lock keeps
+    the pass hermetic) around a deterministic convoy, asserting the site
+    lands in the top-contended table with monotone wait quantiles and a
+    holder→waiter edge; then a full causal-profiler synthetic run whose
+    planted-bottleneck validation must predict the measured gain within
+    ±25% (the acceptance bound — asserted here AND schema-gated by
+    tools_perf_gate.py). Emits the ``contention`` and ``causal``
+    sections the perf gate's --check-schema validates. Runs last; the
+    forced toggles are restored either way."""
+    import threading as _threading
+
+    from corda_tpu.observability import (
+        configure_contention,
+        timed_lock,
+    )
+    from corda_tpu.observability.causal import (
+        configure_causal,
+        run_synthetic,
+    )
+
+    configure_contention(enabled=True, patch=False, reset=True)
+    try:
+        # --- deterministic convoy: a contender grabs the lock and holds
+        # it while the main thread blocks on acquire
+        lk = timed_lock("smoke.convoy")
+        taken = _threading.Event()
+
+        def holder() -> None:
+            with lk:
+                taken.set()
+                time.sleep(0.02)
+
+        t = _threading.Thread(target=holder, name="smoke-holder")
+        t.start()
+        taken.wait(timeout=5.0)
+        t0 = time.perf_counter()
+        with lk:
+            blocked_s = time.perf_counter() - t0
+        t.join()
+        from corda_tpu.observability.contention import contention_section
+
+        csec = contention_section()
+        site = csec["sites"].get("smoke.convoy")
+        assert site is not None, "convoy site missing from contention table"
+        assert site["contended"] >= 1, site
+        assert site["acquires"] >= site["contended"], site
+        assert site["wait_p50_s"] <= site["wait_p95_s"] \
+            <= site["wait_p99_s"], site
+        assert any(r["site"] == "smoke.convoy" for r in csec["top"]), \
+            csec["top"]
+        assert any(e["holder"] == "smoke.convoy" for e in csec["edges"]), \
+            csec["edges"]
+
+        # --- causal profiler: full synthetic ledger + the planted-
+        # bottleneck validation the acceptance criteria pin at ±25%
+        causal = run_synthetic(
+            phases=("serialize", "host_verify", "checkpoint"),
+            speedups=(0.5,),
+            items_per_worker=20,
+        )
+        val = causal["validation"]
+        assert val["ok"], (
+            f"planted-bottleneck validation failed: predicted gain "
+            f"{val['predicted_gain_qps']:.1f} qps vs measured "
+            f"{val['measured_gain_qps']:.1f} qps "
+            f"(rel_err {val['rel_err']:.3f} > tol {val['tol']})"
+        )
+        ledger = causal["ledger"]
+        assert ledger, "empty speedup ledger"
+        gains = [r["predicted_gain_qps"] for r in ledger]
+        assert gains == sorted(gains, reverse=True), ledger
+        assert blocked_s > 0.0
+        return {"contention": csec, "causal": causal}
+    finally:
+        configure_contention(enabled=False, patch=False, reset=True)
+        configure_causal(reset=False)
+
+
 def run_smoke() -> int:
     """``bench.py --smoke``: a seconds-fast, host-crypto-only pass over the
     serving scheduler's end-to-end paths — immediate dispatch on an idle
@@ -2403,6 +2484,17 @@ def run_smoke() -> int:
         # must round-trip its ``timeline`` kind. Scored into the
         # ``timeline`` section the perf gate's --check-schema validates.
         out.update(run_smoke_timeline())
+
+        # 16. concurrency observatory pass (docs/OBSERVABILITY.md
+        # §Concurrency observatory + §Causal profiler): contention
+        # timing forced on around a deterministic lock convoy (site in
+        # the top-contended table, monotone wait quantiles, a
+        # holder→waiter edge), then the causal profiler's synthetic
+        # speedup-ledger run whose planted-bottleneck validation must
+        # land within ±25% of the measured gain. Scored into the
+        # ``contention`` and ``causal`` sections --check-schema
+        # validates.
+        out.update(run_smoke_concurrency())
         out["ok"] = True
     except Exception as e:
         out["error"] = f"{type(e).__name__}: {e}"[:300]

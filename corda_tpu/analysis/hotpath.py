@@ -23,6 +23,19 @@ Flagged forms:
 - ``np.array(x)`` / ``float(x)`` where ``x`` is a bare name, attribute
   or subscript (literals and computed host expressions like
   ``float(len(batch))`` pass — those never hold a device handle)
+
+The pass also guards the flow engine's WORKER scope (PR 19, clearing
+the ground for the async-core rewrite — ROADMAP item 1): inside
+``flows/engine.py``'s bounded worker pool (``_worker_loop`` and the
+``_FlowExecutor`` body it runs) a ``time.sleep`` or a blocking socket
+call parks one of N worker THREADS, not one flow — under load that is
+a 1/N capacity loss per call site, and exactly the pattern an async
+core cannot tolerate. Durable sleeps must go through ``op_sleep`` (the
+park/timer path) and I/O through the messaging layer. Flagged there:
+``time.sleep(...)``, ``socket.*`` constructors/``create_connection``,
+and ``.recv()``/``.accept()``/``.connect()`` method calls. The
+engine's dedicated sleep-timer thread lives outside worker scope and
+stays legal.
 """
 
 from __future__ import annotations
@@ -47,7 +60,28 @@ _ALLOWED_SCOPES = {
     ("corda_tpu/serving/scheduler.py", "_MeshPending.collect"),
 }
 
+# file → scope-qualname prefixes that execute on the flow engine's
+# bounded worker pool: time.sleep / blocking sockets are flagged there
+# (a blocked worker is 1/N of flow capacity, and the async rewrite's
+# event loop cannot host them at all)
+_WORKER_SCOPES = {
+    "corda_tpu/flows/engine.py": (
+        "StateMachineManager._worker_loop",
+        "_FlowExecutor",
+    ),
+}
+
+# blocking socket METHOD calls (the object may be any name — sockets
+# reach worker code through wrappers, so the receiver is not checked)
+_BLOCKING_SOCKET_METHODS = ("recv", "recv_into", "accept", "connect")
+
 _HANDLE_ARG = (ast.Name, ast.Attribute, ast.Subscript)
+
+
+def _in_worker_scope(scope: str, prefixes) -> bool:
+    return any(
+        scope == p or scope.startswith(p + ".") for p in prefixes
+    )
 
 
 def _scope_of(qnames: dict, stack: list) -> str:
@@ -61,17 +95,22 @@ class HotPathBlockingPass:
     id = PASS_ID
     doc = (
         "no block_until_ready / implicit device readback inside the "
-        "async hot-path files outside the designated collect points"
+        "async hot-path files outside the designated collect points; "
+        "no time.sleep / blocking sockets in the flow engine's worker "
+        "scope"
     )
 
     def run(self, project: Project):
         for sf in project.files:
-            if sf.rel not in _HOT_FILES:
+            hot = sf.rel in _HOT_FILES
+            worker_prefixes = _WORKER_SCOPES.get(sf.rel)
+            if not hot and worker_prefixes is None:
                 continue
             qnames = qualname_map(sf.tree)
-            yield from self._scan(sf, qnames)
+            yield from self._scan(sf, qnames, hot=hot,
+                                  worker_prefixes=worker_prefixes)
 
-    def _scan(self, sf, qnames):
+    def _scan(self, sf, qnames, *, hot: bool, worker_prefixes):
         stack: list = []
 
         def walk(node):
@@ -83,22 +122,51 @@ class HotPathBlockingPass:
             for child in ast.iter_child_nodes(node):
                 yield from walk(child)
             if isinstance(node, ast.Call):
-                f = self._flag(node)
-                if f is not None:
-                    scope = _scope_of(qnames, stack)
-                    if (sf.rel, scope) not in _ALLOWED_SCOPES:
-                        yield Finding(
-                            PASS_ID, sf.rel, node.lineno,
-                            f"{f} in {scope}: this file's dispatch "
-                            "paths must not block on (or read back "
-                            "from) the device — move the readback to "
-                            "a collect point or allowlist it",
-                            key=f"{sf.rel}::{scope}::{f}",
-                        )
+                if hot:
+                    f = self._flag(node)
+                    if f is not None:
+                        scope = _scope_of(qnames, stack)
+                        if (sf.rel, scope) not in _ALLOWED_SCOPES:
+                            yield Finding(
+                                PASS_ID, sf.rel, node.lineno,
+                                f"{f} in {scope}: this file's dispatch "
+                                "paths must not block on (or read back "
+                                "from) the device — move the readback to "
+                                "a collect point or allowlist it",
+                                key=f"{sf.rel}::{scope}::{f}",
+                            )
+                if worker_prefixes:
+                    f = self._flag_blocking(node)
+                    if f is not None:
+                        scope = _scope_of(qnames, stack)
+                        if _in_worker_scope(scope, worker_prefixes):
+                            yield Finding(
+                                PASS_ID, sf.rel, node.lineno,
+                                f"{f} in {scope}: worker-pool scope — "
+                                "a blocked worker thread is 1/N of "
+                                "flow capacity; park via op_sleep / "
+                                "route I/O through messaging instead",
+                                key=f"{sf.rel}::{scope}::{f}",
+                            )
             if is_scope:
                 stack.pop()
 
         yield from walk(sf.tree)
+
+    @staticmethod
+    def _flag_blocking(node: ast.Call) -> str | None:
+        name = dotted_name(node.func)
+        if name == "time.sleep":
+            return "time.sleep()"
+        if name == "socket.create_connection":
+            return "socket.create_connection()"
+        if name == "socket.socket":
+            return "socket.socket()"
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _BLOCKING_SOCKET_METHODS:
+            return f".{func.attr}()"
+        return None
 
     @staticmethod
     def _flag(node: ast.Call) -> str | None:

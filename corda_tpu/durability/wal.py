@@ -47,6 +47,14 @@ import threading
 import zlib
 
 from corda_tpu.faultinject import crash_point
+from corda_tpu.observability.contention import register_wait_site
+
+# the sampler's blocked/running classifier (concurrency observatory): a
+# thread sampled inside the group-commit flush is waiting on disk (its
+# own fsync, or the in-flight fsync covering its records) — io-wait,
+# even though the blocked frame underneath is threading.py's cv.wait
+register_wait_site("wal.py", "flush", "io_wait")
+register_wait_site("wal.py", "_flush_inner", "io_wait")
 
 MAGIC = b"TPUWAL01"
 _HEADER = struct.Struct(">8sQ")       # magic, base LSN
@@ -327,7 +335,10 @@ class WriteAheadLog:
         appended after that fsync started."""
         from corda_tpu.observability.flowprof import flowprof_frame
 
-        with flowprof_frame("wal_fsync_wait"):
+        # io_wait is this frame's declared cause: the wall here is fsync
+        # (or waiting on another thread's fsync), so the phase's cause
+        # split is exact evidence, not a sampled estimate
+        with flowprof_frame("wal_fsync_wait", cause="io_wait"):
             self._flush_inner()
 
     def _flush_inner(self) -> None:

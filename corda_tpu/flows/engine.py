@@ -54,9 +54,17 @@ from corda_tpu.observability import (
     tracer,
 )
 from corda_tpu.observability.cluster import active_cluster
+from corda_tpu.observability.contention import register_wait_site
 from corda_tpu.observability.flowprof import active_flowprof, flowprof_frame
 from corda_tpu.observability.trace import current_trace_id
 from corda_tpu.serialization import deserialize, serialize
+
+# the sampler's blocked/running classifier (concurrency observatory):
+# a thread sampled inside these functions is waiting on the SMM monitor
+# — an idle worker, a flow blocked in wait_or_killed, the retransmit
+# timer between scans — not burning CPU
+register_wait_site("engine.py", "_worker_loop", "lock_wait")
+register_wait_site("engine.py", "wait_or_killed", "lock_wait")
 
 from .api import (
     FlowException,
@@ -663,11 +671,24 @@ class StateMachineManager:
         # timed-acquire RLock so blocked acquisition books to lock_wait
         # (enabling flowprof later leaves an existing SMM untimed — the
         # hook costs a lock-construction decision, never a per-acquire
-        # check while off)
-        _fp = active_flowprof()
-        self._lock = threading.Condition(
-            _fp.timed_rlock() if _fp is not None else None
+        # check while off); with contention timing also on, the
+        # contention wrapper sits over THAT, so the hottest monitor in
+        # the process is always in the top-contended table under its
+        # stable "engine.smm" site name, whatever order install() ran in
+        from corda_tpu.observability.contention import (
+            active_contention,
+            timed_lock,
+            wrap_lock,
         )
+
+        _fp = active_flowprof()
+        _smm_inner = _fp.timed_rlock() if _fp is not None else None
+        if active_contention() is not None:
+            if _smm_inner is None:
+                _smm_inner = timed_lock("engine.smm", reentrant=True)
+            else:
+                _smm_inner = wrap_lock(_smm_inner, "engine.smm")
+        self._lock = threading.Condition(_smm_inner)
         self._sessions: dict[int, _SessionState] = {}
         self._flows: dict[str, _FlowExecutor] = {}
         self._consumed_msg_ids: set[str] = set()

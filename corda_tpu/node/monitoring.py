@@ -360,13 +360,20 @@ def monitoring_snapshot() -> dict:
     registries (corda_tpu/statestore — ``{"enabled": false}`` until the
     first device table exists), ``timeline`` the ring-buffer telemetry
     recorder's sampled series (observability/timeseries —
-    ``{"enabled": false}`` while off), ``process`` the remaining
+    ``{"enabled": false}`` while off), ``contention`` the lock-
+    contention observatory's per-site wait/hold tables and wait edges
+    (observability/contention — ``{"enabled": false}`` while off),
+    ``causal`` the causal profiler's last speedup ledger
+    (observability/causal — ``{"enabled": false}`` until a run),
+    ``process`` the remaining
     cross-cutting metrics (e.g. the verifier's ``device_failover``
     counters)."""
     from corda_tpu.durability import durability_section
     from corda_tpu.flows.overload import overload_section
     from corda_tpu.messaging.netstats import netstats_section
+    from corda_tpu.observability.causal import causal_section
     from corda_tpu.observability.cluster import cluster_section
+    from corda_tpu.observability.contention import contention_section
     from corda_tpu.observability.devicemon import devices_section
     from corda_tpu.observability.flowprof import flowprof_section
     from corda_tpu.observability.sampler import sampler_section
@@ -389,6 +396,8 @@ def monitoring_snapshot() -> dict:
         "overload": overload_section(),
         "statestore": statestore_section(),
         "timeline": timeline_section(),
+        "contention": contention_section(),
+        "causal": causal_section(),
         "process": {
             k: v for k, v in _process_registry.snapshot().items()
             if not (k.startswith("serving.") or k.startswith("profiler.")
@@ -403,6 +412,8 @@ def monitoring_snapshot() -> dict:
                     or k.startswith("retry_budget.")
                     or k.startswith("admission.")
                     or k.startswith("statestore.")
-                    or k.startswith("timeline."))
+                    or k.startswith("timeline.")
+                    or k.startswith("contention.")
+                    or k.startswith("causal."))
         },
     }

@@ -33,7 +33,19 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
   to the flow's wall, aggregated per flow class into a waterfall.
 - ``sampler`` — off-by-default wall-clock sampling profiler over
   ``sys._current_frames()``: folded flamegraph stacks per thread role,
-  self-measured duty cycle pinned under a 3% overhead budget.
+  self-measured duty cycle pinned under a 3% overhead budget, plus the
+  blocked/running classifier (on-cpu / lock-wait / io-wait /
+  gil-runnable) feeding flowprof's per-phase cause buckets when the
+  contention observatory is on.
+- ``contention`` — off-by-default lock-contention timing: per
+  allocation-site acquire-wait/hold reservoirs (p50/p95/p99),
+  contention counters, the top-contended table and the holder→waiter
+  wait-edge view, plus the wait-site registry the sampler's classifier
+  matches sampled frames against.
+- ``causal`` — the COZ-style causal profiler: virtual-speedup
+  experiments over flowprof phases (slow everything else, rescale)
+  producing the speedup ledger — phases ranked by predicted knee-qps
+  payoff — validated against a planted-bottleneck synthetic pipeline.
 - ``cluster`` — off-by-default cross-node distributed trace assembly:
   a hop recorder stamping every tracked message's send/delivery on
   wall clocks, a per-edge clock-skew estimator, and a TraceAssembler
@@ -50,6 +62,15 @@ The diagnostic substrate the perf PRs report against (docs/OBSERVABILITY.md):
   flight dump and rendered by ``tools_timeline.py``.
 """
 
+from .causal import (
+    CAUSAL_SCHEMA,
+    CausalProfiler,
+    SyntheticPipeline,
+    causal_section,
+    configure_causal,
+    run_synthetic,
+    validate_planted,
+)
 from .cluster import (
     CLUSTER_SCHEMA,
     ClusterRecorder,
@@ -69,6 +90,19 @@ from .devicemon import (
     device_watchdog,
     devicemon,
 )
+from .contention import (
+    CONTENTION_SCHEMA,
+    ContentionMonitor,
+    TimedContentionLock,
+    active_contention,
+    classify_frame,
+    configure_contention,
+    contention,
+    contention_section,
+    register_wait_site,
+    timed_lock,
+    wrap_lock,
+)
 from .exposition import (
     escape_label_value,
     metrics_text,
@@ -82,6 +116,7 @@ from .federation import (
     set_cluster_handle,
 )
 from .flowprof import (
+    CAUSES,
     PHASES,
     FlowProfiler,
     TimedRLock,
@@ -91,6 +126,7 @@ from .flowprof import (
     flowprof_frame,
     flowprof_hint,
     flowprof_section,
+    set_phase_listener,
 )
 from .profiler import (
     DeviceProfiler,
@@ -146,8 +182,13 @@ from .trace import (
 )
 
 __all__ = [
+    "CAUSAL_SCHEMA",
+    "CAUSES",
     "CLUSTER_SCHEMA",
+    "CONTENTION_SCHEMA",
+    "CausalProfiler",
     "ClusterRecorder",
+    "ContentionMonitor",
     "DeviceMonitor",
     "DeviceProfiler",
     "DeviceWatchdog",
@@ -170,22 +211,29 @@ __all__ = [
     "SPAN_WAVEFRONT_WINDOW",
     "Span",
     "StackSampler",
+    "SyntheticPipeline",
     "TIMELINE_SCHEMA",
+    "TimedContentionLock",
     "TimedRLock",
     "TimelineRecorder",
     "TraceAssembler",
     "TraceContext",
     "Tracer",
     "active_cluster",
+    "active_contention",
     "active_devicemon",
     "active_flowprof",
     "active_profiler",
     "active_sampler",
     "active_slo",
     "active_timeline",
+    "causal_section",
+    "classify_frame",
     "cluster_recorder",
     "cluster_section",
+    "configure_causal",
     "configure_cluster",
+    "configure_contention",
     "configure_devicemon",
     "configure_flowprof",
     "configure_profiler",
@@ -193,6 +241,8 @@ __all__ = [
     "configure_slo",
     "configure_timeline",
     "configure_tracing",
+    "contention",
+    "contention_section",
     "current_trace_id",
     "default_device_ordinal",
     "device_watchdog",
@@ -209,17 +259,23 @@ __all__ = [
     "parse_prometheus",
     "profiler",
     "read_flight_dump",
+    "register_wait_site",
     "render_federated_prometheus",
     "render_prometheus",
+    "run_synthetic",
     "sampler",
     "sampler_section",
     "set_cluster_handle",
+    "set_phase_listener",
     "slo_monitor",
     "stamp_span",
+    "timed_lock",
     "timeline",
     "timeline_section",
     "tracer",
     "uninstall_crash_dump",
+    "validate_planted",
+    "wrap_lock",
 ]
 
 # CORDA_TPU_TIMELINE=1 env opt-in, deferred to here: enabling touches
@@ -229,3 +285,9 @@ __all__ = [
 from .timeseries import _env_opt_in as _timeline_env_opt_in  # noqa: E402
 
 _timeline_env_opt_in()
+
+# CORDA_TPU_CONTENTION=1 likewise: run the one-time env probe now so a
+# process that opts in is timing (and reports an enabled section) from
+# import, not from the first active_contention() hot-path check — a
+# dump-and-exit tool would otherwise read a disabled marker.
+active_contention()

@@ -68,6 +68,13 @@ _HELP = {
     "timeline.marks": "Point events dropped onto the timeline.",
     "timeline.series": "Series rings currently held by the timeline.",
     "verifier.device_failover": "Verifier device-to-host failovers.",
+    "contention.acquires": "Timed-lock acquires observed.",
+    "contention.contended": "Timed-lock acquires that blocked.",
+    "contention.wait_s": "Blocked-acquire wait, seconds.",
+    "contention.hold_s": "Outermost lock hold, seconds.",
+    "contention.sites": "Distinct lock allocation sites tracked.",
+    "causal.experiments": "Virtual-speedup experiment cells run.",
+    "causal.delays": "Calibrated delays inserted by experiments.",
 }
 
 
@@ -240,6 +247,23 @@ def metrics_text(node_registry=None) -> str:
     nets = active_netstats()
     if nets is not None:
         lines = nets.prometheus_lines()
+        if lines:
+            out += "\n".join(lines) + "\n"
+    from corda_tpu.observability.causal import last_result
+    from corda_tpu.observability.causal import (
+        prometheus_lines as causal_prometheus_lines,
+    )
+    from corda_tpu.observability.contention import active_contention
+    from corda_tpu.observability.contention import (
+        prometheus_lines as contention_prometheus_lines,
+    )
+
+    if active_contention() is not None:
+        lines = contention_prometheus_lines()
+        if lines:
+            out += "\n".join(lines) + "\n"
+    if last_result() is not None:
+        lines = causal_prometheus_lines()
         if lines:
             out += "\n".join(lines) + "\n"
     if node_registry is not None:

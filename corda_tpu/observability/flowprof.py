@@ -50,6 +50,19 @@ Accounting model (three feed mechanisms, one ledger):
   response's ``message_transit``) are tallied separately and subtracted
   from the hinted attribution, so the park wall is never double-booked.
 
+Cause buckets (the concurrency observatory, PR 19): each phase's
+aggregate wall additionally splits into WHY buckets — ``on_cpu`` /
+``lock_wait`` / ``io_wait`` / ``gil_runnable`` / ``unattributed``.
+The conservation rule mirrors the phase rule and is structural, not
+aspirational: exact declared evidence (the ``lock_wait`` cross-add hint
+from a blocked ``TimedRLock`` acquire, cause-declaring frames like the
+WAL flush's ``io_wait``) is booked first and clamped to the phase
+total; the remainder is distributed proportionally over the stack
+sampler's classified sample weights; anything without evidence lands in
+``unattributed`` — so per phase the buckets always sum exactly to the
+phase total (``snapshot()["causes"]``, test-pinned at ±5% against the
+phase walls).
+
 Off by default (``CORDA_TPU_FLOWPROF=1`` or ``configure_flowprof``);
 every hook pays two attribute reads (``active_flowprof()`` → None) while
 off, and the process registry gains ZERO ``flowprof.*`` metrics until
@@ -82,6 +95,31 @@ PHASES = (
     "engine_other",
 )
 
+# The closed CAUSE set each phase's wall splits into (the concurrency
+# observatory, docs/OBSERVABILITY.md §Concurrency observatory): why the
+# wall went, not just where. ``unattributed`` is the residual bucket for
+# phases with no classified evidence — conservation to the phase total
+# is structural, like ``engine_other`` for the phases themselves.
+CAUSES = (
+    "on_cpu",
+    "lock_wait",
+    "io_wait",
+    "gil_runnable",
+    "unattributed",
+)
+
+_phase_listener = None  # causal profiler's phase-boundary hook
+
+
+def set_phase_listener(fn) -> None:
+    """Install (or clear, with None) the phase-boundary observer the
+    causal profiler uses to insert virtual-speedup delays: called as
+    ``fn(phase, seconds)`` on the booking thread at every frame exit,
+    cross-thread add and park attribution. At most one listener; it must
+    be cheap and must never raise."""
+    global _phase_listener
+    _phase_listener = fn
+
 
 class _FlowAcct:
     """One flow's phase ledger. Frames are confined to the activating
@@ -109,19 +147,35 @@ class _FlowAcct:
 
 class _Frame:
     """``with flowprof_frame("serialize"):`` — exclusive-time section on
-    the thread's current account. No active account → pure no-op."""
+    the thread's current account. No active account → pure no-op.
 
-    __slots__ = ("_prof", "_phase", "_acct")
+    A frame may declare a *cause* (``io_wait`` for the WAL flush frame):
+    its exclusive time then feeds the phase's cause ledger as exact
+    evidence instead of waiting for the sampler to guess. While a frame
+    is open, the thread→phase map lets the stack sampler's classifier
+    attribute wait samples to the right phase."""
 
-    def __init__(self, prof: "FlowProfiler", phase: str):
+    __slots__ = ("_prof", "_phase", "_cause", "_acct", "_prev_phase",
+                 "_ident")
+
+    def __init__(self, prof: "FlowProfiler", phase: str,
+                 cause: str | None = None):
         self._prof = prof
         self._phase = phase
+        self._cause = cause
         self._acct = None
+        self._prev_phase = None
+        self._ident = 0
 
     def __enter__(self):
         acct = self._prof.current()
         if acct is not None:
             self._acct = acct
+            ident = threading.get_ident()
+            self._ident = ident
+            tp = self._prof._thread_phase
+            self._prev_phase = tp.get(ident)
+            tp[ident] = self._phase
             acct.frames.append([self._phase, self._prof._clock(), 0.0])
         return self
 
@@ -138,6 +192,16 @@ class _Frame:
                     acct.phases[phase] += exclusive
             if acct.frames:
                 acct.frames[-1][2] += elapsed
+            tp = self._prof._thread_phase
+            if self._prev_phase is None:
+                tp.pop(self._ident, None)
+            else:
+                tp[self._ident] = self._prev_phase
+            if self._cause is not None and exclusive > 0.0:
+                self._prof.note_cause_seconds(phase, self._cause, exclusive)
+            lst = _phase_listener
+            if lst is not None:
+                lst(phase, exclusive)
         return False
 
 
@@ -192,6 +256,15 @@ class FlowProfiler:
         self._classes: dict[str, dict] = {}
         self._recent: deque = deque(maxlen=self.RECENT_CAP)
         self._closed_count = 0
+        # Concurrency observatory: per-phase cause evidence. Exact
+        # seconds come from declared feeds (TimedRLock's lock_wait
+        # cross-add hint, cause-declaring frames); sample weights come
+        # from the stack sampler's classifier. thread→phase is the
+        # sampler's attribution map, maintained by open frames.
+        self._cause_lock = threading.Lock()
+        self._cause_seconds: dict[str, dict[str, float]] = {}
+        self._cause_samples: dict[str, dict[str, float]] = {}
+        self._thread_phase: dict[int, str] = {}
 
     # ------------------------------------------------------------- config
     @property
@@ -211,6 +284,10 @@ class FlowProfiler:
             self._classes.clear()
             self._recent.clear()
             self._closed_count = 0
+        with self._cause_lock:
+            self._cause_seconds.clear()
+            self._cause_samples.clear()
+        self._thread_phase.clear()
 
     # ---------------------------------------------------------- lifecycle
     def open(self, flow_id: str, flow_class: str) -> _FlowAcct:
@@ -308,18 +385,25 @@ class FlowProfiler:
             stack = self._local.stack = []
         return stack
 
-    def frame(self, phase: str) -> _Frame:
-        return _Frame(self, phase)
+    def frame(self, phase: str, cause: str | None = None) -> _Frame:
+        return _Frame(self, phase, cause)
 
     def hint(self, phase: str) -> _Hint:
         return _Hint(self, phase)
 
     # --------------------------------------------------------- cross-thread
-    def add(self, acct: _FlowAcct | None, phase: str, seconds: float) -> None:
+    def add(self, acct: _FlowAcct | None, phase: str, seconds: float,
+            cause: str | None = None) -> None:
         """Attribute ``seconds`` of ``phase`` to ``acct`` from a foreign
         thread (scheduler dispatcher/collector, message delivery). Adds
         landing inside a hinted park window are tallied into
-        ``hint_cross`` so the park attribution can subtract them."""
+        ``hint_cross`` so the park attribution can subtract them.
+
+        ``cause`` is the cross-add *hint* for the cause ledger: feeds
+        that know why the time went (a blocked ``TimedRLock`` acquire is
+        lock wait by construction) declare it, and the phase's cause
+        split becomes exact evidence that reconciles with the phase wall
+        instead of a sampled estimate."""
         if acct is None or seconds <= 0.0:
             return
         with acct.lock:
@@ -328,6 +412,11 @@ class FlowProfiler:
             acct.phases[phase] += seconds
             if acct.hint is not None and phase != acct.hint:
                 acct.hint_cross += seconds
+        if cause is not None:
+            self.note_cause_seconds(phase, cause, seconds)
+        lst = _phase_listener
+        if lst is not None:
+            lst(phase, seconds)
 
     # ------------------------------------------------------------ park hook
     def note_park(self, acct: _FlowAcct | None) -> None:
@@ -347,12 +436,20 @@ class FlowProfiler:
         the window`` to the hinted phase (never negative)."""
         if acct is None:
             return
+        booked_phase = None
+        booked = 0.0
         with acct.lock:
             if acct.park_t0 is not None and acct.hint is not None:
                 dt = self._clock() - acct.park_t0
-                acct.phases[acct.hint] += max(0.0, dt - acct.hint_cross)
+                booked = max(0.0, dt - acct.hint_cross)
+                booked_phase = acct.hint
+                acct.phases[booked_phase] += booked
             acct.park_t0 = None
             acct.hint_cross = 0.0
+        if booked_phase is not None:
+            lst = _phase_listener
+            if lst is not None:
+                lst(booked_phase, booked)
 
     # ------------------------------------------------------ message transit
     def note_sent(self, msg_id: str) -> None:
@@ -374,6 +471,74 @@ class FlowProfiler:
     # ------------------------------------------------------------ SMM lock
     def timed_rlock(self) -> "TimedRLock":
         return TimedRLock(self)
+
+    # --------------------------------------------------------- cause ledger
+    def note_cause_seconds(self, phase: str, cause: str,
+                           seconds: float) -> None:
+        """Exact cause evidence: ``seconds`` of ``phase`` were ``cause``
+        by construction (declared frames, the lock_wait cross-add hint)."""
+        if seconds <= 0.0 or cause not in CAUSES:
+            return
+        with self._cause_lock:
+            d = self._cause_seconds.setdefault(phase, {})
+            d[cause] = d.get(cause, 0.0) + seconds
+
+    def note_cause_sample(self, phase: str, cause: str,
+                          weight: float) -> None:
+        """Sampled cause evidence from the stack sampler's classifier:
+        one sample (or a fractional GIL share) saw ``phase``'s thread in
+        ``cause``."""
+        if weight <= 0.0 or cause not in CAUSES:
+            return
+        with self._cause_lock:
+            d = self._cause_samples.setdefault(phase, {})
+            d[cause] = d.get(cause, 0.0) + weight
+
+    def thread_phase(self, ident: int) -> str | None:
+        """The phase the thread ``ident`` is currently inside (its
+        innermost open frame), or None — the sampler's attribution map."""
+        return self._thread_phase.get(ident)
+
+    def causes_snapshot(self) -> dict:
+        """Split each phase's aggregate wall (across all closed flows)
+        into cause buckets. Conservation is STRUCTURAL: exact declared
+        seconds are booked first (clamped to the phase total), the
+        remainder is distributed over the sampler's cause weights, and
+        whatever has no evidence lands in ``unattributed`` — every
+        phase's buckets sum exactly to the phase total
+        (docs/OBSERVABILITY.md §Concurrency observatory)."""
+        with self._lock:
+            totals = {p: 0.0 for p in PHASES}
+            for agg in self._classes.values():
+                for p, v in agg["phases"].items():
+                    totals[p] += v
+        with self._cause_lock:
+            exact = {p: dict(d) for p, d in self._cause_seconds.items()}
+            sampled = {p: dict(d) for p, d in self._cause_samples.items()}
+        out = {}
+        for p in PHASES:
+            total = totals[p]
+            if total <= 0.0:
+                continue
+            buckets = {c: 0.0 for c in CAUSES}
+            ex = exact.get(p, {})
+            ex_sum = sum(ex.values())
+            scale = min(1.0, total / ex_sum) if ex_sum > 0 else 0.0
+            booked = 0.0
+            for c, v in ex.items():
+                share = v * scale
+                buckets[c] += share
+                booked += share
+            remainder = max(0.0, total - booked)
+            sm = sampled.get(p, {})
+            sm_sum = sum(sm.values())
+            if remainder > 0.0 and sm_sum > 0.0:
+                for c, w in sm.items():
+                    buckets[c] += remainder * (w / sm_sum)
+            elif remainder > 0.0:
+                buckets["unattributed"] += remainder
+            out[p] = buckets
+        return out
 
     # ------------------------------------------------------------ snapshot
     def snapshot(self) -> dict:
@@ -409,6 +574,7 @@ class FlowProfiler:
             },
             "wall": section.get("wall_s", {}),
             "classes": classes,
+            "causes": self.causes_snapshot(),
             "recent": recent[-16:],
         }
 
@@ -462,7 +628,10 @@ class TimedRLock:
             return self._inner.acquire(True, timeout)
         t0 = self._prof._clock()
         got = self._inner.acquire(True, timeout)
-        self._prof.add(acct, "lock_wait", self._prof._clock() - t0)
+        # the lock_wait cross-add hint: blocked acquire is lock wait by
+        # construction, so the cause ledger gets exact evidence
+        self._prof.add(acct, "lock_wait", self._prof._clock() - t0,
+                       cause="lock_wait")
         return got
 
     def release(self):
@@ -571,14 +740,16 @@ def flowprof_section() -> dict:
     return p.snapshot()
 
 
-def flowprof_frame(phase: str) -> _Frame:
+def flowprof_frame(phase: str, cause: str | None = None) -> _Frame:
     """Module-level frame helper for hook sites: a timed exclusive
     section on the calling thread's current account; no-op when flowprof
-    is off or no account is active."""
+    is off or no account is active. ``cause`` declares exact cause
+    evidence for the section (the WAL flush frame is ``io_wait`` by
+    construction)."""
     p = active_flowprof()
     if p is None:
         return _NOOP_FRAME
-    return p.frame(phase)
+    return p.frame(phase, cause)
 
 
 def flowprof_hint(phase: str) -> _Hint:

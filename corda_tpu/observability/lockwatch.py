@@ -195,12 +195,15 @@ def watched_condition(name: str | None = None):
 
 
 def _allocation_site() -> str:
-    """file:line of the frame that constructed the lock, skipping this
-    module's own frames — the lock's "class" name in the graph."""
+    """file:line of the frame that constructed the lock, skipping the
+    instrumentation's own frames (this module and contention.py, which
+    shares the naming scheme) — the lock's "class" name in the graph."""
     for frame in reversed(traceback.extract_stack(limit=16)[:-1]):
         fn = frame.filename
-        if not fn.endswith("lockwatch.py") and "threading" not in fn:
-            return f"{fn.rsplit('/', 1)[-1]}:{frame.lineno}"
+        base = fn.rsplit("/", 1)[-1]
+        if base not in ("lockwatch.py", "contention.py") \
+                and "threading" not in fn:
+            return f"{base}:{frame.lineno}"
     return "<unknown>"
 
 

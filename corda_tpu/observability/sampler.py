@@ -10,6 +10,18 @@ counts it per THREAD ROLE — flow workers, the serving dispatcher /
 collector / hedge threads, fsync writers — so a dump reads as one
 flamegraph per subsystem rather than a soup of ephemeral thread names.
 
+The concurrency observatory (PR 19) adds a per-sample CLASSIFIER: when
+lock-contention accounting is on (``CORDA_TPU_CONTENTION=1``) each
+sampled thread is classified as ``on_cpu`` / ``lock_wait`` / ``io_wait``
+/ ``gil_runnable`` by frame inspection over the registered wait sites
+(``contention.classify_frame``). At most one runnable thread can hold
+the GIL, so the k runnable threads in a tick split fractionally: each
+books 1/k of a sample to ``on_cpu`` and (k-1)/k to ``gil_runnable``.
+Classified weights fold per role into the dump's ``causes`` table and
+per phase into flowprof's cause ledger via the thread→phase map. With
+contention off the classifier never runs and the tick's cost is
+unchanged (the <3% budget is re-pinned with the classifier ON).
+
 Off by default: no thread, no metrics, zero cost (the fresh-subprocess
 test pins this). Opt in with ``CORDA_TPU_SAMPLER=1`` or
 ``configure_sampler(enabled=True)``. The sampler measures its OWN duty
@@ -73,6 +85,12 @@ class StackSampler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._names: dict[int, str] = {}  # thread ident → name cache
+        # blocked/running classification (concurrency observatory):
+        # tri-state config — None = auto (on iff contention accounting
+        # is active at start), bool = explicit override.
+        self._classify_cfg: bool | None = None
+        self._classify = False
+        self._causes: dict[tuple, float] = {}  # (role, cause) → weight
 
     # ------------------------------------------------------------- config
     @property
@@ -87,6 +105,17 @@ class StackSampler:
     def start(self) -> None:
         if self.running:
             return
+        if self._classify_cfg is not None:
+            self._classify = self._classify_cfg
+        else:
+            try:
+                from corda_tpu.observability.contention import (
+                    active_contention,
+                )
+
+                self._classify = active_contention() is not None
+            except Exception:
+                self._classify = False
         self._stop.clear()
         with self._lock:
             self._started_at = self._clock()
@@ -110,6 +139,7 @@ class StackSampler:
     def reset(self) -> None:
         with self._lock:
             self._stacks.clear()
+            self._causes.clear()
             self._samples = 0
             self._dropped = 0
             self._busy_s = 0.0
@@ -138,9 +168,20 @@ class StackSampler:
     def sample_once(self) -> int:
         """One sampling tick (public for the fake-clock tests): fold
         every foreign thread's stack into the (role, stack) counts.
-        Returns the number of stacks recorded."""
+        With the classifier on, also classify each thread's cause and
+        fold the weights per role and per flowprof phase. Returns the
+        number of stacks recorded."""
         me = threading.get_ident()
         frames = sys._current_frames()
+        classify = self._classify
+        cf = fp = None
+        if classify:
+            from corda_tpu.observability.contention import classify_frame
+            from corda_tpu.observability.flowprof import active_flowprof
+
+            cf = classify_frame
+            fp = active_flowprof()
+        runnable: list[tuple] = []
         recorded = 0
         for ident, frame in frames.items():
             if ident == me:
@@ -149,7 +190,8 @@ class StackSampler:
             if name is None:
                 self._refresh_names()
                 name = self._names.get(ident, f"tid-{ident}")
-            key = (_role_of(name), self._fold(frame, self.MAX_DEPTH))
+            role = _role_of(name)
+            key = (role, self._fold(frame, self.MAX_DEPTH))
             with self._lock:
                 if key in self._stacks:
                     self._stacks[key] += 1
@@ -157,10 +199,36 @@ class StackSampler:
                     self._stacks[key] = 1
                 else:
                     self._dropped += 1
+            if classify:
+                cause = cf(frame)
+                if cause is None:
+                    runnable.append((role, ident))
+                else:
+                    self._note_cause(role, ident, cause, 1.0, fp)
             recorded += 1
+        if runnable:
+            # only one runnable thread can actually hold the GIL: split
+            # each runnable sample 1/k on-cpu, (k-1)/k gil-runnable
+            k = len(runnable)
+            on = 1.0 / k
+            gil = 1.0 - on
+            for role, ident in runnable:
+                self._note_cause(role, ident, "on_cpu", on, fp)
+                if gil > 0.0:
+                    self._note_cause(role, ident, "gil_runnable", gil, fp)
         with self._lock:
             self._samples += 1
         return recorded
+
+    def _note_cause(self, role: str, ident: int, cause: str,
+                    weight: float, fp) -> None:
+        with self._lock:
+            key = (role, cause)
+            self._causes[key] = self._causes.get(key, 0.0) + weight
+        if fp is not None:
+            phase = fp.thread_phase(ident)
+            if phase is not None:
+                fp.note_cause_sample(phase, cause, weight)
 
     def _loop(self) -> None:
         period = 1.0 / self._hz
@@ -197,11 +265,15 @@ class StackSampler:
             )
             samples = self._samples
             dropped = self._dropped
+            cause_items = list(self._causes.items())
         roles: dict[str, list] = {}
         for (role, folded), count in items:
             bucket = roles.setdefault(role, [])
             if len(bucket) < top_n:
                 bucket.append([folded, count])
+        causes: dict[str, dict] = {}
+        for (role, cause), weight in cause_items:
+            causes.setdefault(role, {})[cause] = round(weight, 4)
         return {
             "enabled": True,
             "running": self.running,
@@ -209,7 +281,9 @@ class StackSampler:
             "samples": samples,
             "dropped_stacks": dropped,
             "overhead_ratio": round(self.overhead_ratio(), 6),
+            "classified": self._classify,
             "roles": roles,
+            "causes": causes,
         }
 
 
@@ -239,15 +313,21 @@ def active_sampler() -> StackSampler | None:
 
 def configure_sampler(*, enabled: bool | None = None,
                       hz: float | None = None,
+                      classify: bool | None = None,
                       reset: bool = False) -> StackSampler:
     """The sampler knob (docs/OBSERVABILITY.md §Critical-path
     accounting): start/stop the sampling thread, retune the rate
-    (applies at next start). Explicit configuration overrides the env
+    (applies at next start). ``classify`` overrides the blocked/running
+    classifier's auto-detection (default: on iff contention accounting
+    is active at start). Explicit configuration overrides the env
     probe."""
     global _env_checked
     _env_checked = True
     if hz is not None:
         _global._hz = max(1.0, min(1000.0, float(hz)))
+    if classify is not None:
+        _global._classify_cfg = classify
+        _global._classify = classify
     if reset:
         _global.reset()
     if enabled is not None:

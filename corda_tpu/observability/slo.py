@@ -582,6 +582,26 @@ def flight_dump(path: str | None = None, *, reason: str = "manual",
         lines.append({"kind": "net", "snapshot": netstats_section()})
     except Exception:
         pass
+    try:
+        # lock-contention observatory (observability/contention): the
+        # top-contended table and holder→waiter edges at breach time —
+        # the convoy evidence. {"enabled": false} while off.
+        from corda_tpu.observability.contention import contention_section
+
+        lines.append({
+            "kind": "contention", "snapshot": contention_section(),
+        })
+    except Exception:
+        pass
+    try:
+        # causal profiler (observability/causal): the last speedup
+        # ledger, so a breach dump carries the current best guess at
+        # what fixing each phase is worth. {"enabled": false} until run.
+        from corda_tpu.observability.causal import causal_section
+
+        lines.append({"kind": "causal", "snapshot": causal_section()})
+    except Exception:
+        pass
     for event in list(devicemon().events) + list(_global.events):
         lines.append({"kind": "event", "event": event})
     try:
@@ -660,9 +680,9 @@ def read_flight_dump(path: str) -> dict:
     """Parse a flight dump back into sections — the round-trip half the
     tests pin: ``spans`` (list of span dicts), ``metrics`` / ``devices``
     / ``slo`` / ``timeline`` / ``resilience`` / ``durability`` /
-    ``flowprof`` / ``sampler`` / ``net`` (the snapshots), ``events``
-    (device + SLO health events), ``faults`` (injected chaos events),
-    ``header``.
+    ``flowprof`` / ``sampler`` / ``net`` / ``contention`` / ``causal``
+    (the snapshots), ``events`` (device + SLO health events),
+    ``faults`` (injected chaos events), ``header``.
 
     Forward-compat: records whose ``kind`` this reader does not know
     (written by a NEWER dumper) round-trip untouched under ``extra``
@@ -671,7 +691,8 @@ def read_flight_dump(path: str) -> dict:
     out: dict = {"header": None, "spans": [], "metrics": None,
                  "devices": None, "slo": None, "timeline": None,
                  "resilience": None, "durability": None, "flowprof": None,
-                 "sampler": None, "net": None, "events": [], "faults": [],
+                 "sampler": None, "net": None, "contention": None,
+                 "causal": None, "events": [], "faults": [],
                  "extra": []}
     with open(path) as f:
         for raw in f:
@@ -686,7 +707,7 @@ def read_flight_dump(path: str) -> dict:
                 out["spans"].append(rec["span"])
             elif kind in ("metrics", "devices", "slo", "timeline",
                           "resilience", "durability", "flowprof",
-                          "sampler", "net"):
+                          "sampler", "net", "contention", "causal"):
                 out[kind] = rec["snapshot"]
             elif kind == "event":
                 out["events"].append(rec["event"])

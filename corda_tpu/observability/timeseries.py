@@ -46,17 +46,24 @@ TIMELINE_SCHEMA = 1
 
 # Default allowlists: the serving plane's request/row/batch flow
 # (counters+meters — anything exposing a monotone .count) and its two
-# latency timers. configure_timeline(counters=…, timers=…) replaces them.
+# latency timers, plus the contention observatory's acquire counters and
+# blocked-wait timer (zero-cost while contention is off: the tick skips
+# counters absent from the snapshot, and the timer tap only fires if the
+# contention monitor ever updates it).
+# configure_timeline(counters=…, timers=…) replaces them.
 DEFAULT_COUNTERS = (
     "serving.requests",
     "serving.rows",
     "serving.batches",
     "serving.shed",
     "serving.rejected",
+    "contention.acquires",
+    "contention.contended",
 )
 DEFAULT_TIMERS = (
     "serving.wait_s",
     "serving.batch_latency_s",
+    "contention.wait_s",
 )
 
 # Per-timer intake bound between ticks: at 512 points a flooded timer

@@ -619,6 +619,21 @@ def flowprof_snapshot_value(proxy) -> PolledValue:
     return PolledValue(lambda: proxy.flowprof_snapshot())
 
 
+def contention_snapshot_value(proxy, top_n: int = 16) -> PolledValue:
+    """Read binding over the lock-contention observatory's tables
+    (``CordaRPCOps.contention_snapshot``): the top-contended table and
+    the holder→waiter wait edges — refresh under load to watch a convoy
+    form."""
+    return PolledValue(lambda: proxy.contention_snapshot(top_n=top_n))
+
+
+def speedup_ledger_value(proxy) -> PolledValue:
+    """Read binding over the causal profiler's speedup ledger
+    (``CordaRPCOps.speedup_ledger``): phases ranked by predicted
+    knee-qps payoff from the last virtual-speedup run."""
+    return PolledValue(lambda: proxy.speedup_ledger())
+
+
 def metrics_text_value(proxy) -> PolledValue:
     """Read binding over the Prometheus text exposition
     (``CordaRPCOps.metrics_text``) — the scrape body as a live value the

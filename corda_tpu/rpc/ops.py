@@ -260,6 +260,28 @@ class CordaRPCOps:
             return {"enabled": False}
         return s.dump(top_n=top_n)
 
+    def contention_snapshot(self, top_n: int = 16) -> dict:
+        """The lock-contention observatory's tables (docs/OBSERVABILITY.md
+        §Concurrency observatory): per-allocation-site acquire/contended
+        counters with wait/hold p50/p95/p99, the top-contended table
+        ranked by total wait, and the holder→waiter wait-edge view.
+        ``{"enabled": false}`` while contention timing is off (the
+        default)."""
+        from corda_tpu.observability.contention import contention_section
+
+        return contention_section(top_n=top_n)
+
+    def speedup_ledger(self) -> dict:
+        """The causal profiler's last speedup ledger
+        (docs/OBSERVABILITY.md §Causal profiler): phases ranked by
+        predicted knee-qps payoff from virtual-speedup experiments, the
+        per-(phase, speedup%) cells behind the ranking, and the
+        planted-bottleneck validation verdict when the run carried one.
+        ``{"enabled": false}`` until a causal run records a ledger."""
+        from corda_tpu.observability.causal import causal_section
+
+        return causal_section()
+
     def flight_dump(self, path: str | None = None,
                     reason: str = "rpc") -> str:
         """Write a black-box flight-recorder dump (docs/OBSERVABILITY.md

@@ -91,10 +91,17 @@ from corda_tpu.observability.profiler import (
     stamp_span,
 )
 from corda_tpu.flows.overload import remaining_deadline
+from corda_tpu.observability.contention import register_wait_site
 from corda_tpu.observability.flowprof import active_flowprof
 from corda_tpu.observability.slo import active_slo
 
 from .shapes import shape_table
+
+# the sampler's blocked/running classifier (concurrency observatory):
+# dispatcher/hedge threads sampled inside these loops are parked on the
+# scheduler monitor awaiting work, not burning CPU
+register_wait_site("scheduler.py", "_dispatch_loop", "lock_wait")
+register_wait_site("scheduler.py", "_hedge_loop", "lock_wait")
 
 # ------------------------------------------------------------ priorities
 

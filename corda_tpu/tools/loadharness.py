@@ -442,6 +442,146 @@ def run_harness(config: HarnessConfig | None = None) -> dict:
     return LoadHarness(config).run()
 
 
+def run_causal(base: HarnessConfig, knee_qps: float, *,
+               phases=("host_verify", "serialize", "checkpoint"),
+               speedups=(0.5,), probe_duration_s: float = 4.0) -> dict:
+    """Virtual-speedup experiments at the knee (``tools_loadgen.py
+    --causal`` — docs/OBSERVABILITY.md §Causal profiler): each cell is
+    a fresh single-step harness run at the knee's arrival rate with the
+    causal profiler dilating every delayable non-target phase by
+    ``k−1`` of its booked duration.
+
+    The prediction is latency-corrected rather than the synthetic run's
+    pure ``k × measured`` rescale. On this workload the inserted sleeps
+    ride each flow's own path (they stretch that flow's wall) but
+    release the GIL, so a *saturated* probe's goodput barely moves and
+    the naked rescale would report ``k×`` for every phase. Instead each
+    probe runs arrival-limited at the knee and the cell recovers the
+    predicted per-flow service time from flowprof's own accounting:
+
+        L_pred = L_E − (k−1)·ô − x·p̂
+
+    (``L_E`` mean per-flow wall under the experiment, ``ô`` the
+    per-flow booked seconds of delayable non-target phases — what the
+    experiment dilated — and ``p̂`` the target phase's own per-flow
+    booking), then scales the knee by the service-time ratio:
+    ``predicted_qps = knee_qps × L₀ / L_pred``. Returns the recorded
+    ``causal`` section (``source: "loadharness"`` — no
+    planted-bottleneck validation key, that is the synthetic run's
+    contract).
+
+    ``probe_duration_s`` trades runtime for ledger stability: each cell
+    is one fresh probe, so run-to-run jitter in mean flow wall (notary
+    RTT variance, warmup) lands directly in the predicted gain. Probes
+    under ~4s on the mocknet carry tens-of-percent noise; raise the
+    duration when the ledger must discriminate small phases."""
+    from corda_tpu.observability.causal import (
+        CAUSAL_SCHEMA,
+        DELAYABLE_PHASES,
+        CausalProfiler,
+        build_ledger,
+        record_result,
+    )
+    from corda_tpu.observability.flowprof import PHASES
+
+    probe_cfg = dataclasses.replace(
+        base,
+        qps_steps=(float(knee_qps),),
+        step_duration_s=probe_duration_s,
+        # the ramp already captured sampler/timeline artifacts
+        sampler=False,
+    )
+
+    def probe_step() -> dict:
+        return LoadHarness(probe_cfg).run()["steps"][0]
+
+    def per_flow(step):
+        """(mean flow wall, per-flow phase seconds) from the step's
+        waterfall; None when the probe completed nothing."""
+        wf = step.get("waterfall") or {}
+        flows = wf.get("flows") or 0
+        if not flows:
+            return None
+        return (
+            wf["wall_s"] / flows,
+            {p: v / flows for p, v in wf.get("phases", {}).items()},
+        )
+
+    profiler = CausalProfiler()
+    cells: list[dict] = []
+    with profiler.session():
+        base_step = probe_step()
+        pf0 = per_flow(base_step)
+        if pf0 is None:
+            raise RuntimeError(
+                "causal baseline probe completed no flows — cannot "
+                "measure per-flow service time"
+            )
+        flow_wall_0, _ = pf0
+        wall0 = base_step["wall_s"]
+        goodput0 = (base_step["completed"] / wall0) if wall0 > 0 else 0.0
+        for phase in phases:
+            if phase not in PHASES:
+                raise ValueError(f"unknown flowprof phase {phase!r}")
+            for x in speedups:
+                k = 1.0 / (1.0 - x)
+                with profiler.experiment(phase, x) as exp:
+                    step = probe_step()
+                wall = step["wall_s"]
+                cell = {
+                    "phase": phase,
+                    "speedup_pct": round(x * 100.0, 3),
+                    "experiment_qps": (
+                        (step["completed"] / wall) if wall > 0 else 0.0
+                    ),
+                    "inserted_delays": exp.delays,
+                    "inserted_s": round(exp.inserted_s, 6),
+                    "baseline_qps": float(knee_qps),
+                }
+                pf = per_flow(step)
+                if pf is None:
+                    # the dilated probe starved out: no per-flow
+                    # accounting to correct against, so no prediction
+                    cell["predicted_qps"] = 0.0
+                    cell["predicted_gain_qps"] = -float(knee_qps)
+                    cell["predicted_gain_pct"] = -100.0
+                    cells.append(cell)
+                    continue
+                flow_wall_e, phase_s = pf
+                dilated = sum(
+                    v for p, v in phase_s.items()
+                    if p in DELAYABLE_PHASES and p != phase
+                )
+                target_s = phase_s.get(phase, 0.0)
+                flow_wall_pred = max(
+                    1e-9,
+                    flow_wall_e - (k - 1.0) * dilated - x * target_s,
+                )
+                predicted = float(knee_qps) * flow_wall_0 / flow_wall_pred
+                cell["flow_wall_s"] = flow_wall_e
+                cell["flow_wall_pred_s"] = flow_wall_pred
+                cell["predicted_qps"] = predicted
+                cell["predicted_gain_qps"] = predicted - float(knee_qps)
+                cell["predicted_gain_pct"] = (
+                    100.0 * cell["predicted_gain_qps"] / float(knee_qps)
+                    if knee_qps > 0 else 0.0
+                )
+                cells.append(cell)
+    result = {
+        "schema": CAUSAL_SCHEMA,
+        "baseline_qps": float(knee_qps),
+        "probe_goodput_qps": goodput0,
+        "probe_flow_wall_s": flow_wall_0,
+        "speedups_pct": [round(x * 100.0, 3) for x in speedups],
+        "cells": cells,
+        "ledger": build_ledger(cells),
+        "source": "loadharness",
+        "knee_qps": float(knee_qps),
+        "probe_duration_s": probe_duration_s,
+    }
+    return record_result(result)
+
+
 # ======================================================================
 # Overload / metastability certification (docs/OVERLOAD.md)
 # ======================================================================

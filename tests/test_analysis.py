@@ -96,6 +96,28 @@ def dispatch(pending):
     return np.asarray(mask)
 """
 
+ENGINE_WORKER_FIXTURE = """\
+import socket
+import threading
+import time
+
+class StateMachineManager:
+    def _worker_loop(self):
+        while True:
+            time.sleep(0.05)
+
+    def _start_timer_locked(self, deadline):
+        def loop():
+            time.sleep(deadline)
+        threading.Thread(target=loop, daemon=True).start()
+
+class _FlowExecutor:
+    def _run_body(self, flow):
+        time.sleep(0.1)
+        conn = socket.create_connection(("peer", 10003))
+        return conn.recv(4096)
+"""
+
 THREAD_FIXTURE = """\
 import threading
 
@@ -232,6 +254,52 @@ class TestPasses:
             {"corda_tpu/serving/scheduler.py": fixed},
         )
         assert live == [] and len(inline) == 2
+
+    def test_hotpath_flags_blocking_in_engine_worker_scope(self, tmp_path):
+        live, _ = _findings(
+            tmp_path, "hot-path-blocking",
+            {"corda_tpu/flows/engine.py": ENGINE_WORKER_FIXTURE},
+        )
+        # _worker_loop's sleep + _run_body's sleep/create_connection/
+        # .recv() — the timer thread's nested `loop` sleep is OUTSIDE
+        # worker scope (dedicated sleep-timer thread) and stays legal
+        assert len(live) == 4, [f.render() for f in live]
+        assert all("worker-pool scope" in f.message for f in live)
+        scopes = {f.key.split("::")[1] for f in live}
+        assert scopes == {
+            "StateMachineManager._worker_loop",
+            "_FlowExecutor._run_body",
+        }
+        kinds = {f.key.split("::")[2] for f in live}
+        assert kinds == {
+            "time.sleep()", "socket.create_connection()", ".recv()",
+        }
+
+    def test_hotpath_worker_scope_is_engine_file_only(self, tmp_path):
+        # the same code anywhere else is not the worker pool's business
+        live, _ = _findings(
+            tmp_path, "hot-path-blocking",
+            {"corda_tpu/flows/other.py": ENGINE_WORKER_FIXTURE},
+        )
+        assert live == []
+
+    def test_hotpath_worker_scope_respects_suppression(self, tmp_path):
+        fixed = ENGINE_WORKER_FIXTURE.replace(
+            "    def _worker_loop(self):\n"
+            "        while True:\n"
+            "            time.sleep(0.05)",
+            "    def _worker_loop(self):\n"
+            "        while True:\n"
+            "            # tpu-lint: allow=hot-path-blocking drain poll\n"
+            "            time.sleep(0.05)",
+        )
+        live, inline = _findings(
+            tmp_path, "hot-path-blocking",
+            {"corda_tpu/flows/engine.py": fixed},
+        )
+        assert len(inline) == 1
+        assert {f.key.split("::")[1] for f in live} == \
+            {"_FlowExecutor._run_body"}
 
     def test_thread_lifecycle_flags_unjoined_nondaemon(self, tmp_path):
         live, _ = _findings(

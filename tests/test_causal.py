@@ -1,0 +1,265 @@
+"""Causal-profiler tests — the COZ virtual-speedup machinery: the
+experiment's delay arithmetic (k−1 dilation of every delayable
+non-target booking, per-event cap), the session-scoped flowprof phase
+listener, the k-rescale cell math and the speedup ledger's ranking, the
+record/section/Prometheus surfaces, and one real planted-bottleneck
+validation (±25%, the acceptance bound the bench smoke and the perf
+gate pin)."""
+
+import sys
+
+import pytest
+
+import corda_tpu.observability.flowprof  # noqa: F401 — module, not the
+# package's re-exported flowprof() accessor, which shadows it in
+# `import ... as` resolution
+flowprof_mod = sys.modules["corda_tpu.observability.flowprof"]
+
+from corda_tpu.observability.causal import (  # noqa: E402
+    DELAY_CAP_S,
+    DELAYABLE_PHASES,
+    CausalProfiler,
+    SyntheticPipeline,
+    _Experiment,
+    build_ledger,
+    causal_section,
+    configure_causal,
+    last_result,
+    prometheus_lines,
+    record_result,
+    run_synthetic,
+    validate_planted,
+)
+from corda_tpu.observability.exposition import parse_prometheus
+from corda_tpu.observability.flowprof import PHASES, FlowProfiler
+
+
+class FakeSleep:
+    """Capture the inserted virtual delays instead of sleeping."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, seconds):
+        self.calls.append(seconds)
+
+    @property
+    def total(self):
+        return sum(self.calls)
+
+
+# ----------------------------------------------------------- experiment
+
+class TestExperiment:
+    def test_mult_is_k_minus_one(self):
+        # x=0.5 → k=2 → every other phase dilated by 1.0× its booking
+        assert _Experiment("host_verify", 0.5).mult == pytest.approx(1.0)
+        # x=0.75 → k=4 → dilation 3×
+        assert _Experiment("host_verify", 0.75).mult == pytest.approx(3.0)
+        assert _Experiment("host_verify", 0.0).mult == 0.0
+
+    def test_rejects_out_of_range_speedup(self):
+        with pytest.raises(ValueError):
+            _Experiment("host_verify", 1.0)
+        with pytest.raises(ValueError):
+            _Experiment("host_verify", -0.1)
+
+
+class TestOnPhase:
+    def _profiler(self):
+        sleep = FakeSleep()
+        return CausalProfiler(sleep=sleep), sleep
+
+    def test_dilates_delayable_non_target(self):
+        prof, sleep = self._profiler()
+        with prof.session(), prof.experiment("host_verify", 0.5) as exp:
+            prof._on_phase("serialize", 0.010)
+            prof._on_phase("checkpoint", 0.004)
+        assert sleep.calls == pytest.approx([0.010, 0.004])
+        assert exp.delays == 2
+        assert exp.inserted_s == pytest.approx(0.014)
+
+    def test_skips_target_waits_and_off_worker_phases(self):
+        prof, sleep = self._profiler()
+        with prof.session(), prof.experiment("host_verify", 0.5):
+            prof._on_phase("host_verify", 0.010)    # the target itself
+            prof._on_phase("queue_wait", 0.010)     # demand-driven wait
+            prof._on_phase("lock_wait", 0.010)
+            prof._on_phase("message_transit", 0.010)  # off-worker
+            prof._on_phase("notary_rtt", 0.010)
+            prof._on_phase("engine_other", 0.010)   # close residual
+            prof._on_phase("serialize", 0.0)        # zero booking
+            prof._on_phase("serialize", -1.0)
+        assert sleep.calls == []
+
+    def test_caps_pathological_bookings(self):
+        prof, sleep = self._profiler()
+        with prof.session(), prof.experiment("host_verify", 0.5) as exp:
+            prof._on_phase("serialize", 10.0)
+        assert sleep.calls == [DELAY_CAP_S]
+        assert exp.inserted_s == pytest.approx(DELAY_CAP_S)
+
+    def test_noop_outside_an_experiment(self):
+        prof, sleep = self._profiler()
+        with prof.session():
+            prof._on_phase("serialize", 0.010)
+        assert sleep.calls == []
+
+    def test_delayable_phases_are_real_worker_phases(self):
+        assert set(DELAYABLE_PHASES) <= set(PHASES)
+        for never in ("queue_wait", "lock_wait", "message_transit",
+                      "notary_rtt", "engine_other"):
+            assert never not in DELAYABLE_PHASES
+
+
+class TestSessionListener:
+    def test_session_installs_and_clears_the_flowprof_listener(self):
+        prof = CausalProfiler(sleep=FakeSleep())
+        assert flowprof_mod._phase_listener is None
+        with prof.session():
+            assert flowprof_mod._phase_listener is not None
+        assert flowprof_mod._phase_listener is None
+
+    def test_real_flowprof_bookings_reach_the_experiment(self):
+        """Frame exit on a live account fires the listener with the
+        booked seconds — the integration the whole profiler rides."""
+        clock = [0.0]
+
+        def fake_clock():
+            return clock[0]
+
+        fp = FlowProfiler(clock=fake_clock)
+        sleep = FakeSleep()
+        prof = CausalProfiler(sleep=sleep)
+        with prof.session(), prof.experiment("host_verify", 0.5) as exp:
+            acct = fp.open("f1", "PaymentFlow")
+            with fp.activate(acct):
+                with fp.frame("serialize"):
+                    clock[0] += 0.010
+                with fp.frame("host_verify"):   # target: never dilated
+                    clock[0] += 0.020
+            fp.close("f1")
+        assert sleep.total == pytest.approx(0.010)
+        assert exp.delays == 1
+
+
+# ------------------------------------------------------- cells & ledger
+
+class TestRunAndLedger:
+    def test_run_rescales_cells_against_baseline(self):
+        prof = CausalProfiler(sleep=FakeSleep())
+        qps = iter([100.0, 80.0, 60.0])
+        result = prof.run(lambda: next(qps),
+                          phases=("host_verify",), speedups=(0.25, 0.5))
+        assert result["schema"] == 1
+        assert result["baseline_qps"] == 100.0
+        c25, c50 = result["cells"]
+        # k-rescale: predicted = qps / (1 - x)
+        assert c25["predicted_qps"] == pytest.approx(80.0 / 0.75)
+        assert c50["predicted_qps"] == pytest.approx(120.0)
+        assert c50["predicted_gain_qps"] == pytest.approx(20.0)
+        assert c50["predicted_gain_pct"] == pytest.approx(20.0)
+        # the ledger keeps host_verify's best cell
+        (row,) = result["ledger"]
+        assert row["phase"] == "host_verify"
+        assert row["speedup_pct"] == 50.0
+
+    def test_run_rejects_unknown_phase(self):
+        prof = CausalProfiler(sleep=FakeSleep())
+        with pytest.raises(ValueError):
+            prof.run(lambda: 1.0, phases=("warp_drive",))
+
+    def test_build_ledger_best_cell_per_phase_desc(self):
+        cells = [
+            {"phase": "a", "speedup_pct": 25.0, "predicted_qps": 5.0,
+             "predicted_gain_qps": 1.0, "predicted_gain_pct": 25.0},
+            {"phase": "a", "speedup_pct": 50.0, "predicted_qps": 9.0,
+             "predicted_gain_qps": 5.0, "predicted_gain_pct": 125.0},
+            {"phase": "b", "speedup_pct": 50.0, "predicted_qps": 7.0,
+             "predicted_gain_qps": 3.0, "predicted_gain_pct": 75.0},
+        ]
+        ledger = build_ledger(cells)
+        assert [(r["phase"], r["speedup_pct"]) for r in ledger] == \
+            [("a", 50.0), ("b", 50.0)]
+        gains = [r["predicted_gain_qps"] for r in ledger]
+        assert gains == sorted(gains, reverse=True)
+
+
+# ----------------------------------------------------- process surfaces
+
+class TestSurfaces:
+    def test_section_disabled_until_a_run_records(self):
+        configure_causal(reset=True)
+        assert causal_section() == {"enabled": False}
+        assert last_result() is None
+        assert prometheus_lines() == []
+
+    def test_record_result_round_trips_the_section(self):
+        configure_causal(reset=True)
+        try:
+            out = record_result({
+                "schema": 1, "baseline_qps": 10.0, "cells": [],
+                "ledger": [
+                    {"phase": "host_verify", "speedup_pct": 50.0,
+                     "predicted_qps": 12.0, "predicted_gain_qps": 2.0,
+                     "predicted_gain_pct": 20.0},
+                ],
+            })
+            assert out["enabled"]
+            assert causal_section() is last_result()
+            assert causal_section()["baseline_qps"] == 10.0
+            text = "\n".join(prometheus_lines()) + "\n"
+            samples = parse_prometheus(text)
+            key = ('cordatpu_causal_predicted_gain_qps'
+                   '{phase="host_verify",speedup_pct="50"}')
+            assert key in samples
+        finally:
+            configure_causal(reset=True)
+
+
+# ------------------------------------------- planted-bottleneck (real)
+
+class TestPlantedBottleneck:
+    def test_synthetic_pipeline_books_real_phases(self):
+        clockless = FlowProfiler()
+        pipe = SyntheticPipeline(
+            (("serialize", 0.001), ("host_verify", 0.001)),
+            workers=2, items_per_worker=3, prof=clockless,
+        )
+        qps = pipe.probe()
+        assert qps > 0
+        snap = clockless.snapshot()
+        cls = snap["classes"]["SyntheticItem"]
+        assert cls["flows"] == 6
+        assert cls["phases"]["serialize"] > 0
+        assert cls["phases"]["host_verify"] > 0
+
+    def test_run_synthetic_validates_within_tolerance(self):
+        """The acceptance bound: predict the clean pipeline's capacity
+        from experiments on the planted one, ±25% on the gain — one
+        real (sleeping) run, small quotas to stay CI-cheap."""
+        configure_causal(reset=True)
+        try:
+            result = run_synthetic(
+                phases=("host_verify",), speedups=(0.5,),
+                workers=3, items_per_worker=12,
+            )
+            assert result["source"] == "synthetic"
+            assert result["enabled"]
+            val = result["validation"]
+            assert val["ok"], val
+            assert val["rel_err"] <= val["tol"] == 0.25
+            assert result["baseline_qps"] > 0
+            for cell in result["cells"]:
+                assert cell["experiment_qps"] > 0
+                assert cell["inserted_delays"] > 0
+            assert result["ledger"]
+            # recorded as the process's last causal run
+            assert causal_section()["enabled"]
+            assert causal_section()["source"] == "synthetic"
+        finally:
+            configure_causal(reset=True)
+
+    def test_validate_planted_rejects_unknown_phase(self):
+        with pytest.raises(ValueError):
+            validate_planted(phase="queue_wait")

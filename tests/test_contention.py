@@ -1,0 +1,568 @@
+"""Concurrency-observatory tests — lock-contention timing (per-site
+acquire-wait/hold reservoirs, the top-contended table, holder→waiter
+wait edges), the timed-lock wrapper's Condition composition, the
+factory install/uninstall hook, the sampler classifier's wait-site
+registry and frame walk, the Prometheus/timeline/flight-dump surfaces,
+and the acceptance pin: with ``CORDA_TPU_CONTENTION`` unset there is NO
+patched factory, NO extra thread and ZERO ``contention.*`` metrics
+(fresh subprocess)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from corda_tpu.observability.contention import (
+    MAX_SITES,
+    OVERFLOW_SITE,
+    ContentionMonitor,
+    TimedContentionLock,
+    _Reservoir,
+    classify_frame,
+    configure_contention,
+    contention_section,
+    install,
+    installed,
+    register_wait_site,
+    timed_lock,
+    uninstall,
+    wrap_lock,
+)
+from corda_tpu.observability.exposition import parse_prometheus
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def mon():
+    return ContentionMonitor()
+
+
+def _convoy(lock, hold_s=0.05):
+    """Grab ``lock`` on a helper thread and hold it while the caller
+    blocks on acquire — one deterministic contended acquire."""
+    taken = threading.Event()
+
+    def holder():
+        with lock:
+            taken.set()
+            time.sleep(hold_s)
+
+    t = threading.Thread(target=holder, name="convoy-holder")
+    t.start()
+    taken.wait(timeout=5.0)
+    with lock:
+        pass
+    t.join(timeout=5.0)
+
+
+# ----------------------------------------------------------- reservoir
+
+class TestReservoir:
+    def test_quantiles_monotone_and_bounded(self):
+        r = _Reservoir(slots=64)
+        for i in range(1000):
+            r.add(float(i))
+        q = r.quantiles()
+        assert 0.0 <= q["p50"] <= q["p95"] <= q["p99"] <= 999.0
+        assert len(r._buf) == 64          # memory stays bounded
+
+    def test_empty_reservoir_is_zeroes(self):
+        assert _Reservoir().quantiles() == {
+            "p50": 0.0, "p95": 0.0, "p99": 0.0,
+        }
+
+
+# ----------------------------------------------------------- the ledger
+
+class TestTimedContentionLock:
+    def test_uncontended_acquire_counts_but_is_not_contended(self, mon):
+        lk = TimedContentionLock("t.site", _monitor=mon)
+        with lk:
+            pass
+        snap = mon.snapshot()
+        s = snap["sites"]["t.site"]
+        assert s["acquires"] == 1
+        assert s["contended"] == 0
+        assert s["wait_total_s"] == 0.0
+        # the uncontended site never reaches the top-contended table
+        assert snap["top"] == []
+
+    def test_convoy_books_wait_and_edge(self, mon):
+        lk = TimedContentionLock("t.convoy", _monitor=mon)
+        _convoy(lk, hold_s=0.05)
+        snap = mon.snapshot()
+        s = snap["sites"]["t.convoy"]
+        assert s["acquires"] == 2
+        assert s["contended"] >= 1
+        assert s["wait_total_s"] >= 0.03
+        assert s["wait_p50_s"] <= s["wait_p95_s"] <= s["wait_p99_s"]
+        assert s["hold_p50_s"] <= s["hold_p95_s"] <= s["hold_p99_s"]
+        # the holder's ~0.05s hold made it into the hold reservoir
+        assert s["hold_p99_s"] >= 0.03
+        assert [r["site"] for r in snap["top"]] == ["t.convoy"]
+        # the blocked main thread held no timed lock → thread-name waiter
+        (edge,) = snap["edges"]
+        assert edge["holder"] == "t.convoy"
+        assert edge["waiter"] == "thread:MainThread"
+        assert edge["count"] == 1
+        assert edge["wait_s"] >= 0.03
+
+    def test_edge_waiter_is_innermost_held_timed_lock(self, mon):
+        """A thread that blocks while holding another timed lock names
+        THAT site as the waiter — the 'A convoys behind B' arrow."""
+        outer = TimedContentionLock("t.outer", _monitor=mon)
+        inner = TimedContentionLock("t.inner", _monitor=mon)
+        taken = threading.Event()
+
+        def holder():
+            with inner:
+                taken.set()
+                time.sleep(0.05)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        taken.wait(timeout=5.0)
+        with outer:          # held while blocking on inner
+            with inner:
+                pass
+        t.join(timeout=5.0)
+        edges = {(e["holder"], e["waiter"]) for e in mon.snapshot()["edges"]}
+        assert ("t.inner", "t.outer") in edges
+
+    def test_reentrant_hold_timed_on_outermost_release(self, mon):
+        lk = TimedContentionLock("t.re", _monitor=mon, reentrant=True)
+        with lk:
+            with lk:
+                time.sleep(0.02)
+        s = mon.snapshot()["sites"]["t.re"]
+        assert s["acquires"] == 2
+        # the outermost release books the real hold; the inner one a 0
+        assert s["hold_p99_s"] >= 0.015
+
+    def test_failed_try_acquire_counts_as_blocked(self, mon):
+        lk = TimedContentionLock("t.try", _monitor=mon)
+        taken = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with lk:
+                taken.set()
+                release.wait(5.0)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        taken.wait(timeout=5.0)
+        assert lk.acquire(blocking=False) is False
+        release.set()
+        t.join(timeout=5.0)
+        (edge,) = mon.snapshot()["edges"]
+        assert edge["holder"] == "t.try" and edge["count"] == 1
+
+    def test_condition_composition_wait_notify(self, mon):
+        """The SMM idiom: a Condition over a wrapped reentrant lock —
+        wait/notify must work through _release_save/_acquire_restore,
+        and the roundtrip feeds the site's ledger."""
+        cv = threading.Condition(
+            TimedContentionLock("t.cv", _monitor=mon, reentrant=True)
+        )
+        state = {"go": False}
+
+        def waiter():
+            with cv:
+                cv.wait_for(lambda: state["go"], timeout=5)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cv:
+            state["go"] = True
+            cv.notify_all()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        s = mon.snapshot()["sites"]["t.cv"]
+        # entry acquires on both threads + the waiter's wait() reacquire
+        assert s["acquires"] >= 3
+
+    def test_wrap_lock_composes_over_foreign_lock(self, mon):
+        inner = threading.RLock()
+        lk = TimedContentionLock("t.wrap", _monitor=mon, _inner=inner)
+        with lk:
+            assert lk._is_owned()
+        assert mon.snapshot()["sites"]["t.wrap"]["acquires"] == 1
+
+    def test_overflow_pools_excess_sites(self, mon):
+        for i in range(MAX_SITES + 10):
+            mon.note_acquire(f"site-{i}", 0.0, contended=False)
+            mon.note_release(f"site-{i}", 0.0)
+        sites = mon.snapshot()["sites"]
+        assert len(sites) <= MAX_SITES + 1
+        assert OVERFLOW_SITE in sites
+        assert sites[OVERFLOW_SITE]["acquires"] == 10
+
+
+# ------------------------------------------------------- factory patch
+
+class TestInstall:
+    def test_install_patches_and_uninstall_restores(self):
+        real_lock = threading.Lock
+        try:
+            install()
+            assert installed()
+            lk = threading.Lock()
+            assert isinstance(lk, TimedContentionLock)
+            rlk = threading.RLock()
+            assert isinstance(rlk, TimedContentionLock)
+            with rlk:
+                with rlk:     # reentrant through the patch
+                    pass
+            cv = threading.Condition()
+            assert isinstance(cv._lock, TimedContentionLock)
+            with cv:
+                cv.notify_all()
+        finally:
+            uninstall()
+        assert not installed()
+        assert threading.Lock is real_lock
+        assert not isinstance(threading.Lock(), TimedContentionLock)
+
+    def test_timed_lock_names_allocation_site(self):
+        lk = timed_lock()     # no explicit name → file:line site
+        assert "test_contention.py" in lk.name
+        assert timed_lock("explicit").name == "explicit"
+        assert wrap_lock(threading.RLock(), "w").name == "w"
+
+
+# ------------------------------------------------- the frame classifier
+
+class _FakeCode:
+    def __init__(self, filename, name):
+        self.co_filename = filename
+        self.co_name = name
+
+
+class _FakeFrame:
+    def __init__(self, filename, name, back=None):
+        self.f_code = _FakeCode(filename, name)
+        self.f_back = back
+
+
+class TestClassifyFrame:
+    def test_stdlib_wait_sites(self):
+        f = _FakeFrame("/usr/lib/python3.11/threading.py", "wait")
+        assert classify_frame(f) == "lock_wait"
+        f = _FakeFrame("/usr/lib/python3.11/selectors.py", "select")
+        assert classify_frame(f) == "io_wait"
+
+    def test_runnable_frame_is_none(self):
+        f = _FakeFrame("/repo/corda_tpu/flows/engine.py", "run")
+        assert classify_frame(f) is None
+
+    def test_registered_site_wins_over_stdlib(self):
+        """A WAL flush blocked in cv.wait is io-wait: the stdlib frame
+        says THAT the thread waits, the subsystem frame says WHY."""
+        register_wait_site("fakewal.py", "flush", "io_wait")
+        inner = _FakeFrame("/usr/lib/python3.11/threading.py", "wait")
+        outer = _FakeFrame("/repo/fakewal.py", "flush")
+        inner.f_back = outer
+        assert classify_frame(inner) == "io_wait"
+
+    def test_max_depth_bounds_the_walk(self):
+        # the wait frame sits 20 call levels below the innermost frame —
+        # outside the 16-frame walk window, so the thread reads runnable
+        frame = _FakeFrame("/usr/lib/python3.11/threading.py", "wait")
+        for i in range(20):
+            frame = _FakeFrame("/repo/app.py", f"fn{i}", back=frame)
+        assert classify_frame(frame, max_depth=16) is None
+        assert classify_frame(frame, max_depth=32) == "lock_wait"
+
+    def test_register_rejects_unknown_cause(self):
+        with pytest.raises(ValueError):
+            register_wait_site("x.py", "f", "napping")
+
+    def test_subsystems_registered_their_wait_sites(self):
+        """Importing the WAL and the engine registers their wait sites
+        (the classifier's subsystem table is populated at import)."""
+        import corda_tpu.durability.wal  # noqa: F401
+        import corda_tpu.flows.engine  # noqa: F401
+        from corda_tpu.observability.contention import wait_sites
+
+        sites = wait_sites()
+        assert sites[("wal.py", "flush")] == "io_wait"
+        assert sites[("engine.py", "_worker_loop")] == "lock_wait"
+
+
+# ---------------------------------------------------- process surfaces
+
+class TestSurfaces:
+    def test_section_disabled_marker(self):
+        configure_contention(enabled=False, patch=False)
+        assert contention_section() == {"enabled": False}
+
+    def test_section_and_prometheus_while_on(self):
+        configure_contention(enabled=True, patch=False, reset=True)
+        try:
+            lk = timed_lock('hostile"site\\name')
+            _convoy(lk, hold_s=0.03)
+            sec = contention_section()
+            assert sec["enabled"] and sec["schema"] == 1
+            assert 'hostile"site\\name' in sec["sites"]
+            from corda_tpu.observability.contention import (
+                prometheus_lines,
+            )
+
+            text = "\n".join(prometheus_lines()) + "\n"
+            samples = parse_prometheus(text)   # raises on malformed lines
+            assert any(
+                "contention_site_acquires_total" in k for k in samples
+            )
+            assert any(
+                "contention_wait_edge_total" in k for k in samples
+            )
+            # the registry gained the contention.* names
+            from corda_tpu.node.monitoring import node_metrics
+
+            names = list(node_metrics().snapshot())
+            assert "contention.acquires" in names
+            assert "contention.wait_s" in names
+        finally:
+            configure_contention(enabled=False, patch=False, reset=True)
+
+    def test_registry_snapshot_completes_with_patched_metric_locks(self):
+        """Deadlock pin: registry.snapshot() holds the registry lock
+        while acquiring every metric's own lock — metrics born under the
+        factory patch have TIMED guards, and a note path that looked
+        contention.* metrics up by name would re-enter the registry lock
+        (same thread) or ABBA a concurrent writer. The note paths must
+        run off the cached metric objects."""
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability.contention import (
+            configure_contention,
+        )
+
+        configure_contention(enabled=True, patch=True, reset=True)
+        try:
+            # a metric born under the patch: its guard lock is timed
+            t = node_metrics().timer("contention_test.patched_timer")
+            t.update(0.001)
+            done = threading.Event()
+
+            def snap():
+                node_metrics().snapshot()
+                from corda_tpu.node.monitoring import monitoring_snapshot
+                monitoring_snapshot()
+                done.set()
+
+            th = threading.Thread(target=snap, daemon=True)
+            th.start()
+            assert done.wait(timeout=30), (
+                "registry snapshot deadlocked against the contention "
+                "note paths"
+            )
+        finally:
+            configure_contention(enabled=False, patch=True, reset=True)
+            with node_metrics()._lock:
+                node_metrics()._metrics.pop(
+                    "contention_test.patched_timer", None)
+
+    def test_env_probe_runs_at_import_fresh_subprocess(self):
+        """CORDA_TPU_CONTENTION=1 must be live from the observability
+        import itself — a dump-and-exit tool that never constructs an
+        SMM (never hits the active_contention() hot-path check) still
+        reads an enabled section."""
+        code = """
+import json
+import corda_tpu.observability  # the env probe runs at import
+from corda_tpu.node.monitoring import monitoring_snapshot
+from corda_tpu.observability.contention import installed
+sec = monitoring_snapshot()["contention"]
+assert sec["enabled"], sec
+assert sec["installed"] and installed()
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "CORDA_TPU_CONTENTION": "1",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]
+
+    def test_timeline_tap_renders_contention_series(self):
+        """Satellite: the timeline's default allowlists tap the
+        contention families — a convoy between ticks lands as
+        ``contention.*`` series in the snapshot."""
+        from corda_tpu.observability import configure_timeline
+        from corda_tpu.observability.timeseries import timeline
+
+        configure_contention(enabled=True, patch=False, reset=True)
+        configure_timeline(enabled=True, cadence_s=0.05, ring_points=16,
+                           thread=False, reset=True)
+        try:
+            tl = timeline()
+            tl.tick()
+            _convoy(timed_lock("tap.site"), hold_s=0.03)
+            tl.tick()
+            series = tl.snapshot()["series"]
+            assert "contention.acquires" in series
+            assert series["contention.acquires"]["points"][-1] >= 2.0
+            assert "contention.wait_s.p50_s" in series
+        finally:
+            configure_timeline(enabled=False, reset=True)
+            configure_contention(enabled=False, patch=False, reset=True)
+
+    def test_flight_dump_round_trips_contention_and_causal(self, tmp_path):
+        from corda_tpu.node.monitoring import node_metrics
+        from corda_tpu.observability.slo import (
+            flight_dump,
+            read_flight_dump,
+        )
+
+        # flight_dump incs slo.flight_dumps — scrub any slo.* metric this
+        # test births so the devicemon off-by-default pin (which asserts
+        # an slo.*-free exposition, and sorts after this file) stays true
+        reg = node_metrics()
+        before = set(reg.snapshot())
+        try:
+            configure_contention(enabled=False, patch=False)
+            path = flight_dump(str(tmp_path / "off.jsonl"), reason="off")
+            out = read_flight_dump(path)
+            assert out["contention"] == {"enabled": False}
+            assert out["causal"] == {"enabled": False} or \
+                out["causal"].get("enabled")
+
+            configure_contention(enabled=True, patch=False, reset=True)
+            try:
+                _convoy(timed_lock("dump.site"), hold_s=0.03)
+                path = flight_dump(str(tmp_path / "on.jsonl"), reason="on")
+                out = read_flight_dump(path)
+                assert out["contention"]["enabled"]
+                assert "dump.site" in out["contention"]["sites"]
+                json.dumps(out["contention"])   # JSON all the way down
+            finally:
+                configure_contention(enabled=False, patch=False, reset=True)
+        finally:
+            with reg._lock:
+                for name in set(reg._metrics) - before:
+                    if name.startswith("slo."):
+                        del reg._metrics[name]
+
+    def test_rpc_bindings_wrap_the_sections(self):
+        from corda_tpu.rpc.bindings import (
+            contention_snapshot_value,
+            speedup_ledger_value,
+        )
+
+        class FakeProxy:
+            def contention_snapshot(self, top_n=16):
+                return {"enabled": False}
+
+            def speedup_ledger(self):
+                return {"enabled": False}
+
+        assert contention_snapshot_value(FakeProxy()).refresh() == {
+            "enabled": False,
+        }
+        assert speedup_ledger_value(FakeProxy()).refresh() == {
+            "enabled": False,
+        }
+
+    def test_monitoring_snapshot_carries_both_sections(self):
+        from corda_tpu.node.monitoring import monitoring_snapshot
+
+        snap = monitoring_snapshot()
+        assert "contention" in snap
+        assert "causal" in snap
+
+
+# ------------------------------------------------- off-by-default pins
+
+class TestOffByDefaultPins:
+    def test_zero_footprint_when_off_fresh_subprocess(self):
+        """The acceptance pin: with CORDA_TPU_CONTENTION unset a REAL
+        mocknet flow leaves the lock factories untouched, spawns no
+        observatory thread, hands back None from the hot-path check and
+        registers ZERO contention./causal. metrics — fresh subprocess so
+        no other test's configure_* latch can mask a regression."""
+        code = """
+import json, os, threading
+os.environ.pop("CORDA_TPU_CONTENTION", None)
+real_lock = threading.Lock
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.testing import MockNetworkNodes
+from corda_tpu.node.monitoring import monitoring_snapshot, node_metrics
+from corda_tpu.observability.contention import (
+    active_contention, installed,
+)
+with MockNetworkNodes() as net:
+    alice = net.create_node("OffAlice")
+    notary = net.create_notary_node("OffNotary")
+    alice.run_flow(CashIssueFlow(100, "GBP", b"\\x01", notary.party))
+snap = monitoring_snapshot()
+assert snap["contention"] == {"enabled": False}, snap["contention"]
+assert snap["causal"] == {"enabled": False}, snap["causal"]
+names = list(node_metrics().snapshot())
+assert not any(
+    n.startswith(("contention.", "causal.")) for n in names
+), names
+assert active_contention() is None
+assert not installed()
+assert threading.Lock is real_lock
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]
+
+    def test_env_knob_times_the_smm_monitor_fresh_subprocess(self):
+        """CORDA_TPU_CONTENTION=1: the env probe installs the factory
+        patch, the engine wraps its SMM lock under the stable
+        ``engine.smm`` site, and a real flow's section carries it."""
+        code = """
+import json, threading
+from corda_tpu.observability.contention import (
+    active_contention, installed,
+)
+assert active_contention() is not None      # env probe enables
+assert installed()
+from corda_tpu.observability.contention import TimedContentionLock
+assert isinstance(threading.Lock(), TimedContentionLock)
+from corda_tpu.finance import CashIssueFlow
+from corda_tpu.testing import MockNetworkNodes
+from corda_tpu.node.monitoring import monitoring_snapshot
+with MockNetworkNodes() as net:
+    alice = net.create_node("EnvAlice")
+    notary = net.create_notary_node("EnvNotary")
+    alice.run_flow(CashIssueFlow(100, "GBP", b"\\x01", notary.party))
+snap = monitoring_snapshot()["contention"]
+assert snap["enabled"] and snap["installed"]
+assert "engine.smm" in snap["sites"], sorted(snap["sites"])[:20]
+assert snap["sites"]["engine.smm"]["acquires"] > 0
+print(json.dumps({"ok": True}))
+"""
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "CORDA_TPU_CONTENTION": "1",
+                 "PYTHONPATH": REPO + os.pathsep
+                 + os.environ.get("PYTHONPATH", "")},
+            cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout.strip().splitlines()[-1])["ok"]

@@ -489,3 +489,257 @@ class TestFlightDumpRoundTrip:
         finally:
             configure_flowprof(enabled=False, reset=True)
             configure_sampler(enabled=False, reset=True)
+
+
+# ------------------------------------------- cause-bucket conservation
+
+class TestCauseLedger:
+    """The concurrency observatory's cause split: every phase's
+    aggregate wall divides into on_cpu / lock_wait / io_wait /
+    gil_runnable / unattributed buckets that CONSERVE to the phase total
+    (±5%, the acceptance pin) — exact declared evidence first, sampled
+    apportionment of the remainder, residual to unattributed."""
+
+    def _sum(self, buckets):
+        return sum(buckets.values())
+
+    def test_declared_frame_cause_is_exact(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("wal_fsync_wait", cause="io_wait"):
+                fp.clock.advance(0.4)
+        fp.close("f1")
+        causes = fp.causes_snapshot()
+        b = causes["wal_fsync_wait"]
+        assert b["io_wait"] == pytest.approx(0.4)
+        assert self._sum(b) == pytest.approx(0.4)
+
+    def test_contended_timed_rlock_feeds_exact_lock_wait(self):
+        """Satellite pin: the SMM lock's contended acquire declares its
+        wait as lock_wait cause evidence — the lock_wait phase bucket
+        conserves to the phase total within 5% with NO sampler help."""
+        prof = FlowProfiler()
+        prof.enable()
+        lock = prof.timed_rlock()
+        acct = prof.open("f1", "test.Flow")
+        lock.acquire()
+
+        def waiter():
+            with prof.activate(acct):
+                lock.acquire()
+                lock.release()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.15)
+        lock.release()
+        t.join(timeout=5)
+        prof.close("f1")
+        total = sum(
+            agg["phases"]["lock_wait"]
+            for agg in prof.snapshot()["classes"].values()
+        )
+        assert total >= 0.1
+        b = prof.causes_snapshot()["lock_wait"]
+        assert b["lock_wait"] == pytest.approx(total, rel=0.05)
+        assert self._sum(b) == pytest.approx(total, rel=0.05)
+
+    def test_exact_evidence_clamped_to_phase_total(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("host_verify"):
+                fp.clock.advance(0.5)
+        fp.close("f1")
+        # over-declared exact evidence (10s against a 0.5s phase) must
+        # scale down, never inflate the buckets past the total
+        fp.note_cause_seconds("host_verify", "io_wait", 10.0)
+        b = fp.causes_snapshot()["host_verify"]
+        assert b["io_wait"] == pytest.approx(0.5)
+        assert self._sum(b) == pytest.approx(0.5)
+
+    def test_sampled_weights_apportion_the_remainder(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("host_verify"):
+                fp.clock.advance(1.0)
+        fp.close("f1")
+        fp.note_cause_sample("host_verify", "on_cpu", 3.0)
+        fp.note_cause_sample("host_verify", "gil_runnable", 1.0)
+        b = fp.causes_snapshot()["host_verify"]
+        assert b["on_cpu"] == pytest.approx(0.75)
+        assert b["gil_runnable"] == pytest.approx(0.25)
+        assert self._sum(b) == pytest.approx(1.0)
+
+    def test_no_evidence_lands_in_unattributed(self, fp):
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("serialize"):
+                fp.clock.advance(0.3)
+        fp.close("f1")
+        b = fp.causes_snapshot()["serialize"]
+        assert b["unattributed"] == pytest.approx(0.3)
+
+    def test_mixed_evidence_conserves_per_phase(self, fp):
+        """Exact + sampled + residual together: every phase's buckets
+        sum to its total within 5%."""
+        acct = fp.open("f1", "test.Flow")
+        with fp.activate(acct):
+            with fp.frame("wal_fsync_wait", cause="io_wait"):
+                fp.clock.advance(0.2)
+            with fp.frame("host_verify"):
+                fp.clock.advance(0.6)
+            with fp.frame("serialize"):
+                fp.clock.advance(0.1)
+        fp.close("f1")
+        fp.note_cause_seconds("host_verify", "lock_wait", 0.2)
+        fp.note_cause_sample("host_verify", "on_cpu", 5.0)
+        causes = fp.causes_snapshot()
+        totals = {"wal_fsync_wait": 0.2, "host_verify": 0.6,
+                  "serialize": 0.1}
+        for phase, total in totals.items():
+            assert self._sum(causes[phase]) == pytest.approx(
+                total, rel=0.05), phase
+        # exact evidence first, sampled weights take the remainder
+        assert causes["host_verify"]["lock_wait"] == pytest.approx(0.2)
+        assert causes["host_verify"]["on_cpu"] == pytest.approx(0.4)
+
+
+# --------------------------------------------- the sampler's classifier
+
+class TestClassifier:
+    def test_auto_on_iff_contention_active(self):
+        from corda_tpu.observability.contention import (
+            configure_contention,
+        )
+
+        try:
+            configure_contention(enabled=True, patch=False)
+            s = StackSampler(hz=100)
+            s.start()
+            try:
+                assert s._classify is True
+            finally:
+                s.stop()
+        finally:
+            configure_contention(enabled=False, patch=False)
+        s = StackSampler(hz=100)
+        s.start()
+        try:
+            assert s._classify is False
+        finally:
+            s.stop()
+
+    def test_blocked_worker_classifies_lock_wait(self):
+        s = StackSampler(hz=100)
+        s._classify = True
+        stop = threading.Event()
+        t = threading.Thread(target=lambda: stop.wait(5),
+                             name="flow-worker-41", daemon=True)
+        t.start()
+        try:
+            time.sleep(0.05)
+            s.sample_once()
+            causes = s.dump()["causes"]
+            assert causes["flow_worker"]["lock_wait"] >= 1.0
+        finally:
+            stop.set()
+            t.join(timeout=5)
+
+    def test_runnable_workers_split_the_gil(self):
+        """k runnable threads split each tick 1/k on-cpu, (k−1)/k
+        gil-runnable — each runnable thread still books exactly one
+        sample's worth of weight in total."""
+        s = StackSampler(hz=100)
+        s._classify = True
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x = (x + 1) % 1000003
+
+        workers = [
+            threading.Thread(target=busy, name=f"flow-worker-{i}",
+                             daemon=True)
+            for i in range(2)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            time.sleep(0.05)
+            s.sample_once()
+            causes = s.dump()["causes"]["flow_worker"]
+            assert causes.get("on_cpu", 0.0) > 0.0
+            assert causes.get("gil_runnable", 0.0) > 0.0
+            assert sum(causes.values()) == pytest.approx(2.0, abs=0.01)
+        finally:
+            stop.set()
+            for w in workers:
+                w.join(timeout=5)
+
+    def test_classified_weights_feed_flowprof_phases(self):
+        """The thread→phase map routes a classified sample to the phase
+        the thread is inside — the bridge from sampler to cause ledger."""
+        configure_flowprof(enabled=True, reset=True)
+        prof = flowprof()
+        s = StackSampler(hz=100)
+        s._classify = True
+        stop = threading.Event()
+        acct = prof.open("f1", "test.Flow")
+
+        def worker():
+            with prof.activate(acct):
+                with prof.frame("host_verify"):
+                    stop.wait(5)
+
+        t = threading.Thread(target=worker, name="flow-worker-7",
+                             daemon=True)
+        t.start()
+        try:
+            time.sleep(0.05)
+            assert prof.thread_phase(t.ident) == "host_verify"
+            s.sample_once()
+            assert prof._cause_samples["host_verify"]["lock_wait"] >= 1.0
+        finally:
+            stop.set()
+            t.join(timeout=5)
+            prof.close("f1")
+            configure_flowprof(enabled=False, reset=True)
+
+
+class TestClassifierOverhead:
+    def test_real_thread_overhead_under_budget_with_classifier(self):
+        """Satellite re-pin: the <3% sampling budget HOLDS with the
+        blocked/running classifier on — same shape as the classifier-off
+        budget test, classification forced via the public override."""
+        stop = threading.Event()
+
+        def busy():
+            x = 0
+            while not stop.is_set():
+                x = (x + 1) % 1000003
+
+        workers = [
+            threading.Thread(target=busy, name=f"flow-worker-{i}",
+                             daemon=True)
+            for i in range(3)
+        ]
+        for w in workers:
+            w.start()
+        s = StackSampler(hz=100)
+        s._classify_cfg = True
+        s.start()
+        try:
+            time.sleep(0.8)
+            ratio = s.overhead_ratio()
+            dump = s.dump(top_n=10)
+        finally:
+            s.stop()
+            stop.set()
+            for w in workers:
+                w.join(timeout=5)
+        assert dump["classified"] is True
+        assert dump["samples"] >= 20, dump["samples"]
+        assert ratio < 0.03, f"classifying sampler {ratio:.4f} >= 3%"
+        assert dump["causes"], "classifier on but no causes folded"
+        assert "flow_worker" in dump["causes"]

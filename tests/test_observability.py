@@ -333,7 +333,8 @@ class TestMonitoringSurface:
         assert set(snap) == {"serving", "profiler", "devices", "slo",
                              "resilience", "durability", "flowprof",
                              "sampler", "net", "cluster", "overload",
-                             "statestore", "timeline", "process"}
+                             "statestore", "timeline", "contention",
+                             "causal", "process"}
         # devicemon/slo/resilience/durability/flowprof/sampler are off by
         # default: bare disabled markers, no slots laid out, no metrics
         # created (ISSUE 7 overhead contract; ISSUEs 9/10 extend it to

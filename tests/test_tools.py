@@ -1084,6 +1084,162 @@ class TestPerfGate:
         assert proc.returncode == 1, proc.stdout + proc.stderr
 
 
+class TestPerfGateObservatory:
+    """ISSUE 19: the smoke's `contention` and `causal` sections are
+    schema-validated — well-formed captures pass, and every doctored
+    failure (missing quantiles, non-monotone reservoirs, unsorted
+    tables, dead probes, a failed planted-bottleneck validation) is
+    named in the gate's output."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    GATE = os.path.join(REPO, "tools_perf_gate.py")
+
+    BASE = {"ed25519_sigs_per_sec": 100000.0}
+
+    CONTENTION = {
+        "enabled": True, "schema": 1, "installed": False,
+        "sites": {
+            "engine.smm": {
+                "acquires": 120, "contended": 8, "wait_total_s": 0.5,
+                "wait_p50_s": 0.01, "wait_p95_s": 0.05,
+                "wait_p99_s": 0.09, "hold_p50_s": 0.001,
+                "hold_p95_s": 0.002, "hold_p99_s": 0.004,
+            },
+            "wal.flush": {
+                "acquires": 40, "contended": 2, "wait_total_s": 0.2,
+                "wait_p50_s": 0.05, "wait_p95_s": 0.1,
+                "wait_p99_s": 0.1, "hold_p50_s": 0.01,
+                "hold_p95_s": 0.02, "hold_p99_s": 0.02,
+            },
+        },
+        "top": [
+            {"site": "engine.smm", "wait_total_s": 0.5},
+            {"site": "wal.flush", "wait_total_s": 0.2},
+        ],
+        "edges": [
+            {"holder": "engine.smm", "waiter": "thread:flow-worker",
+             "count": 3, "wait_s": 0.4},
+        ],
+    }
+
+    CAUSAL = {
+        "enabled": True, "schema": 1, "baseline_qps": 120.0,
+        "source": "synthetic",
+        "cells": [
+            {"phase": "host_verify", "speedup_pct": 50.0,
+             "experiment_qps": 90.0, "predicted_qps": 180.0,
+             "predicted_gain_qps": 60.0, "predicted_gain_pct": 50.0,
+             "baseline_qps": 120.0, "inserted_delays": 12,
+             "inserted_s": 0.1},
+        ],
+        "ledger": [
+            {"phase": "host_verify", "speedup_pct": 50.0,
+             "predicted_qps": 180.0, "predicted_gain_qps": 60.0,
+             "predicted_gain_pct": 50.0},
+            {"phase": "serialize", "speedup_pct": 50.0,
+             "predicted_qps": 130.0, "predicted_gain_qps": 10.0,
+             "predicted_gain_pct": 8.3},
+        ],
+        "validation": {
+            "phase": "host_verify", "ok": True, "rel_err": 0.05,
+            "tol": 0.25, "baseline_qps": 120.0, "predicted_qps": 180.0,
+            "measured_qps": 175.0,
+        },
+    }
+
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, self.GATE, *args],
+            capture_output=True, text=True, timeout=60,
+        )
+
+    def _check(self, tmp_path, doc):
+        path = tmp_path / "bench.json"
+        path.write_text(json.dumps(doc))
+        return self._run("--result", str(path), "--check-schema")
+
+    def test_check_schema_validates_contention_section(self, tmp_path):
+        good = dict(self.BASE)
+        good["contention"] = json.loads(json.dumps(self.CONTENTION))
+        proc = self._check(tmp_path, good)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        # a disabled capture carries no numbers and still passes
+        off = dict(self.BASE)
+        off["contention"] = {"enabled": False}
+        assert self._check(tmp_path, off).returncode == 0
+
+        for doctor, needle in (
+            (lambda c: c.__setitem__("sites", {}),
+             "missing non-empty 'sites' object"),
+            (lambda c: c["sites"]["engine.smm"].pop("wait_p95_s"),
+             "missing numeric 'wait_p95_s'"),
+            (lambda c: c["sites"]["engine.smm"].__setitem__(
+                "acquires", -1),
+             "negative acquires"),
+            (lambda c: c["sites"]["wal.flush"].__setitem__(
+                "contended", 99),
+             "exceeds acquires"),
+            (lambda c: c["sites"]["engine.smm"].__setitem__(
+                "wait_p50_s", 0.2),
+             "wait quantiles not monotone"),
+            (lambda c: c["sites"]["engine.smm"].__setitem__(
+                "hold_p99_s", 0.0),
+             "hold quantiles not monotone"),
+            (lambda c: c.__setitem__("top", []),
+             "missing non-empty 'top' list"),
+            (lambda c: c["top"].append(
+                {"site": "late.big", "wait_total_s": 9.0}),
+             "rows not sorted by descending wait_total_s"),
+            (lambda c: c["edges"][0].__setitem__("holder", 7),
+             "string 'holder'/'waiter'"),
+            (lambda c: c["edges"][0].__setitem__("wait_s", -1.0),
+             "'wait_s' not a non-negative number"),
+            (lambda c: c.pop("edges"),
+             "missing 'edges' list"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["contention"])
+            proc = self._check(tmp_path, broken)
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+    def test_check_schema_validates_causal_section(self, tmp_path):
+        good = dict(self.BASE)
+        good["causal"] = json.loads(json.dumps(self.CAUSAL))
+        proc = self._check(tmp_path, good)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+        off = dict(self.BASE)
+        off["causal"] = {"enabled": False}
+        assert self._check(tmp_path, off).returncode == 0
+
+        for doctor, needle in (
+            (lambda c: c.pop("baseline_qps"),
+             "missing 'baseline_qps'"),
+            (lambda c: c.__setitem__("baseline_qps", 0.0),
+             "not a positive number"),
+            (lambda c: c["cells"][0].__setitem__("experiment_qps", 0.0),
+             "the probe must have run"),
+            (lambda c: c["ledger"][0].pop("predicted_qps"),
+             "missing 'predicted_qps'"),
+            (lambda c: c.__setitem__(
+                "ledger", list(reversed(c["ledger"]))),
+             "must rank payoffs"),
+            (lambda c: c.pop("validation"),
+             "synthetic run missing 'validation' object"),
+            (lambda c: c["validation"].__setitem__("ok", False),
+             "ok is not true"),
+            (lambda c: c["validation"].update(ok=True, rel_err=0.3),
+             "rel_err 0.3 exceeds tol 0.25"),
+        ):
+            broken = json.loads(json.dumps(good))
+            doctor(broken["causal"])
+            proc = self._check(tmp_path, broken)
+            assert proc.returncode == 1, (needle, proc.stdout)
+            assert needle in proc.stdout, (needle, proc.stdout)
+
+
 class TestTimelineCLI:
     """ISSUE 18: tools_timeline.py renders a timeline snapshot (from a
     flight dump, a saved snapshot JSON, or its in-process live demo) as
@@ -1161,6 +1317,108 @@ class TestTimelineCLI:
         proc = self._run("--flight", path)
         assert proc.returncode == 1
         assert "no timeline kind" in proc.stderr
+
+    def test_partitions_contention_series_under_subheading(self, tmp_path):
+        """ISSUE 19 satellite: `contention.*` series render in their own
+        concurrency-observatory block, separated from the general
+        sparkline table."""
+        snap = self._snapshot()
+        snap["series"]["contention.acquires"] = {
+            "kind": "counter_delta", "points": [0.0, 2.0, 5.0]}
+        snap["series"]["contention.wait_s.p99_s"] = {
+            "kind": "timer_quantile", "points": [0.001, 0.002, 0.004]}
+        doc = tmp_path / "snap.json"
+        doc.write_text(json.dumps(snap))
+        proc = self._run("--snapshot", str(doc))
+        assert proc.returncode == 0, proc.stderr
+        out = proc.stdout
+        assert "contention (concurrency observatory):" in out
+        assert "contention.acquires" in out
+        assert "contention.wait_s.p99_s" in out
+        # observatory block comes after the general series
+        assert out.index("serving.requests") < \
+            out.index("contention (concurrency observatory):")
+
+    def test_renders_contention_table_from_artifact(self, tmp_path):
+        """An artifact carrying a `contention` section gets the
+        top-contended table + wait edges appended to the render."""
+        doc = tmp_path / "artifact.json"
+        doc.write_text(json.dumps({
+            "timeline": self._snapshot(),
+            "contention": {
+                "enabled": True, "schema": 1, "installed": False,
+                "sites": {
+                    "engine.smm": {
+                        "acquires": 12, "contended": 3,
+                        "wait_total_s": 0.5, "wait_p50_s": 0.01,
+                        "wait_p95_s": 0.05, "wait_p99_s": 0.09,
+                        "hold_p50_s": 0.001, "hold_p95_s": 0.002,
+                        "hold_p99_s": 0.004,
+                    },
+                },
+                "top": [
+                    {"site": "engine.smm", "acquires": 12,
+                     "contended": 3, "wait_total_s": 0.5,
+                     "wait_p50_s": 0.01, "wait_p95_s": 0.05,
+                     "wait_p99_s": 0.09, "hold_p50_s": 0.001,
+                     "hold_p95_s": 0.002, "hold_p99_s": 0.004},
+                ],
+                "edges": [
+                    {"holder": "engine.smm",
+                     "waiter": "thread:flow-worker", "count": 3,
+                     "wait_s": 0.4},
+                ],
+            },
+        }))
+        proc = self._run("--snapshot", str(doc))
+        assert proc.returncode == 0, proc.stderr
+        assert "engine.smm" in proc.stdout
+        assert "wait edges" in proc.stdout
+        assert "thread:flow-worker" in proc.stdout
+
+    def test_render_contention_none_when_absent_or_disabled(self):
+        sys.path.insert(0, self.REPO)
+        try:
+            from tools_timeline import render_contention
+        finally:
+            sys.path.remove(self.REPO)
+        assert render_contention({"enabled": False}) is None
+        assert render_contention({}) is None
+        assert render_contention({"enabled": True, "sites": {},
+                                  "top": [], "edges": []}) is None
+
+
+class TestLoadGenCLI:
+    """ISSUE 19: tools_loadgen.py --causal argument validation fails
+    FAST — a bad experiment grid exits 2 before the ramp spends minutes
+    locating a knee it would then waste."""
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    CLI = os.path.join(REPO, "tools_loadgen.py")
+
+    def _run(self, *args):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        return subprocess.run(
+            [sys.executable, self.CLI, *args],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+
+    def test_bad_causal_speedups_fail_fast(self):
+        proc = self._run("--causal", "--causal-speedups", "0")
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "bad --causal-speedups" in proc.stdout
+        proc = self._run("--causal", "--causal-speedups", "100")
+        assert proc.returncode == 2
+        assert "bad --causal-speedups" in proc.stdout
+        proc = self._run("--causal", "--causal-speedups", "fifty")
+        assert proc.returncode == 2
+        assert "bad --causal-speedups" in proc.stdout
+
+    def test_unknown_causal_phase_fails_fast(self):
+        proc = self._run("--causal", "--causal-phases", "warp_drive")
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "bad --causal-phases" in proc.stdout
+        assert "warp_drive" in proc.stdout
 
 
 class TestOpCount:

@@ -42,6 +42,22 @@ recovery wall) merges into LOADTEST.json and is validated by the same
     --overload-factor F   storm arrival multiple of the knee (default 3)
     --storm S             storm duration in seconds (default 6)
     --recovery S          recovery wall bound in seconds (default 30)
+
+Causal profiling (docs/OBSERVABILITY.md §Causal profiler) also rides
+the ramp: after the knee is located, ``--causal`` runs COZ-style
+virtual-speedup experiments — one fresh single-step probe at the knee's
+arrival rate per (phase, speedup%) cell, with calibrated delays
+inserted into every *other* flowprof phase — and merges the resulting
+``causal`` section (the speedup ledger ranking phases by predicted
+knee-qps payoff) into LOADTEST.json, validated by the same
+``--check-schema``.
+
+    --causal              run virtual-speedup experiments at the knee
+    --causal-phases P,..  flowprof phases to experiment on
+                          (default host_verify,serialize,checkpoint)
+    --causal-speedups N,..  virtual speedup percentages (default 50)
+    --causal-duration S   seconds of arrivals per probe (default 4;
+                          longer probes = less noisy ledger)
 """
 
 from __future__ import annotations
@@ -84,6 +100,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="record the telemetry timeline through the ramp "
                          "(qps steps stamped as marks; render with "
                          "tools_timeline.py --snapshot)")
+    ap.add_argument("--causal", action="store_true",
+                    help="after the ramp, run COZ-style virtual-speedup "
+                         "experiments at the knee and merge the speedup "
+                         "ledger into the artifact")
+    ap.add_argument("--causal-phases", default="host_verify,serialize,"
+                    "checkpoint",
+                    help="comma-separated flowprof phases to experiment "
+                         "on (default host_verify,serialize,checkpoint)")
+    ap.add_argument("--causal-speedups", default="50",
+                    help="comma-separated virtual speedup percentages "
+                         "(default 50)")
+    ap.add_argument("--causal-duration", type=float, default=4.0,
+                    help="seconds of arrivals per causal probe — longer "
+                         "probes mean a less noisy ledger (default 4)")
     ap.add_argument("--overload", action="store_true",
                     help="after the ramp, certify graceful degradation "
                          "at --overload-factor × the knee under chaos")
@@ -104,6 +134,37 @@ def main(argv: list[str] | None = None) -> int:
     if not qps_steps or any(q <= 0 for q in qps_steps):
         print(f"loadgen: --qps steps must be positive: {args.qps!r}")
         return 2
+
+    causal_speedups: tuple = ()
+    causal_phases: tuple = ()
+    if args.causal:
+        # validate the experiment grid BEFORE the ramp spends minutes
+        # locating a knee the bad arguments would then waste
+        try:
+            causal_speedups = tuple(
+                float(x) / 100.0
+                for x in args.causal_speedups.split(",") if x
+            )
+        except ValueError:
+            causal_speedups = ()
+        if not causal_speedups or any(
+            not 0.0 < x < 1.0 for x in causal_speedups
+        ):
+            print(f"loadgen: bad --causal-speedups "
+                  f"{args.causal_speedups!r} (want e.g. 25,50 — "
+                  "percentages strictly between 0 and 100)")
+            return 2
+        from corda_tpu.observability.flowprof import PHASES
+
+        causal_phases = tuple(
+            p for p in args.causal_phases.split(",") if p
+        )
+        unknown = [p for p in causal_phases if p not in PHASES]
+        if not causal_phases or unknown:
+            print(f"loadgen: bad --causal-phases {args.causal_phases!r}"
+                  f" (unknown: {', '.join(unknown) or '<empty>'}; "
+                  f"flowprof phases: {', '.join(PHASES)})")
+            return 2
 
     from corda_tpu.tools.loadharness import (
         HarnessConfig,
@@ -174,6 +235,27 @@ def main(argv: list[str] | None = None) -> int:
         "top phases: "
         + ", ".join(f"{p} {v:.2f}s" for p, v in top)
     )
+    if args.causal:
+        from corda_tpu.tools.loadharness import run_causal
+
+        causal = run_causal(
+            cfg, knee["qps"], phases=causal_phases,
+            speedups=causal_speedups,
+            probe_duration_s=args.causal_duration,
+        )
+        result["causal"] = causal
+        path = write_loadtest(result, args.out)
+        print(f"loadgen: causal baseline {causal['baseline_qps']:.1f} "
+              "qps; speedup ledger:")
+        for row in causal["ledger"]:
+            print(
+                "loadgen:   {phase} +{sp:g}% -> {gain:+.1f} qps "
+                "({pct:+.1f}%)".format(
+                    phase=row["phase"], sp=row["speedup_pct"],
+                    gain=row["predicted_gain_qps"],
+                    pct=row["predicted_gain_pct"],
+                )
+            )
     if args.overload:
         from corda_tpu.tools.loadharness import OverloadConfig, run_overload
 

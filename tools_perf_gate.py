@@ -253,6 +253,25 @@ TIMELINE_REQUIRED_KEYS = (
     "burn_alerts", "flight_roundtrip_ok",
 )
 
+# keys every per-site entry in the smoke's contention section must carry
+# for --check-schema (the concurrency-observatory pass —
+# docs/OBSERVABILITY.md §Concurrency observatory): acquire/contention
+# census plus both reservoir quantile triples
+CONTENTION_SITE_REQUIRED_KEYS = (
+    "acquires", "contended", "wait_total_s",
+    "wait_p50_s", "wait_p95_s", "wait_p99_s",
+    "hold_p50_s", "hold_p95_s", "hold_p99_s",
+)
+
+# keys the smoke's causal (speedup-ledger) section must carry for
+# --check-schema (docs/OBSERVABILITY.md §Causal profiler)
+CAUSAL_REQUIRED_KEYS = ("schema", "baseline_qps", "cells", "ledger")
+
+# keys every speedup-ledger row must carry
+CAUSAL_LEDGER_ROW_KEYS = (
+    "phase", "speedup_pct", "predicted_qps", "predicted_gain_qps",
+)
+
 # keys every BENCH_HISTORY.jsonl entry must carry (--history appends
 # them, --trend validates before trusting the trajectory)
 HISTORY_REQUIRED_KEYS = (
@@ -908,6 +927,196 @@ def check_schema(result: dict) -> list[str]:
                     f"timeline: burn_alerts is {v:g} — the synthetic "
                     "burn-rate breach must fire"
                 )
+    contention = result.get("contention")
+    if contention is not None:
+        if not isinstance(contention, dict):
+            problems.append("contention: expected an object")
+        elif not contention.get("enabled", True):
+            # a disabled capture ({"enabled": false}) carries no numbers
+            pass
+        else:
+            sites = contention.get("sites")
+            if not isinstance(sites, dict) or not sites:
+                problems.append(
+                    "contention: missing non-empty 'sites' object"
+                )
+                sites = {}
+            for name, site in sites.items():
+                if not isinstance(site, dict):
+                    problems.append(
+                        f"contention/sites/{name}: expected an object"
+                    )
+                    continue
+
+                def cnum(key, _site=site):
+                    v = _site.get(key)
+                    return v if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool) else None
+
+                for key in CONTENTION_SITE_REQUIRED_KEYS:
+                    if cnum(key) is None:
+                        problems.append(
+                            f"contention/sites/{name}: missing numeric "
+                            f"{key!r}"
+                        )
+                    elif cnum(key) < 0:
+                        problems.append(
+                            f"contention/sites/{name}: negative {key} "
+                            f"{cnum(key)}"
+                        )
+                acq, cont = cnum("acquires"), cnum("contended")
+                if acq is not None and cont is not None and cont > acq:
+                    problems.append(
+                        f"contention/sites/{name}: contended {cont:g} "
+                        f"exceeds acquires {acq:g} (every contended "
+                        "acquire is still an acquire)"
+                    )
+                # reservoir quantiles must be monotone, per triple
+                for stem in ("wait", "hold"):
+                    q50 = cnum(f"{stem}_p50_s")
+                    q95 = cnum(f"{stem}_p95_s")
+                    q99 = cnum(f"{stem}_p99_s")
+                    if None not in (q50, q95, q99) \
+                            and not (q50 <= q95 <= q99):
+                        problems.append(
+                            f"contention/sites/{name}: {stem} quantiles "
+                            f"not monotone (p50 {q50:g}, p95 {q95:g}, "
+                            f"p99 {q99:g})"
+                        )
+            top = contention.get("top")
+            if not isinstance(top, list) or not top:
+                problems.append(
+                    "contention: missing non-empty 'top' list"
+                )
+            else:
+                waits = [
+                    r.get("wait_total_s") for r in top
+                    if isinstance(r, dict)
+                ]
+                if len(waits) != len(top) or not all(
+                    isinstance(w, (int, float)) and not isinstance(w, bool)
+                    for w in waits
+                ):
+                    problems.append(
+                        "contention/top: every row must be an object "
+                        "with numeric 'wait_total_s'"
+                    )
+                elif any(b > a for a, b in zip(waits, waits[1:])):
+                    problems.append(
+                        "contention/top: rows not sorted by descending "
+                        "wait_total_s"
+                    )
+            edges = contention.get("edges")
+            if not isinstance(edges, list):
+                problems.append("contention: missing 'edges' list")
+            else:
+                for i, e in enumerate(edges):
+                    if not isinstance(e, dict) \
+                            or not isinstance(e.get("holder"), str) \
+                            or not isinstance(e.get("waiter"), str):
+                        problems.append(
+                            f"contention/edges[{i}]: expected an object "
+                            "with string 'holder'/'waiter'"
+                        )
+                        continue
+                    w = e.get("wait_s")
+                    if not isinstance(w, (int, float)) \
+                            or isinstance(w, bool) or w < 0:
+                        problems.append(
+                            f"contention/edges[{i}]: 'wait_s' not a "
+                            "non-negative number"
+                        )
+    causal = result.get("causal")
+    if causal is not None:
+        if not isinstance(causal, dict):
+            problems.append("causal: expected an object")
+        elif not causal.get("enabled", True):
+            # run-on-demand: no recorded ledger yet
+            pass
+        else:
+            for key in CAUSAL_REQUIRED_KEYS:
+                if key not in causal:
+                    problems.append(f"causal: missing {key!r}")
+            base = causal.get("baseline_qps")
+            if base is not None and (
+                not isinstance(base, (int, float))
+                or isinstance(base, bool) or base <= 0
+            ):
+                problems.append(
+                    f"causal: baseline_qps {base!r} is not a positive "
+                    "number"
+                )
+            cells = causal.get("cells")
+            if isinstance(cells, list):
+                for i, c in enumerate(cells):
+                    if not isinstance(c, dict):
+                        problems.append(
+                            f"causal/cells[{i}]: expected an object"
+                        )
+                        continue
+                    q = c.get("experiment_qps")
+                    if not isinstance(q, (int, float)) \
+                            or isinstance(q, bool) or q <= 0:
+                        problems.append(
+                            f"causal/cells[{i}]: 'experiment_qps' not a "
+                            "positive number (the probe must have run)"
+                        )
+            elif cells is not None:
+                problems.append("causal: 'cells' is not a list")
+            ledger = causal.get("ledger")
+            if isinstance(ledger, list):
+                gains = []
+                for i, row in enumerate(ledger):
+                    if not isinstance(row, dict):
+                        problems.append(
+                            f"causal/ledger[{i}]: expected an object"
+                        )
+                        continue
+                    for key in CAUSAL_LEDGER_ROW_KEYS:
+                        if key not in row:
+                            problems.append(
+                                f"causal/ledger[{i}]: missing {key!r}"
+                            )
+                    g = row.get("predicted_gain_qps")
+                    if isinstance(g, (int, float)) \
+                            and not isinstance(g, bool):
+                        gains.append(g)
+                if len(gains) == len(ledger) and any(
+                    b > a for a, b in zip(gains, gains[1:])
+                ):
+                    problems.append(
+                        "causal/ledger: rows not sorted by descending "
+                        "predicted_gain_qps (the ledger must rank "
+                        "payoffs)"
+                    )
+            elif ledger is not None:
+                problems.append("causal: 'ledger' is not a list")
+            # a synthetic run must carry the planted-bottleneck
+            # validation and it must have passed (±tol) — the ledger is
+            # only trustworthy if its math was checked against a
+            # measured gain this run
+            val = causal.get("validation")
+            if causal.get("source") == "synthetic" \
+                    and not isinstance(val, dict):
+                problems.append(
+                    "causal: synthetic run missing 'validation' object"
+                )
+            if isinstance(val, dict):
+                if not val.get("ok"):
+                    problems.append(
+                        "causal/validation: ok is not true (the "
+                        "planted-bottleneck prediction must land within "
+                        "tolerance of the measured gain)"
+                    )
+                rel, tol = val.get("rel_err"), val.get("tol")
+                if isinstance(rel, (int, float)) \
+                        and isinstance(tol, (int, float)) \
+                        and not isinstance(rel, bool) \
+                        and not isinstance(tol, bool) and rel > tol:
+                    problems.append(
+                        f"causal/validation: rel_err {rel:g} exceeds "
+                        f"tol {tol:g}"
+                    )
     return problems
 
 

@@ -20,6 +20,13 @@ artifact). ``--live`` forces the timeline on around a host-path
 scheduler burst and renders what the rings caught — a seconds-fast
 demo of the recorder end to end.
 
+Concurrency-observatory series (``contention.*`` counter deltas and
+wait-time quantile rings — docs/OBSERVABILITY.md §Concurrency
+observatory) group under their own subheading in the sparkline table,
+and when the artifact also carries a ``contention`` section (a flight
+dump's kind, a monitoring snapshot's key) the top-contended table and
+wait edges print beneath it.
+
 Knobs:
 
     --flight PATH    render the ``timeline`` kind of a flight dump
@@ -99,18 +106,34 @@ def render_timeline(snap: dict, *, points: int | None = None,
         f"  {'series'.ljust(name_w)}{'kind'.ljust(kind_w)}"
         f"{'min'.rjust(12)}{'max'.rjust(12)}{'last'.rjust(12)}  spark"
     )
-    for name in sorted(series):
+
+    def row(name: str) -> str | None:
         s = series[name]
         pts = [float(v) for v in (s.get("points") or [])]
         if points is not None:
             pts = pts[-points:]
         if not pts:
-            continue
-        lines.append(
+            return None
+        return (
             f"  {name.ljust(name_w)}{s.get('kind', '?').ljust(kind_w)}"
             f"{_fmt(min(pts)).rjust(12)}{_fmt(max(pts)).rjust(12)}"
             f"{_fmt(pts[-1]).rjust(12)}  {_sparkline(pts, width)}"
         )
+
+    # the concurrency observatory's families (contention.* counter
+    # deltas + wait-time quantile rings) group under their own
+    # subheading so lock behaviour reads as one block next to the
+    # PR 18 series rather than interleaving with them
+    general = [n for n in sorted(series) if not n.startswith("contention.")]
+    observatory = [n for n in sorted(series) if n.startswith("contention.")]
+    for name in general:
+        r = row(name)
+        if r is not None:
+            lines.append(r)
+    rows = [r for r in (row(n) for n in observatory) if r is not None]
+    if rows:
+        lines.append("  contention (concurrency observatory):")
+        lines.extend(rows)
     marks = snap.get("marks") or []
     if marks:
         lines.append(f"  marks ({len(marks)}):")
@@ -118,6 +141,45 @@ def render_timeline(snap: dict, *, points: int | None = None,
             lines.append(
                 f"    t={_fmt(float(mk.get('t', 0.0)))}"
                 f" {mk.get('name', '?')}={_fmt(float(mk.get('value', 0.0)))}"
+            )
+    return "\n".join(lines)
+
+
+def render_contention(section: dict, *, top_n: int = 8) -> str | None:
+    """The top-contended table + wait edges from a ``contention``
+    section (a flight dump's kind, or ``monitoring_snapshot()``'s key),
+    as a printable string — None when the section is absent/disabled or
+    carries no sites."""
+    if not isinstance(section, dict) or not section.get("enabled"):
+        return None
+    top = section.get("top") or []
+    if not top:
+        return None
+    lines = [f"contention: {len(section.get('sites') or {})} sites, "
+             f"top {min(top_n, len(top))} by total wait:"]
+    name_w = max(len(str(r.get("site", "?"))) for r in top[:top_n]) + 2
+    lines.append(
+        f"  {'site'.ljust(name_w)}{'acquires'.rjust(10)}"
+        f"{'contended'.rjust(11)}{'wait_total'.rjust(12)}"
+        f"{'wait_p95'.rjust(11)}{'hold_p95'.rjust(11)}"
+    )
+    for r in top[:top_n]:
+        lines.append(
+            f"  {str(r.get('site', '?')).ljust(name_w)}"
+            f"{_fmt(float(r.get('acquires', 0))).rjust(10)}"
+            f"{_fmt(float(r.get('contended', 0))).rjust(11)}"
+            f"{float(r.get('wait_total_s', 0.0)):>11.4f}s"
+            f"{float(r.get('wait_p95_s', 0.0)):>10.4f}s"
+            f"{float(r.get('hold_p95_s', 0.0)):>10.4f}s"
+        )
+    edges = section.get("edges") or []
+    if edges:
+        lines.append(f"  wait edges ({len(edges)}):")
+        for e in edges[:top_n]:
+            lines.append(
+                f"    {e.get('holder', '?')} -> {e.get('waiter', '?')}"
+                f"  x{_fmt(float(e.get('count', 0)))}"
+                f"  {float(e.get('wait_s', 0.0)):.4f}s"
             )
     return "\n".join(lines)
 
@@ -166,6 +228,7 @@ def main(argv: list[str] | None = None) -> int:
                     help="sparkline glyph budget (default 32)")
     args = ap.parse_args(argv)
 
+    contention_doc = None
     if args.live:
         snap = run_live_demo()
     elif args.flight:
@@ -173,6 +236,7 @@ def main(argv: list[str] | None = None) -> int:
 
         dump = read_flight_dump(args.flight)
         snap = dump.get("timeline")
+        contention_doc = dump.get("contention")
         if not isinstance(snap, dict) or not snap.get("enabled"):
             print(f"timeline: no timeline kind in {args.flight} "
                   "(was the recorder enabled when the dump was written?)",
@@ -182,11 +246,19 @@ def main(argv: list[str] | None = None) -> int:
         with open(args.snapshot, encoding="utf-8") as f:
             doc = json.load(f)
         snap = extract_timeline(doc)
+        contention_doc = doc.get("contention") \
+            if isinstance(doc, dict) else None
         if snap is None:
             print(f"timeline: no timeline snapshot in {args.snapshot}",
                   file=sys.stderr)
             return 1
     print(render_timeline(snap, points=args.points, width=args.width))
+    # when the artifact also carries a contention section (a flight
+    # dump's kind, a monitoring snapshot's key), append the
+    # top-contended table under the sparklines
+    table = render_contention(contention_doc)
+    if table is not None:
+        print(table)
     return 0
 
 
